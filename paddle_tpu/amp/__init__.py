@@ -1,0 +1,204 @@
+"""Automatic mixed precision (reference: python/paddle/amp/auto_cast.py:20,
+grad_scaler.py:20; trace-time autocast tracer.cc:159-162, lists
+contrib/mixed_precision/fp16_lists.py:34-38).
+
+TPU-native: the compute dtype is bfloat16 — same exponent range as fp32 —
+so dynamic loss scaling is unnecessary (SURVEY §7 translation table). The
+autocast context casts inputs of matmul-class ops to bf16 at op-dispatch
+time exactly like the reference's tracer autocast; GradScaler is kept
+API-compatible and becomes a no-op scaler by default (enable fp16-style
+scaling explicitly if requested).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+# reference fp16_lists.py white/black lists, adapted
+WHITE_LIST = {"matmul", "mm", "bmm", "linear", "conv1d", "conv2d", "conv3d",
+              "conv2d_transpose", "einsum", "sdpa", "flash_attention"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "log_softmax", "cross_entropy", "layer_norm", "batch_norm",
+              "softmax_with_cross_entropy"}
+
+_state = threading.local()
+
+
+def _amp_state():
+    if not hasattr(_state, "enabled"):
+        _state.enabled = False
+        _state.dtype = jnp.bfloat16
+        _state.white = set(WHITE_LIST)
+        _state.black = set(BLACK_LIST)
+        _state.level = "O1"
+    return _state
+
+
+def amp_cast_inputs(op_name, vals):
+    """Called from autograd.tape.apply on tensor input values."""
+    s = _amp_state()
+    if not s.enabled:
+        return vals
+    if s.level == "O2":
+        # cast everything float except blacklist
+        if op_name in s.black:
+            tgt = jnp.float32
+        else:
+            tgt = s.dtype
+    elif op_name in s.white:
+        tgt = s.dtype
+    elif op_name in s.black:
+        tgt = jnp.float32
+    else:
+        return vals
+    out = []
+    for v in vals:
+        if hasattr(v, "dtype") and jnp.issubdtype(jnp.asarray(v).dtype,
+                                                  jnp.floating):
+            out.append(jnp.asarray(v).astype(tgt))
+        else:
+            out.append(v)
+    return out
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast equivalent."""
+    s = _amp_state()
+    prev = (s.enabled, s.white.copy(), s.black.copy(), s.level, s.dtype)
+    s.enabled = enable
+    s.level = level
+    s.dtype = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    if custom_white_list:
+        s.white |= set(custom_white_list)
+    if custom_black_list:
+        s.black |= set(custom_black_list)
+    try:
+        yield
+    finally:
+        s.enabled, s.white, s.black, s.level, s.dtype = prev
+
+
+autocast = auto_cast
+
+
+def amp_guard(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1"):
+    return auto_cast(enable, custom_white_list, custom_black_list, level)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the compute dtype.
+    Master fp32 weights live in the optimizer state (multi_precision)."""
+    d = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """reference: amp/grad_scaler.py GradScaler + loss_scaler.py
+    (check_finite_and_unscale + update_loss_scaling ops, operators/amp/).
+
+    With bf16 (the TPU default) scaling is mathematically unnecessary;
+    `enable=False` semantics. The dynamic-scaling state machine is kept fully
+    functional for fp16 parity."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = set()   # optimizers already unscaled this step
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        """Idempotent per step: a second call before step() is a no-op, so
+        the unscale → clip → step pattern doesn't divide twice."""
+        if not self._enable or id(optimizer) in self._unscaled:
+            return
+        self._unscaled.add(id(optimizer))
+        self._found_inf = False
+        inv = 1.0 / self._scale
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._value * inv
+                if not bool(jnp.all(jnp.isfinite(g))):
+                    self._found_inf = True
+                p.grad._value = g
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        self._unscaled.discard(id(optimizer))
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        """Paddle contract: the caller has already run
+        ``scaled_loss.backward()``; minimize only unscales and steps
+        (reference: amp/grad_scaler.py minimize)."""
+        self.step(optimizer)
+
+    def update(self):
+        pass  # state already updated in step(); kept for API parity
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd["good_steps"]
+        self._bad_steps = sd["bad_steps"]

@@ -1,0 +1,5 @@
+"""Autograd package (reference: paddle.autograd, imperative engine)."""
+from .tape import (apply, backward, enable_grad, grad,  # noqa: F401
+                   is_grad_enabled, no_grad, set_grad_enabled)
+
+PyLayer = None  # custom-op style autograd extension: see paddle_tpu.incubate

@@ -1,0 +1,16 @@
+"""Core runtime: device abstraction, dtypes, flags, errors, rng, profiler.
+
+TPU-native analogue of the reference L0 platform layer
+(reference: paddle/fluid/platform/)."""
+from . import dtype, errors, flags, place, profiler, rng  # noqa: F401
+from .dtype import (bfloat16, bool_, complex64, complex128,  # noqa: F401
+                    convert_dtype, float16, float32, float64,
+                    get_default_dtype, int8, int16, int32, int64,
+                    set_default_dtype, uint8)
+from .errors import EnforceNotMet, enforce  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .place import (CPUPlace, CUDAPinnedPlace, CUDAPlace, Place,  # noqa: F401
+                    TPUPlace, XPUPlace, device_count, expected_place,
+                    get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+                    set_device)
+from .rng import default_generator, get_rng_state, seed, set_rng_state  # noqa: F401

@@ -1,0 +1,89 @@
+"""Dtype registry.
+
+TPU-native analogue of the reference's proto::VarType dtype enum
+(reference: paddle/fluid/framework/framework.proto:91-141, data_type.h).
+We expose paddle-style dtype names backed directly by numpy/jax dtypes;
+bfloat16 is first-class since it is the TPU compute dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jax dtypes are numpy dtypes; bfloat16 comes from ml_dtypes).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+_INTEGRAL = {uint8, int8, int16, int32, int64}
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (str / numpy / jax) to a numpy dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower()
+        if key in _ALIASES:
+            return jnp.dtype(_ALIASES[key])
+        return jnp.dtype(key)
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def get_default_dtype():
+    from . import flags
+
+    return convert_dtype(flags.get_flags("default_dtype"))
+
+
+def set_default_dtype(dtype):
+    from . import flags
+
+    d = convert_dtype(dtype)
+    if not (jnp.issubdtype(d, jnp.floating)):
+        raise TypeError(
+            "set_default_dtype only supports floating dtypes, got %s" % d)
+    flags.set_flags({"default_dtype": str(d)})
+
+
+def promote_types(a, b):
+    return np.promote_types(convert_dtype(a), convert_dtype(b))

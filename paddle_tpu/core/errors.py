@@ -1,0 +1,87 @@
+"""Typed error machinery.
+
+TPU-native analogue of PADDLE_ENFORCE_* + platform::errors
+(reference: paddle/fluid/platform/enforce.h, errors.cc, error_codes.proto).
+On TPU the Python layer is the host control plane, so these are plain Python
+exceptions with the same taxonomy; `enforce` raises with a captured message.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error — reference enforce.h:EnforceNotMet."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet, PermissionError):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+class ExternalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg="", exc=InvalidArgumentError, *fmt_args):
+    """PADDLE_ENFORCE equivalent: raise `exc` with `msg` when cond is false."""
+    if not cond:
+        raise exc(msg % fmt_args if fmt_args else msg)
+
+
+def enforce_eq(a, b, msg="", exc=InvalidArgumentError):
+    if a != b:
+        raise exc(f"Expected {a} == {b}. {msg}")
+
+
+def enforce_gt(a, b, msg="", exc=InvalidArgumentError):
+    if not a > b:
+        raise exc(f"Expected {a} > {b}. {msg}")
+
+
+def enforce_ge(a, b, msg="", exc=InvalidArgumentError):
+    if not a >= b:
+        raise exc(f"Expected {a} >= {b}. {msg}")
+
+
+def enforce_not_none(x, msg="", exc=NotFoundError):
+    if x is None:
+        raise exc(msg or "Expected value to be not None.")
+    return x

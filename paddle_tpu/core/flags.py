@@ -1,0 +1,95 @@
+"""Global flag/config registry.
+
+TPU-native analogue of the reference's gflags tier
+(reference: paddle/fluid/platform/flags.cc:33-359 and
+pybind/global_value_getter_setter.cc): a typed, env-overridable registry
+exposed through paddle-style ``set_flags``/``get_flags``.
+
+Flags whose reference counterparts are CUDA-allocator knobs either map to the
+XLA/TPU equivalent (documented per-flag) or exist for API compatibility.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    ctor: Callable[[str], Any]
+    value: Any = None
+    on_set: Optional[Callable[[Any], None]] = None
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def _parse_bool(s: str) -> bool:
+    return str(s).lower() in ("1", "true", "yes", "on")
+
+
+def define_flag(name, default, help="", ctor=None, on_set=None):
+    if ctor is None:
+        if isinstance(default, bool):
+            ctor = _parse_bool
+        elif isinstance(default, int):
+            ctor = int
+        elif isinstance(default, float):
+            ctor = float
+        else:
+            ctor = str
+    env = os.environ.get("FLAGS_" + name)
+    value = ctor(env) if env is not None else default
+    _REGISTRY[name] = _Flag(name, default, help, ctor, value, on_set)
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags equivalent (reference global_value_getter_setter.cc)."""
+    for k, v in flags.items():
+        key = k[6:] if k.startswith("FLAGS_") else k
+        if key not in _REGISTRY:
+            raise KeyError(f"Unknown flag: {k}")
+        f = _REGISTRY[key]
+        f.value = f.ctor(v) if isinstance(v, str) else v
+        if f.on_set is not None:
+            f.on_set(f.value)
+
+
+def get_flags(flags):
+    """paddle.get_flags equivalent. Accepts a name or list of names."""
+    if isinstance(flags, str):
+        key = flags[6:] if flags.startswith("FLAGS_") else flags
+        return _REGISTRY[key].value
+    return {k: get_flags(k) for k in flags}
+
+
+def all_flags():
+    return {k: f.value for k, f in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# Core flags (reference: platform/flags.cc). TPU mapping noted where relevant.
+# ---------------------------------------------------------------------------
+define_flag("default_dtype", "float32", "default floating dtype for tensor creation")
+define_flag("check_nan_inf", False,
+            "scan op outputs for nan/inf in eager mode (flags.cc:33 FLAGS_check_nan_inf)")
+define_flag("benchmark", False,
+            "block_until_ready after each eager op (flags.cc FLAGS_benchmark sync)")
+define_flag("seed", 0, "global random seed")
+define_flag("use_bf16_matmul", True,
+            "allow bf16 matmul accumulation policy on TPU MXU")
+define_flag("eager_delete_tensor_gb", 0.0,
+            "compat: XLA manages memory; retained for API parity")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "compat: maps to XLA_PYTHON_CLIENT_MEM_FRACTION")
+define_flag("allocator_strategy", "auto_growth",
+            "compat: device memory is managed by the XLA runtime BFC allocator")
+define_flag("cudnn_deterministic", False,
+            "deterministic mode: on TPU, XLA is deterministic by construction")
+define_flag("paddle_num_threads", 1, "host threads for data pipeline")
+define_flag("print_op_summary", False, "print per-op timing summary at exit")

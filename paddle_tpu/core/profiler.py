@@ -1,0 +1,134 @@
+"""Profiling spans.
+
+TPU-native analogue of RecordEvent / EnableProfiler
+(reference: paddle/fluid/platform/profiler.h:127,210, profiler.proto).
+
+Host spans are recorded in-process (start/stop/summary table, chrome-trace
+export); device truth comes from jax.profiler (XLA trace), which replaces the
+reference's CUPTI DeviceTracer (device_tracer.h:43). RecordEvent doubles as a
+jax.profiler.TraceAnnotation so spans show up inside XLA traces too.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+import jax
+
+_enabled = False
+_lock = threading.Lock()
+_events: List[tuple] = []  # (name, start_ns, end_ns, thread_id)
+_jax_trace_dir: Optional[str] = None
+
+
+class RecordEvent:
+    """RAII span (reference profiler.h:127). Usable as context manager."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = 0
+        self._jax_ctx = None
+
+    def begin(self):
+        if _enabled:
+            self._t0 = time.perf_counter_ns()
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        return self
+
+    def end(self):
+        if _enabled and self._t0:
+            t1 = time.perf_counter_ns()
+            with _lock:
+                _events.append(
+                    (self.name, self._t0, t1, threading.get_ident()))
+            if self._jax_ctx is not None:
+                self._jax_ctx.__exit__(None, None, None)
+                self._jax_ctx = None
+            self._t0 = 0
+
+    __enter__ = begin
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def enable_profiler(trace_dir: Optional[str] = None):
+    """Start profiling (reference EnableProfiler profiler.h:210). If trace_dir
+    is given, also starts a jax/XLA device trace into it."""
+    global _enabled, _jax_trace_dir
+    with _lock:
+        _events.clear()
+    _enabled = True
+    if trace_dir:
+        _jax_trace_dir = trace_dir
+        jax.profiler.start_trace(trace_dir)
+
+
+def disable_profiler(sorted_key: str = "total") -> str:
+    """Stop profiling and return the formatted summary table."""
+    global _enabled, _jax_trace_dir
+    _enabled = False
+    if _jax_trace_dir:
+        jax.profiler.stop_trace()
+        _jax_trace_dir = None
+    return summary(sorted_key)
+
+
+def reset_profiler():
+    with _lock:
+        _events.clear()
+
+
+def summary(sorted_key: str = "total") -> str:
+    stats: Dict[str, List[float]] = defaultdict(list)
+    with _lock:
+        for name, t0, t1, _tid in _events:
+            stats[name].append((t1 - t0) / 1e6)
+    rows = []
+    for name, times in stats.items():
+        rows.append((name, len(times), sum(times), sum(times) / len(times),
+                     max(times), min(times)))
+    key_idx = {"total": 2, "calls": 1, "ave": 3, "max": 4, "min": 5}.get(
+        sorted_key, 2)
+    rows.sort(key=lambda r: r[key_idx], reverse=True)
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>10}"
+             f"{'Max(ms)':>10}{'Min(ms)':>10}"]
+    for r in rows:
+        lines.append(f"{r[0]:<40}{r[1]:>8}{r[2]:>12.3f}{r[3]:>10.3f}"
+                     f"{r[4]:>10.3f}{r[5]:>10.3f}")
+    return "\n".join(lines)
+
+
+def export_chrome_tracing(path: str):
+    """Write collected host spans as a chrome://tracing JSON file
+    (reference profiler chrome-trace via profiler.proto)."""
+    with _lock:
+        evs = list(_events)
+    trace = {"traceEvents": [
+        {"name": n, "ph": "X", "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,
+         "pid": 0, "tid": tid, "cat": "host"}
+        for n, t0, t1, tid in evs]}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+
+
+@contextmanager
+def profiler(state: str = "All", tracer_option: str = "Default",
+             profile_path: Optional[str] = None):
+    """paddle.fluid.profiler context-manager equivalent."""
+    enable_profiler()
+    try:
+        yield
+    finally:
+        table = disable_profiler()
+        if profile_path:
+            with open(profile_path, "w") as f:
+                f.write(table)
+        else:
+            print(table)

@@ -1,0 +1,117 @@
+"""RNG state management.
+
+TPU-native analogue of the reference Generator / seed plumbing
+(reference: paddle/fluid/framework/generator.cc, python paddle.seed).
+
+JAX RNG is functional (explicit keys); the dygraph layer needs stateful
+semantics (`paddle.seed`, dropout without a key argument), so we keep a
+global counter-based key chain: each draw splits off the chain
+deterministically. Under jit (functional path) callers pass explicit keys.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful RNG: a root key plus a monotone counter."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh jax PRNG key, advancing the stream."""
+        with self._lock:
+            c = self._count
+            self._count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), c)
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        self._seed, self._count = int(state[0]), int(state[1])
+
+
+_default_generator = Generator(0)
+_numpy_generator = np.random.RandomState(0)
+
+# --- functional key scope ---------------------------------------------------
+# Under jax.jit tracing the global stateful generator would bake a constant
+# key into the compiled program; instead the functional entry points
+# (static.functional_call, hapi train step) push an explicit traced key here
+# and stateless ops (dropout etc.) derive per-call subkeys from it.
+import contextlib as _contextlib
+import threading as _threading
+
+_scope_state = _threading.local()
+
+
+@_contextlib.contextmanager
+def key_scope(key):
+    """Make `key` the source of randomness for ops executed inside."""
+    prev = getattr(_scope_state, "stack", None)
+    if prev is None:
+        _scope_state.stack = []
+    _scope_state.stack.append([key, 0])
+    try:
+        yield
+    finally:
+        _scope_state.stack.pop()
+
+
+def in_key_scope() -> bool:
+    stack = getattr(_scope_state, "stack", None)
+    return bool(stack)
+
+
+def scope_key():
+    """Next subkey from the innermost functional scope (traced-safe)."""
+    stack = _scope_state.stack
+    entry = stack[-1]
+    k = jax.random.fold_in(entry[0], entry[1])
+    entry[1] += 1
+    return k
+
+
+def op_key():
+    """Key for a stateless-random op: functional scope if active, else the
+    global stateful generator."""
+    if in_key_scope():
+        return scope_key()
+    return next_key()
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed equivalent: reseed the global generator (and numpy helper)."""
+    _default_generator.manual_seed(value)
+    _numpy_generator.seed(value % (2**32))
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

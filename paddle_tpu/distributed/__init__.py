@@ -1,0 +1,28 @@
+"""paddle.distributed equivalent — TPU-native distributed runtime.
+
+Reference surface: python/paddle/distributed/ (collective.py, parallel.py,
+spawn.py, fleet/). TPU design: SURVEY.md §5/§7 — mesh axes replace rings,
+GSPMD/pjit replaces program surgery, jax.distributed replaces TCP
+bootstrap.
+"""
+from . import fleet  # noqa: F401
+from .collective import (ReduceOp, all_gather, all_reduce, alltoall,  # noqa: F401
+                         barrier, broadcast, get_group, recv, reduce,
+                         reduce_scatter, scatter, send, split)
+from .env import (ParallelEnv, get_rank, get_world_size,  # noqa: F401
+                  init_parallel_env, is_initialized)
+from .mesh import (P, axis_size, create_mesh, get_mesh, init_mesh,  # noqa: F401
+                   set_mesh, sharding)
+from .parallel import DataParallel  # noqa: F401
+from . import primitives  # noqa: F401
+from .parallel_layers import (ColumnParallelLinear, ParallelEmbedding,  # noqa: F401
+                              RowParallelLinear, VocabParallelEmbedding)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """reference: distributed/spawn.py. Multi-host TPU jobs are launched by
+    the cluster scheduler (one process per host); in-process spawn of extra
+    jax runtimes is not supported — use paddle_tpu.distributed.launch."""
+    raise NotImplementedError(
+        "spawn: launch one process per host via `python -m "
+        "paddle_tpu.distributed.launch` (env protocol PADDLE_TRAINER_*).")

@@ -1,0 +1,174 @@
+"""Collective communication python API
+(reference: python/paddle/distributed/collective.py:101-457 — broadcast/
+all_reduce/reduce/all_gather/scatter/barrier; C++ data plane
+operators/collective/c_allreduce_op.h:157 etc.).
+
+Two execution regimes, matching how the reference's ops were used:
+
+1. **Eager / host regime** (this module's functions): cross-*process*
+   collectives over the jax coordination service
+   (multihost_utils) — the analogue of the reference's dygraph
+   `core.ops.c_allreduce_sum` calls on the NCCL communicator. With one
+   process they degenerate to identity, like a 1-rank ring.
+
+2. **Compiled / SPMD regime**: inside pjit/shard_map, use
+   paddle_tpu.distributed.primitives (psum/all_gather/ppermute wrappers) —
+   XLA emits the ICI collectives. This is where all performance-critical
+   communication happens (SURVEY §5: "there is no role for a NCCL-like
+   userspace library").
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+
+
+def _world() -> int:
+    return jax.process_count()
+
+
+def _allgather_np(arr: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None,
+               use_calc_stream=True):
+    """In-place all-reduce across processes (reference: c_allreduce_op.h)."""
+    if _world() == 1:
+        return tensor
+    stacked = _allgather_np(tensor.numpy())
+    if op == ReduceOp.SUM:
+        out = stacked.sum(0)
+    elif op == ReduceOp.MAX:
+        out = stacked.max(0)
+    elif op == ReduceOp.MIN:
+        out = stacked.min(0)
+    else:
+        out = stacked.prod(0)
+    tensor.set_value(out)
+    return tensor
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor, group=None,
+               use_calc_stream=True):
+    if _world() == 1:
+        tensor_list.append(Tensor(tensor._value))
+        return tensor_list
+    stacked = _allgather_np(tensor.numpy())
+    for i in range(stacked.shape[0]):
+        tensor_list.append(Tensor(stacked[i]))
+    return tensor_list
+
+
+def broadcast(tensor: Tensor, src: int, group=None, use_calc_stream=True):
+    if _world() == 1:
+        return tensor
+    stacked = _allgather_np(tensor.numpy())
+    tensor.set_value(stacked[src])
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group=None,
+           use_calc_stream=True):
+    all_reduce(tensor, op, group, use_calc_stream)
+    return tensor
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
+            use_calc_stream=True):
+    if _world() == 1:
+        if tensor_list:
+            tensor.set_value(tensor_list[0])
+        return tensor
+    if tensor_list is not None:
+        full = np.stack([np.asarray(t) for t in tensor_list])
+    else:
+        full = np.zeros((_world(),) + tuple(tensor.shape),
+                        tensor.numpy().dtype)
+    stacked = _allgather_np(full)[src]
+    tensor.set_value(stacked[jax.process_index()])
+    return tensor
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None):
+    full = np.stack([np.asarray(t) for t in tensor_list])
+    if _world() > 1:
+        full = _allgather_np(full).sum(0)
+    tensor.set_value(full[jax.process_index()] if _world() > 1 else full[0])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None):
+    if _world() == 1:
+        out_tensor_list.extend(Tensor(t._value) for t in in_tensor_list)
+        return out_tensor_list
+    full = np.stack([np.asarray(t) for t in in_tensor_list])
+    gathered = _allgather_np(full)  # [world, world, ...]
+    me = jax.process_index()
+    for r in range(_world()):
+        out_tensor_list.append(Tensor(gathered[r, me]))
+    return out_tensor_list
+
+
+def send(tensor, dst=0, group=None, use_calc_stream=True):
+    raise NotImplementedError(
+        "eager p2p send/recv is served by the SPMD pipeline path "
+        "(distributed.pipeline uses ppermute); host-level p2p is not needed "
+        "on TPU.")
+
+
+recv = send
+
+
+def barrier(group=None):
+    """reference: operators/collective/barrier_op."""
+    if _world() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def get_group(id=0):  # noqa: A002
+    return None
+
+
+# --- Megatron-style parallel building block -------------------------------
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split equivalent
+    (reference: distributed/collective.py:566 — _parallel_linear /
+    _parallel_embedding). On TPU this is subsumed by the first-class
+    tensor-parallel layers; kept as the compatibility entry point."""
+    from .parallel_layers import ColumnParallelLinear, ParallelEmbedding, \
+        RowParallelLinear
+
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1 or axis == "column":
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                         bias_attr=bias_attr,
+                                         gather_output=gather_out)
+        else:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      bias_attr=bias_attr)
+        return layer(x)
+    if operation == "embedding":
+        vocab, dim = size
+        layer = ParallelEmbedding(vocab, dim, weight_attr=weight_attr)
+        return layer(x)
+    raise ValueError(f"Unsupported split operation: {operation}")

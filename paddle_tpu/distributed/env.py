@@ -1,0 +1,93 @@
+"""Distributed environment bootstrap.
+
+TPU-native analogue of the reference's process bring-up:
+  - launcher env protocol  PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS
+    (reference: distributed/fleet/launch_utils.py:457-464)
+  - NCCL id TCP exchange   (reference: platform/gen_comm_id_helper.cc:208-319)
+  - init_parallel_env      (reference: python/paddle/distributed/parallel.py:57)
+
+On TPU all of this maps to jax.distributed.initialize: the coordination
+service replaces the raw-TCP ncclUniqueId exchange, and the 'ring' concept
+becomes mesh axes (SURVEY.md §5 backend translation).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def device_id(self) -> int:
+        return int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+
+    @property
+    def current_endpoint(self) -> str:
+        eps = self.trainer_endpoints
+        return eps[self.rank] if self.rank < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """paddle.distributed.init_parallel_env equivalent.
+
+    Reads the PADDLE_* env protocol when explicit args are absent, then
+    brings up the jax coordination service (multi-host). Single-process is a
+    no-op (the one jax runtime already sees all local devices).
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    n = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if n > 1:
+        pid = process_id if process_id is not None else \
+            int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        coord = coordinator_address or os.environ.get(
+            "PADDLE_COORDINATOR", None)
+        if coord is None:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+            coord = eps[0] if eps and eps[0] else "127.0.0.1:12355"
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=n, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized

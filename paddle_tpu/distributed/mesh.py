@@ -1,0 +1,67 @@
+"""Device-mesh management.
+
+TPU-native replacement for the reference's ring registry
+(reference: platform/collective_helper.h:52-110 NCCLCommContext keyed by
+ring_id). Rings become named mesh axes; the 'comm backend' is XLA's
+collective lowering over ICI/DCN (SURVEY.md §5).
+
+Axis convention (north-star GPT hybrid parallel, SURVEY §7):
+  dp — data parallel        pp — pipeline stages
+  tp — tensor/model parallel sp — sequence/context parallel
+  ep — expert parallel
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_current_mesh: Optional[Mesh] = None
+
+P = PartitionSpec
+
+
+def create_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None
+                ) -> Mesh:
+    """Build a Mesh from {'dp': 2, 'tp': 4, ...}. Axis sizes must multiply to
+    the device count; axes of size 1 are kept (so sharding specs stay
+    stable across configs)."""
+    devs = list(devices if devices is not None else jax.devices())
+    names = list(axes.keys())
+    sizes = [int(axes[n]) for n in names]
+    total = int(np.prod(sizes))
+    if total != len(devs):
+        raise ValueError(
+            f"mesh axes {axes} require {total} devices, have {len(devs)}")
+    arr = np.asarray(devs).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def set_mesh(mesh: Mesh):
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+def init_mesh(axes: Dict[str, int], devices=None) -> Mesh:
+    return set_mesh(create_mesh(axes, devices))
+
+
+def sharding(*spec, mesh: Optional[Mesh] = None) -> NamedSharding:
+    m = mesh or _current_mesh
+    if m is None:
+        raise RuntimeError("No mesh set; call init_mesh first.")
+    return NamedSharding(m, PartitionSpec(*spec))
+
+
+def axis_size(name: str, mesh: Optional[Mesh] = None) -> int:
+    m = mesh or _current_mesh
+    if m is None or name not in m.axis_names:
+        return 1
+    return m.shape[name]

@@ -1,0 +1,47 @@
+"""Dygraph data parallel (reference: python/paddle/fluid/dygraph/parallel.py
+DataParallel:322 + imperative Reducer reducer.cc).
+
+On TPU, eager multi-process DP syncs grads at step time (see
+fleet_base.DistributedOptimizer.step); the Reducer's bucketing/overlap
+machinery is unnecessary — XLA fuses gradient reductions in the compiled
+path, and eager sync is one fused host call. DataParallel therefore only
+needs to (a) broadcast initial params, (b) mark the model so optimizers
+know to sync.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .collective import all_reduce, broadcast
+from .env import get_world_size, init_parallel_env  # noqa: F401
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        if get_world_size() > 1:
+            for p in layers.parameters():
+                broadcast(p, src=0)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        if get_world_size() <= 1:
+            return
+        n = get_world_size()
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                all_reduce(p.grad)
+                p.grad._value = p.grad._value / n
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,96 @@
+"""Tensor-parallel layers.
+
+First-class TP (SURVEY.md §2.2: the reference only has the Megatron-style
+`paddle.distributed.split` seed, collective.py:566,492,526 — full TP must be
+first-class here for the GPT north star).
+
+Design: layers carry a PartitionSpec per parameter in ``param_shardings``.
+In the pjit path the strategy compiler reads these to build NamedShardings —
+GSPMD then inserts the all-reduces the reference wrote by hand
+(_parallel_linear's c_allreduce after row-parallel matmul). Eagerly (single
+process) they behave exactly like their dense counterparts, so tests run
+anywhere.
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer.layers import Layer
+
+TP_AXIS = "tp"
+
+
+class ColumnParallelLinear(Layer):
+    """W [in, out] sharded on out (column). Forward output is sharded on the
+    feature dim; gather_output=True adds an all-gather (GSPMD inserts it
+    when the output spec demands replication)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, bias_attr=None, gather_output=True,
+                 name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True) if has_bias else None
+        self.param_shardings = {"weight": P(None, TP_AXIS),
+                                "bias": P(TP_AXIS)}
+        self.output_sharding = P() if gather_output else \
+            P(None, None, TP_AXIS)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class RowParallelLinear(Layer):
+    """W [in, out] sharded on in (row); input expected feature-sharded; the
+    partial products are psum'd (GSPMD all-reduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, bias_attr=None, input_is_parallel=False,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True) if has_bias else None
+        self.param_shardings = {"weight": P(TP_AXIS, None), "bias": P()}
+        self.output_sharding = P()
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded over vocab
+    (reference: collective.py:492 _parallel_embedding)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.param_shardings = {"weight": P(TP_AXIS, None)}
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+ParallelEmbedding = VocabParallelEmbedding
+
+
+class ParallelCrossEntropy(Layer):
+    """Loss over vocab-sharded logits; GSPMD handles the partial max/sum
+    reductions across the tp axis."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(logits, labels, reduction="mean")

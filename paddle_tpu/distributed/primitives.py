@@ -1,0 +1,36 @@
+"""SPMD collective primitives for use inside pjit/shard_map.
+
+The compiled-regime data plane (reference's NCCL calls inside CUDA graphs —
+c_allreduce_op.h:157, send_v2/recv_v2 — become these XLA collectives over
+ICI; SURVEY.md §5 translation table).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+psum = lax.psum
+pmean = lax.pmean
+pmax = lax.pmax
+pmin = lax.pmin
+ppermute = lax.ppermute
+all_gather = lax.all_gather
+all_to_all = lax.all_to_all
+axis_index = lax.axis_index
+
+
+def psum_scatter(x, axis_name, scatter_dimension=0, tiled=True):
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+reduce_scatter = psum_scatter
+
+
+def ring_permute(x, axis_name, shift=1):
+    """Cyclic shift along a mesh axis (pipeline/ring-attention building
+    block; replaces the reference's send_v2/recv_v2 p2p ops)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)

@@ -1,0 +1,3 @@
+"""Framework core: Tensor/Parameter plus program-plan utilities
+(reference: paddle/fluid/framework/)."""
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

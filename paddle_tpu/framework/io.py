@@ -1,0 +1,49 @@
+"""Checkpoint save/load (reference: python/paddle/framework/io.py:202,292 —
+pickled per-tensor numpy state dicts; C++ save/load ops operators/save_op.cc).
+
+Format-compatible idea: a dict of numpy arrays pickled to disk. Sharded /
+async multi-host checkpointing for the distributed path lives in
+paddle_tpu.distributed.checkpoint (orbax/tensorstore-backed).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Tensor
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._value)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_saveable(v) for v in obj)
+    try:
+        import jax
+
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj)
+    except Exception:
+        pass
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    """paddle.save equivalent."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    """paddle.load equivalent. Returns numpy-backed state (set_state_dict
+    accepts numpy directly)."""
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,284 @@
+"""Eager Tensor and Parameter.
+
+TPU-native analogue of the reference's imperative VarBase/VariableWrapper
+(reference: paddle/fluid/imperative/layer.h, variable_wrapper.h) and the
+framework Tensor (framework/tensor.h:305).
+
+A Tensor wraps a ``jax.Array`` (device memory managed by the XLA runtime —
+this subsumes the reference's AllocatorFacade, memory/allocation/) plus
+autograd metadata used by the tape engine in ``paddle_tpu.autograd.tape``.
+Under ``jax.jit`` tracing, ``_value`` may hold a tracer; all methods that
+stay in jax-land keep working, so the same Layer code runs eagerly and
+compiled (the reference needed a separate dygraph-to-static translator for
+this; on TPU it is free).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core.place import CPUPlace, Place, TPUPlace, expected_place
+
+
+class Tensor:
+    # Make numpy defer binary-op dispatch to us.
+    __array_priority__ = 100
+
+    def __init__(self, value, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array,)) or dtype is not None:
+            d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
+            if d is None and isinstance(value, (float,)):
+                d = dtype_mod.get_default_dtype()
+            if d is None and isinstance(value, (list, tuple)):
+                probe = np.asarray(value)
+                if probe.dtype == np.float64:
+                    d = dtype_mod.get_default_dtype()
+            if d is None and isinstance(value, np.ndarray) and \
+                    value.dtype == np.float64:
+                # Match paddle: python/numpy float data defaults to fp32.
+                d = dtype_mod.get_default_dtype()
+            value = jnp.asarray(value, dtype=d)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node = None          # producing tape Node
+        self._out_idx = 0
+        self.name = name or ""
+        self.persistable = False
+        self._place = place
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape, dtype=np.int64))
+
+    @property
+    def place(self):
+        if self._place is not None:
+            return self._place
+        try:
+            dev = list(self._value.devices())[0]
+            return CPUPlace() if dev.platform == "cpu" else TPUPlace(dev.id)
+        except Exception:
+            return expected_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        body = repr(np.asarray(self._value)) if not self._is_traced() \
+            else f"<traced {self._value.aval}>"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+                f"stop_gradient={self.stop_gradient},\n{body})")
+
+    def _is_traced(self):
+        return not isinstance(self._value, jax.Array) or \
+            isinstance(self._value, jax.core.Tracer)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd import tape
+
+        tape.backward([self], None if grad_tensor is None else [grad_tensor],
+                      retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..autograd import tape
+
+        return tape.apply(lambda x: x + 0, self, name="clone")
+
+    def register_hook(self, hook):
+        raise NotImplementedError(
+            "Tensor.register_hook: planned for the eager tape (round 2).")
+
+    # -- conversion / movement --------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        from ..autograd import tape
+
+        d = dtype_mod.convert_dtype(dtype)
+        return tape.apply(lambda x: x.astype(d), self, name="cast")
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        _DEVICE_NAMES = ("cpu", "gpu", "tpu", "xpu", "cuda")
+        t = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, Place):
+                t = t.cpu() if isinstance(a, CPUPlace) else \
+                    t.cuda(a.get_device_id())
+            elif isinstance(a, str) and \
+                    a.split(":")[0].lower() in _DEVICE_NAMES:
+                name = a.lower()
+                if name.startswith("cpu"):
+                    t = t.cpu()
+                else:
+                    idx = int(name.split(":")[1]) if ":" in name else 0
+                    t = t.cuda(idx)
+            else:
+                t = t.astype(a)  # dtype string / dtype object
+        return t
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, device_id: int = 0, blocking: bool = True) -> "Tensor":
+        return Tensor(jax.device_put(
+            self._value, TPUPlace(device_id).jax_device()),
+            stop_gradient=self.stop_gradient)
+
+    tpu = cuda
+
+    def pin_memory(self):
+        return self.cpu()
+
+    # -- in-place mutation (leaf-only, like reference VarBase set_value) ---
+    def set_value(self, value):
+        v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+        self._value = v.astype(self._value.dtype) if hasattr(v, "astype") else v
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        return self.set_value(other)
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def scale_(self, scale):
+        self._value = self._value * scale
+        return self
+
+    def add_(self, other):
+        o = other._value if isinstance(other, Tensor) else other
+        self._value = self._value + o
+        return self
+
+    def subtract_(self, other):
+        o = other._value if isinstance(other, Tensor) else other
+        self._value = self._value - o
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx):
+        from ..autograd import tape
+
+        if isinstance(idx, Tensor):
+            idx = idx._value
+        elif isinstance(idx, tuple):
+            idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        return tape.apply(lambda x: x[idx], self, name="getitem")
+
+    def __setitem__(self, idx, value):
+        if isinstance(idx, Tensor):
+            idx = idx._value
+        v = value._value if isinstance(value, Tensor) else value
+        self._value = self._value.at[idx].set(v)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # Arithmetic operators are attached by paddle_tpu.tensor._install_methods
+    # (single table shared with the functional op library).
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: fluid/framework.py Parameter,
+    imperative VarBase with persistable=True)."""
+
+    def __init__(self, value, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor equivalent."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)

@@ -1,0 +1,4 @@
+"""High-level API (reference: python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .model import Model  # noqa: F401
+from .model_summary import flops, summary  # noqa: F401

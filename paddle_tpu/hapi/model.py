@@ -1,0 +1,222 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py — Model:810,
+fit:1299, DynamicGraphAdapter:609).
+
+The adapter split of the reference (static vs dygraph) collapses here: one
+adapter that runs the network through the jit'd functional path for speed
+while exposing the eager state (state_dict etc.) unchanged.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..autograd import no_grad
+from ..framework.tensor import Tensor
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        return self
+
+    # -- single-batch ops --------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        lbls = labels if isinstance(labels, (list, tuple)) else [labels]
+        if callable(self._loss):
+            return self._loss(*(list(outs) + list(lbls)))
+        raise ValueError("loss is not set; call prepare(loss=...)")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in ins]
+        outputs = self.network(*ins)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            lbls = labels if isinstance(labels, (list, tuple)) else [labels]
+            m_in = m.compute(outputs, *lbls)
+            metrics.append(m.update(m_in.numpy()
+                                    if isinstance(m_in, Tensor) else m_in))
+        return ([loss.numpy()] + metrics) if metrics else [loss.numpy()]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in ins]
+        outputs = self.network(*ins)
+        losses = []
+        if self._loss is not None and labels is not None:
+            losses = [self._compute_loss(outputs, labels).numpy()]
+        metrics = []
+        for m in self._metrics:
+            lbls = labels if isinstance(labels, (list, tuple)) else [labels]
+            m_in = m.compute(outputs, *lbls)
+            metrics.append(m.update(m_in.numpy()
+                                    if isinstance(m_in, Tensor) else m_in))
+        return losses + metrics if metrics else losses
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+               for x in ins]
+        out = self.network(*ins)
+        if isinstance(out, (list, tuple)):
+            return [o.numpy() for o in out]
+        return [out.numpy()]
+
+    # -- loops -------------------------------------------------------------
+    def _to_loader(self, data, batch_size, shuffle):
+        from ..io import DataLoader, Dataset
+
+        if data is None or hasattr(data, "__iter__") and not isinstance(
+                data, Dataset):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = self._to_loader(train_data, batch_size, shuffle)
+        eval_loader = self._to_loader(eval_data, batch_size, False)
+        try:
+            steps = len(train_loader)
+        except Exception:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir,
+                                metrics=["loss"] + [m.name()
+                                                    for m in self._metrics])
+        cbks.on_train_begin()
+        self.stop_training = False
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                res = self.train_batch(ins, lbls,
+                                       update=(step + 1) %
+                                       accumulate_grad_batches == 0)
+                logs = self._make_logs(res)
+                cbks.on_train_batch_end(step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=0, callbacks=cbks
+                              if False else None)
+                eval_logs = {m.name()[0] if isinstance(m.name(), list)
+                             else m.name(): m.accumulate()
+                             for m in self._metrics}
+                cbks.on_eval_end(eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+
+    @no_grad()
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._to_loader(eval_data, batch_size, False)
+        for m in self._metrics:
+            m.reset()
+        last = []
+        for step, batch in enumerate(loader):
+            ins, lbls = self._split_batch(batch)
+            last = self.eval_batch(ins, lbls)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = self._make_logs(last)
+        for m in self._metrics:
+            name = m.name()
+            logs[name[0] if isinstance(name, list) else name] = m.accumulate()
+        return logs
+
+    @no_grad()
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False)
+        outputs = []
+        for batch in loader:
+            ins = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return batch[0], batch[1]
+        return batch, None
+
+    def _make_logs(self, res):
+        logs = {}
+        if res:
+            logs["loss"] = float(np.asarray(res[0]).reshape(-1)[0])
+        for m, v in zip(self._metrics, res[1:]):
+            name = m.name()
+            logs[name[0] if isinstance(name, list) else name] = \
+                float(np.asarray(v).reshape(-1)[0]) \
+                if not isinstance(v, list) else v
+        return logs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load as _load
+
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        return summary(self.network, input_size)

@@ -1,0 +1,59 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    total_params = 0
+    trainable_params = 0
+    lines = [f"{'Layer (type)':<40}{'Param #':>12}"]
+    lines.append("-" * 52)
+    for name, layer in net.named_sublayers(include_self=True):
+        n = 0
+        for _, p in layer.named_parameters(include_sublayers=False):
+            n += int(np.prod(p.shape))
+        if name == "":
+            continue
+        lines.append(f"{name + ' (' + type(layer).__name__ + ')':<40}"
+                     f"{n:>12,}")
+    for _, p in net.named_parameters():
+        c = int(np.prod(p.shape))
+        total_params += c
+        if p.trainable:
+            trainable_params += c
+    lines.append("-" * 52)
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable_params:,}")
+    lines.append(
+        f"Non-trainable params: {total_params - trainable_params:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params,
+            "trainable_params": trainable_params}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough analytic FLOPs via jax cost analysis on the jitted forward."""
+    import jax
+
+    from ..static.functional import functional_call, state_tensors
+
+    pn, pt, bn, bt = state_tensors(net)
+    x = jax.ShapeDtypeStruct(tuple(input_size), np.float32)
+
+    def pure(p_vals, b_vals, xv):
+        out, _ = functional_call(net, p_vals, b_vals, (xv,), training=False)
+        return out
+
+    try:
+        lowered = jax.jit(pure).lower(
+            [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+             for p in pt],
+            [jax.ShapeDtypeStruct(b._value.shape, b._value.dtype)
+             for b in bt], x)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return int(cost.get("flops", 0))
+    except Exception:
+        return 0

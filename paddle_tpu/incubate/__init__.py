@@ -1,0 +1,6 @@
+"""Incubating APIs (reference: python/paddle/fluid/incubate/).
+
+Hosts the functional autograd surface (higher-order grads via jax
+composition — the eager tape is first-order; see autograd.tape.grad).
+"""
+from . import autograd  # noqa: F401

@@ -1,0 +1,87 @@
+"""Functional autograd (reference: imperative/partial_grad_engine.cc
+double-grad; python/paddle/autograd/functional.py).
+
+These operate on pure functions of Tensors and support arbitrary-order
+differentiation by composing jax transforms.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework.tensor import Tensor
+
+
+def _wrap_fn(fn):
+    def pure(*vals):
+        outs = fn(*[Tensor(v, stop_gradient=False) for v in vals])
+        if isinstance(outs, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in outs)
+        return outs._value if isinstance(outs, Tensor) else outs
+
+    return pure
+
+
+def _vals(xs):
+    if isinstance(xs, Tensor):
+        return (xs._value,), True
+    return tuple(x._value for x in xs), False
+
+
+def vjp(func, xs, v=None):
+    vals, single = _vals(xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *vals)
+    if v is None:
+        import jax.numpy as jnp
+
+        v_val = jnp.ones_like(out)
+    else:
+        v_val = v._value if isinstance(v, Tensor) else v
+    grads = vjp_fn(v_val)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        tuple(Tensor(o) for o in out)
+    gs = tuple(Tensor(g) for g in grads)
+    return outs, gs[0] if single else gs
+
+
+def jvp(func, xs, v=None):
+    vals, single = _vals(xs)
+    if v is None:
+        import jax.numpy as jnp
+
+        tangents = tuple(jnp.ones_like(x) for x in vals)
+    else:
+        vs = (v,) if isinstance(v, Tensor) else tuple(v)
+        tangents = tuple(t._value for t in vs)
+    out, tangent_out = jax.jvp(_wrap_fn(func), vals, tangents)
+    outs = Tensor(out) if not isinstance(out, tuple) else \
+        tuple(Tensor(o) for o in out)
+    touts = Tensor(tangent_out) if not isinstance(tangent_out, tuple) else \
+        tuple(Tensor(t) for t in tangent_out)
+    return outs, touts
+
+
+def grad(func, argnums=0):
+    """Higher-order-capable functional grad."""
+    g = jax.grad(_wrap_fn(func), argnums=argnums)
+
+    def wrapper(*xs):
+        vals = tuple(x._value if isinstance(x, Tensor) else x for x in xs)
+        out = g(*vals)
+        if isinstance(out, tuple):
+            return tuple(Tensor(o) for o in out)
+        return Tensor(out)
+
+    return wrapper
+
+
+def Jacobian(func, xs, is_batched=False):  # noqa: N802
+    vals, single = _vals(xs)
+    jac = jax.jacrev(_wrap_fn(func))(*vals)
+    return Tensor(jac)
+
+
+def Hessian(func, xs, is_batched=False):  # noqa: N802
+    vals, single = _vals(xs)
+    hes = jax.hessian(_wrap_fn(func))(*vals)
+    return Tensor(hes)

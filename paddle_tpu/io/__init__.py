@@ -1,0 +1,338 @@
+"""Data pipeline (reference: python/paddle/io/ — Dataset/DataLoader,
+python/paddle/fluid/reader.py:149; C++ side framework/data_feed.cc and
+operators/reader/buffered_reader).
+
+TPU-native: the loader is a host-side prefetch pipeline (worker threads +
+bounded queue, double-buffering batches to device) — the reference's
+BufferedReader GPU-prefetch idea without per-op readers. A C++ acceleration
+for hot collate paths lives in csrc/ (optional, ctypes-loaded).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..core import rng
+from ..framework.tensor import Tensor
+
+
+class Dataset:
+    """Map-style dataset (reference: python/paddle/io/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset does not support indexing")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: List):
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cum = np.cumsum([len(d) for d in self.datasets])
+
+    def __len__(self):
+        return int(self.cum[-1])
+
+    def __getitem__(self, idx):
+        ds = int(np.searchsorted(self.cum, idx, side="right"))
+        prev = 0 if ds == 0 else int(self.cum[ds - 1])
+        return self.datasets[ds][idx - prev]
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = datasets
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+def random_split(dataset, lengths, generator=None):
+    idx = rng._numpy_generator.permutation(len(dataset))
+    out, ofs = [], 0
+    for ln in lengths:
+        out.append(Subset(dataset, idx[ofs:ofs + ln].tolist()))
+        ofs += ln
+    return out
+
+
+# -- samplers ---------------------------------------------------------------
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None,
+                 generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.replacement:
+            return iter(rng._numpy_generator.randint(
+                0, n, self.num_samples).tolist())
+        return iter(rng._numpy_generator.permutation(n)[
+            :self.num_samples].tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        return iter(rng._numpy_generator.choice(
+            len(self.weights), self.num_samples, self.replacement,
+            p).tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """reference: python/paddle/io/batch_sampler.py"""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False,
+                 batch_size=1, drop_last=False):
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if sampler is not None:
+            self.sampler = sampler
+        elif shuffle:
+            self.sampler = RandomSampler(dataset)
+        else:
+            self.sampler = SequenceSampler(dataset)
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """reference: python/paddle/io/dataloader/batch_sampler.py
+    DistributedBatchSampler — shards the index space across data-parallel
+    ranks; on TPU the 'rank' is the process index of the jax runtime."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        import jax
+
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.nranks = num_replicas if num_replicas is not None else \
+            jax.process_count()
+        self.local_rank = rank if rank is not None else jax.process_index()
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.num_samples = int(np.ceil(len(dataset) / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            r = np.random.RandomState(self.epoch)
+            indices = r.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[:(self.total_size - n)]
+        indices = indices[self.local_rank::self.nranks]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+
+# -- collate ----------------------------------------------------------------
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return Tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn([b[i] for b in batch])
+                            for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+class _DataLoaderIter:
+    def __init__(self, loader):
+        self.loader = loader
+        self._index_iter = iter(loader.batch_sampler) \
+            if not loader._iterable_mode else None
+        if loader.num_workers > 0:
+            self._queue = queue.Queue(maxsize=max(2, loader.prefetch_factor))
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._worker, daemon=True)
+            self._thread.start()
+
+    def _load_batch(self, indices):
+        samples = [self.loader.dataset[i] for i in indices]
+        return self.loader.collate_fn(samples)
+
+    def _worker(self):
+        try:
+            if self.loader._iterable_mode:
+                it = iter(self.loader.dataset)
+                while not self._stop.is_set():
+                    batch = list(itertools.islice(it, self.loader.batch_size))
+                    if not batch or (self.loader.drop_last and
+                                     len(batch) < self.loader.batch_size):
+                        break
+                    self._queue.put(self.loader.collate_fn(batch))
+            else:
+                for indices in self._index_iter:
+                    if self._stop.is_set():
+                        break
+                    self._queue.put(self._load_batch(indices))
+        finally:
+            self._queue.put(StopIteration)
+
+    def __next__(self):
+        if self.loader.num_workers > 0:
+            item = self._queue.get()
+            if item is StopIteration:
+                raise StopIteration
+            return item
+        if self.loader._iterable_mode:
+            if not hasattr(self, "_raw_iter"):
+                self._raw_iter = iter(self.loader.dataset)
+            batch = list(itertools.islice(self._raw_iter,
+                                          self.loader.batch_size))
+            if not batch or (self.loader.drop_last and
+                             len(batch) < self.loader.batch_size):
+                raise StopIteration
+            return self.loader.collate_fn(batch)
+        return self._load_batch(next(self._index_iter))
+
+    def __iter__(self):
+        return self
+
+
+class DataLoader:
+    """reference: fluid/reader.py DataLoader(:149). Thread-prefetch instead of
+    the reference's multiprocess+mmap pipeline (jax arrays are not fork-safe;
+    worker threads release the GIL during numpy/host IO)."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.collate_fn = collate_fn or default_collate_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if not self._iterable_mode:
+            self.batch_sampler = batch_sampler or BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+
+    def __iter__(self):
+        return _DataLoaderIter(self)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no fixed length")
+        return len(self.batch_sampler)
+
+
+def get_worker_info():
+    return None

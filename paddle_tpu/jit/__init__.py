@@ -1,0 +1,11 @@
+"""paddle.jit equivalent."""
+from .api import (InputSpec, StaticLayer, TracedLayer, load, save,  # noqa: F401
+                  to_static)
+
+
+def not_to_static(fn):
+    return fn
+
+
+def enable_to_static(flag: bool):
+    return None

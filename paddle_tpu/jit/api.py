@@ -1,0 +1,168 @@
+"""jit: compiled execution of Layers and functions.
+
+TPU-native analogue of paddle.jit.to_static / TracedLayer / jit.save
+(reference: python/paddle/fluid/dygraph/jit.py, dygraph_to_static/
+program_translator.py:756, imperative/jit/ ProgramDescTracer). Here
+"static graph" == jaxpr/StableHLO: we trace forward once per input shape
+and hand it to XLA, while keeping the result differentiable by registering
+the whole compiled forward as ONE node on the eager tape.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..autograd import tape
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+from ..static.functional import functional_call, state_tensors
+from ..static.input_spec import InputSpec  # noqa: F401 (re-export)
+
+
+class StaticLayer:
+    """Compiled wrapper around a Layer (or plain function)."""
+
+    def __init__(self, target, input_spec=None):
+        self._target = target
+        self._input_spec = input_spec
+        self._is_layer = isinstance(target, Layer)
+        self._compiled = {}
+        if self._is_layer:
+            self._jit_fn = jax.jit(self._pure_forward,
+                                   static_argnames=("training",))
+
+    # pure function traced by XLA
+    def _pure_forward(self, param_vals, buffer_vals, key, arg_vals,
+                      training=False):
+        out, new_buf = functional_call(self._target, param_vals, buffer_vals,
+                                       arg_vals, training=training,
+                                       rng_key=key)
+        return out, new_buf
+
+    def __call__(self, *args):
+        if not self._is_layer:
+            fn = self._target
+            vals = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+            if not hasattr(self, "_fn_jit"):
+                def raw(*vs):
+                    outs = fn(*[Tensor(v) for v in vs])
+                    return jax.tree_util.tree_map(
+                        lambda x: x._value if isinstance(x, Tensor) else x,
+                        outs, is_leaf=lambda x: isinstance(x, Tensor))
+
+                self._fn_jit = raw
+            return tape.apply(self._fn_jit, *vals, name="jit_fn")
+
+        from ..core import rng
+
+        layer = self._target
+        pn, pt, bn, bt = state_tensors(layer)
+        arg_tensors = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+        key = rng.next_key()
+        training = layer.training
+
+        def run(*flat):
+            n_p, n_b, n_a = len(pt), len(bt), len(arg_tensors)
+            p_vals = flat[:n_p]
+            b_vals = flat[n_p:n_p + n_b]
+            a_vals = flat[n_p + n_b:n_p + n_b + n_a]
+            out, new_buf = self._jit_fn(list(p_vals), list(b_vals), key,
+                                        list(a_vals), training=training)
+            return out
+
+        out = tape.apply(run, *(pt + bt + arg_tensors), name="jit_layer")
+        return out
+
+    # paddle API surface
+    @property
+    def forward(self):
+        return self.__call__
+
+    def state_dict(self):
+        return self._target.state_dict()
+
+    def parameters(self):
+        return self._target.parameters() if self._is_layer else []
+
+
+def to_static(layer=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static — decorator or direct call."""
+    def wrap(t):
+        return StaticLayer(t, input_spec)
+
+    if layer is None:
+        return wrap
+    return wrap(layer)
+
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save equivalent (reference: fluid/dygraph/jit.py save).
+
+    Persists the layer's state_dict plus a lowered StableHLO text of the
+    forward (when input_spec given) — the serialized 'program' analogue.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    target = layer._target if isinstance(layer, StaticLayer) else layer
+    state = {k: np.asarray(v._value)
+             for k, v in target.state_dict().items()}
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    meta = {"class": type(target).__name__}
+    if input_spec:
+        try:
+            import jax.numpy as jnp
+
+            from ..static.functional import functional_call, state_tensors
+
+            pn, pt, bn, bt = state_tensors(target)
+            specs = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                          np.dtype(s.dtype))
+                     for s in input_spec]
+
+            def pure(p_vals, b_vals, *a_vals):
+                out, _ = functional_call(target, p_vals, b_vals, a_vals,
+                                         training=False)
+                return out
+
+            lowered = jax.jit(pure).lower(
+                [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+                 for p in pt],
+                [jax.ShapeDtypeStruct(b._value.shape, b._value.dtype)
+                 for b in bt], *specs)
+            with open(path + ".pdmodel", "w") as f:
+                f.write(lowered.as_text())
+            meta["stablehlo"] = True
+        except Exception as e:  # pragma: no cover
+            meta["stablehlo_error"] = str(e)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load(path, **config):
+    """Load a saved state_dict (model reconstruction requires the class)."""
+    with open(path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+class TracedLayer:
+    """reference: fluid/dygraph/jit.py TracedLayer(:1047)."""
+
+    def __init__(self, layer):
+        self._static = StaticLayer(layer)
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer)
+        out = tl._static(*inputs)
+        return out, tl
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._static, path)

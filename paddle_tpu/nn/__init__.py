@@ -1,0 +1,66 @@
+"""paddle.nn equivalent — layers, functional, initializers."""
+from ..framework.param_attr import ParamAttr  # noqa: F401
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.activation import (CELU, ELU, GELU, SELU, Hardshrink,  # noqa: F401
+                               Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+                               LogSigmoid, LogSoftmax, Maxout, Mish, PReLU,
+                               ReLU, ReLU6, Sigmoid, Silu, Softmax, Softplus,
+                               Softshrink, Softsign, Swish, Tanh, Tanhshrink,
+                               ThresholdedReLU)
+from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity,  # noqa: F401
+                           Dropout, Dropout2D, Dropout3D, Embedding, Flatten,
+                           Identity, Linear, Pad1D, Pad2D, Pad3D,
+                           PixelShuffle, Unfold, Upsample,
+                           UpsamplingBilinear2D, UpsamplingNearest2D,
+                           ZeroPad2D)
+from .layer.container import (LayerDict, LayerList, ParameterList,  # noqa: F401
+                              Sequential)
+from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D,  # noqa: F401
+                         Conv2DTranspose, Conv3D, Conv3DTranspose)
+from .layer.layers import Layer  # noqa: F401
+from .layer.loss import (BCELoss, BCEWithLogitsLoss,  # noqa: F401
+                         CosineEmbeddingLoss, CrossEntropyLoss, CTCLoss,
+                         HingeEmbeddingLoss, KLDivLoss, L1Loss,
+                         MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
+                         TripletMarginLoss)
+from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa: F401
+                         BatchNorm3D, GroupNorm, InstanceNorm1D,
+                         InstanceNorm2D, InstanceNorm3D, LayerNorm,
+                         LocalResponseNorm, RMSNorm, SpectralNorm,
+                         SyncBatchNorm)
+from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa: F401
+                            AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D,
+                            AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
+                            MaxPool3D)
+from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401
+                        RNNCellBase, SimpleRNN, SimpleRNNCell)
+from .layer.transformer import (MultiHeadAttention, Transformer,  # noqa: F401
+                                TransformerDecoder, TransformerDecoderLayer,
+                                TransformerEncoder, TransformerEncoderLayer)
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    from ..optimizer.clip import clip_grad_norm_ as _impl
+
+    return _impl(parameters, max_norm, norm_type, error_if_nonfinite)
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+
+class ClipGradByGlobalNorm:
+    """reference: fluid/clip.py GradientClipByGlobalNorm."""
+
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = clip_norm
+        self.group_name = group_name
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = max
+        self.min = -max if min is None else min

@@ -1,0 +1,28 @@
+"""paddle.nn.functional equivalent."""
+from ...tensor.creation import one_hot  # noqa: F401
+from ...tensor.manipulation import gather, gather_nd, squeeze, unsqueeze  # noqa: F401
+from .activation import (celu, elu, gelu, gumbel_softmax, hardshrink,  # noqa: F401
+                         hardsigmoid, hardswish, hardtanh, leaky_relu,
+                         log_sigmoid, log_softmax, maxout, mish, prelu, relu,
+                         relu6, selu, sigmoid, silu, softmax, softplus,
+                         softshrink, softsign, swish, tanh, tanhshrink,
+                         thresholded_relu)
+from .attention import scaled_dot_product_attention  # noqa: F401
+from .common import (alpha_dropout, bilinear, cosine_similarity,  # noqa: F401
+                     dropout, dropout2d, dropout3d, embedding, interpolate,
+                     label_smooth, linear, pad, pixel_shuffle, unfold,
+                     upsample, zeropad2d)
+from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose,  # noqa: F401
+                   conv3d, conv3d_transpose)
+from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # noqa: F401
+                   cosine_embedding_loss, cross_entropy, ctc_loss,
+                   hinge_embedding_loss, kl_div, l1_loss, log_loss,
+                   margin_ranking_loss, mse_loss, nll_loss, sigmoid_focal_loss,
+                   smooth_l1_loss, softmax_with_cross_entropy,
+                   square_error_cost, triplet_margin_loss)
+from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
+                   local_response_norm, normalize, rms_norm)
+from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
+                      adaptive_max_pool1d, adaptive_max_pool2d, avg_pool1d,
+                      avg_pool2d, avg_pool3d, max_pool1d, max_pool2d,
+                      max_pool3d)

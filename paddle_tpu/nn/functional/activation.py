@@ -1,0 +1,145 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py;
+kernels operators/activation_op.cc/.cu). All lower to XLA elementwise ops that
+fuse into surrounding MXU matmuls."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helper import apply, make_unary, unwrap
+
+relu = make_unary(jax.nn.relu, "relu")
+relu6 = make_unary(lambda x: jnp.clip(x, 0.0, 6.0), "relu6")
+sigmoid = make_unary(jax.nn.sigmoid, "sigmoid")
+tanh = make_unary(jnp.tanh, "tanh")
+softplus_ = jax.nn.softplus
+silu = make_unary(jax.nn.silu, "silu")
+swish = silu
+mish = make_unary(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+tanhshrink = make_unary(lambda x: x - jnp.tanh(x), "tanhshrink")
+log_sigmoid = make_unary(jax.nn.log_sigmoid, "log_sigmoid")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x,
+                 name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x,
+                 name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size > 1:
+            shape = [1] * v.ndim
+            ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+            shape[ch_axis] = w.size
+            w = w.reshape(shape)
+        return jnp.where(v >= 0, v, w * v)
+
+    return apply(f, x, weight, name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), x, name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)),
+                 x, name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), x, name="celu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply(lambda v: jnp.clip(v, min, max), x, name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x,
+                 name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0.0)), x, name="softshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), x,
+                 name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x,
+                 name="hardswish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(v * beta > threshold, v,
+                                     jax.nn.softplus(v * beta) / beta), x,
+                 name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x, name="softsign")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, 0.0), x,
+                 name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+
+    return apply(f, x, name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            v = v.astype(dtype)
+        return jax.nn.softmax(v, axis=axis)
+
+    return apply(f, x, name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            v = v.astype(dtype)
+        return jax.nn.log_softmax(v, axis=axis)
+
+    return apply(f, x, name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng
+
+    key = rng.op_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.take_along_axis(
+                jnp.zeros_like(y), idx, axis=axis) * 0 + \
+                (jnp.arange(y.shape[axis]).reshape(
+                    [-1 if i == (axis % y.ndim) else 1
+                     for i in range(y.ndim)]) == idx).astype(y.dtype)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+
+    return apply(f, x, name="gumbel_softmax")

@@ -1,0 +1,61 @@
+"""Attention functionals.
+
+The reference has no fused training attention (SURVEY.md §5 long-context:
+only inference-side multihead_matmul, operators/fused/multihead_matmul_op.cu).
+Here attention is first-class: a reference jnp path plus a Pallas
+flash-attention kernel (paddle_tpu.ops.flash_attention) selected
+automatically for TPU-friendly shapes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helper import apply
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, scale=None, name=None):
+    """q,k,v: [batch, seq, heads, head_dim] (paddle convention).
+
+    Uses the Pallas flash kernel when shapes allow, else the jnp path (which
+    XLA still fuses reasonably well)."""
+    from ...ops import flash_attention as fa
+
+    use_flash = fa.supported(query.shape, attn_mask, dropout_p)
+    if use_flash:
+        return fa.flash_attention(query, key, value, causal=is_causal,
+                                  scale=scale)
+
+    def f(q, k, v, *rest):
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / (d ** 0.5)
+        # [B, S, H, D] -> [B, H, S, D]
+        qt = jnp.swapaxes(q, 1, 2)
+        kt = jnp.swapaxes(k, 1, 2)
+        vt = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qt, kt) * s
+        logits = logits.astype(jnp.float32)
+        if is_causal:
+            sq, sk = logits.shape[-2], logits.shape[-1]
+            causal = jnp.tril(jnp.ones((sq, sk), bool))
+            logits = jnp.where(causal, logits, -1e30)
+        if rest:
+            m = rest[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -1e30)
+            else:
+                logits = logits + m.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None
+                                  else ())
+    out = apply(f, *args, name="sdpa")
+    if dropout_p > 0.0 and training:
+        from .common import dropout
+
+        out = dropout(out, dropout_p, training=training)
+    return out

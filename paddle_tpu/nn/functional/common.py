@@ -1,0 +1,240 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate…
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...framework.tensor import Tensor
+from ...tensor._helper import apply, unwrap
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b, W shaped [in, out] (paddle convention,
+    reference: operators/matmul_v2_op.cc path of nn.Linear). Lowers to one MXU
+    dot_general; bias-add fuses."""
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(v, w), x, weight, name="linear")
+    return apply(lambda v, w, b: jnp.matmul(v, w) + b, x, weight, bias,
+                 name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    """reference: operators/dropout_op.cu. Keys come from the functional key
+    scope under jit, else the global generator."""
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda v: v * (1.0 - p), x, name="dropout_infer")
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = rng.op_key()
+
+    def f(v):
+        if axis is None:
+            mask_shape = v.shape
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = tuple(s if i in axes else 1
+                               for i, s in enumerate(v.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+
+    return apply(f, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rng.op_key()
+
+    def f(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** 0.5)
+        b = -a * alpha_p * p
+        return (a * jnp.where(keep, v, alpha_p) + b).astype(v.dtype)
+
+    return apply(f, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: operators/lookup_table_v2_op.cu. On TPU a gather from the
+    [vocab, dim] table; grads are dense (scatter-add), SelectedRows sparse
+    grads are unnecessary under XLA."""
+    def f(ids, w):
+        pad = padding_idx
+        if pad is not None and pad < 0:
+            pad = w.shape[0] + pad   # paddle normalizes negative indices
+        out = jnp.take(w, ids, axis=0)
+        if pad is not None:
+            out = jnp.where((ids == pad)[..., None], 0.0, out)
+        return out
+
+    return apply(f, x, weight, name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    from ...tensor.creation import one_hot as _oh
+
+    return _oh(x, num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lbl, *rest):
+        k = lbl.shape[-1]
+        if rest:
+            return (1 - epsilon) * lbl + epsilon * rest[0]
+        return (1 - epsilon) * lbl + epsilon / k
+
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return apply(f, *args, name="label_smooth")
+
+
+_PAD_MODES = {"constant": "constant", "reflect": "reflect",
+              "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    """reference: operators/pad3d_op.cc — paddle pad list is
+    [left, right, top, bottom, front, back] over trailing spatial dims."""
+    pad = [int(unwrap(p)) for p in pad] if not isinstance(pad, int) else pad
+
+    def f(v):
+        if isinstance(pad, int):
+            cfg = [(pad, pad)] * v.ndim
+        elif len(pad) == 2 * v.ndim:
+            # full-tensor pad pairs, per-dim from first dim
+            cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            # spatial pad on trailing dims (NCHW => W then H then D order)
+            cfg = [(0, 0)] * v.ndim
+            spatial = list(range(v.ndim))
+            if data_format.startswith("NC"):
+                spatial = spatial[2:]
+            else:
+                spatial = spatial[1:-1]
+            pairs = [(pad[2 * i], pad[2 * i + 1])
+                     for i in range(len(pad) // 2)]
+            # paddle orders pairs innermost-first (W,H,D); numpy wants per-axis
+            for ax, pr in zip(reversed(spatial), pairs):
+                cfg[ax] = pr
+        kwargs = {"constant_values": value} if mode == "constant" else {}
+        return jnp.pad(v, cfg, mode=_PAD_MODES[mode], **kwargs)
+
+    return apply(f, x, name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference: operators/interpolate_v2_op.cc (bilinear/nearest/bicubic)."""
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear",
+              "area": "linear"}[mode]
+
+    def f(v):
+        chan_last = not data_format.startswith("NC")
+        spatial_idx = list(range(1, v.ndim - 1)) if chan_last else \
+            list(range(2, v.ndim))
+        if size is not None:
+            tgt = [int(unwrap(s)) for s in (size if isinstance(size, (list, tuple))
+                                            else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(spatial_idx)
+            tgt = [int(v.shape[ax] * s) for ax, s in zip(spatial_idx, sf)]
+        out_shape = list(v.shape)
+        for ax, s in zip(spatial_idx, tgt):
+            out_shape[ax] = s
+        if method == "nearest":
+            return jax.image.resize(v, out_shape, "nearest")
+        return jax.image.resize(v, out_shape, method)
+
+    return apply(f, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply(f, *args, name="bilinear")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply(f, x1, x2, name="cosine_similarity")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(b, c // (r * r), h * r, w * r)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(b, h * r, w * r, c // (r * r))
+
+    return apply(f, x, name="pixel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op.cc, math/im2col.cc)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \
+        [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(v):
+        b, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(v[:, :, di:di + oh * st[0]:st[0],
+                                 dj:dj + ow * st[1]:st[1]])
+        out = jnp.stack(patches, axis=2)  # b, c, k*k, oh, ow
+        return out.reshape(b, c * ks[0] * ks[1], oh * ow)
+
+    return apply(f, x, name="unfold")

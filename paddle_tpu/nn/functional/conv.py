@@ -1,0 +1,132 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py;
+CUDA kernels operators/conv_cudnn_op.cu). On TPU these lower to XLA
+conv_general_dilated which tiles onto the MXU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helper import apply
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in (v * n if len(v) == 1 else v))
+    return (int(v),) * n
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    # nested [[l, r], ...]
+    return [tuple(int(q) for q in p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, transpose=False, output_padding=0, name="conv"):
+    stride = _tuple(stride, n)
+    dilation = _tuple(dilation, n)
+    pad_cfg = _padding(padding, n)
+    chan_last = not data_format.startswith("NC")
+    # jax dimension numbers: use NCHW-style regardless, transposing if needed.
+    spatial = "".join(chr(ord("0") + i) for i in range(n))
+    if chan_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn_args = (lhs_spec, rhs_spec, out_spec)
+
+    def f(v, w, *rest):
+        dn = jax.lax.conv_dimension_numbers(v.shape, w.shape, dn_args)
+        if not transpose:
+            out = jax.lax.conv_general_dilated(
+                v, w, window_strides=stride, padding=pad_cfg,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups)
+        else:
+            # conv_transpose: gradient of forward conv — express via
+            # lhs_dilation (fractional stride).
+            opad = _tuple(output_padding, n)
+            if isinstance(pad_cfg, str):
+                raise ValueError("string padding unsupported for transpose")
+            k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)]
+            pads = [(k[i] - 1 - pad_cfg[i][0],
+                     k[i] - 1 - pad_cfg[i][1] + opad[i]) for i in range(n)]
+            # weight is [in, out/groups, *k] for transpose in paddle; flip
+            # spatial dims and move to [out, in/groups, *k].
+            if groups == 1:
+                wt = jnp.swapaxes(jnp.flip(w, axis=tuple(range(2, 2 + n))),
+                                  0, 1)
+            else:
+                ci, co_g = w.shape[0], w.shape[1]
+                wt = w.reshape((groups, ci // groups, co_g) + w.shape[2:])
+                wt = jnp.flip(wt, axis=tuple(range(3, 3 + n)))
+                wt = jnp.swapaxes(wt, 1, 2)
+                wt = wt.reshape((groups * co_g, ci // groups) + w.shape[2:])
+            out = jax.lax.conv_general_dilated(
+                v, wt, window_strides=(1,) * n, padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn, feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out.ndim - 1 - n if chan_last else 1] = b.shape[0]
+            if chan_last:
+                shape = [1] * (out.ndim - 1) + [b.shape[0]]
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply(f, *args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, name="conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, name="conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, name="conv3d")
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 data_format, transpose=True, output_padding=output_padding,
+                 name="conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, transpose=True, output_padding=output_padding,
+                 name="conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, transpose=True, output_padding=output_padding,
+                 name="conv3d_transpose")

@@ -1,0 +1,262 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py;
+kernels operators/softmax_with_cross_entropy_op.cu, bce_loss_op…)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helper import apply, unwrap
+
+
+def _reduce_loss(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, name=None):
+    """reference: softmax_with_cross_entropy_op.cu — fused
+    log_softmax + nll in one traced fn so XLA emits the stable fused form."""
+    def f(logits, lbl, *rest):
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else \
+            jnp.log(jnp.clip(logits, 1e-30, None))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            lbl_idx = lbl.astype(jnp.int32)
+            squeeze = lbl_idx.ndim == logp.ndim
+            if squeeze:
+                lbl_idx = jnp.squeeze(lbl_idx, axis)
+            # clip before gather so ignore_index (e.g. -100) can't wrap into
+            # a real row via negative indexing; masked out below.
+            mask = (lbl_idx != ignore_index)
+            safe_idx = jnp.clip(lbl_idx, 0, logp.shape[axis] - 1)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+            loss = jnp.squeeze(loss, axis)
+            loss = jnp.where(mask, loss, 0.0)
+            if rest:
+                w = jnp.take(rest[0], safe_idx) * mask
+                loss = loss * jnp.take(rest[0], safe_idx)
+                if reduction == "mean":
+                    return jnp.sum(jnp.where(mask, loss, 0.0)) / \
+                        jnp.maximum(jnp.sum(w), 1e-12)
+            elif reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args, name="cross_entropy")
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    def f(logp, lbl, *rest):
+        loss = -jnp.take_along_axis(logp, lbl[:, None].astype(jnp.int32),
+                                    axis=1)[:, 0]
+        if rest:
+            loss = loss * jnp.take(rest[0], lbl.astype(jnp.int32))
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce_loss(jnp.square(a - b), reduction),
+                 input, label, name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    return apply(lambda a, b: _reduce_loss(jnp.abs(a - b), reduction),
+                 input, label, name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce_loss(loss, reduction)
+
+    return apply(f, input, label, name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",  # noqa: A002
+                         name=None):
+    def f(p, y, *rest):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if rest:
+            loss = loss * rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return apply(f, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *rest):
+        i = 0
+        w = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        pw = rest[i] if pos_weight is not None else None
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight variant
+        if pw is None:
+            loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            log_sig = jax.nn.log_sigmoid(z)
+            log_sig_neg = jax.nn.log_sigmoid(-z)
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce_loss(loss, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply(f, *args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.clip(y, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce_loss(loss, reduction)
+
+    return apply(f, input, label, name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",  # noqa: A002
+                        name=None):
+    return apply(lambda a, b, y: _reduce_loss(
+        jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        input, other, label, name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",  # noqa: A002
+                         name=None):
+    return apply(lambda x, y: _reduce_loss(
+        jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction),
+        input, label, name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(loss, reduction)
+
+    return apply(f, input1, input2, label, name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce_loss(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply(f, input, positive, negative, name="triplet_margin_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *rest):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if rest:
+            loss = loss / rest[0]
+        return _reduce_loss(loss, reduction)
+
+    args = (logit, label) + ((normalizer,) if normalizer is not None else ())
+    return apply(f, *args, name="sigmoid_focal_loss")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    """reference: fluid.layers.square_error_cost"""
+    return apply(lambda a, b: jnp.square(a - b), input, label,
+                 name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    return apply(lambda p, y: -y * jnp.log(p + epsilon)
+                 - (1 - y) * jnp.log(1 - p + epsilon), input, label,
+                 name="log_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (reference: operators/warpctc_op.cc). Native JAX
+    forward-algorithm implementation over lax.scan (no warpctc dylib)."""
+    def f(lp, lbl, in_len, lbl_len):
+        # lp: [T, B, C] log-probs; lbl: [B, S]
+        T, B, C = lp.shape
+        S = lbl.shape[1]
+        # extended label seq: blank interleaved -> length 2S+1
+        ext = jnp.full((B, 2 * S + 1), blank, lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)
+        ext_len = 2 * lbl_len + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        first_lbl = lp[0, jnp.arange(B), ext[:, 1]]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lbl_len > 0, first_lbl,
+                                               neg_inf))
+
+        def step(alpha, lp_t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], 1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], 1)
+            ext_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), -1, ext.dtype), ext[:, :-2]], 1)
+            allow_skip = (ext != blank) & (ext != ext_shift2)
+            merged = jnp.logaddexp(alpha, a_shift1)
+            merged = jnp.where(allow_skip, jnp.logaddexp(merged, a_shift2),
+                               merged)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return merged + emit, None
+
+        def masked_step(carry, inp):
+            alpha, t = carry
+            lp_t = inp
+            new_alpha, _ = step(alpha, lp_t)
+            keep = (t < in_len)[:, None]
+            return (jnp.where(keep, new_alpha, alpha), t + 1), None
+
+        (alpha, _), _ = jax.lax.scan(masked_step, (alpha0, jnp.ones((), jnp.int32)),
+                                     lp[1:])
+        idx_last = jnp.clip(ext_len - 1, 0, 2 * S)
+        idx_prev = jnp.clip(ext_len - 2, 0, 2 * S)
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(alpha, idx_last[:, None], 1)[:, 0],
+            jnp.take_along_axis(alpha, idx_prev[:, None], 1)[:, 0])
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len, 1))
+        return _reduce_loss(loss, reduction)
+
+    return apply(f, log_probs, labels, input_lengths, label_lengths,
+                 name="ctc_loss")

@@ -1,0 +1,195 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+kernels operators/batch_norm_op.cu, layer_norm_op.cu). XLA fuses the
+reduce+scale+shift chains; no hand-written welford kernels needed."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...tensor._helper import apply, unwrap
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        nrm = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(nrm, epsilon)
+
+    return apply(f, x, name="normalize")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else \
+        tuple(normalized_shape)
+    n_axes = len(ns)
+
+    def f(v, *rest):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        # fp32 statistics even for bf16 activations (TPU numerics policy)
+        vf = v.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(vf - mean), axis=axes, keepdims=True)
+        out = (vf - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args, name="layer_norm")
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """reference: operators/batch_norm_op.cc. In training mode the running
+    stats are updated in-place on the stats tensors (host-side mutation of
+    the buffer value, like the reference's in-place MomentumTensor update)."""
+    chan_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    xv = unwrap(x)
+    reduce_axes = tuple(i for i in range(xv.ndim)
+                        if i != (chan_axis % xv.ndim))
+    if use_batch_stats:
+        mean = jnp.mean(xv.astype(jnp.float32), axis=reduce_axes)
+        var = jnp.var(xv.astype(jnp.float32), axis=reduce_axes)
+        # update running stats (paddle: r = m*r + (1-m)*batch)
+        running_mean._value = (momentum * running_mean._value
+                               + (1 - momentum) * mean).astype(
+                                   running_mean._value.dtype)
+        running_var._value = (momentum * running_var._value
+                              + (1 - momentum) * var).astype(
+                                  running_var._value.dtype)
+        mean_t, var_t = Tensor(mean), Tensor(var)
+    else:
+        mean_t, var_t = running_mean, running_var
+
+    shape = [1] * xv.ndim
+    shape[chan_axis] = xv.shape[chan_axis]
+
+    def f(v, m, s, *rest):
+        vf = v.astype(jnp.float32)
+        out = (vf - m.reshape(shape)) / jnp.sqrt(
+            s.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape).astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    # In training, grads must flow through the batch statistics: recompute
+    # them inside the traced fn so vjp sees them.
+    if use_batch_stats:
+        def g(v, *rest):
+            vf = v.astype(jnp.float32)
+            m = jnp.mean(vf, axis=reduce_axes)
+            s = jnp.var(vf, axis=reduce_axes)
+            out = (vf - m.reshape(shape)) / jnp.sqrt(s.reshape(shape) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * rest[i].reshape(shape).astype(jnp.float32)
+                i += 1
+            if bias is not None:
+                out = out + rest[i].reshape(shape).astype(jnp.float32)
+            return out.astype(v.dtype)
+
+        args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+        return apply(g, *args, name="batch_norm")
+
+    args = (x, mean_t, var_t) + tuple(
+        t for t in (weight, bias) if t is not None)
+    return apply(f, *args, name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    chan_last = not data_format.startswith("NC")
+
+    def f(v, *rest):
+        # reduce over spatial dims only, per (batch, channel)
+        axes = tuple(range(1, v.ndim - 1)) if chan_last else \
+            tuple(range(2, v.ndim))
+        vf = v.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        s = jnp.var(vf, axis=axes, keepdims=True)
+        out = (vf - m) / jnp.sqrt(s + eps)
+        shape = [1] * v.ndim
+        shape[-1 if chan_last else 1] = v.shape[-1 if chan_last else 1]
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out.astype(v.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(v, *rest):
+        b = v.shape[0]
+        if data_format == "NCHW":
+            c = v.shape[1]
+            vv = v.reshape((b, num_groups, c // num_groups) + v.shape[2:])
+            axes = tuple(range(2, vv.ndim))
+        else:
+            c = v.shape[-1]
+            vv = v.reshape(v.shape[:-1] + (num_groups, c // num_groups))
+            axes = tuple(range(1, vv.ndim - 2)) + (vv.ndim - 1,)
+        vf = vv.astype(jnp.float32)
+        m = jnp.mean(vf, axis=axes, keepdims=True)
+        s = jnp.var(vf, axis=axes, keepdims=True)
+        out = ((vf - m) / jnp.sqrt(s + epsilon)).reshape(v.shape)
+        shape = [1] * v.ndim
+        shape[1 if data_format == "NCHW" else -1] = c
+        i = 0
+        if weight is not None:
+            out = out * rest[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + rest[i].reshape(shape)
+        return out.astype(v.dtype)
+
+    args = (x,) + tuple(t for t in (weight, bias) if t is not None)
+    return apply(f, *args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        pads = [(0, 0)] * v.ndim
+        pads[c_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pads)
+        acc = sum(jnp.take(padded, jnp.arange(i, i + v.shape[c_axis]),
+                           axis=c_axis) for i in range(size))
+        return v / jnp.power(k + alpha * acc, beta)
+
+    return apply(f, x, name="local_response_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm — beyond-reference op needed by modern LLM blocks."""
+    def f(v, *rest):
+        vf = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)
+        out = vf / jnp.sqrt(ms + epsilon)
+        if rest:
+            out = out * rest[0].astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    args = (x,) + ((weight,) if weight is not None else ())
+    return apply(f, *args, name="rms_norm")

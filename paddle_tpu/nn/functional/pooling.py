@@ -1,0 +1,155 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py;
+kernels operators/math/pooling.cu). Lower to lax.reduce_window."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor._helper import apply
+from .conv import _padding, _tuple
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init,
+          ceil_mode=False, name="pool", average=False,
+          exclusive=True):
+    kernel = _tuple(kernel, n)
+    stride = _tuple(stride if stride is not None else kernel, n)
+    pad_cfg = _padding(padding, n)
+    chan_last = not data_format.startswith("NC")
+
+    def f(v):
+        if chan_last:
+            dims = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = [(0, 0)] + (pad_cfg if isinstance(pad_cfg, list)
+                               else [(0, 0)] * n) + [(0, 0)]
+        else:
+            dims = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + (pad_cfg if isinstance(pad_cfg, list)
+                                       else [(0, 0)] * n)
+        if isinstance(pad_cfg, str):
+            pads = pad_cfg
+        out = jax.lax.reduce_window(v, init(v.dtype), reducer, dims, strides,
+                                    pads)
+        if average:
+            if exclusive and not isinstance(pads, str):
+                ones = jnp.ones(v.shape, v.dtype)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                               strides, pads)
+                out = out / counts
+            else:
+                out = out / float(np.prod(kernel))
+        return out
+
+    return apply(f, x, name=name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                 else jnp.iinfo(dt).min, ceil_mode, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                 else jnp.iinfo(dt).min, ceil_mode, "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.max,
+                 lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                 else jnp.iinfo(dt).min, ceil_mode, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add,
+                 lambda dt: 0.0, ceil_mode, "avg_pool1d", average=True,
+                 exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add,
+                 lambda dt: 0.0, ceil_mode, "avg_pool2d", average=True,
+                 exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add,
+                 lambda dt: 0.0, ceil_mode, "avg_pool3d", average=True,
+                 exclusive=exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _tuple(output_size, 2)
+
+    def f(v):
+        chan_last = not data_format.startswith("NC")
+        hw_axes = (1, 2) if chan_last else (2, 3)
+        # split each spatial dim into output_size regions and mean-reduce
+        h, w = v.shape[hw_axes[0]], v.shape[hw_axes[1]]
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            # fast path: reshape + mean
+            if chan_last:
+                b, _, _, c = v.shape
+                vv = v.reshape(b, oh, h // oh, ow, w // ow, c)
+                return vv.mean(axis=(2, 4))
+            b, c = v.shape[0], v.shape[1]
+            vv = v.reshape(b, c, oh, h // oh, ow, w // ow)
+            return vv.mean(axis=(3, 5))
+        # general path via interpolation-style gather
+        import jax
+
+        return jax.image.resize(
+            v, v.shape[:hw_axes[0]] + (oh, ow) + v.shape[hw_axes[1] + 1:],
+            "linear")
+
+    return apply(f, x, name="adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def f(v):
+        b, c, l = v.shape
+        o = int(output_size)
+        if l % o == 0:
+            return v.reshape(b, c, o, l // o).mean(axis=3)
+        import jax
+
+        return jax.image.resize(v, (b, c, o), "linear")
+
+    return apply(f, x, name="adaptive_avg_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _tuple(output_size, 2)
+
+    def f(v):
+        b, c, h, w = v.shape
+        oh, ow = out_hw
+        if h % oh == 0 and w % ow == 0:
+            vv = v.reshape(b, c, oh, h // oh, ow, w // ow)
+            return vv.max(axis=(3, 5))
+        raise NotImplementedError(
+            "adaptive_max_pool2d with non-divisible sizes")
+
+    return apply(f, x, name="adaptive_max_pool2d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    def f(v):
+        b, c, l = v.shape
+        o = int(output_size)
+        return v.reshape(b, c, o, l // o).max(axis=3)
+
+    return apply(f, x, name="adaptive_max_pool1d")

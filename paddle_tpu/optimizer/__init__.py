@@ -1,0 +1,20 @@
+"""paddle.optimizer equivalent."""
+from . import lr  # noqa: F401
+from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa: F401
+                        Lamb, Lars, Momentum, Optimizer, RMSProp)
+
+
+class L2Decay:
+    """reference: fluid/regularizer.py L2DecayRegularizer."""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    coeff = property(lambda self: self._coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    coeff = property(lambda self: self._coeff)

@@ -1,0 +1,79 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+GradientClipByValue/ByNorm/ByGlobalNorm)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+
+@jax.jit
+def _global_norm(grads):
+    return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in grads))
+
+
+def apply_grad_clip(clip, params):
+    """Mutates p.grad in place according to the clip object."""
+    from ..nn import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+
+    clipped = [p for p in params if p.grad is not None and
+               getattr(p, "need_clip", True)]
+    if not clipped:
+        return
+    if isinstance(clip, ClipGradByValue):
+        for p in clipped:
+            p.grad._value = jnp.clip(p.grad._value, clip.min, clip.max)
+    elif isinstance(clip, ClipGradByNorm):
+        for p in clipped:
+            g = p.grad._value
+            n = jnp.linalg.norm(g.astype(jnp.float32))
+            scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(n, 1e-12))
+            p.grad._value = (g * scale).astype(g.dtype)
+    elif isinstance(clip, ClipGradByGlobalNorm):
+        grads = [p.grad._value for p in clipped]
+        gn = _global_norm(grads)
+        scale = jnp.minimum(1.0, clip.clip_norm / jnp.maximum(gn, 1e-12))
+        for p in clipped:
+            p.grad._value = (p.grad._value * scale).astype(p.grad._value.dtype)
+    else:
+        raise TypeError(f"Unknown grad clip type: {type(clip)}")
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros([]))
+    grads = [p.grad._value for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g.astype(jnp.float32)), norm_type))
+                for g in grads), 1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    for p in params:
+        p.grad._value = (p.grad._value * scale).astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+def clip_by_norm(x, max_norm, name=None):
+    from ..tensor._helper import apply
+
+    def f(v):
+        n = jnp.sqrt(jnp.sum(jnp.square(v)))
+        return jnp.where(n > max_norm, v * (max_norm / n), v)
+
+    return apply(f, x, name="clip_by_norm")
+
+
+def clip_by_global_norm(t_list, clip_norm, name=None):
+    from ..tensor._helper import apply
+
+    vals = [t._value for t in t_list]
+    gn = _global_norm(vals)
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(gn, 1e-12))
+    return [apply(lambda v: v * scale, t, name="clip_by_global_norm")
+            for t in t_list], Tensor(gn)

@@ -1,0 +1,481 @@
+"""Optimizers.
+
+TPU-native analogue of the reference optimizer ops
+(reference: paddle/fluid/operators/optimizers/ — sgd_op, momentum_op,
+adam_op.cu, lamb_op.cu…; python API python/paddle/optimizer/).
+
+Design: each optimizer is a *functional* update rule
+``_update(p, g, state, lr) -> (new_p, new_state)`` lifted over the whole
+parameter list in ONE jit-compiled XLA computation per step, so the eager
+``opt.step()`` costs a single device dispatch (the reference launches one
+CUDA kernel per parameter — SURVEY.md §3.1 flags that as a hot loop; this
+is the TPU fix). The same rule object plugs into the distributed strategy
+compiler for the pjit path.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import no_grad
+from ..framework.tensor import Parameter, Tensor
+from .clip import apply_grad_clip
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _rule_name = "base"
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if weight_decay is None:
+            self._weight_decay = 0.0
+            self._decay_mode = "none"
+        elif isinstance(weight_decay, (int, float)):
+            self._weight_decay = float(weight_decay)
+            self._decay_mode = "l2"          # L2 regularizer → grad += wd * p
+        else:  # L2Decay object
+            self._weight_decay = float(getattr(weight_decay, "_coeff",
+                                               getattr(weight_decay,
+                                                       "coeff", 0.0)))
+            self._decay_mode = "l2"
+        self._accumulators: Dict[int, dict] = {}
+        self._global_step = 0
+        self._jitted = None
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "optimizer's learning rate can't be LRScheduler when invoke "
+                "this API, because this will lead to conflict.")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate,
+                                                 LRScheduler) else None
+
+    # -- state -------------------------------------------------------------
+    def _init_state(self, p: Parameter) -> dict:
+        return {}
+
+    def _state_for(self, p: Parameter) -> dict:
+        s = self._accumulators.get(id(p))
+        if s is None:
+            s = self._init_state(p)
+            self._accumulators[id(p)] = s
+        return s
+
+    # -- the update rule (override) ---------------------------------------
+    def _update(self, p, g, state: dict, lr, step, wd=0.0):
+        raise NotImplementedError
+
+    def _decoupled_wd(self, p: Parameter) -> float:
+        """Per-parameter decoupled weight-decay coefficient (AdamW/Lamb/Lars
+        override; 0 disables)."""
+        return 0.0
+
+    # -- step --------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if p.trainable and p.grad is not None]
+        if not params:
+            return
+        if self._grad_clip is not None:
+            apply_grad_clip(self._grad_clip, params)
+        self._global_step += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self._global_step, jnp.int32)
+        states = [self._state_for(p) for p in params]
+        p_vals = [p._value for p in params]
+        g_vals = [p.grad._value for p in params]
+        lrs = tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
+        regs = tuple(
+            float(getattr(p.regularizer, "_coeff",
+                          getattr(p.regularizer, "coeff", 0.0)))
+            if p.regularizer is not None else -1.0 for p in params)
+        wds = tuple(self._decoupled_wd(p) for p in params)
+
+        sig = (lrs, regs, wds, tuple(id(p) for p in params))
+        if self._jitted is not None and self._jit_sig != sig:
+            self._jitted = None
+        if self._jitted is None:
+            decay_mode = self._decay_mode
+            wd = self._weight_decay
+            update = self._update
+
+            def fused(p_vals, g_vals, states, lr, step_no):
+                new_ps, new_ss = [], []
+                for p, g, s, plr, reg, pwd in zip(p_vals, g_vals, states,
+                                                  fused._lrs, fused._regs,
+                                                  fused._wds):
+                    g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
+                    if reg >= 0.0:
+                        g = g + reg * p            # per-param regularizer
+                    elif decay_mode == "l2" and wd:
+                        g = g + wd * p
+                    np_, ns = update(p, g, s, lr * plr, step_no, wd=pwd)
+                    new_ps.append(np_)
+                    new_ss.append(ns)
+                return new_ps, new_ss
+
+            fused._lrs = lrs
+            fused._regs = regs
+            fused._wds = wds
+            self._jitted = jax.jit(fused)
+            self._jit_sig = sig
+
+        new_p, new_s = self._jitted(p_vals, g_vals, states, lr, step_no)
+        for p, v, s in zip(params, new_p, new_s):
+            p._value = v
+            self._accumulators[id(p)] = s
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph minimize: backward + step
+        (reference: python/paddle/optimizer/optimizer.py minimize)."""
+        loss.backward()
+        self.step()
+        return [], []
+
+    @no_grad()
+    def clear_grad(self):
+        for p in self._parameter_list or []:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- checkpoint --------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd = {}
+        for i, p in enumerate(self._parameter_list or []):
+            s = self._accumulators.get(id(p))
+            if s:
+                for k, v in s.items():
+                    sd[f"{p.name or i}_{k}"] = Tensor(v) \
+                        if not isinstance(v, Tensor) else v
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        sd["global_step"] = self._global_step
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._parameter_list or []):
+            s = self._init_state(p)
+            found = False
+            for k in list(s.keys()):
+                key = f"{p.name or i}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    s[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    found = True
+            if found:
+                self._accumulators[id(p)] = s
+        self._jitted = None
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op.cc"""
+
+    _rule_name = "sgd"
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        return (p - (lr * g).astype(p.dtype)), state
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op.h"""
+
+    _rule_name = "momentum"
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        v = self._momentum * state["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v).astype(p.dtype)
+        else:
+            new_p = p - (lr * v).astype(p.dtype)
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op.cu — the reference launches
+    one kernel per param (SURVEY §3.1 hot loop); here all params update in
+    one fused XLA computation."""
+
+    _rule_name = "adam"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+                "moment2": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _decayed_update(self, p, g, state, lr, step, decoupled_wd=0.0):
+        g = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        pf = p.astype(jnp.float32)
+        upd = lr * (mhat / (jnp.sqrt(vhat) + self._epsilon)
+                    + decoupled_wd * pf)
+        return (pf - upd).astype(p.dtype), {"moment1": m, "moment2": v}
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        return self._decayed_update(p, g, state, lr, step)
+
+
+class AdamW(Adam):
+    """reference: python/paddle/optimizer/adamw.py (decoupled decay)."""
+
+    _rule_name = "adamw"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if isinstance(
+            weight_decay, (int, float)) else float(weight_decay._coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decay_mode = "decoupled"
+
+    def _decoupled_wd(self, p):
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return self._coeff
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        return self._decayed_update(p, g, state, lr, step, decoupled_wd=wd)
+
+
+class Adamax(Optimizer):
+    """reference: operators/optimizers/adamax_op.h"""
+
+    _rule_name = "adamax"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros(p._value.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        g = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        new_p = p - (lr / (1 - self._beta1 ** t) * m /
+                     (u + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    """reference: operators/optimizers/adagrad_op.h"""
+
+    _rule_name = "adagrad"
+
+    def __init__(self, learning_rate, epsilon=1e-06, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_val = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full(p._value.shape, self._init_val,
+                                   jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        g = g.astype(jnp.float32)
+        m = state["moment"] + g * g
+        new_p = p - (lr * g / (jnp.sqrt(m) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment": m}
+
+
+class Adadelta(Optimizer):
+    """reference: operators/optimizers/adadelta_op.h"""
+
+    _rule_name = "adadelta"
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-06, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p._value.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        g = g.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
+        upd = g * jnp.sqrt(state["avg_squared_update"] + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon)
+        asu = self._rho * state["avg_squared_update"] + \
+            (1 - self._rho) * upd * upd
+        return (p - (lr * upd).astype(p.dtype)), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    """reference: operators/optimizers/rmsprop_op.h"""
+
+    _rule_name = "rmsprop"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-06, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state(self, p):
+        s = {"mean_square": jnp.zeros(p._value.shape, jnp.float32),
+             "momentum": jnp.zeros(p._value.shape, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros(p._value.shape, jnp.float32)
+        return s
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        g = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new_s = {"mean_square": ms, "momentum": mom}
+        if self._centered:
+            new_s["mean_grad"] = mg
+        return (p - mom.astype(p.dtype)), new_s
+
+
+class Lamb(Optimizer):
+    """reference: operators/optimizers/lamb_op.h (large-batch LAMB)."""
+
+    _rule_name = "lamb"
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros(p._value.shape, jnp.float32),
+                "moment2": jnp.zeros(p._value.shape, jnp.float32)}
+
+    def _decoupled_wd(self, p):
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            return 0.0
+        return self._lamb_wd
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        g = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * g * g
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        pf = p.astype(jnp.float32)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * pf
+        p_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class Lars(Momentum):
+    """LARS momentum (reference: operators/optimizers/lars_momentum_op.cu)."""
+
+    _rule_name = "lars"
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, name=None, exclude_from_weight_decay=None,
+                 epsilon=0.0):
+        super().__init__(learning_rate, momentum, parameters, False, None,
+                         grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude_names = list(exclude_from_weight_decay or [])
+
+    def _decoupled_wd(self, p):
+        if any(frag in (p.name or "") for frag in self._exclude_names):
+            return 0.0
+        return self._lars_wd
+
+    def _update(self, p, g, state, lr, step, wd=0.0):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            self._lars_coeff * p_norm /
+            (g_norm + wd * p_norm + self._eps), 1.0)
+        v = self._momentum * state["velocity"] + lr * local_lr * (
+            g + wd * pf)
+        return (pf - v).astype(p.dtype), {"velocity": v}

@@ -1,0 +1,29 @@
+"""Static-graph analogue layer.
+
+The reference's static graph (ProgramDesc + Executor, reference:
+paddle/fluid/framework/framework.proto, executor.cc) maps to traced
+jaxprs compiled by XLA. This package holds the functionalization bridge
+plus thin compat names (InputSpec, Program-like plan objects).
+"""
+from .functional import functional_call, state_tensors  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401
+
+
+class Program:
+    """Compat shell: the serialized unit on TPU is (module, mesh, shardings).
+
+    Real graph capture/serialization is jit.save's StableHLO export."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()

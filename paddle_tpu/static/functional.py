@@ -1,0 +1,75 @@
+"""Functionalization of Layers — the bridge between the stateful Layer API
+and jax's pure-function world.
+
+This replaces the reference's entire dygraph-to-static subsystem
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/ — AST transforms,
+program_translator.py:756): because Layers execute jnp ops on their
+``_value``s, we can swap parameter values for jit tracers and trace
+``forward`` directly; no source translation needed.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+from ..framework.tensor import Tensor
+
+
+def state_tensors(layer) -> Tuple[List[str], List[Tensor], List[str],
+                                  List[Tensor]]:
+    """Ordered (param_names, params, buffer_names, buffers)."""
+    pn, pv = zip(*layer.named_parameters()) if \
+        list(layer.named_parameters()) else ((), ())
+    bn, bv = zip(*layer.named_buffers()) if \
+        list(layer.named_buffers()) else ((), ())
+    return list(pn), list(pv), list(bn), list(bv)
+
+
+class _swapped_state:
+    """Temporarily substitute tensor values (tracers) into live tensors."""
+
+    def __init__(self, tensors: List[Tensor], values):
+        self.tensors = tensors
+        self.values = values
+
+    def __enter__(self):
+        self.saved = [t._value for t in self.tensors]
+        for t, v in zip(self.tensors, self.values):
+            t._value = v
+        return self
+
+    def __exit__(self, *exc):
+        for t, v in zip(self.tensors, self.saved):
+            t._value = v
+        return False
+
+
+def functional_call(layer, param_values, buffer_values, args,
+                    training: Optional[bool] = None, rng_key=None):
+    """Run ``layer.forward`` with the given state values, purely.
+
+    Returns (outputs, new_buffer_values). Output Tensors are unwrapped to raw
+    values. Safe to call under jax tracing.
+    """
+    from ..core import rng
+
+    pn, pt, bn, bt = state_tensors(layer)
+    prev_mode = layer.training
+    if training is not None and training != prev_mode:
+        layer.train() if training else layer.eval()
+    try:
+        with _swapped_state(pt + bt, list(param_values) + list(buffer_values)):
+            if rng_key is not None:
+                with rng.key_scope(rng_key):
+                    out = layer(*args)
+            else:
+                out = layer(*args)
+            new_buffers = [t._value for t in bt]
+        out_vals = jax.tree_util.tree_map(
+            lambda x: x._value if isinstance(x, Tensor) else x, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return out_vals, new_buffers
+    finally:
+        if training is not None and training != prev_mode:
+            layer.train() if prev_mode else layer.eval()

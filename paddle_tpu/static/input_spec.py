@@ -1,0 +1,12 @@
+"""InputSpec (reference: python/paddle/static/input.py)."""
+from __future__ import annotations
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"

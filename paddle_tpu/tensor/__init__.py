@@ -1,0 +1,117 @@
+"""paddle.tensor-equivalent op library.
+
+Aggregates all op submodules and installs them as ``Tensor`` methods plus the
+arithmetic dunder operators — the TPU-native replacement for the reference's
+monkey-patched math-op methods (reference: python/paddle/fluid/dygraph/
+math_op_patch.py and python/paddle/tensor/__init__.py).
+"""
+from __future__ import annotations
+
+from ..framework.tensor import Parameter, Tensor, to_tensor  # noqa: F401
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import (cholesky, cholesky_solve, cond, corrcoef, cov,  # noqa: F401
+                     cross, det, dist, eig, eigh, eigvals, eigvalsh, inverse,
+                     lstsq, matrix_power, matrix_rank, multi_dot, norm, pinv,
+                     qr, slogdet, solve, svd, triangular_solve)
+from .logic import (allclose, bitwise_and, bitwise_not, bitwise_or,  # noqa: F401
+                    bitwise_xor, equal, equal_all, greater_equal, greater_than,
+                    is_empty, is_tensor, isclose, isin, less_equal, less_than,
+                    logical_and, logical_not, logical_or, logical_xor,
+                    not_equal)
+from .manipulation import (broadcast_tensors, broadcast_to, cast,  # noqa: F401
+                           chunk, concat, crop, expand, expand_as, flatten,
+                           flip, gather, gather_nd, index_sample, index_select,
+                           masked_fill, masked_select, moveaxis,
+                           put_along_axis, repeat_interleave, reshape,
+                           reshape_, roll, rot90, scatter, scatter_,
+                           scatter_nd, scatter_nd_add, shard_index, slice,
+                           split, squeeze, stack, strided_slice, swapaxes, t,
+                           take_along_axis, tile, transpose, unbind, unique,
+                           unique_consecutive, unsqueeze, where)
+from .math import *  # noqa: F401,F403
+from .random import (bernoulli, exponential_, gaussian, multinomial,  # noqa: F401
+                     normal, normal_, poisson, rand, randint, randint_like,
+                     randn, randperm, shuffle, standard_normal, uniform,
+                     uniform_)
+from .search import (argmax, argmin, argsort, bucketize, kthvalue,  # noqa: F401
+                     masked_select, mode, nonzero, searchsorted, sort, topk)
+from .stat import (bincount, histogram, median, nanmedian, numel,  # noqa: F401
+                   quantile, std, var)
+
+# ---------------------------------------------------------------------------
+# Install tensor methods
+# ---------------------------------------------------------------------------
+_METHOD_SOURCES = [math, manipulation, logic, search, stat, linalg, creation,
+                   random]
+_SKIP = {"apply", "unwrap", "wrap", "axis_arg", "shape_arg", "make_unary",
+         "make_binary", "to_tensor"}
+
+
+def _install_methods():
+    import types
+
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not isinstance(fn, types.FunctionType):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+
+    import operator as _op  # noqa: F401
+
+    def _binop(fn, swap=False):
+        def method(self, other):
+            if swap:
+                return fn(other if isinstance(other, Tensor)
+                          else Tensor(other, dtype=None), self)
+            return fn(self, other)
+
+        return method
+
+    Tensor.__add__ = _binop(math.add)
+    Tensor.__radd__ = _binop(math.add, swap=True)
+    Tensor.__sub__ = _binop(math.subtract)
+    Tensor.__rsub__ = _binop(math.subtract, swap=True)
+    Tensor.__mul__ = _binop(math.multiply)
+    Tensor.__rmul__ = _binop(math.multiply, swap=True)
+    Tensor.__truediv__ = _binop(math.divide)
+    Tensor.__rtruediv__ = _binop(math.divide, swap=True)
+    Tensor.__floordiv__ = _binop(math.floor_divide)
+    Tensor.__rfloordiv__ = _binop(math.floor_divide, swap=True)
+    Tensor.__mod__ = _binop(math.remainder)
+    Tensor.__pow__ = _binop(math.pow)
+    Tensor.__rpow__ = _binop(math.pow, swap=True)
+    Tensor.__matmul__ = _binop(math.matmul)
+    Tensor.__rmatmul__ = _binop(math.matmul, swap=True)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__eq__ = _binop(logic.equal)
+    Tensor.__ne__ = _binop(logic.not_equal)
+    Tensor.__lt__ = _binop(logic.less_than)
+    Tensor.__le__ = _binop(logic.less_equal)
+    Tensor.__gt__ = _binop(logic.greater_than)
+    Tensor.__ge__ = _binop(logic.greater_equal)
+    Tensor.__hash__ = object.__hash__  # __eq__ override would kill hashing
+    Tensor.__invert__ = lambda self: logic.logical_not(self)
+    Tensor.__and__ = _binop(logic.logical_and)
+    Tensor.__or__ = _binop(logic.logical_or)
+    Tensor.__xor__ = _binop(logic.logical_xor)
+
+    @property
+    def T(self):  # noqa: N802
+        return manipulation.transpose(self, list(range(self.ndim))[::-1])
+
+    Tensor.T = T
+    Tensor.exp_ = lambda self: self.set_value(math.exp(self.detach()))
+    Tensor.sqrt_ = lambda self: self.set_value(math.sqrt(self.detach()))
+    Tensor.clip_ = lambda self, lo=None, hi=None: self.set_value(
+        math.clip(self.detach(), lo, hi))
+    Tensor.mean_all = lambda self: stat.mean(self)
+
+
+_install_methods()
+del _install_methods

@@ -1,0 +1,64 @@
+"""Shared plumbing for the tensor op library.
+
+Every public op routes through the eager tape (``autograd.tape.apply``) so it
+is differentiable and also traceable under jax.jit. This single entry point is
+the TPU-native replacement for the reference's generated ``core.ops.*``
+fast-path functions (reference: paddle/fluid/pybind/op_function_generator.cc).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd.tape import apply as _apply
+from ..framework.tensor import Tensor
+
+
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def wrap(v, stop_gradient=True) -> Tensor:
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def apply(fn, *args, **kwargs):
+    return _apply(fn, *args, **kwargs)
+
+
+def axis_arg(axis):
+    """Normalize paddle axis arg (int | list | tuple | None) for jnp."""
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(a) for a in axis.numpy().reshape(-1))
+    return int(axis)
+
+
+def shape_arg(shape):
+    """Normalize a paddle shape arg (list of ints / Tensors, or Tensor)."""
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().reshape(-1))
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def make_unary(jnp_fn, opname):
+    def op(x, name=None):
+        return apply(jnp_fn, x, name=opname)
+
+    op.__name__ = opname
+    op.__doc__ = f"Elementwise {opname} (jnp.{getattr(jnp_fn, '__name__', opname)})."
+    return op
+
+
+def make_binary(jnp_fn, opname):
+    def op(x, y, name=None):
+        return apply(jnp_fn, x, y, name=opname)
+
+    op.__name__ = opname
+    op.__doc__ = f"Elementwise {opname} with numpy broadcasting."
+    return op

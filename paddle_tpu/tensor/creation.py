@@ -1,0 +1,143 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..framework.tensor import Tensor, to_tensor  # re-export to_tensor
+from ._helper import apply, shape_arg, unwrap
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "arange", "linspace", "logspace", "eye", "empty",
+    "empty_like", "meshgrid", "diag", "diagflat", "tril", "triu", "assign",
+    "clone", "numel", "tolist", "one_hot",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default
+    return dtype_mod.convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(shape_arg(shape),
+                            _dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(shape_arg(shape),
+                           _dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(jnp.full(shape_arg(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(unwrap(x), dtype=_dt(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(unwrap(x), dtype=_dt(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(unwrap(x), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = unwrap(start)
+    end = unwrap(end)
+    step = unwrap(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(num),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(num),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          None if num_columns is None else int(num_columns),
+                          dtype=_dt(dtype, dtype_mod.get_default_dtype())))
+
+
+def meshgrid(*args, **kwargs):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) \
+        else args
+    outs = jnp.meshgrid(*[unwrap(a) for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, v.dtype)
+            return base + jnp.diag(v - 0, offset) - \
+                jnp.diag(jnp.full(v.shape, padding_value, v.dtype), offset)
+        return jnp.diag(v, offset)
+
+    return apply(f, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda v: jnp.diagflat(v, offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.tril(v, diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda v: jnp.triu(v, diagonal), x, name="triu")
+
+
+def assign(x, output=None):
+    """paddle.assign: copy input into a (new or given) tensor."""
+    v = jnp.asarray(unwrap(x) if isinstance(x, Tensor) else np.asarray(x))
+    if output is None:
+        return apply(lambda a: a + 0, x if isinstance(x, Tensor) else Tensor(v),
+                     name="assign")
+    output.set_value(v)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape, dtype=np.int64))))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(
+        lambda v: jnp.eye(int(num_classes),
+                          dtype=dtype_mod.get_default_dtype())[v],
+        x, differentiable=False, name="one_hot")

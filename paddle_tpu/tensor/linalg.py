@@ -1,0 +1,156 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py; CUDA path
+cusolver/cublas via operators/math/, here jnp.linalg → XLA)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._helper import apply, axis_arg, unwrap
+from .manipulation import t  # noqa: F401 (re-export)
+from .math import bmm, dot, matmul, mm, mv  # noqa: F401 (re-export)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        if axis is None:
+            vv = v.reshape(-1)
+            return jnp.linalg.norm(vv, ord=p, keepdims=keepdim)
+        a = axis_arg(axis)
+        if isinstance(a, tuple) and len(a) == 1:
+            a = a[0]
+        return jnp.linalg.norm(v, ord=None if p == "fro" else p, axis=a,
+                               keepdims=keepdim)
+
+    return apply(f, x, name="norm")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+    return apply(f, x, y, name="dist")
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda v: jnp.linalg.cond(v, p), x, name="cond")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        lower = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(lower, -1, -2) if upper else lower
+
+    return apply(f, x, name="cholesky")
+
+
+def inverse(x, name=None):
+    return apply(jnp.linalg.inv, x, name="inverse")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.pinv(v, rcond=rcond,
+                                           hermitian=hermitian), x, name="pinv")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x, name="det")
+
+
+def slogdet(x, name=None):
+    def f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return apply(f, x, name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply(lambda v: jnp.linalg.svd(v, full_matrices=full_matrices),
+                 x, name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda v: jnp.linalg.qr(v, mode=mode), x, name="qr")
+
+
+def eig(x, name=None):
+    return apply(jnp.linalg.eig, x, differentiable=False, name="eig")
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigh(v, UPLO=UPLO), x, name="eigh")
+
+
+def eigvals(x, name=None):
+    return apply(jnp.linalg.eigvals, x, differentiable=False, name="eigvals")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x,
+                 name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda v: jnp.linalg.matrix_power(v, n), x,
+                 name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda v: jnp.linalg.matrix_rank(v, tol),
+                 x, differentiable=False, name="matrix_rank")
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y, name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    import jax
+
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+
+    return apply(f, x, y, name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    import jax
+
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+    return apply(f, x, y, name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return apply(lambda a, b: jnp.linalg.lstsq(a, b, rcond=rcond)[0], x, y,
+                 name="lstsq")
+
+
+def multi_dot(x, name=None):
+    return apply(lambda *vs: jnp.linalg.multi_dot(vs), *x, name="multi_dot")
+
+
+def cross(x, y, axis=None, name=None):
+    return apply(lambda a, b: jnp.cross(a, b, axis=axis if axis is not None
+                                        else -1), x, y, name="cross")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda v: jnp.cov(v, rowvar=rowvar,
+                                   ddof=1 if ddof else 0), x, name="cov")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda v: jnp.corrcoef(v, rowvar=rowvar), x, name="corrcoef")

@@ -1,0 +1,67 @@
+"""Comparison / logic ops (reference: python/paddle/tensor/logic.py;
+kernels operators/controlflow/compare_op.cc, logical_op.cc)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ._helper import apply, unwrap
+
+
+def _cmp(jnp_fn, opname):
+    def op(x, y, name=None):
+        return apply(jnp_fn, x, y, differentiable=False, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+equal = _cmp(jnp.equal, "equal")
+not_equal = _cmp(jnp.not_equal, "not_equal")
+greater_than = _cmp(jnp.greater, "greater_than")
+greater_equal = _cmp(jnp.greater_equal, "greater_equal")
+less_than = _cmp(jnp.less, "less_than")
+less_equal = _cmp(jnp.less_equal, "less_equal")
+logical_and = _cmp(jnp.logical_and, "logical_and")
+logical_or = _cmp(jnp.logical_or, "logical_or")
+logical_xor = _cmp(jnp.logical_xor, "logical_xor")
+bitwise_and = _cmp(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _cmp(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _cmp(jnp.bitwise_xor, "bitwise_xor")
+
+
+def logical_not(x, name=None):
+    return apply(jnp.logical_not, x, differentiable=False, name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply(jnp.bitwise_not, x, differentiable=False, name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(unwrap(x), unwrap(y), rtol=rtol, atol=atol,
+                               equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 x, y, differentiable=False, name="isclose")
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(int(np.prod(unwrap(x).shape)) == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda a, b: jnp.isin(a, b, invert=invert), x, test_x,
+                 differentiable=False, name="isin")

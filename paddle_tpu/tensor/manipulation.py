@@ -1,0 +1,319 @@
+"""Shape / layout manipulation ops
+(reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ._helper import apply, axis_arg, shape_arg, unwrap
+
+
+def reshape(x, shape, name=None):
+    s = shape_arg(shape)
+    return apply(lambda v: jnp.reshape(v, s), x, name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    x._value = jnp.reshape(x._value, shape_arg(shape))
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        a = start_axis % nd if nd else 0
+        b = stop_axis % nd if nd else 0
+        new_shape = v.shape[:a] + (-1,) + v.shape[b + 1:]
+        return jnp.reshape(v, new_shape)
+
+    return apply(f, x, name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    return apply(lambda v: jnp.squeeze(v, axis_arg(axis)), x, name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    return apply(lambda v: jnp.expand_dims(v, axis_arg(axis)), x,
+                 name="unsqueeze")
+
+
+def transpose(x, perm=None, name=None):
+    return apply(lambda v: jnp.transpose(v, perm), x, name="transpose")
+
+
+def t(x, name=None):
+    return apply(lambda v: v.T, x, name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x,
+                 name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis0, axis1), x, name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda *vs: jnp.concatenate(vs, axis=axis), *x, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *x, name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        return list(apply(lambda v: tuple(jnp.split(v, n, axis=axis)), x,
+                          name="split"))
+    secs = [int(unwrap(s)) for s in num_or_sections]
+    dim = x.shape[axis]
+    secs = [dim - sum(s for s in secs if s >= 0) if s < 0 else s for s in secs]
+    offsets = np.cumsum([0] + secs[:-1]).tolist()
+
+    def f(v):
+        return tuple(jnp.take(v, jnp.arange(o, o + s), axis=axis)
+                     for o, s in zip(offsets, secs))
+
+    return list(apply(f, x, name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    return list(apply(
+        lambda v: tuple(jnp.take(v, i, axis=axis) for i in range(n)),
+        x, name="unbind"))
+
+
+def tile(x, repeat_times, name=None):
+    reps = shape_arg(repeat_times)
+    return apply(lambda v: jnp.tile(v, reps), x, name="tile")
+
+
+def expand(x, shape, name=None):
+    s = shape_arg(shape)
+
+    def f(v):
+        tgt = tuple(v.shape[i - (len(s) - v.ndim)] if d == -1 else d
+                    for i, d in enumerate(s))
+        return jnp.broadcast_to(v, tgt)
+
+    return apply(f, x, name="expand")
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(y.shape)
+    return apply(lambda v: jnp.broadcast_to(v, tgt), x, name="expand_as")
+
+
+def broadcast_tensors(inputs, name=None):
+    shapes = [tuple(t.shape) for t in inputs]
+    tgt = np.broadcast_shapes(*shapes)
+    return [apply(lambda v: jnp.broadcast_to(v, tgt), t,
+                  name="broadcast_tensors") for t in inputs]
+
+
+def flip(x, axis, name=None):
+    return apply(lambda v: jnp.flip(v, axis_arg(axis)), x, name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k, axes), x, name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, axis_arg(axis)), x, name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1), axis=axis), x, index,
+                 name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return v[comps]
+
+    return apply(f, x, index, name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        return v.at[i].add(u)
+
+    return apply(f, x, index, updates, name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._value = scatter(x.detach(), index, updates, overwrite)._value
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, idx, u):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return v.at[comps].add(u)
+
+    return apply(f, x, index, updates, name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+
+    return scatter_nd_add(zeros(shape, dtype=updates.dtype), index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i.reshape(-1), axis=axis), x, index,
+                 name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=1), x, index,
+                 name="index_sample")
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=axis), arr,
+                 indices, name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    def f(v, i, u):
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1
+                                       for k in range(v.ndim)])
+                for d, s in enumerate(i.shape)]
+        comps = tuple(i if d == axis else jnp.broadcast_to(dims[d], i.shape)
+                      for d in range(v.ndim))
+        if reduce == "add":
+            return v.at[comps].add(u)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[comps].multiply(u)
+        return v.at[comps].set(u)
+
+    return apply(f, arr, indices, values, name="put_along_axis")
+
+
+def masked_select(x, mask, name=None):
+    # Dynamic output shape: eager-only (like reference op, masked_select_op.cc).
+    return Tensor(unwrap(x)[np.asarray(unwrap(mask))],
+                  stop_gradient=True)
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda v, m: jnp.where(m, jnp.asarray(value, v.dtype), v),
+                 x, mask, name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y,
+                 name="where")
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """reference: operators/slice_op.cc"""
+    import builtins
+
+    sl = [builtins.slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        sl[int(a)] = builtins.slice(int(unwrap(s)), int(unwrap(e)))
+    sl = tuple(sl)
+    return apply(lambda v: v[sl], x, name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sl = [jnp.s_[:]] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        import builtins
+
+        sl[a] = builtins.slice(int(unwrap(s)), int(unwrap(e)), int(unwrap(st)))
+    return apply(lambda v: v[tuple(sl)], x, name="strided_slice")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # Dynamic output shape → eager numpy path (reference unique_op.cc is also
+    # host-synchronous for the count).
+    res = np.unique(np.asarray(unwrap(x)), return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(Tensor(r) for r in res)
+    return Tensor(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(unwrap(x))
+    if axis is None:
+        arr = arr.reshape(-1)
+    keep = np.ones(arr.shape[0], bool)
+    keep[1:] = np.any(
+        arr[1:].reshape(arr.shape[0] - 1, -1) !=
+        arr[:-1].reshape(arr.shape[0] - 1, -1), axis=1)
+    out = [Tensor(arr[keep])]
+    if return_inverse:
+        out.append(Tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        out.append(Tensor(np.diff(np.append(idx, arr.shape[0]))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = np.asarray(unwrap(repeats))
+        total = int(repeats.sum())
+        return apply(lambda v: jnp.repeat(v, jnp.asarray(repeats), axis=axis,
+                                          total_repeat_length=total),
+                     x, name="repeat_interleave")
+    return apply(lambda v: jnp.repeat(v, repeats, axis=axis), x,
+                 name="repeat_interleave")
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    s = shape_arg(shape)
+    offs = [0] * len(s) if offsets is None else \
+        [int(unwrap(o)) for o in offsets]
+    sl = tuple(builtins.slice(o, o + d) for o, d in zip(offs, s))
+    return apply(lambda v: v[sl], x, name="crop")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,  # noqa: A002
+                name=None):
+    """reference: operators/shard_index_op.cc (PS sharded embedding helper)."""
+    def f(v):
+        size = index_num // nshards
+        owner = v // size
+        local = v % size
+        return jnp.where(owner == shard_id, local, ignore_value)
+
+    return apply(f, input, differentiable=False, name="shard_index")

@@ -1,0 +1,247 @@
+"""Math / reduction ops (reference: python/paddle/tensor/math.py; kernels in
+paddle/fluid/operators/elementwise/, reduce_ops/, math/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ._helper import apply, axis_arg, make_binary, make_unary, unwrap
+
+# -- elementwise unary ------------------------------------------------------
+exp = make_unary(jnp.exp, "exp")
+expm1 = make_unary(jnp.expm1, "expm1")
+log = make_unary(jnp.log, "log")
+log2 = make_unary(jnp.log2, "log2")
+log10 = make_unary(jnp.log10, "log10")
+log1p = make_unary(jnp.log1p, "log1p")
+sqrt = make_unary(jnp.sqrt, "sqrt")
+rsqrt = make_unary(lambda x: jax.lax.rsqrt(x), "rsqrt")
+square = make_unary(jnp.square, "square")
+abs = make_unary(jnp.abs, "abs")  # noqa: A001
+neg = make_unary(jnp.negative, "neg")
+sign = make_unary(jnp.sign, "sign")
+floor = make_unary(jnp.floor, "floor")
+ceil = make_unary(jnp.ceil, "ceil")
+round = make_unary(jnp.round, "round")  # noqa: A001
+trunc = make_unary(jnp.trunc, "trunc")
+frac = make_unary(lambda x: x - jnp.trunc(x), "frac")
+sin = make_unary(jnp.sin, "sin")
+cos = make_unary(jnp.cos, "cos")
+tan = make_unary(jnp.tan, "tan")
+asin = make_unary(jnp.arcsin, "asin")
+acos = make_unary(jnp.arccos, "acos")
+atan = make_unary(jnp.arctan, "atan")
+sinh = make_unary(jnp.sinh, "sinh")
+cosh = make_unary(jnp.cosh, "cosh")
+tanh = make_unary(jnp.tanh, "tanh")
+asinh = make_unary(jnp.arcsinh, "asinh")
+acosh = make_unary(jnp.arccosh, "acosh")
+atanh = make_unary(jnp.arctanh, "atanh")
+reciprocal = make_unary(jnp.reciprocal, "reciprocal")
+erf = make_unary(jax.scipy.special.erf, "erf")
+erfinv = make_unary(jax.scipy.special.erfinv, "erfinv")
+digamma = make_unary(jax.scipy.special.digamma, "digamma")
+lgamma = make_unary(jax.scipy.special.gammaln, "lgamma")
+angle = make_unary(jnp.angle, "angle")
+conj = make_unary(jnp.conj, "conj")
+real = make_unary(jnp.real, "real")
+imag = make_unary(jnp.imag, "imag")
+
+# -- elementwise binary -----------------------------------------------------
+add = make_binary(jnp.add, "add")
+subtract = make_binary(jnp.subtract, "subtract")
+multiply = make_binary(jnp.multiply, "multiply")
+divide = make_binary(jnp.true_divide, "divide")
+floor_divide = make_binary(jnp.floor_divide, "floor_divide")
+remainder = make_binary(jnp.remainder, "remainder")
+mod = remainder
+floor_mod = remainder
+pow = make_binary(jnp.power, "pow")  # noqa: A001
+maximum = make_binary(jnp.maximum, "maximum")
+minimum = make_binary(jnp.minimum, "minimum")
+fmax = make_binary(jnp.fmax, "fmax")
+fmin = make_binary(jnp.fmin, "fmin")
+atan2 = make_binary(jnp.arctan2, "atan2")
+hypot = make_binary(jnp.hypot, "hypot")
+logaddexp = make_binary(jnp.logaddexp, "logaddexp")
+heaviside = make_binary(jnp.heaviside, "heaviside")
+gcd = make_binary(jnp.gcd, "gcd")
+lcm = make_binary(jnp.lcm, "lcm")
+inner = make_binary(jnp.inner, "inner")
+outer = make_binary(jnp.outer, "outer")
+kron = make_binary(jnp.kron, "kron")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference: operators/scale_op.cc"""
+    def f(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out.astype(v.dtype)
+
+    out = apply(f, x, unwrap(scale), unwrap(bias), name="scale")
+    if act is not None:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    return apply(lambda v, lo, hi: jnp.clip(v, lo, hi), x, unwrap(min),
+                 unwrap(max), name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x, name="stanh")
+
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        u = v if eps is None else jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(u / (1.0 - u))
+
+    return apply(f, x, name="logit")
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, 0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+
+    return apply(f, index, *inputs, name="multiplex")
+
+
+def add_n(inputs, name=None):
+    """reference: operators/sum_op.cc"""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return apply(lambda *xs: sum(xs[1:], xs[0]), *inputs, name="add_n")
+
+
+# -- reductions -------------------------------------------------------------
+def _reduce(jnp_fn, opname):
+    def op(x, axis=None, keepdim=False, name=None):
+        return apply(lambda v: jnp_fn(v, axis=axis_arg(axis), keepdims=keepdim),
+                     x, name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")  # noqa: A001
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod")
+max = _reduce(jnp.max, "max")  # noqa: A001
+min = _reduce(jnp.min, "min")  # noqa: A001
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.all(v, axis=axis_arg(axis), keepdims=keepdim),
+                 x, differentiable=False, name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.any(v, axis=axis_arg(axis), keepdims=keepdim),
+                 x, differentiable=False, name="any")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(
+        v, axis=axis_arg(axis), keepdims=keepdim), x, name="logsumexp")
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=dtype)
+        return jnp.cumsum(v, axis=int(axis), dtype=dtype)
+
+    return apply(f, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda v: jnp.cumprod(v, axis=dim, dtype=dtype), x,
+                 name="cumprod")
+
+
+# -- predicates -------------------------------------------------------------
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, x, differentiable=False, name="isfinite")
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, x, differentiable=False, name="isinf")
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, x, differentiable=False, name="isnan")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), x, name="nan_to_num")
+
+
+# -- matmul family (MXU path) ----------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """reference: operators/matmul_v2_op.cc — on TPU this lowers straight to
+    an MXU dot_general; bf16 inputs hit the systolic array natively."""
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return apply(f, x, y, name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y, name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply(jnp.matmul, x, vec, name="mv")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return apply(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                 input, x, y, name="addmm")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset, axis1, axis2), x, name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset, axis1, axis2), x,
+                 name="diagonal")
+
+
+def einsum(equation, *operands, name=None):
+    ops = operands[0] if len(operands) == 1 and \
+        isinstance(operands[0], (list, tuple)) else operands
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *ops, name="einsum")
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + value
+    return x

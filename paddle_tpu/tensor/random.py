@@ -1,0 +1,119 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py; kernels
+operators/uniform_random_op.cc, gaussian_random_op.cc …).
+
+Eager mode draws from the global stateful Generator (core.rng); under jit the
+functional layers take explicit keys. Sampling ops are non-differentiable
+w.r.t. their (absent) tensor inputs, matching the reference.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtype_mod
+from ..core import rng
+from ..framework.tensor import Tensor
+from ._helper import shape_arg, unwrap
+
+
+def _d(dtype, default=None):
+    if dtype is None:
+        return dtype_mod.convert_dtype(default) if default else \
+            dtype_mod.get_default_dtype()
+    return dtype_mod.convert_dtype(dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = jax.random.PRNGKey(seed) if seed else rng.next_key()
+    return Tensor(jax.random.uniform(key, shape_arg(shape), _d(dtype),
+                                     minval=unwrap(min), maxval=unwrap(max)))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        shape = np.broadcast_shapes(
+            np.shape(unwrap(mean)), np.shape(unwrap(std)))
+    out = jax.random.normal(rng.next_key(), shape_arg(shape or ()),
+                            dtype_mod.get_default_dtype())
+    return Tensor(out * unwrap(std) + unwrap(mean))
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None):
+    out = jax.random.normal(rng.next_key(), shape_arg(shape), _d(dtype))
+    return Tensor(out * std + mean)
+
+
+def randn(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return gaussian(shape, 0.0, 1.0, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(rng.next_key(), shape_arg(shape),
+                                     int(low), int(high),
+                                     _d(dtype, "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    v = unwrap(x)
+    return randint(low, high, v.shape, dtype or v.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), int(n)).astype(
+        dtype_mod.convert_dtype(dtype)))
+
+
+def shuffle(x, name=None):
+    return Tensor(jax.random.permutation(rng.next_key(), unwrap(x), axis=0))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    v = unwrap(x)
+    logits = jnp.log(jnp.clip(v, 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(rng.next_key(), logits,
+                                     shape=v.shape[:-1] + (num_samples,))
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(rng.next_key(), v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.bernoulli(rng.next_key(), v, v.shape).astype(
+        v.dtype))
+
+
+def poisson(x, name=None):
+    v = unwrap(x)
+    return Tensor(jax.random.poisson(rng.next_key(), v, v.shape).astype(
+        v.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    v = unwrap(x)
+    x._value = jax.random.exponential(rng.next_key(), v.shape, v.dtype) / lam
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    x._value = unwrap(uniform(x.shape, x.dtype, min, max, seed))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = unwrap(gaussian(x.shape, mean, std, x.dtype))
+    return x

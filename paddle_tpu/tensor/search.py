@@ -1,0 +1,139 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py;
+kernels operators/argsort_op.cc, top_k_v2_op.cc, where_index_op.cc)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ._helper import apply, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                         axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.int64)
+
+    return apply(f, x, differentiable=False, name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jnp.int64)
+
+    return apply(f, x, differentiable=False, name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        idx = jnp.argsort(-v if descending else v, axis=axis)
+        return idx.astype(jnp.int64)
+
+    return apply(f, x, differentiable=False, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis)
+        return jnp.flip(out, axis) if descending else out
+
+    return apply(f, x, name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    k = int(unwrap(k))
+
+    def f(v):
+        ax = -1 if axis is None else int(axis)
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        idx = idx.astype(jnp.int64)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply(f, x, name="topk")
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vv = jnp.sort(v, axis=axis)
+        ii = jnp.argsort(v, axis=axis).astype(jnp.int64)
+        val = jnp.take(vv, k - 1, axis=axis)
+        idx = jnp.take(ii, k - 1, axis=axis)
+        if keepdim:
+            val = jnp.expand_dims(val, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return val, idx
+
+    return apply(f, x, name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value along axis (ties → larger value, like reference
+    mode_op which picks the last of sorted equals)."""
+    arr = np.asarray(unwrap(x))
+    mv = np.moveaxis(arr, axis, -1)
+    flat = mv.reshape(-1, mv.shape[-1])
+    vals = np.empty(flat.shape[0], arr.dtype)
+    idxs = np.empty(flat.shape[0], np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        best = uniq[np.argmax(counts)]
+        vals[i] = best
+        idxs[i] = np.max(np.flatnonzero(row == best))
+    out_shape = mv.shape[:-1]
+    v_out, i_out = vals.reshape(out_shape), idxs.reshape(out_shape)
+    if keepdim:
+        v_out = np.expand_dims(v_out, axis)
+        i_out = np.expand_dims(i_out, axis)
+    return Tensor(v_out), Tensor(i_out)
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic shape → host-synchronous, like reference where_index_op.
+    arr = np.asarray(unwrap(x))
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(n.reshape(-1, 1).astype(np.int64)) for n in nz)
+    return Tensor(np.stack(nz, axis=1).astype(np.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _where
+
+    return _where(condition, x, y, name)
+
+
+def index_select(x, index, axis=0, name=None):
+    from .manipulation import index_select as _is
+
+    return _is(x, index, axis, name)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+
+    return _ms(x, mask, name)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    return apply(lambda s, v: jnp.searchsorted(s, v, side=side).astype(
+        jnp.int32 if out_int32 else jnp.int64),
+        sorted_sequence, values, differentiable=False, name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)

@@ -1,0 +1,68 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ._helper import apply, axis_arg, unwrap
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=axis_arg(axis), keepdims=keepdim),
+                 x, name="mean")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=axis_arg(axis), keepdims=keepdim,
+                                   ddof=1 if unbiased else 0), x, name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=axis_arg(axis), keepdims=keepdim,
+                                   ddof=1 if unbiased else 0), x, name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(v):
+        if mode == "min":
+            # lower median
+            vv = jnp.sort(v if axis is not None else v.reshape(-1),
+                          axis=axis if axis is not None else 0)
+            n = vv.shape[axis if axis is not None else 0]
+            return jnp.take(vv, (n - 1) // 2, axis=axis if axis is not None else 0)
+        return jnp.median(v, axis=axis_arg(axis), keepdims=keepdim)
+
+    return apply(f, x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmedian(v, axis=axis_arg(axis),
+                                         keepdims=keepdim), x, name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(unwrap(q)),
+                                        axis=axis_arg(axis), keepdims=keepdim,
+                                        method=interpolation),
+                 x, name="quantile")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    arr = np.asarray(unwrap(input))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(np.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is None:
+        return Tensor(np.bincount(np.asarray(unwrap(x)).reshape(-1),
+                                  minlength=minlength))
+    return Tensor(np.bincount(np.asarray(unwrap(x)).reshape(-1),
+                              np.asarray(unwrap(weights)).reshape(-1),
+                              minlength=minlength))
+
+
+def numel(x, name=None):
+    return Tensor(np.asarray(int(np.prod(unwrap(x).shape, dtype=np.int64))))

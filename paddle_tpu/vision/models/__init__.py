@@ -1,0 +1,4 @@
+"""Model zoo (reference: python/paddle/vision/models/)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa: F401
+                     resnet152)

@@ -1,0 +1,29 @@
+"""Test configuration: force an 8-device virtual CPU mesh so SPMD logic is
+exercised without TPU hardware (SURVEY.md §4 implication (b): XLA's
+--xla_force_host_platform_device_count replaces the reference's
+"2 subprocesses on localhost" distributed-test trick)."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Golden-value tests compare against float64 numpy: use exact fp32 matmuls.
+# (The perf path keeps the platform default — bf16 on the MXU.)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(102)
+    np.random.seed(102)
+    yield

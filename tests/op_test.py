@@ -1,0 +1,97 @@
+"""OpTest harness — NumPy-golden forward + finite-difference gradient checks.
+
+TPU-native analogue of the reference's op unit-test contract
+(reference: python/paddle/fluid/tests/unittests/op_test.py:238 —
+check_output:1262 runs vs a NumPy reference; check_grad:1335 compares
+analytic grads against numeric finite differences, get_numeric_gradient:101).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+def check_output(op_fn: Callable, np_fn: Callable, inputs: Dict[str, np.ndarray],
+                 attrs: Optional[dict] = None, rtol=1e-4, atol=1e-5):
+    """Run op_fn on Tensors and np_fn on arrays; compare all outputs."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(v) for v in inputs.values()]
+    got = op_fn(*tensors, **attrs)
+    want = np_fn(*inputs.values(), **attrs)
+    got_list = got if isinstance(got, (list, tuple)) else [got]
+    want_list = want if isinstance(want, (list, tuple)) else [want]
+    assert len(got_list) == len(want_list), \
+        f"output arity {len(got_list)} != {len(want_list)}"
+    for i, (g, w) in enumerate(zip(got_list, want_list)):
+        g_np = g.numpy() if isinstance(g, Tensor) else np.asarray(g)
+        np.testing.assert_allclose(
+            g_np.astype(np.float64) if g_np.dtype != bool else g_np,
+            np.asarray(w).astype(np.float64)
+            if np.asarray(w).dtype != bool else np.asarray(w),
+            rtol=rtol, atol=atol, err_msg=f"output {i} mismatch")
+
+
+def numeric_grad(op_fn: Callable, inputs: Dict[str, np.ndarray],
+                 wrt: str, attrs: Optional[dict] = None, delta=5e-3,
+                 output_index: Optional[int] = None) -> np.ndarray:
+    """Central finite differences of sum(op(x)) w.r.t. inputs[wrt]
+    (reference: op_test.py get_numeric_gradient:101)."""
+    attrs = attrs or {}
+
+    def run(arrs):
+        tensors = [paddle.to_tensor(v) for v in arrs.values()]
+        out = op_fn(*tensors, **attrs)
+        if isinstance(out, (list, tuple)):
+            out = out[output_index if output_index is not None else 0]
+        return float(out.sum().numpy())
+
+    base = {k: np.asarray(v, np.float64 if np.issubdtype(
+        np.asarray(v).dtype, np.floating) else None) for k, v in
+        inputs.items()}
+    x = np.array(inputs[wrt], dtype=np.float64)
+    grad = np.zeros_like(x)
+    flat = x.reshape(-1)
+    g_flat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        arrs = dict(inputs)
+        arrs[wrt] = x.astype(inputs[wrt].dtype)
+        plus = run(arrs)
+        flat[i] = orig - delta
+        arrs[wrt] = x.astype(inputs[wrt].dtype)
+        minus = run(arrs)
+        flat[i] = orig
+        g_flat[i] = (plus - minus) / (2 * delta)
+    return grad
+
+
+def check_grad(op_fn: Callable, inputs: Dict[str, np.ndarray],
+               grad_vars: Sequence[str], attrs: Optional[dict] = None,
+               delta=5e-3, max_relative_error=5e-3,
+               output_index: Optional[int] = None):
+    """Analytic (tape) vs numeric gradients (reference: check_grad:1335)."""
+    attrs = attrs or {}
+    tensors = {k: paddle.to_tensor(np.asarray(v), stop_gradient=k not in
+                                   grad_vars)
+               for k, v in inputs.items()}
+    out = op_fn(*tensors.values(), **attrs)
+    if isinstance(out, (list, tuple)):
+        out = out[output_index if output_index is not None else 0]
+    loss = out.sum()
+    loss.backward()
+    for name in grad_vars:
+        analytic = tensors[name].grad.numpy().astype(np.float64)
+        numeric = numeric_grad(op_fn, inputs, name, attrs, delta,
+                               output_index)
+        abs_err = np.abs(analytic - numeric)
+        denom = np.maximum(np.maximum(np.abs(analytic), np.abs(numeric)), 1.0)
+        rel = (abs_err / denom).max()
+        assert rel <= max_relative_error, (
+            f"grad check failed for '{name}': max rel err {rel:.2e} > "
+            f"{max_relative_error:.2e}\nanalytic={analytic}\n"
+            f"numeric={numeric}")

@@ -1,0 +1,88 @@
+"""End-to-end LeNet/MNIST — north-star config 1 (SURVEY.md §7 build step 3;
+reference book test: fluid/tests/book/test_recognize_digits.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def test_lenet_learns_synthetic_mnist():
+    paddle.seed(33)
+    train = MNIST(mode="train", synthetic_size=512)
+    loader = DataLoader(train, batch_size=64, shuffle=True, drop_last=True)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(0.002, parameters=net.parameters())
+    first = last = None
+    for epoch in range(3):
+        for x, y in loader:
+            out = net(x)
+            loss = F.cross_entropy(out, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.numpy())
+            last = float(loss.numpy())
+    assert last < first * 0.7, (first, last)
+
+    # accuracy on train data should be far above chance
+    net.eval()
+    acc = Accuracy()
+    with paddle.no_grad():
+        for x, y in DataLoader(train, batch_size=128):
+            correct = acc.compute(net(x), y)
+            acc.update(correct.numpy())
+    assert acc.accumulate() > 0.5, acc.accumulate()
+
+
+def test_hapi_model_fit():
+    paddle.seed(1)
+    train = MNIST(mode="train", synthetic_size=256)
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(0.002, parameters=model.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        Accuracy())
+    model.fit(train, epochs=1, batch_size=64, verbose=0)
+    logs = model.evaluate(train, batch_size=128, verbose=0)
+    assert logs["acc"] > 0.3, logs
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    net = LeNet()
+    opt = paddle.optimizer.Adam(0.001, parameters=net.parameters())
+    path = str(tmp_path / "ck")
+    paddle.save(net.state_dict(), path + ".pdparams")
+    paddle.save(opt.state_dict(), path + ".pdopt")
+    net2 = LeNet()
+    net2.set_state_dict(paddle.load(path + ".pdparams"))
+    x = paddle.to_tensor(np.random.rand(2, 1, 28, 28).astype(np.float32))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_jit_to_static_forward_matches_eager():
+    paddle.seed(5)
+    net = LeNet()
+    net.eval()
+    static_net = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.random.rand(2, 1, 28, 28).astype(np.float32))
+    eager_out = net(x)
+    static_out = static_net(x)
+    np.testing.assert_allclose(eager_out.numpy(), static_out.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_jit_static_backward():
+    net = LeNet()
+    static_net = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.random.rand(2, 1, 28, 28).astype(np.float32))
+    y = paddle.to_tensor(np.array([1, 2], np.int64))
+    out = static_net(x)
+    loss = F.cross_entropy(out, y)
+    loss.backward()
+    assert net.features[0].weight.grad is not None
+    assert float(np.abs(net.features[0].weight.grad.numpy()).sum()) > 0

@@ -1,0 +1,149 @@
+"""Layer tests (reference test model: unittests/test_layers.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_linear_shapes_and_grad():
+    layer = nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    out = layer(x)
+    assert out.shape == [2, 4]
+    out.sum().backward()
+    assert layer.weight.grad.shape == [8, 4]
+    assert layer.bias.grad.shape == [4]
+
+
+def test_conv2d_matches_naive():
+    layer = nn.Conv2D(2, 3, 3, padding=1)
+    x = paddle.to_tensor(np.random.rand(1, 2, 5, 5).astype(np.float32))
+    out = layer(x)
+    assert out.shape == [1, 3, 5, 5]
+    out.mean().backward()
+    assert layer.weight.grad is not None
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.to_tensor(
+        np.random.rand(8, 4, 3, 3).astype(np.float32) * 5 + 2)
+    bn.train()
+    out = bn(x)
+    # batch-normalized output should have ~0 mean, ~1 std per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-4
+    assert abs(o.std() - 1.0) < 1e-2
+    # running stats moved toward batch stats
+    assert abs(float(bn._mean.numpy().mean())) > 1e-4
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 4, 3, 3]
+
+
+def test_layernorm_values():
+    ln = nn.LayerNorm(6)
+    x = np.random.rand(3, 6).astype(np.float32) * 4
+    out = ln(paddle.to_tensor(x)).numpy()
+    want = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, want, rtol=1e-3, atol=1e-4)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 3], [5, 0]], np.int64))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4), atol=1e-7)
+
+
+def test_dropout_train_eval():
+    do = nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((100, 100), np.float32))
+    do.train()
+    y = do(x).numpy()
+    assert (y == 0).mean() > 0.3
+    assert abs(y.mean() - 1.0) < 0.1  # upscale_in_train preserves mean
+    do.eval()
+    np.testing.assert_allclose(do(x).numpy(), 1.0)
+
+
+def test_sequential_and_state_dict():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    assert set(sd.keys()) == {"0.weight", "0.bias", "2.weight", "2.bias"}
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    x = paddle.to_tensor(np.random.rand(3, 4).astype(np.float32))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_lstm_forward():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.to_tensor(np.random.rand(2, 5, 4).astype(np.float32))
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 5, 8]
+    assert h.shape == [2, 2, 8]
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 6, direction="bidirect")
+    x = paddle.to_tensor(np.random.rand(2, 5, 4).astype(np.float32))
+    out, h = gru(x)
+    assert out.shape == [2, 5, 12]
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(np.random.rand(2, 6, 16).astype(np.float32))
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.to_tensor(np.random.rand(2, 5, 16).astype(np.float32))
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+
+
+def test_sdpa_causal_matches_manual():
+    import paddle_tpu.nn.functional as F
+
+    q = np.random.rand(1, 4, 2, 8).astype(np.float32)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        is_causal=True, training=False)
+    # position 0 can only attend to itself → output == v[0]
+    np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_parameter_registration_and_named():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+            self.w = self.create_parameter([3])
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert "w" in names and "fc.weight" in names and "fc.bias" in names
+    assert len(net.parameters()) == 3
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training

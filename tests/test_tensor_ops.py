@@ -1,0 +1,281 @@
+"""Op tests via the OpTest harness (reference test strategy: SURVEY.md §4.1).
+Covers the hot-path op families: elementwise, reduce, matmul, manipulation,
+activation, loss."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad, check_output
+
+
+class TestElementwise:
+    def test_add_forward_grad(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        check_output(paddle.add, np.add, {"x": x, "y": y})
+        check_grad(paddle.add, {"x": x, "y": y}, ["x", "y"])
+
+    def test_broadcast_add_grad(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4).astype(np.float32)
+        check_output(paddle.add, np.add, {"x": x, "y": y})
+        check_grad(paddle.add, {"x": x, "y": y}, ["x", "y"])
+
+    def test_multiply(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        check_output(paddle.multiply, np.multiply, {"x": x, "y": y})
+        check_grad(paddle.multiply, {"x": x, "y": y}, ["x", "y"])
+
+    def test_divide(self):
+        x = np.random.rand(2, 3).astype(np.float32) + 0.5
+        y = np.random.rand(2, 3).astype(np.float32) + 0.5
+        check_output(paddle.divide, np.true_divide, {"x": x, "y": y})
+        check_grad(paddle.divide, {"x": x, "y": y}, ["x", "y"])
+
+    @pytest.mark.parametrize("op,npop", [
+        ("exp", np.exp), ("tanh", np.tanh), ("sqrt", np.sqrt),
+        ("log", np.log), ("abs", np.abs), ("sin", np.sin), ("cos", np.cos),
+    ])
+    def test_unary(self, op, npop):
+        x = (np.random.rand(3, 4).astype(np.float32) + 0.3)
+        check_output(getattr(paddle, op), npop, {"x": x}, rtol=1e-3)
+        check_grad(getattr(paddle, op), {"x": x}, ["x"],
+                   max_relative_error=1e-2)
+
+    def test_pow_scalar(self):
+        x = np.random.rand(3).astype(np.float32) + 0.5
+        t = paddle.to_tensor(x, stop_gradient=False)
+        out = t ** 2
+        out.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), 2 * x, rtol=1e-5)
+
+    def test_clip(self):
+        x = np.random.randn(10).astype(np.float32)
+        check_output(paddle.clip, lambda x, min, max: np.clip(x, min, max),
+                     {"x": x}, attrs={"min": -0.5, "max": 0.5})
+
+
+class TestReduce:
+    def test_sum_axis(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        check_output(paddle.sum, lambda x, axis, keepdim: np.sum(
+            x, axis=axis, keepdims=keepdim),
+            {"x": x}, attrs={"axis": 1, "keepdim": True})
+        check_grad(paddle.sum, {"x": x}, ["x"], attrs={"axis": 1,
+                                                       "keepdim": False})
+
+    def test_mean(self):
+        x = np.random.rand(4, 5).astype(np.float32)
+        check_output(paddle.mean, lambda x: np.mean(x), {"x": x})
+        check_grad(paddle.mean, {"x": x}, ["x"])
+
+    def test_max_min_prod(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_output(paddle.max, lambda x: np.max(x), {"x": x})
+        check_output(paddle.min, lambda x: np.min(x), {"x": x})
+        check_output(paddle.prod, lambda x: np.prod(x), {"x": x},
+                     rtol=1e-4)
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+
+        x = np.random.rand(3, 4).astype(np.float32)
+        try:
+            check_output(paddle.logsumexp, lambda x: np_lse(x), {"x": x})
+        except ImportError:
+            pass
+
+    def test_cumsum(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_output(paddle.cumsum, lambda x, axis: np.cumsum(x, axis),
+                     {"x": x}, attrs={"axis": 1})
+
+
+class TestMatmul:
+    def test_matmul_2d(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, {"x": x, "y": y}, rtol=1e-4)
+        check_grad(paddle.matmul, {"x": x, "y": y}, ["x", "y"])
+
+    def test_matmul_transpose(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        got = paddle.matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                            transpose_x=True)
+        np.testing.assert_allclose(got.numpy(), x.T @ y, rtol=1e-4)
+
+    def test_batched(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(2, 4, 5).astype(np.float32)
+        check_output(paddle.bmm, np.matmul, {"x": x, "y": y}, rtol=1e-4)
+
+    def test_einsum(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        got = paddle.einsum("ij,jk->ik", paddle.to_tensor(x),
+                            paddle.to_tensor(y))
+        np.testing.assert_allclose(got.numpy(), x @ y, rtol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_grad(self):
+        x = np.random.rand(2, 6).astype(np.float32)
+        check_output(paddle.reshape, lambda x, shape: np.reshape(x, shape),
+                     {"x": x}, attrs={"shape": [3, 4]})
+        check_grad(paddle.reshape, {"x": x}, ["x"],
+                   attrs={"shape": [3, 4]})
+
+    def test_transpose(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        check_output(paddle.transpose,
+                     lambda x, perm: np.transpose(x, perm),
+                     {"x": x}, attrs={"perm": [2, 0, 1]})
+
+    def test_concat_split(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        got = paddle.concat([paddle.to_tensor(x), paddle.to_tensor(y)], 0)
+        np.testing.assert_allclose(got.numpy(), np.concatenate([x, y], 0))
+        parts = paddle.split(got, 2, axis=0)
+        np.testing.assert_allclose(parts[0].numpy(), x)
+        parts = paddle.split(got, [1, 3], axis=0)
+        assert parts[0].shape == [1, 3] and parts[1].shape == [3, 3]
+
+    def test_gather(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4], np.int64)
+        got = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(got.numpy(), x[idx])
+
+    def test_stack_squeeze_unsqueeze(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        s = paddle.stack([paddle.to_tensor(x)] * 3, axis=1)
+        assert s.shape == [2, 3, 3]
+        u = paddle.unsqueeze(paddle.to_tensor(x), [0, 2])
+        assert u.shape == [1, 2, 1, 3]
+        q = paddle.squeeze(u, 0)
+        assert q.shape == [2, 1, 3]
+
+    def test_where(self):
+        c = np.array([True, False, True])
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        y = np.array([-1.0, -2.0, -3.0], np.float32)
+        got = paddle.where(paddle.to_tensor(c), paddle.to_tensor(x),
+                           paddle.to_tensor(y))
+        np.testing.assert_allclose(got.numpy(), np.where(c, x, y))
+
+    def test_indexing_grad(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4),
+                             stop_gradient=False)
+        y = x[1]
+        y.sum().backward()
+        g = np.zeros((3, 4), np.float32)
+        g[1] = 1
+        np.testing.assert_allclose(x.grad.numpy(), g)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", ["relu", "sigmoid", "gelu", "silu",
+                                      "softplus", "elu", "leaky_relu",
+                                      "hardswish", "mish"])
+    def test_grads(self, name):
+        x = np.random.randn(4, 5).astype(np.float32) + 0.1
+        fn = getattr(F, name)
+        check_grad(fn, {"x": x}, ["x"], max_relative_error=1e-2)
+
+    def test_softmax(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+
+        def np_softmax(x, axis):
+            e = np.exp(x - x.max(axis, keepdims=True))
+            return e / e.sum(axis, keepdims=True)
+
+        check_output(F.softmax, np_softmax, {"x": x}, attrs={"axis": -1})
+        check_grad(F.softmax, {"x": x}, ["x"], attrs={"axis": -1})
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 7).astype(np.float32)
+        label = np.array([0, 3, 6, 2], np.int64)
+
+        def np_ce(input, label):
+            e = np.exp(input - input.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return -np.log(p[np.arange(4), label]).mean()
+
+        check_output(F.cross_entropy, np_ce,
+                     {"input": logits, "label": label}, rtol=1e-4)
+        check_grad(F.cross_entropy, {"input": logits, "label": label},
+                   ["input"])
+
+    def test_mse(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32)
+        check_output(F.mse_loss,
+                     lambda input, label: np.mean((input - label) ** 2),
+                     {"input": x, "label": y})
+        check_grad(F.mse_loss, {"input": x, "label": y}, ["input"])
+
+    def test_bce_with_logits(self):
+        z = np.random.randn(6).astype(np.float32)
+        y = (np.random.rand(6) > 0.5).astype(np.float32)
+
+        def np_bce(logit, label):
+            return np.mean(np.maximum(logit, 0) - logit * label +
+                           np.log1p(np.exp(-np.abs(logit))))
+
+        check_output(F.binary_cross_entropy_with_logits, np_bce,
+                     {"logit": z, "label": y}, rtol=1e-4)
+
+
+class TestAutogradEngine:
+    def test_multi_use_accumulation(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x + x * 3
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * x
+        assert y.stop_gradient
+
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        assert x.grad is None  # .grad untouched
+
+    def test_detach(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 2
+        z = y.detach() * x
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+    def test_stop_gradient_leaf(self):
+        x = paddle.to_tensor([1.0], stop_gradient=True)
+        w = paddle.to_tensor([2.0], stop_gradient=False)
+        (w * x).backward()
+        assert x.grad is None
+        np.testing.assert_allclose(w.grad.numpy(), [1.0])
+
+    def test_topk_multi_output_grad(self):
+        x = paddle.to_tensor(np.array([3.0, 1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        vals, idx = paddle.topk(x, 2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 0.0, 1.0])
