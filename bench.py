@@ -1,0 +1,98 @@
+"""Driver benchmark: GPT training step on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric: tokens/sec/chip training GPT (BASELINE.md: tokens/sec/chip + MFU).
+vs_baseline: achieved MFU / 0.45 (the north-star 45% MFU target — the
+reference publishes no numbers to compare against, BASELINE.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak FLOP/s for the local accelerator."""
+    import jax
+
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "").lower()
+    if "v6" in kind:
+        return 918e12       # v6e ("TPU v6 lite") — check before "lite"
+    if "v5p" in kind:
+        return 459e12
+    if "v5" in kind or "v5e" in kind or "lite" in kind:
+        return 197e12       # TPU v5e bf16
+    if "v4" in kind:
+        return 275e12
+    return 197e12
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid_gpt import GPTHybridTrainer
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.models import GPT, GPTConfig
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    paddle.seed(0)
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024)
+        batch, seq, steps = 8, 1024, 20
+    else:  # CPU smoke fallback
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128)
+        batch, seq, steps = 2, 64, 2
+
+    model = GPT(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    s = DistributedStrategy()
+    s.amp = True
+    mesh = create_mesh({"dp": 1, "pp": 1, "tp": 1, "sp": 1},
+                       jax.devices()[:1])
+    trainer = GPTHybridTrainer(model, opt, s, mesh, n_micro=1)
+
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+
+    # warmup (compile); NOTE: under the axon tunnel block_until_ready
+    # reports ready before execution completes — a host value fetch
+    # (np.asarray) is the only truthful synchronization.
+    float(np.asarray(trainer.step(tokens)))
+    float(np.asarray(trainer.step(tokens)))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step(tokens)
+    final_loss = float(np.asarray(loss))
+    dt = (time.perf_counter() - t0) / steps
+
+    toks_per_sec = batch * seq / dt
+    flops_per_token = cfg.flops_per_token(seq)
+    mfu = toks_per_sec * flops_per_token / peak_flops_per_chip()
+    print(json.dumps({
+        "metric": "gpt_125m_train_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "extra": {"mfu": round(mfu, 4), "step_ms": round(dt * 1e3, 2),
+                  "batch": batch, "seq": seq,
+                  "params_m": round(cfg.num_params() / 1e6, 1),
+                  "final_loss": round(final_loss, 4),
+                  "device": str(jax.devices()[0])},
+    }))
+
+
+if __name__ == "__main__":
+    main()

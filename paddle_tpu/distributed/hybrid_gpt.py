@@ -1,0 +1,286 @@
+"""GPT hybrid-parallel trainer: dp × tp × pp × ZeRO in ONE pjit program.
+
+This is the TPU-native composition the reference achieved with a chain of
+meta-optimizers rewriting programs per rank (reference:
+sharding_optimizer.py + pipeline_optimizer.py + amp/recompute optimizers,
+chained by strategy_compiler.py) — here it's sharding specs + shard_map:
+
+  - embeddings / final-norm / lm-head params: GSPMD (tp/zero specs)
+  - transformer blocks: params stacked to [pp, layers_per_stage, ...],
+    stage axis shard_map'd over 'pp' (pipeline.py), layers scanned within a
+    stage, each block optionally rematerialized (jax.checkpoint ==
+    reference RecomputeOptimizer),
+  - batch sharded over 'dp'; XLA derives gradient reduce-scatter from the
+    ZeRO opt-state shardings,
+  - bf16 compute / fp32 master params when strategy.amp.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..models.gpt import GPT
+from ..static.functional import _swapped_state, state_tensors
+from .fleet.distributed_strategy import DistributedStrategy
+from .pipeline import pipeline_apply
+from .strategy_compiler import (_add_axis, _local_check_shape,
+                                build_mesh_from_strategy,
+                                resolve_param_specs)
+
+
+class GPTHybridTrainer:
+    def __init__(self, model: GPT, optimizer,
+                 strategy: Optional[DistributedStrategy] = None,
+                 mesh: Optional[Mesh] = None, n_micro: Optional[int] = None):
+        self.model = model
+        self.optimizer = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self.mesh = mesh if mesh is not None else \
+            build_mesh_from_strategy(self.strategy)
+        self.pp = self.mesh.shape.get("pp", 1)
+        self.n_micro = n_micro or max(
+            self.strategy.pipeline_configs.accumulate_steps,
+            self.strategy.pipeline_configs.micro_batch, self.pp)
+        self.amp = self.strategy.amp
+        self.remat = self.strategy.recompute
+        self.zero = self.strategy.sharding_configs.sharding_stage \
+            if self.strategy.sharding else 0
+
+        L = model.config.num_layers
+        if L % self.pp != 0:
+            raise ValueError(
+                f"num_layers={L} must be divisible by pp_degree={self.pp}")
+        self.lps = L // self.pp
+
+        # --- split state: block params (stacked) vs the rest --------------
+        pn, pt, bn, bt = state_tensors(model)
+        self.all_names = pn
+        base_specs = resolve_param_specs(model, self.mesh, zero_stage=0)
+
+        blk0 = [n for n in pn if n.startswith("blocks.0.")]
+        self.block_suffixes = [n[len("blocks.0."):] for n in blk0]
+        self.other_names = [n for n in pn if not n.startswith("blocks.")]
+        name2t = dict(zip(pn, pt))
+        self._name2tensor = name2t
+
+        dp = self.mesh.shape.get("dp", 1)
+
+        # stacked block params: [pp, lps, ...]
+        self.block_vals: Dict[str, jax.Array] = {}
+        self.block_specs: Dict[str, P] = {}
+        for sfx in self.block_suffixes:
+            per_layer = [name2t[f"blocks.{i}.{sfx}"]._value
+                         for i in range(L)]
+            stacked = jnp.stack(per_layer, 0).reshape(
+                (self.pp, self.lps) + per_layer[0].shape)
+            spec0 = base_specs[f"blocks.0.{sfx}"]
+            spec = P("pp", None, *spec0)
+            if self.zero >= 3:
+                shape = _local_check_shape(stacked.shape, spec, self.mesh)
+                spec = _add_axis(spec, stacked.ndim, shape, "dp", dp)
+            self.block_specs[sfx] = spec
+            self.block_vals[sfx] = jax.device_put(
+                stacked, NamedSharding(self.mesh, spec))
+
+        self.other_vals: List[jax.Array] = []
+        self.other_specs: List[P] = []
+        for n in self.other_names:
+            spec = base_specs[n]
+            t = name2t[n]
+            if self.zero >= 3:
+                shape = _local_check_shape(t._value.shape, spec, self.mesh)
+                spec = _add_axis(spec, t._value.ndim, shape, "dp", dp)
+            self.other_specs.append(spec)
+            self.other_vals.append(jax.device_put(
+                t._value, NamedSharding(self.mesh, spec)))
+
+        # --- optimizer state ----------------------------------------------
+        def opt_state_spec(spec, shape, ndim):
+            if self.zero >= 1:
+                local = _local_check_shape(shape, spec, self.mesh)
+                return _add_axis(spec, ndim, local, "dp", dp)
+            return spec
+
+        class _FakeParam:
+            def __init__(self, v):
+                self._value = v
+
+        self.block_opt: Dict[str, dict] = {}
+        self.block_opt_specs: Dict[str, dict] = {}
+        for sfx, v in self.block_vals.items():
+            s = optimizer._init_state(_FakeParam(v))
+            sp = opt_state_spec(self.block_specs[sfx], v.shape, v.ndim)
+            self.block_opt[sfx] = jax.device_put(
+                s, {k: NamedSharding(self.mesh, sp) for k in s})
+            self.block_opt_specs[sfx] = {k: sp for k in s}
+        self.other_opt: List[dict] = []
+        self.other_opt_specs: List[dict] = []
+        for n, v, spec in zip(self.other_names, self.other_vals,
+                              self.other_specs):
+            s = optimizer._init_state(_FakeParam(v))
+            sp = opt_state_spec(spec, v.shape, v.ndim)
+            self.other_opt.append(jax.device_put(
+                s, {k: NamedSharding(self.mesh, sp) for k in s}))
+            self.other_opt_specs.append({k: sp for k in s})
+
+        self._step = 0
+        self._build()
+
+    # ---------------------------------------------------------------------
+    def _forward_loss(self, block_params, other_params, tokens, key):
+        model = self.model
+        cfg = model.config
+        from ..core import rng as rng_mod
+
+        if self.amp:
+            castf = lambda v: v.astype(jnp.bfloat16) if \
+                jnp.issubdtype(v.dtype, jnp.floating) else v
+        else:
+            castf = lambda v: v
+        other_cast = [castf(v) for v in other_params]
+        block_cast = {k: castf(v) for k, v in block_params.items()}
+
+        other_tensors = [self._name2tensor[n] for n in self.other_names]
+        blk0_tensors = [self._name2tensor[f"blocks.0.{s}"]
+                        for s in self.block_suffixes]
+
+        def block_apply(stage_local, x):
+            """Apply one stage's lps blocks (lax.scan over layers)."""
+            def one_block(h, layer_params):
+                vals = [layer_params[s] for s in self.block_suffixes]
+                with _swapped_state(blk0_tensors, vals):
+                    out = model.blocks[0](Tensor(h))._value
+                return out
+
+            if self.remat:
+                one_block = jax.checkpoint(one_block)
+
+            def body(h, layer_params):
+                return one_block(h, layer_params), None
+
+            out, _ = jax.lax.scan(body, x, stage_local)
+            return out
+
+        with _swapped_state(other_tensors, other_cast):
+            with rng_mod.key_scope(key):
+                x = model.embeddings(Tensor(tokens))._value
+                x = pipeline_apply(self.mesh, block_apply, block_cast, x,
+                                   self.n_micro)
+                x = model.ln_f(Tensor(x))
+                if cfg.tie_word_embeddings:
+                    from ..tensor import matmul
+
+                    logits = matmul(x, model.embeddings.wte.weight,
+                                    transpose_y=True)
+                else:
+                    logits = model.lm_head(x)
+                from ..nn import functional as F
+
+                lg = logits[:, :-1]
+                lb = Tensor(tokens)[:, 1:]
+                b, s = lb.shape[0], lb.shape[1]
+                loss = F.cross_entropy(
+                    lg.reshape([b * s, -1]).astype("float32"),
+                    lb.reshape([b * s]))
+        return loss._value.astype(jnp.float32)
+
+    def _build(self):
+        from .strategy_compiler import functional_clip, make_param_update
+
+        opt = self.optimizer
+        clip = opt._grad_clip
+        mesh = self.mesh
+        wd_other = tuple(opt._decoupled_wd(self._name2tensor[n])
+                         for n in self.other_names)
+        lr_other = tuple(
+            self._name2tensor[n].optimize_attr.get("learning_rate", 1.0)
+            for n in self.other_names)
+        wd_block = {s: opt._decoupled_wd(
+            self._name2tensor[f"blocks.0.{s}"])
+            for s in self.block_suffixes}
+        lr_block = {s: self._name2tensor[
+            f"blocks.0.{s}"].optimize_attr.get("learning_rate", 1.0)
+            for s in self.block_suffixes}
+        upd = make_param_update(opt)
+
+        def step_fn(block_params, other_params, block_opt, other_opt,
+                    tokens, lr, step_no, key):
+            def loss_of(bp, op):
+                return self._forward_loss(bp, op, tokens, key)
+
+            loss, (g_blk, g_oth) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(block_params, other_params)
+            g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
+
+            new_blk, new_blk_opt = {}, {}
+            for sfx in block_params:
+                np_, ns = upd(block_params[sfx], g_blk[sfx],
+                              block_opt[sfx], lr, step_no,
+                              plr=lr_block[sfx], wd=wd_block[sfx])
+                new_blk[sfx] = np_
+                new_blk_opt[sfx] = ns
+            new_oth, new_oth_opt = [], []
+            for p, g, s, plr, wd in zip(other_params, g_oth, other_opt,
+                                        lr_other, wd_other):
+                np_, ns = upd(p, g, s, lr, step_no, plr=plr, wd=wd)
+                new_oth.append(np_)
+                new_oth_opt.append(ns)
+            return loss, new_blk, new_oth, new_blk_opt, new_oth_opt
+
+        ns = lambda spec: NamedSharding(mesh, spec)
+        blk_sh = {k: ns(v) for k, v in self.block_specs.items()}
+        oth_sh = [ns(s) for s in self.other_specs]
+        blk_opt_sh = {k: {kk: ns(vv) for kk, vv in v.items()}
+                      for k, v in self.block_opt_specs.items()}
+        oth_opt_sh = [{kk: ns(vv) for kk, vv in d.items()}
+                      for d in self.other_opt_specs]
+        self._step_fn = jax.jit(
+            step_fn,
+            in_shardings=(blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
+                          ns(P("dp")), None, None, None),
+            out_shardings=(ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh),
+            donate_argnums=(0, 1, 2, 3))
+
+    def step(self, tokens) -> jax.Array:
+        from ..core import rng as rng_mod
+
+        self._step += 1
+        v = tokens._value if isinstance(tokens, Tensor) else \
+            jnp.asarray(tokens)
+        v = jax.device_put(v, NamedSharding(self.mesh, P("dp")))
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.block_vals, self.other_vals, self.block_opt, \
+            self.other_opt = self._step_fn(
+                self.block_vals, self.other_vals, self.block_opt,
+                self.other_opt, v, lr, jnp.asarray(self._step, jnp.int32),
+                rng_mod.next_key())
+        self.optimizer._global_step = self._step
+        return loss
+
+    __call__ = step
+
+    def sync_to_layer(self):
+        """Unstack device state (params AND optimizer accumulators) back
+        into the eager model/optimizer, so state_dict/checkpoints see the
+        trained values."""
+        L = self.model.config.num_layers
+        for sfx, stacked in self.block_vals.items():
+            flat = stacked.reshape((L,) + tuple(stacked.shape[2:]))
+            opt_flat = {k: v.reshape((L,) + tuple(v.shape[2:]))
+                        for k, v in self.block_opt[sfx].items()}
+            for i in range(L):
+                t = self._name2tensor[f"blocks.{i}.{sfx}"]
+                t._value = flat[i]
+                self.optimizer._accumulators[id(t)] = {
+                    k: v[i] for k, v in opt_flat.items()}
+        for n, v, s in zip(self.other_names, self.other_vals,
+                           self.other_opt):
+            t = self._name2tensor[n]
+            t._value = v
+            self.optimizer._accumulators[id(t)] = s
+        return self.model

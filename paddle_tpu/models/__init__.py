@@ -1,0 +1,3 @@
+"""Flagship model families (GPT for the hybrid-parallel north star,
+BERT for the DP+AMP config)."""
+from .gpt import GPT, GPTBlock, GPTConfig, gpt_tiny  # noqa: F401

@@ -1,0 +1,219 @@
+"""GPT model family — the flagship for the hybrid-parallel north star
+(BASELINE.md: GPT-3 1.3B/13B, TP×PP×sharding, ≥45% MFU target).
+
+The reference has no GPT in-tree (its GPT configs ran via fleet meta
+optimizers over user model code); here the model is first-class and
+TPU-first:
+  - attention through F.scaled_dot_product_attention (flash path),
+  - q/kv/mlp projections as tensor-parallel layers carrying PartitionSpecs
+    (distributed/parallel_layers.py) that the strategy compiler turns into
+    GSPMD shardings,
+  - identical block structure per layer so the compiled path can stack
+    block params into [L, ...] arrays and lax.scan over layers (and shard
+    the stage axis for pipeline parallelism).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..distributed.parallel_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..tensor import arange
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_hidden_size: int = 0          # default 4*hidden
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if not self.ffn_hidden_size:
+            self.ffn_hidden_size = 4 * self.hidden_size
+
+    # presets from the reference north-star table (BASELINE.md)
+    @staticmethod
+    def gpt3_125m():
+        return GPTConfig(hidden_size=768, num_layers=12, num_heads=12)
+
+    @staticmethod
+    def gpt3_350m():
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @staticmethod
+    def gpt3_1_3b():
+        return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                         max_seq_len=2048)
+
+    @staticmethod
+    def gpt3_6_7b():
+        return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                         max_seq_len=2048)
+
+    @staticmethod
+    def gpt3_13b():
+        return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                         max_seq_len=2048)
+
+    def num_params(self) -> int:
+        h, L, v = self.hidden_size, self.num_layers, self.vocab_size
+        per_block = 4 * h * h + 2 * h * self.ffn_hidden_size + 13 * h
+        return v * h + self.max_seq_len * h + L * per_block + 2 * h
+
+    def flops_per_token(self, seq_len=None) -> float:
+        """Training FLOPs/token ≈ 6N + 12·L·h·s (attention term)."""
+        s = seq_len or self.max_seq_len
+        return 6.0 * self.num_params() + 12.0 * self.num_layers * \
+            self.hidden_size * s
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        init = I.Normal(0.0, c.initializer_range)
+        out_init = I.Normal(0.0, c.initializer_range /
+                            math.sqrt(2 * c.num_layers))
+        self.num_heads = c.num_heads
+        self.head_dim = c.hidden_size // c.num_heads
+        self.qkv_proj = ColumnParallelLinear(
+            c.hidden_size, 3 * c.hidden_size, weight_attr=init,
+            gather_output=False)
+        self.out_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, weight_attr=out_init)
+        self.dropout = c.dropout
+        # qkv weight columns interleave q|k|v: shard on out dim stays valid
+        self.qkv_proj.param_shardings = {"weight": P(None, "tp"),
+                                         "bias": P("tp")}
+
+    def forward(self, x):
+        b, s, h = x.shape[0], x.shape[1], x.shape[2]
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv.unbind(2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True, dropout_p=self.dropout,
+            training=self.training)
+        out = out.reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        init = I.Normal(0.0, c.initializer_range)
+        out_init = I.Normal(0.0, c.initializer_range /
+                            math.sqrt(2 * c.num_layers))
+        self.fc_in = ColumnParallelLinear(c.hidden_size, c.ffn_hidden_size,
+                                          weight_attr=init,
+                                          gather_output=False)
+        self.fc_out = RowParallelLinear(c.ffn_hidden_size, c.hidden_size,
+                                        weight_attr=out_init)
+        self.dropout = c.dropout
+
+    def forward(self, x):
+        x = F.gelu(self.fc_in(x), approximate=True)
+        x = self.fc_out(x)
+        return F.dropout(x, self.dropout, training=self.training)
+
+
+class GPTBlock(nn.Layer):
+    """Pre-norm transformer block; identical structure per layer (stackable)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        c = config
+        self.wte = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size,
+            weight_attr=I.Normal(0.0, c.initializer_range))
+        self.wpe = nn.Embedding(
+            c.max_seq_len, c.hidden_size,
+            weight_attr=I.Normal(0.0, c.initializer_range))
+        self.dropout = c.dropout
+
+    def forward(self, tokens):
+        s = tokens.shape[1]
+        pos = arange(0, s, dtype="int64").unsqueeze(0)
+        x = self.wte(tokens) + self.wpe(pos)
+        return F.dropout(x, self.dropout, training=self.training)
+
+
+class GPT(nn.Layer):
+    """Decoder-only GPT. ``forward`` returns logits; ``loss`` computes the
+    shifted next-token cross entropy."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.blocks = nn.LayerList([GPTBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_eps)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, has_bias=False,
+                weight_attr=I.Normal(0.0, config.initializer_range),
+                gather_output=True)
+
+    def forward(self, tokens):
+        x = self.embeddings(tokens)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        if self.config.tie_word_embeddings:
+            from ..tensor import matmul
+
+            return matmul(x, self.embeddings.wte.weight, transpose_y=True)
+        return self.lm_head(x)
+
+    def loss(self, tokens, labels=None):
+        """Next-token LM loss. labels default: tokens shifted left."""
+        logits = self.forward(tokens)
+        if labels is None:
+            lg = logits[:, :-1]
+            lb = tokens[:, 1:]
+        else:
+            lg, lb = logits, labels
+        b, s = lb.shape[0], lb.shape[1]
+        return F.cross_entropy(lg.reshape([b * s, -1]), lb.reshape([b * s]))
+
+
+def gpt_tiny(**kw):
+    """Small config for tests/dryrun."""
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=64, **kw)
+    return GPT(cfg)

@@ -2,15 +2,12 @@
 exercised without TPU hardware (SURVEY.md §4 implication (b): XLA's
 --xla_force_host_platform_device_count replaces the reference's
 "2 subprocesses on localhost" distributed-test trick)."""
-import os
+import jax
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
+# NOTE: env-var routes (JAX_PLATFORMS / XLA_FLAGS) are unreliable here —
+# the axon TPU plugin's sitecustomize interferes; jax.config is authoritative.
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_platforms", "cpu")
 
 # Golden-value tests compare against float64 numpy: use exact fp32 matmuls.
 # (The perf path keeps the platform default — bf16 on the MXU.)
