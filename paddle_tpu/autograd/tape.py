@@ -132,6 +132,22 @@ def apply(fn, *args, n_diff: Optional[int] = None, differentiable: bool = True,
                                              jax.numpy.inexact)):
                 diff_idx.append(i)
 
+    # Inside an outer jax transform (jit/grad/linearize — e.g. the hybrid
+    # trainer tracing the Layer graph, hybrid_gpt.py), the outer AD owns
+    # differentiation: recording a nested jax.vjp here is redundant work and
+    # breaks custom_vjp ops (the outer JVP trace would differentiate through
+    # the custom fwd's pallas_call). Run the op plainly and let the outer
+    # trace see it.
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        out_vals = fn(*vals, **kwargs)
+        outs = _wrap_outputs(out_vals, node=None, name=name)
+        if isinstance(outs, (tuple, list)):
+            for o in outs:
+                o.stop_gradient = not diff_idx
+        else:
+            outs.stop_gradient = not diff_idx
+        return outs
+
     if not diff_idx:
         out_vals = fn(*vals, **kwargs)
         return _wrap_outputs(out_vals, node=None, name=name)

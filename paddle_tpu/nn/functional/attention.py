@@ -23,7 +23,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     XLA still fuses reasonably well)."""
     from ...ops import flash_attention as fa
 
-    use_flash = fa.supported(query.shape, attn_mask, dropout_p)
+    use_flash = fa.supported(query.shape, attn_mask, dropout_p,
+                             kv_seq=key.shape[1])
     if use_flash:
         return fa.flash_attention(query, key, value, causal=is_causal,
                                   scale=scale)

@@ -1,9 +1,31 @@
-"""Flash attention for TPU.
+"""Flash attention — hand-tiled Pallas TPU kernel, fwd + bwd.
 
-New capability vs the reference (SURVEY.md §5: the reference has no fused
-training attention). Round-1 ships the blockwise-softmax jnp formulation
-(XLA fuses it into a flash-style loop under jit); the hand-tiled Pallas
-kernel lands behind the same API.
+New capability vs the reference: the reference has no fused *training*
+attention at all (SURVEY.md §5 "Long-context" — only the inference-side
+multihead_matmul op, reference operators/fused/multihead_matmul_op.cu built by
+framework/ir/multihead_matmul_fuse_pass.cc). Training attention there is an
+unfused python composition over matmul/softmax kernels
+(python/paddle/nn/layer/transformer.py). On TPU the attention kernel is the
+MFU make-or-break (SURVEY.md §7 "Hard parts"), so it is first-class here:
+
+  - forward: online-softmax (flash) tiling. Grid (batch·heads, q_blocks,
+    k_blocks); the k dimension is the innermost ("arbitrary") axis so the
+    running max / denominator / accumulator live in VMEM scratch across k
+    steps. Logits never materialize in HBM: O(S) memory instead of O(S^2).
+  - backward: recompute-based flash backward as two kernels — one accumulates
+    dQ (grid over q blocks), one accumulates dK/dV (grid over k blocks) —
+    using the saved logsumexp and the precomputed row dot delta = sum(dO·O).
+  - causal masking: fully-masked tiles skip all compute (the MXU never sees
+    them) and tiles below the diagonal skip mask evaluation; K/V block DMA
+    for dead tiles is not yet elided (a fori_loop-over-HBM rewrite would —
+    future work).
+
+All kernel math is f32 (MXU accumulates f32 even for bf16 inputs via
+preferred_element_type); outputs are cast back to the input dtype.
+
+The public entry keeps the paddle layout [batch, seq, heads, head_dim]
+(reference python/paddle API convention) and composes with the eager tape via
+jax.custom_vjp. On CPU (tests) the kernel runs in Pallas interpret mode.
 """
 from __future__ import annotations
 
@@ -11,26 +33,379 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ..tensor._helper import apply
 
-_PALLAS_MIN_SEQ = 1 << 30  # Pallas kernel gate; lowered when kernel lands.
+_BLOCK_Q = 512         # default tile edges (capped by seq len). Large tiles
+_BLOCK_K = 512         # amortize grid/DMA overhead; equal q/k tiles under
+                       # causal so the diagonal block covers its own row.
+_SEQ_ALIGN = 128
+_NEG_INF = -1e30
 
 
-def supported(q_shape, attn_mask, dropout_p) -> bool:
-    return False  # jnp path used until the Pallas kernel is enabled
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def _causal_mask(iq, ik, block_q, block_k):
+    qi = iq * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    ki = ik * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qi >= ki
+
+
+def _tile_class(iq, ik, block_q, block_k):
+    """(live, crosses_diagonal) for causal tile (iq, ik)."""
+    q_lo, q_hi = iq * block_q, iq * block_q + block_q - 1
+    k_lo, k_hi = ik * block_k, ik * block_k + block_k - 1
+    live = k_lo <= q_hi
+    diag = live & (k_hi > q_lo)
+    return live, diag
+
+
+def _pick_block(seq, cap):
+    """Largest block edge <= cap that divides seq (128-aligned), else None."""
+    b = min(cap, seq)
+    while b >= _SEQ_ALIGN:
+        if seq % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def supported(q_shape, attn_mask, dropout_p, kv_seq=None) -> bool:
+    """True when the Pallas kernel handles this case; else jnp path."""
+    if attn_mask is not None or dropout_p:
+        return False
+    if len(q_shape) != 4:
+        return False
+    if _pick_block(q_shape[1], _BLOCK_Q) is None:
+        return False
+    if kv_seq is not None and _pick_block(kv_seq, _BLOCK_K) is None:
+        return False
+    return q_shape[3] <= 256
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_ref, l_ref, acc_ref, *, scale, causal, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    def _tile(masked):
+        q = q_ref[0]                                     # [bq, d]
+        k = k_ref[0]                                     # [bk, d]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if masked:
+            mask = _causal_mask(iq, ik, block_q, block_k)
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:]                                # [bq, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)
+        if masked:
+            p = jnp.where(mask, p, 0.0)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = m_cur
+
+    if causal:
+        # tiles fully below the diagonal skip masking; tiles crossing it mask;
+        # tiles fully above are dead (no compute, MXU never sees them)
+        live, diag = _tile_class(iq, ik, block_q, block_k)
+        pl.when(live & ~diag)(lambda: _tile(False))
+        pl.when(diag)(lambda: _tile(True))
+    else:
+        _tile(False)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(l_safe)          # [bq, 1]
+
+
+def _fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    """q3/k3/v3: [BH, S, D] -> (o [BH, S, D], lse [BH, S, 1] f32)."""
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    grid = (bh, nq, nk)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),       # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),       # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * sq * sk * d // (2 if causal else 1),
+            bytes_accessed=2 * (q3.size + k3.size + v3.size) * q3.dtype.itemsize,
+            transcendentals=bh * sq * sk),
+        interpret=_interpret(),
+    )(q3, k3, v3)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _tile(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                                  # [bq, 1]
+        delta = delta_ref[0]                              # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s,
+                          _NEG_INF)
+        p = jnp.exp(s - lse)                              # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        live, diag = _tile_class(iq, ik, block_q, block_k)
+        pl.when(live & ~diag)(lambda: _tile(False))
+        pl.when(diag)(lambda: _tile(True))
+    else:
+        _tile(False)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k):
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _tile(masked):
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                                  # [bq, 1]
+        delta = delta_ref[0]                              # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s,
+                          _NEG_INF)
+        p = jnp.exp(s - lse)                              # [bq, bk]
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # p^T @ do
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale                     # [bq, bk]
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # ds^T @ q
+
+    if causal:
+        live, diag = _tile_class(iq, ik, block_q, block_k)
+        pl.when(live & ~diag)(lambda: _tile(False))
+        pl.when(diag)(lambda: _tile(True))
+    else:
+        _tile(False)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do3):
+    q3, k3, v3, o3, lse = res
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1, keepdims=True)               # [BH, S, 1]
+
+    dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                                block_q=block_q, block_k=block_k)
+    dq = pl.pallas_call(
+        dq_kern,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)[0]
+
+    dkv_kern = functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                                 block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kern,
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper (pure jax level, [B, S, H, D] layout)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_mha(q, k, v, causal, scale):
+    o, _ = _flash_fwd_res(q, k, v, causal, scale)
+    return o
+
+
+def _reshape_in(x):
+    b, s, h, d = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+
+
+def _reshape_out(x3, b, h):
+    bh, s, d = x3.shape
+    return jnp.swapaxes(x3.reshape(b, h, s, d), 1, 2)
+
+
+def _flash_fwd_res(q, k, v, causal, scale):
+    b, sq, h, d = q.shape
+    s_val = scale if scale is not None else 1.0 / (d ** 0.5)
+    sk = k.shape[1]
+    bq = _pick_block(sq, _BLOCK_Q)
+    bk = _pick_block(sk, _BLOCK_K)
+    if bq is None or bk is None:
+        raise ValueError(
+            f"flash_attention needs 128-aligned seq lens, got q={sq} kv={sk}")
+    if causal:
+        if sq != sk:
+            raise ValueError("causal flash_attention requires seq_q == seq_kv")
+        bq = bk = min(bq, bk)
+    q3, k3, v3 = _reshape_in(q), _reshape_in(k), _reshape_in(v)
+    o3, lse = _fwd(q3, k3, v3, s_val, causal, bq, bk)
+    return _reshape_out(o3, b, h), (q3, k3, v3, o3, lse, b, h, s_val, bq, bk)
+
+
+def _flash_mha_bwd(causal, scale, res, do):
+    q3, k3, v3, o3, lse, b, h, s_val, bq, bk = res
+    do3 = _reshape_in(do)
+    dq3, dk3, dv3 = _bwd(s_val, causal, bq, bk, (q3, k3, v3, o3, lse), do3)
+    return (_reshape_out(dq3, b, h), _reshape_out(dk3, b, h),
+            _reshape_out(dv3, b, h))
+
+
+_flash_mha.defvjp(_flash_fwd_res, _flash_mha_bwd)
 
 
 def flash_attention(query, key, value, causal=False, scale=None, name=None):
-    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim]."""
+    """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
+
+    Tape-level entry (Tensor in/out). ``_flash_mha`` is the pure-jax kernel
+    entry used by jitted functional paths (distributed/hybrid_gpt.py).
+    """
     def f(q, k, v):
-        return _mha_reference(q, k, v, causal=causal, scale=scale)
+        return _flash_mha(q, k, v, causal, scale)
 
     return apply(f, query, key, value, name="flash_attention")
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale"))
-def _mha_reference(q, k, v, causal=False, scale=None):
+def mha_reference(q, k, v, causal=False, scale=None):
+    """Unfused reference (tests compare the kernel against this)."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     qt = jnp.swapaxes(q, 1, 2)
@@ -43,3 +418,7 @@ def _mha_reference(q, k, v, causal=False, scale=None):
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("bhst,bhtd->bhsd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
+
+
+# back-compat alias (pre-kernel rounds exposed the reference as the impl)
+_mha_reference = mha_reference
