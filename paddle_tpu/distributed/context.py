@@ -1,0 +1,52 @@
+"""Trace-time distributed context.
+
+The strategy compiler / hybrid trainers set this scope while tracing the
+model so that layers (e.g. GPTAttention) can dispatch to mesh-aware
+implementations (ring attention over 'sp') without threading the mesh
+through every ``forward`` signature. The reference threads the analogous
+information through per-rank rewritten programs + global collective ring
+ids (reference: fleet meta-optimizers inserting c_* ops keyed by ring_id,
+meta_optimizers/common.py); here it is a trace-scoped (mesh, axis) pair.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Tuple
+
+from jax.sharding import Mesh
+
+_SP: Optional[Tuple[Mesh, str, bool]] = None
+
+
+@contextlib.contextmanager
+def sequence_parallel_scope(mesh: Mesh, axis_name: str = "sp"):
+    """Within this scope, attention layers use ring attention over
+    ``axis_name`` (when the axis is larger than 1)."""
+    global _SP
+    prev = _SP
+    _SP = (mesh, axis_name, False) if mesh.shape.get(axis_name, 1) > 1 \
+        else None
+    try:
+        yield
+    finally:
+        _SP = prev
+
+
+@contextlib.contextmanager
+def manual_sequence_parallel_scope():
+    """Marks that the surrounding code is ALREADY manual over the sp axis
+    (e.g. inside the pipeline's shard_map, distributed/pipeline.py) — the
+    attention layer then calls the inside-shard_map ring directly instead
+    of opening a nested shard_map over the same axis."""
+    global _SP
+    prev = _SP
+    if prev is not None:
+        _SP = (prev[0], prev[1], True)
+    try:
+        yield
+    finally:
+        _SP = prev
+
+
+def current_sequence_parallel() -> Optional[Tuple[Mesh, str, bool]]:
+    return _SP

@@ -148,13 +148,33 @@ class GPTHybridTrainer:
         other_tensors = [self._name2tensor[n] for n in self.other_names]
         blk0_tensors = [self._name2tensor[f"blocks.0.{s}"]
                         for s in self.block_suffixes]
+        sp = self.mesh.shape.get("sp", 1)
+
+        def seq_constraint(h):
+            """Keep activations sequence-sharded between ring attentions.
+            Skipped for bf16 on XLA:CPU (tests): resharding constraints on
+            bf16 trip a CPU-backend crash; TPU is unaffected."""
+            if sp > 1 and not (jax.default_backend() == "cpu"
+                               and h.dtype == jnp.bfloat16):
+                return jax.lax.with_sharding_constraint(
+                    h, NamedSharding(self.mesh, P("dp", "sp", None)))
+            return h
+
+        from . import context as dctx
+        manual_sp = sp > 1 and self.pp > 1
 
         def block_apply(stage_local, x):
             """Apply one stage's lps blocks (lax.scan over layers)."""
             def one_block(h, layer_params):
                 vals = [layer_params[s] for s in self.block_suffixes]
                 with _swapped_state(blk0_tensors, vals):
-                    out = model.blocks[0](Tensor(h))._value
+                    if manual_sp:
+                        # pipeline shard_map is manual over sp too:
+                        # attention runs the in-context ring directly
+                        with dctx.manual_sequence_parallel_scope():
+                            out = model.blocks[0](Tensor(h))._value
+                    else:
+                        out = model.blocks[0](Tensor(h))._value
                 return out
 
             if self.remat:
@@ -166,12 +186,16 @@ class GPTHybridTrainer:
             out, _ = jax.lax.scan(body, x, stage_local)
             return out
 
-        with _swapped_state(other_tensors, other_cast):
+        with _swapped_state(other_tensors, other_cast), \
+                dctx.sequence_parallel_scope(self.mesh):
             with rng_mod.key_scope(key):
                 x = model.embeddings(Tensor(tokens))._value
+                x = seq_constraint(x)
                 x = pipeline_apply(self.mesh, block_apply, block_cast, x,
-                                   self.n_micro)
-                x = model.ln_f(Tensor(x))
+                                   self.n_micro,
+                                   sp_axis="sp" if manual_sp else None)
+                x = Tensor(seq_constraint(x))
+                x = model.ln_f(x)
                 if cfg.tie_word_embeddings:
                     from ..tensor import matmul
 
@@ -239,10 +263,12 @@ class GPTHybridTrainer:
                       for k, v in self.block_opt_specs.items()}
         oth_opt_sh = [{kk: ns(vv) for kk, vv in d.items()}
                       for d in self.other_opt_specs]
+        tok_spec = P("dp", "sp") if mesh.shape.get("sp", 1) > 1 else P("dp")
+        self._token_sharding = ns(tok_spec)
         self._step_fn = jax.jit(
             step_fn,
             in_shardings=(blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
-                          ns(P("dp")), None, None, None),
+                          self._token_sharding, None, None, None),
             out_shardings=(ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh),
             donate_argnums=(0, 1, 2, 3))
 
@@ -252,7 +278,7 @@ class GPTHybridTrainer:
         self._step += 1
         v = tokens._value if isinstance(tokens, Tensor) else \
             jnp.asarray(tokens)
-        v = jax.device_put(v, NamedSharding(self.mesh, P("dp")))
+        v = jax.device_put(v, self._token_sharding)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         loss, self.block_vals, self.other_vals, self.block_opt, \
             self.other_opt = self._step_fn(

@@ -40,14 +40,24 @@ def stack_block_params(block_param_lists):
 
 
 def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
-                   x, n_micro: int, pp_axis: str = "pp"):
+                   x, n_micro: int, pp_axis: str = "pp",
+                   sp_axis: str = None):
     """Run x [batch, ...] through pp×layers_per_stage stacked blocks.
 
     stage_fn(params_one_stage, x_mb) -> y_mb applies one stage's layers to
     one microbatch. stacked_params leaves are [pp, ...]; x is split into
     n_micro microbatches along dim 0.
+
+    sp_axis: when set (sequence parallelism composed with pipeline), the
+    shard_map is manual over BOTH axes — x's seq dim (dim 1) stays sharded
+    over sp_axis and stage_fn sees the local sequence shard (its attention
+    must then run the in-context ring, see models/gpt.py). Nested
+    shard_maps over the same axis are rejected by the partitioner, so
+    manual-over-both is the composition mechanism.
     """
     pp = mesh.shape[pp_axis]
+    if sp_axis is not None and mesh.shape.get(sp_axis, 1) <= 1:
+        sp_axis = None
     if pp == 1:
         sliced = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
         mbs = _to_microbatches(x, n_micro)
@@ -64,18 +74,35 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
 
     param_specs = jax.tree_util.tree_map(
         lambda _: P(pp_axis), stacked_params)
+    manual = frozenset({pp_axis} if sp_axis is None else {pp_axis, sp_axis})
+    # params are pp-sharded but REPLICATED over sp: the shard_map transpose
+    # psums their cotangents over sp — promote that boundary too on CPU
+    # (same XLA:CPU bf16-collective crash as above; TPU unaffected).
+    param_f32 = boundary_f32 and sp_axis is not None
+
+    def _pf(a):
+        return a.astype(jnp.float32) if (param_f32
+                                         and a.dtype == jnp.bfloat16) else a
+    # xs is [n_micro, mb, seq, ...]: seq (dim 2) sharded over sp when set
+    x_spec = P() if sp_axis is None else P(None, None, sp_axis)
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(param_specs, P()), out_specs=P(),
-             check_vma=False, axis_names=frozenset({pp_axis}))
+             in_specs=(param_specs, x_spec), out_specs=x_spec,
+             check_vma=False, axis_names=manual)
     def pipelined(params, xs):
         # params leaves: [1, ...] local slice; xs: [n_micro, mb, ...]
-        local = jax.tree_util.tree_map(lambda a: a[0], params)
+        local = jax.tree_util.tree_map(
+            lambda a: a[0].astype(compute_dtype)
+            if (param_f32 and a.dtype == jnp.float32
+                and compute_dtype == jnp.bfloat16) else a[0], params)
         stage = jax.lax.axis_index(pp_axis)
         n_ticks = n_micro + pp - 1
         mb_shape = xs.shape[1:]
-        state0 = jnp.zeros(mb_shape, compute_dtype)
-        outputs0 = jnp.zeros(xs.shape, compute_dtype)
+        # carry dtype: f32 on CPU+bf16 so the inter-stage ppermute (a
+        # collective inside the manual region) never runs in bf16
+        carry_dtype = jnp.float32 if boundary_f32 else compute_dtype
+        state0 = jnp.zeros(mb_shape, carry_dtype)
+        outputs0 = jnp.zeros(xs.shape, carry_dtype)
 
         def tick(carry, t):
             prev_out, outputs = carry
@@ -88,9 +115,10 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
             inp = jnp.where(stage == 0,
                             jax.lax.dynamic_index_in_dim(
                                 xs, mb_idx, 0,
-                                keepdims=False).astype(compute_dtype),
+                                keepdims=False).astype(carry_dtype),
                             recv)
-            out = stage_fn(local, inp)
+            out = stage_fn(local, inp.astype(compute_dtype)) \
+                .astype(carry_dtype)
             out_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
             valid = (t >= pp - 1)
             cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
@@ -111,6 +139,8 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     mbs = _to_microbatches(x, n_micro)
     if boundary_f32:
         mbs = mbs.astype(jnp.float32)
+    if param_f32:
+        stacked_params = jax.tree_util.tree_map(_pf, stacked_params)
     out = pipelined(stacked_params, mbs)
     return _from_microbatches(out, x.shape).astype(compute_dtype)
 

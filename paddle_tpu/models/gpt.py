@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
+from ..distributed import context as _dctx
 from ..distributed.parallel_layers import (ColumnParallelLinear,
                                            RowParallelLinear,
                                            VocabParallelEmbedding)
@@ -107,9 +108,37 @@ class GPTAttention(nn.Layer):
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(2)
-        out = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True, dropout_p=self.dropout,
-            training=self.training)
+        sp = _dctx.current_sequence_parallel()
+        dropout_active = bool(self.dropout) and self.training
+        if sp is not None:
+            # sequence-parallel: ring attention over the 'sp' mesh axis
+            # (ops/ring_attention.py) — seq dim stays sharded end to end.
+            # Attention-prob dropout is not expressible in the ring (probs
+            # never materialize): under sp it must be off. Inside the
+            # manual region there is NO correct fallback (plain attention
+            # would be block-diagonal over the local shard), so raise.
+            from ..ops.ring_attention import (_ring_mha,
+                                              sequence_parallel_attention)
+            from ..tensor._helper import apply
+
+            mesh, axis, manual = sp
+            if dropout_active:
+                raise NotImplementedError(
+                    "attention-probability dropout is not supported under "
+                    "sequence parallelism (ring attention); set "
+                    "GPTConfig.dropout=0 or sp_degree=1")
+            if manual:
+                # already inside a shard_map manual over `axis`
+                fn = lambda q_, k_, v_: _ring_mha(q_, k_, v_, True, None,
+                                                  axis)
+            else:
+                fn = lambda q_, k_, v_: sequence_parallel_attention(
+                    q_, k_, v_, mesh, causal=True, axis_name=axis)
+            out = apply(fn, q, k, v, name="ring_attention")
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout,
+                training=self.training)
         out = out.reshape([b, s, h])
         return self.out_proj(out)
 

@@ -281,13 +281,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do3):
+def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
+         out_dtype=None):
+    """delta/out_dtype are overridable for the ring-attention caller
+    (ops/ring_attention.py): there delta is a property of the GLOBAL
+    output row (computed once outside the ring) and per-chunk partials
+    must come back f32 so the ring accumulation doesn't round."""
     q3, k3, v3, o3, lse = res
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     nq, nk = sq // block_q, sk // block_k
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
-                    axis=-1, keepdims=True)               # [BH, S, 1]
+    if delta is None:
+        delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                        axis=-1, keepdims=True)           # [BH, S, 1]
+    dq_dtype = out_dtype or q3.dtype
+    dk_dtype = out_dtype or k3.dtype
+    dv_dtype = out_dtype or v3.dtype
 
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k)
@@ -303,7 +312,7 @@ def _bwd(scale, causal, block_q, block_k, res, do3):
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q3.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), dq_dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -328,8 +337,8 @@ def _bwd(scale, causal, block_q, block_k, res, do3):
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), dk_dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), dv_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
