@@ -1,0 +1,255 @@
+// Native host-side data engine: multi-threaded shuffled batch gather with
+// prefetch, sharding, and strided (overlapping) row views.
+//
+// TPU-native redesign of the reference's C++ data-ingestion machinery
+// (SURVEY.md §2 N21: framework/data_feed.{h,cc} MultiSlotDataFeed worker
+// threads + framework/data_set.cc shuffle, and N34 operators/reader/
+// buffered_reader.cc GPU-prefetch): instead of per-op reader graph nodes
+// feeding a Scope, this is a standalone engine the Python DataLoader
+// drives through a C ABI (ctypes — no pybind dependency). The gather/
+// shuffle/copy work runs on C++ threads with the GIL released, so host
+// data prep overlaps device compute; batches land in a ring of
+// preallocated staging buffers (the "pinned arena" role of the
+// reference's CUDAPinnedAllocator, N8) that jax.device_put consumes
+// zero-copy from numpy views.
+//
+// Strided rows: each array has independent base/stride/row_bytes, so a
+// "sample" may be an OVERLAPPING window into a flat buffer — which makes
+// a GPT token stream (windows of seq_len+1 int32s at stride tokens*4
+// over one mmap'd corpus) a zero-copy dataset, no materialized windows.
+//
+// Ordering: workers gather batches in parallel; a reorder stage delivers
+// them in logical batch order so shuffle=False iteration is
+// deterministic (eval / loss-curve parity).
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <random>
+#include <thread>
+#include <vector>
+
+#include "queue.h"
+
+namespace ptl {
+
+struct ArraySpec {
+  const uint8_t* base;
+  int64_t stride;     // bytes between consecutive samples
+  int64_t row_bytes;  // bytes copied per sample
+};
+
+struct Task {
+  int64_t seq;                   // logical batch index (for reorder)
+  std::vector<int64_t> indices;  // sample ids
+};
+
+struct Slot {
+  std::vector<std::vector<uint8_t>> buffers;  // one per array
+  int64_t rows = 0;
+  int64_t seq = -1;
+};
+
+class Loader {
+ public:
+  Loader(std::vector<ArraySpec> arrays, int64_t n_samples,
+         int64_t batch_size, bool shuffle, uint64_t seed, bool drop_last,
+         int num_shards, int shard_id, int prefetch_depth, int num_workers,
+         int64_t epochs)
+      : arrays_(std::move(arrays)),
+        n_samples_(n_samples),
+        batch_(batch_size),
+        shuffle_(shuffle),
+        seed_(seed),
+        drop_last_(drop_last),
+        num_shards_(num_shards < 1 ? 1 : num_shards),
+        shard_id_(shard_id),
+        epochs_(epochs),
+        tasks_(static_cast<size_t>(prefetch_depth)),
+        done_(static_cast<size_t>(prefetch_depth)),
+        free_(static_cast<size_t>(prefetch_depth) + 1) {
+    int depth = prefetch_depth < 2 ? 2 : prefetch_depth;
+    slots_.resize(static_cast<size_t>(depth) + 1);
+    for (auto& s : slots_) {
+      s.buffers.resize(arrays_.size());
+      for (size_t a = 0; a < arrays_.size(); ++a)
+        s.buffers[a].resize(static_cast<size_t>(batch_) *
+                            static_cast<size_t>(arrays_[a].row_bytes));
+    }
+    for (size_t i = 0; i < slots_.size(); ++i)
+      free_.Push(static_cast<int>(i));
+    producer_ = std::thread(&Loader::Produce, this);
+    int nw = num_workers < 1 ? 1 : num_workers;
+    for (int w = 0; w < nw; ++w)
+      workers_.emplace_back(&Loader::Work, this);
+  }
+
+  ~Loader() {
+    tasks_.Close();
+    done_.Close();
+    free_.Close();
+    if (producer_.joinable()) producer_.join();
+    for (auto& w : workers_) w.join();
+  }
+
+  // Returns slot id (>=0) or -1 at end of data.
+  int Next(void** out_ptrs, int64_t* out_rows) {
+    std::pair<int64_t, int> item;  // (seq, slot)
+    while (true) {
+      {
+        // deliver from the reorder buffer first
+        std::lock_guard<std::mutex> lk(reorder_mu_);
+        auto it = reorder_.find(next_seq_);
+        if (it != reorder_.end()) {
+          int slot = it->second;
+          reorder_.erase(it);
+          ++next_seq_;
+          Slot& s = slots_[static_cast<size_t>(slot)];
+          for (size_t a = 0; a < arrays_.size(); ++a)
+            out_ptrs[a] = s.buffers[a].data();
+          *out_rows = s.rows;
+          return slot;
+        }
+      }
+      if (!done_.Pop(&item)) return -1;
+      std::lock_guard<std::mutex> lk(reorder_mu_);
+      reorder_[item.first] = item.second;
+    }
+  }
+
+  void Release(int slot) { free_.Push(slot); }
+
+ private:
+  void Produce() {
+    // shard: contiguous equal split of the (shuffled) epoch order, same
+    // rule as the reference DistributedBatchSampler (padded to even)
+    int64_t per_shard = (n_samples_ + num_shards_ - 1) / num_shards_;
+    int64_t seq = 0;
+    for (int64_t ep = 0; epochs_ < 0 || ep < epochs_; ++ep) {
+      std::vector<int64_t> order(static_cast<size_t>(n_samples_));
+      for (int64_t i = 0; i < n_samples_; ++i)
+        order[static_cast<size_t>(i)] = i;
+      if (shuffle_) {
+        std::mt19937_64 g(seed_ + static_cast<uint64_t>(ep));
+        for (int64_t i = n_samples_ - 1; i > 0; --i) {
+          int64_t j = static_cast<int64_t>(
+              g() % static_cast<uint64_t>(i + 1));
+          std::swap(order[static_cast<size_t>(i)],
+                    order[static_cast<size_t>(j)]);
+        }
+      }
+      std::vector<int64_t> mine;
+      for (int64_t k = 0; k < per_shard; ++k) {
+        int64_t pos = static_cast<int64_t>(shard_id_) * per_shard + k;
+        mine.push_back(order[static_cast<size_t>(pos % n_samples_)]);
+      }
+      for (size_t ofs = 0; ofs < mine.size(); ofs += batch_) {
+        size_t end = ofs + static_cast<size_t>(batch_);
+        if (end > mine.size()) {
+          if (drop_last_) break;
+          end = mine.size();
+        }
+        Task t;
+        t.seq = seq++;
+        t.indices.assign(mine.begin() + static_cast<int64_t>(ofs),
+                         mine.begin() + static_cast<int64_t>(end));
+        if (!tasks_.Push(std::move(t))) return;
+      }
+    }
+    total_batches_.store(seq);
+    producer_done_.store(true);
+    MaybeFinish();
+  }
+
+  void Work() {
+    Task t;
+    while (true) {
+      // acquire the slot BEFORE the task: guarantees the worker holding
+      // the lowest undelivered batch already owns a buffer, so the
+      // reorder stage can never deadlock the slot pool
+      int slot;
+      if (!free_.Pop(&slot)) return;
+      if (!tasks_.Pop(&t)) {
+        free_.Push(slot);
+        return;
+      }
+      Slot& s = slots_[static_cast<size_t>(slot)];
+      s.rows = static_cast<int64_t>(t.indices.size());
+      s.seq = t.seq;
+      for (size_t a = 0; a < arrays_.size(); ++a) {
+        const ArraySpec& sp = arrays_[a];
+        uint8_t* dst = s.buffers[a].data();
+        for (size_t r = 0; r < t.indices.size(); ++r)
+          std::memcpy(dst + static_cast<int64_t>(r) * sp.row_bytes,
+                      sp.base + t.indices[r] * sp.stride,
+                      static_cast<size_t>(sp.row_bytes));
+      }
+      done_.Push({t.seq, slot});
+      delivered_.fetch_add(1);
+      MaybeFinish();
+    }
+  }
+
+  void MaybeFinish() {
+    if (producer_done_.load() &&
+        delivered_.load() >= total_batches_.load())
+      done_.Close();
+  }
+
+  std::vector<ArraySpec> arrays_;
+  int64_t n_samples_, batch_;
+  bool shuffle_;
+  uint64_t seed_;
+  bool drop_last_;
+  int num_shards_, shard_id_;
+  int64_t epochs_;
+  std::vector<Slot> slots_;
+  BoundedQueue<Task> tasks_;
+  BoundedQueue<std::pair<int64_t, int>> done_;
+  BoundedQueue<int> free_;
+  std::map<int64_t, int> reorder_;
+  std::mutex reorder_mu_;
+  int64_t next_seq_ = 0;
+  std::atomic<int64_t> total_batches_{INT64_MAX};
+  std::atomic<int64_t> delivered_{0};
+  std::atomic<bool> producer_done_{false};
+  std::thread producer_;
+  std::vector<std::thread> workers_;
+};
+
+}  // namespace ptl
+
+extern "C" {
+
+int64_t ptl_version() { return 1; }
+
+void* ptl_loader_create(int n_arrays, const void** bases,
+                        const int64_t* strides, const int64_t* row_bytes,
+                        int64_t n_samples, int64_t batch_size, int shuffle,
+                        uint64_t seed, int drop_last, int num_shards,
+                        int shard_id, int prefetch_depth, int num_workers,
+                        int64_t epochs) {
+  std::vector<ptl::ArraySpec> arrs;
+  arrs.reserve(static_cast<size_t>(n_arrays));
+  for (int i = 0; i < n_arrays; ++i)
+    arrs.push_back({static_cast<const uint8_t*>(bases[i]), strides[i],
+                    row_bytes[i]});
+  return new ptl::Loader(std::move(arrs), n_samples, batch_size,
+                         shuffle != 0, seed, drop_last != 0, num_shards,
+                         shard_id, prefetch_depth, num_workers, epochs);
+}
+
+int ptl_loader_next(void* loader, void** out_ptrs, int64_t* out_rows) {
+  return static_cast<ptl::Loader*>(loader)->Next(out_ptrs, out_rows);
+}
+
+void ptl_loader_release(void* loader, int slot) {
+  static_cast<ptl::Loader*>(loader)->Release(slot);
+}
+
+void ptl_loader_destroy(void* loader) {
+  delete static_cast<ptl::Loader*>(loader);
+}
+
+}  // extern "C"

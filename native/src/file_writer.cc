@@ -1,0 +1,129 @@
+// Async checkpoint stream writer: enqueue buffers from the training
+// thread, a background thread performs write() syscalls, close() joins
+// and fsyncs. This is the native building block under the framework's
+// async checkpointing (SURVEY.md §5 checkpoint/resume: the reference has
+// only synchronous save ops, operators/save_op.cc + fluid/io.py; async
+// multi-host checkpoint is a designed-fresh capability). A rolling
+// CRC32 of everything written is returned at close for integrity
+// checking on load.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "queue.h"
+
+namespace ptl {
+
+static uint32_t* Crc32Table() {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  return table;
+}
+
+static uint32_t Crc32(uint32_t crc, const uint8_t* p, size_t n) {
+  uint32_t* t = Crc32Table();
+  crc ^= 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = t[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+class Writer {
+ public:
+  explicit Writer(const char* path, int depth)
+      : q_(static_cast<size_t>(depth < 2 ? 2 : depth)) {
+    f_ = std::fopen(path, "wb");
+    if (f_) thread_ = std::thread(&Writer::Run, this);
+  }
+
+  bool ok() const { return f_ != nullptr; }
+
+  bool Write(const void* data, int64_t n) {
+    if (!f_) return false;
+    std::vector<uint8_t> buf(static_cast<size_t>(n));
+    std::memcpy(buf.data(), data, static_cast<size_t>(n));
+    return q_.Push(std::move(buf));
+  }
+
+  // Joins the writer thread; returns total bytes, or -1 on any IO error.
+  int64_t Close(uint32_t* crc_out) {
+    q_.Close();
+    if (thread_.joinable()) thread_.join();
+    if (f_) {
+      if (std::fflush(f_) != 0) error_ = true;
+      std::fclose(f_);
+      f_ = nullptr;
+    }
+    if (crc_out) *crc_out = crc_;
+    return error_ ? -1 : total_;
+  }
+
+  ~Writer() { Close(nullptr); }
+
+ private:
+  void Run() {
+    std::vector<uint8_t> buf;
+    while (q_.Pop(&buf)) {
+      if (std::fwrite(buf.data(), 1, buf.size(), f_) != buf.size()) {
+        error_ = true;
+        // close the queue so producer Push() fails fast instead of
+        // blocking forever once the bounded queue fills
+        q_.Close();
+        break;
+      }
+      crc_ = Crc32(crc_, buf.data(), buf.size());
+      total_ += static_cast<int64_t>(buf.size());
+    }
+  }
+
+  std::FILE* f_ = nullptr;
+  BoundedQueue<std::vector<uint8_t>> q_;
+  std::thread thread_;
+  int64_t total_ = 0;
+  uint32_t crc_ = 0;
+  bool error_ = false;
+};
+
+}  // namespace ptl
+
+extern "C" {
+
+void* ptl_writer_open(const char* path, int depth) {
+  auto* w = new ptl::Writer(path, depth);
+  if (!w->ok()) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+
+int ptl_writer_write(void* writer, const void* data, int64_t n) {
+  return static_cast<ptl::Writer*>(writer)->Write(data, n) ? 0 : -1;
+}
+
+int64_t ptl_writer_close(void* writer, uint32_t* crc_out) {
+  auto* w = static_cast<ptl::Writer*>(writer);
+  int64_t total = w->Close(crc_out);
+  delete w;
+  return total;
+}
+
+uint32_t ptl_crc32(uint32_t crc, const void* data, int64_t n) {
+  return ptl::Crc32(crc, static_cast<const uint8_t*>(data),
+                    static_cast<size_t>(n));
+}
+
+}  // extern "C"

@@ -1,0 +1,55 @@
+// Bounded blocking MPMC queue — the channel the data engine's stages
+// communicate through. TPU-native analogue of the reference's
+// paddle/fluid/framework/blocking_queue.h + channel.h (the DataFeed
+// plumbing, SURVEY.md §2 N21): same close-semantics (Pop returns false
+// once closed AND drained) so downstream stages terminate cleanly.
+#pragma once
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+
+namespace ptl {
+
+template <typename T>
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap) : cap_(cap) {}
+
+  // Returns false if the queue was closed before the push happened.
+  bool Push(T v) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return closed_ || q_.size() < cap_; });
+    if (closed_) return false;
+    q_.push_back(std::move(v));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  // Returns false when closed and drained.
+  bool Pop(T* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return closed_ || !q_.empty(); });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  bool closed_ = false;
+  std::deque<T> q_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+};
+
+}  // namespace ptl

@@ -1,0 +1,147 @@
+"""ctypes loader for the native runtime library (native/ — C++).
+
+The reference exposes its C++ core through a pybind11 module (reference:
+paddle/fluid/pybind/pybind.cc, SURVEY.md §2 N38); here the native surface
+is a minimal C ABI loaded with ctypes — no build-time Python dependency,
+and the library is compiled on demand from native/ with the system
+toolchain. Everything degrades gracefully: callers check ``available()``
+and fall back to pure-Python paths.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SO = os.path.join(_REPO, "paddle_tpu", "_native", "libptl_runtime.so")
+_SRC = os.path.join(_REPO, "native")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-s"], cwd=_SRC, capture_output=True,
+                           timeout=300)
+        return r.returncode == 0 and os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def _newer_than_lib(path: str) -> bool:
+    try:
+        return os.path.getmtime(path) > os.path.getmtime(_SO)
+    except OSError:
+        return False
+
+
+def _sources_changed() -> bool:
+    src_dir = os.path.join(_SRC, "src")
+    try:
+        names = os.listdir(src_dir)
+    except OSError:
+        return False
+    return any(_newer_than_lib(os.path.join(src_dir, n)) for n in names)
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _tried:
+            return None
+        _tried = True
+        if (not os.path.exists(_SO) or _sources_changed()) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.ptl_version.restype = ctypes.c_int64
+        lib.ptl_loader_create.restype = ctypes.c_void_p
+        lib.ptl_loader_create.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int64]
+        lib.ptl_loader_next.restype = ctypes.c_int
+        lib.ptl_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.ptl_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptl_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptl_writer_open.restype = ctypes.c_void_p
+        lib.ptl_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.ptl_writer_write.restype = ctypes.c_int
+        lib.ptl_writer_write.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_int64]
+        lib.ptl_writer_close.restype = ctypes.c_int64
+        lib.ptl_writer_close.argtypes = [ctypes.c_void_p,
+                                         ctypes.POINTER(ctypes.c_uint32)]
+        lib.ptl_crc32.restype = ctypes.c_uint32
+        lib.ptl_crc32.argtypes = [ctypes.c_uint32, ctypes.c_void_p,
+                                  ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+class AsyncWriter:
+    """Background-thread file writer (native/src/file_writer.cc). Write
+    calls return immediately; close() joins and returns (bytes, crc32)."""
+
+    def __init__(self, path: str, depth: int = 8):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._res = None
+        self._h = lib.ptl_writer_open(str(path).encode(), depth)
+        if not self._h:
+            raise OSError(f"cannot open {path} for writing")
+
+    def write(self, data) -> None:
+        buf = memoryview(data).cast("B")
+        arr = (ctypes.c_char * len(buf)).from_buffer_copy(buf)
+        if self._lib.ptl_writer_write(self._h, arr, len(buf)) != 0:
+            raise OSError("native writer failed")
+
+    def close(self):
+        if self._h is None:
+            if self._res is None:
+                raise OSError("native writer IO error (earlier close failed)")
+            return self._res
+        crc = ctypes.c_uint32(0)
+        total = self._lib.ptl_writer_close(self._h, ctypes.byref(crc))
+        self._h = None
+        if total < 0:
+            raise OSError("native writer IO error on close")
+        self._res = (int(total), int(crc.value))
+        return self._res
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+def crc32(data, crc: int = 0) -> int:
+    """Rolling CRC32 matching the native writer's checksum. zlib's C
+    implementation computes the identical polynomial, so use it directly
+    (and it needs no native library)."""
+    import zlib
+
+    return zlib.crc32(memoryview(data).cast("B"), crc)

@@ -159,6 +159,7 @@ class BatchSampler(Sampler):
                  batch_size=1, drop_last=False):
         self.batch_size = batch_size
         self.drop_last = drop_last
+        self.shuffle = bool(shuffle)
         if sampler is not None:
             self.sampler = sampler
         elif shuffle:
@@ -301,22 +302,45 @@ class _DataLoaderIter:
         return self
 
 
+class _NativeIterAdapter:
+    """Adapts NativeArrayLoader output (numpy tuples) to the DataLoader
+    contract (tuples of Tensors)."""
+
+    def __init__(self, nat):
+        self._nat = nat
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            batch = next(self._nat)
+        except StopIteration:
+            self._nat.close()
+            raise
+        return tuple(Tensor(b) for b in batch)
+
+
 class DataLoader:
     """reference: fluid/reader.py DataLoader(:149). Thread-prefetch instead of
     the reference's multiprocess+mmap pipeline (jax arrays are not fork-safe;
-    worker threads release the GIL during numpy/host IO)."""
+    worker threads release the GIL during numpy/host IO). Array-backed
+    datasets are served by the native C++ engine (io/native_engine.py)
+    when its semantics match; ``use_native_engine=False`` opts out."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
-                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 use_native_engine=True):
         self.dataset = dataset
         self.batch_size = batch_size
         self.drop_last = drop_last
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.collate_fn = collate_fn or default_collate_fn
+        self.use_native_engine = use_native_engine
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if not self._iterable_mode:
             self.batch_sampler = batch_sampler or BatchSampler(
@@ -326,7 +350,52 @@ class DataLoader:
             self.batch_sampler = None
 
     def __iter__(self):
-        return _DataLoaderIter(self)
+        it = self._try_native_iter()
+        return it if it is not None else _DataLoaderIter(self)
+
+    def _try_native_iter(self):
+        """Use the C++ data engine (core/native.py + native/) when the
+        configuration maps onto it exactly: an array-backed dataset,
+        default collate, plain (Random|Sequence)-sampled BatchSampler.
+        Anything else falls back to the Python path."""
+        if self.use_native_engine is False:
+            return None
+        if self._iterable_mode or self.collate_fn is not default_collate_fn:
+            return None
+        bs = self.batch_sampler
+        if type(bs) is not BatchSampler or \
+                type(bs.sampler) not in (RandomSampler, SequenceSampler):
+            return None
+        if isinstance(bs.sampler, RandomSampler) and (
+                bs.sampler.replacement or bs.sampler._num_samples):
+            return None
+        if type(self.dataset) is not TensorDataset:
+            return None
+        try:
+            from ..core import native as _native
+
+            if not _native.available():
+                return None
+            from .native_engine import NativeArrayLoader
+
+            arrays = [np.asarray(t._value) if isinstance(t, Tensor)
+                      else np.asarray(t) for t in self.dataset.tensors]
+            # only plain fixed-size buffer dtypes can be byte-gathered
+            if any(a.dtype.hasobject or a.dtype.kind not in "biufc"
+                   for a in arrays):
+                return None
+            # the sampler object, not the stored kwarg, decides the order
+            # (an explicitly passed RandomSampler means shuffle)
+            shuffle = isinstance(bs.sampler, RandomSampler)
+            seed = int(rng._numpy_generator.randint(0, 2**31 - 1))
+            nat = NativeArrayLoader(
+                arrays, bs.batch_size, shuffle=shuffle, seed=seed,
+                drop_last=bs.drop_last,
+                prefetch_depth=max(2, self.prefetch_factor),
+                num_workers=max(1, self.num_workers), epochs=1)
+        except Exception:
+            return None
+        return _NativeIterAdapter(nat)
 
     def __len__(self):
         if self._iterable_mode:

@@ -1,0 +1,143 @@
+"""Python face of the native (C++) data engine.
+
+``NativeArrayLoader`` drives native/src/data_engine.cc over ctypes: the
+shuffle, shard, gather and staging copies all happen on C++ threads with
+the GIL released, overlapping host data prep with device compute — the
+role DataFeed worker threads + BufferedReader played in the reference
+(SURVEY.md §2 N21/N34). ``token_windows`` exposes the strided-row trick:
+a flat token corpus (e.g. np.memmap of an int32 file) becomes a dataset
+of OVERLAPPING [seq_len+1] windows without materializing them — the GPT
+pretraining input pipeline.
+"""
+from __future__ import annotations
+
+import ctypes
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core import native as _native
+
+
+class NativeArrayLoader:
+    """Iterate [batch, ...] numpy batches gathered by the C++ engine.
+
+    arrays: same-length-dim0 C-contiguous numpy arrays (one per field).
+    zero_copy: yield views into the engine's staging slots (valid until
+    ``prefetch_depth - 1`` further batches have been drawn) instead of
+    copies. Default False: yield owned copies.
+    """
+
+    def __init__(self, arrays: Sequence[np.ndarray], batch_size: int,
+                 shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = False, num_shards: int = 1,
+                 shard_id: int = 0, prefetch_depth: int = 4,
+                 num_workers: int = 2, epochs: int = 1,
+                 zero_copy: bool = False,
+                 row_bytes: Optional[List[int]] = None,
+                 strides: Optional[List[int]] = None,
+                 n_samples: Optional[int] = None,
+                 out_shapes: Optional[List[tuple]] = None):
+        lib = _native.get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = lib
+        self._arrays = [np.ascontiguousarray(a) if strides is None else a
+                        for a in arrays]
+        n = len(self._arrays)
+        if n_samples is None:
+            n_samples = len(self._arrays[0])
+            for a in self._arrays:
+                if len(a) != n_samples:
+                    raise ValueError("arrays disagree on dim0")
+        self.n_samples = int(n_samples)
+        bases = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value
+              for a in self._arrays])
+        if row_bytes is None:
+            row_bytes = [int(np.prod(a.shape[1:], dtype=np.int64) *
+                             a.itemsize) for a in self._arrays]
+        if strides is None:
+            strides = list(row_bytes)
+        self._row_bytes = row_bytes
+        rb = (ctypes.c_int64 * n)(*row_bytes)
+        st = (ctypes.c_int64 * n)(*strides)
+        if out_shapes is None:
+            out_shapes = [tuple(a.shape[1:]) for a in self._arrays]
+        self._out_shapes = out_shapes
+        self._dtypes = [a.dtype for a in self._arrays]
+        self.batch_size = int(batch_size)
+        self._zero_copy = zero_copy
+        self._depth = max(2, int(prefetch_depth))
+        self._h = lib.ptl_loader_create(
+            n, bases, st, rb, self.n_samples, self.batch_size,
+            int(bool(shuffle)), ctypes.c_uint64(seed & (2**64 - 1)),
+            int(bool(drop_last)), int(num_shards), int(shard_id),
+            self._depth, int(num_workers), int(epochs))
+        if not self._h:
+            raise RuntimeError("native loader creation failed")
+        self._held: deque = deque()
+        self._n_arrays = n
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._h is None:
+            raise StopIteration
+        ptrs = (ctypes.c_void_p * self._n_arrays)()
+        rows = ctypes.c_int64(0)
+        slot = self._lib.ptl_loader_next(self._h, ptrs, ctypes.byref(rows))
+        if slot < 0:
+            raise StopIteration
+        out = []
+        for i in range(self._n_arrays):
+            nbytes = int(rows.value) * self._row_bytes[i]
+            buf = (ctypes.c_char * nbytes).from_address(ptrs[i])
+            view = np.frombuffer(buf, dtype=self._dtypes[i]).reshape(
+                (int(rows.value),) + tuple(self._out_shapes[i]))
+            out.append(view if self._zero_copy else view.copy())
+        if self._zero_copy:
+            self._held.append(slot)
+            # keep the most recent depth-1 slots alive for the consumer
+            while len(self._held) > self._depth - 1:
+                self._lib.ptl_loader_release(self._h, self._held.popleft())
+        else:
+            self._lib.ptl_loader_release(self._h, slot)
+        return tuple(out)
+
+    def close(self):
+        if self._h is not None:
+            h, self._h = self._h, None
+            self._lib.ptl_loader_destroy(h)
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def token_windows(tokens: np.ndarray, seq_len: int, batch_size: int,
+                  stride: Optional[int] = None, shuffle: bool = True,
+                  seed: int = 0, drop_last: bool = True,
+                  num_shards: int = 1, shard_id: int = 0,
+                  epochs: int = 1, **kw) -> NativeArrayLoader:
+    """Loader of [batch, seq_len + 1] windows over a flat token array
+    (labels are the shifted window; +1 covers both). ``tokens`` may be an
+    np.memmap over a binary corpus file — windows are gathered straight
+    from the mapping, never materialized."""
+    tokens = np.ascontiguousarray(tokens).reshape(-1)
+    if stride is None:
+        stride = seq_len
+    span = seq_len + 1
+    if len(tokens) < span:
+        raise ValueError("token stream shorter than one window")
+    n = (len(tokens) - span) // stride + 1
+    it = tokens.itemsize
+    return NativeArrayLoader(
+        [tokens], batch_size, shuffle=shuffle, seed=seed,
+        drop_last=drop_last, num_shards=num_shards, shard_id=shard_id,
+        epochs=epochs, row_bytes=[span * it], strides=[stride * it],
+        n_samples=n, out_shapes=[(span,)], **kw)
