@@ -51,6 +51,10 @@ struct Slot {
   int64_t seq = -1;
 };
 
+// Minimum prefetch depth 2: every bounded queue and the slot pool must be
+// sized from this one function or the constructor deadlocks (see ctor).
+static size_t ClampDepth(int d) { return static_cast<size_t>(d < 2 ? 2 : d); }
+
 class Loader {
  public:
   Loader(std::vector<ArraySpec> arrays, int64_t n_samples,
@@ -66,11 +70,13 @@ class Loader {
         num_shards_(num_shards < 1 ? 1 : num_shards),
         shard_id_(shard_id),
         epochs_(epochs),
-        tasks_(static_cast<size_t>(prefetch_depth)),
-        done_(static_cast<size_t>(prefetch_depth)),
-        free_(static_cast<size_t>(prefetch_depth) + 1) {
-    int depth = prefetch_depth < 2 ? 2 : prefetch_depth;
-    slots_.resize(static_cast<size_t>(depth) + 1);
+        tasks_(ClampDepth(prefetch_depth)),
+        done_(ClampDepth(prefetch_depth)),
+        free_(ClampDepth(prefetch_depth) + 1) {
+    // All queue/slot capacities must derive from the SAME clamped depth:
+    // a depth<2 caller would otherwise deadlock pushing slot ids into a
+    // smaller bounded queue below.
+    slots_.resize(ClampDepth(prefetch_depth) + 1);
     for (auto& s : slots_) {
       s.buffers.resize(arrays_.size());
       for (size_t a = 0; a < arrays_.size(); ++a)

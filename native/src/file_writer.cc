@@ -14,6 +14,17 @@
 #include <thread>
 #include <vector>
 
+#if defined(_WIN32)
+#include <io.h>
+#define PTL_FSYNC(fd) _commit(fd)
+#define PTL_FILENO(f) _fileno(f)
+#else
+#include <fcntl.h>
+#include <unistd.h>
+#define PTL_FSYNC(fd) fsync(fd)
+#define PTL_FILENO(f) fileno(f)
+#endif
+
 #include "queue.h"
 
 namespace ptl {
@@ -44,7 +55,7 @@ static uint32_t Crc32(uint32_t crc, const uint8_t* p, size_t n) {
 class Writer {
  public:
   explicit Writer(const char* path, int depth)
-      : q_(static_cast<size_t>(depth < 2 ? 2 : depth)) {
+      : path_(path), q_(static_cast<size_t>(depth < 2 ? 2 : depth)) {
     f_ = std::fopen(path, "wb");
     if (f_) thread_ = std::thread(&Writer::Run, this);
   }
@@ -64,8 +75,12 @@ class Writer {
     if (thread_.joinable()) thread_.join();
     if (f_) {
       if (std::fflush(f_) != 0) error_ = true;
+      // Durability, not just stream flush: a successful Close must mean
+      // the checkpoint bytes survive a crash (CRC verifies reads only).
+      if (PTL_FSYNC(PTL_FILENO(f_)) != 0) error_ = true;
       std::fclose(f_);
       f_ = nullptr;
+      SyncParentDir();
     }
     if (crc_out) *crc_out = crc_;
     return error_ ? -1 : total_;
@@ -74,6 +89,24 @@ class Writer {
   ~Writer() { Close(nullptr); }
 
  private:
+  // A new file is only crash-durable once its directory entry is also
+  // journaled: fsync the containing directory after closing the file.
+  void SyncParentDir() {
+#if !defined(_WIN32)
+    std::string dir = path_;
+    size_t slash = dir.find_last_of('/');
+    dir = (slash == std::string::npos) ? "." : dir.substr(0, slash);
+    if (dir.empty()) dir = "/";
+    int dfd = open(dir.c_str(), O_RDONLY);
+    if (dfd < 0) {
+      error_ = true;
+      return;
+    }
+    if (fsync(dfd) != 0) error_ = true;
+    close(dfd);
+#endif
+  }
+
   void Run() {
     std::vector<uint8_t> buf;
     while (q_.Pop(&buf)) {
@@ -90,6 +123,7 @@ class Writer {
   }
 
   std::FILE* f_ = nullptr;
+  std::string path_;
   BoundedQueue<std::vector<uint8_t>> q_;
   std::thread thread_;
   int64_t total_ = 0;

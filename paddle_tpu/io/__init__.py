@@ -326,7 +326,15 @@ class DataLoader:
     the reference's multiprocess+mmap pipeline (jax arrays are not fork-safe;
     worker threads release the GIL during numpy/host IO). Array-backed
     datasets are served by the native C++ engine (io/native_engine.py)
-    when its semantics match; ``use_native_engine=False`` opts out."""
+    when its semantics match; ``use_native_engine=False`` opts out.
+
+    Native-engine behavior differences (vs the Python ``num_workers=0``
+    path): under ``shuffle=True`` the engine draws its own mt19937_64
+    Fisher-Yates permutation from ``paddle.seed``, which is a *different*
+    order than the Python ``RandomSampler`` for the same seed (both are
+    seed-deterministic); and batches are prefetched by C++ threads reading
+    the source arrays asynchronously, so the dataset's arrays must not be
+    mutated in place while iterating."""
 
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,

@@ -1,3 +1,5 @@
 """Flagship model families (GPT for the hybrid-parallel north star,
 BERT for the DP+AMP config)."""
+from .bert import (Bert, BertBlock, BertConfig, BertForPretraining,  # noqa: F401
+                   bert_tiny)
 from .gpt import GPT, GPTBlock, GPTConfig, gpt_tiny  # noqa: F401
