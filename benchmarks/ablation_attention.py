@@ -1,0 +1,92 @@
+"""Scratch: amortized (scan-12x) component timings on chip."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+N_REP = 12
+
+
+def timeit(f, *args, n=20):
+    g = jax.jit(f)
+    r = g(*args)
+    float(np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0]))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = g(*args)
+    float(np.asarray(jax.tree_util.tree_leaves(r)[0].ravel()[0]))
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def rep(fn):
+    """Apply fn N_REP times sequentially inside one jit (data-dependent)."""
+    def wrapped(*args):
+        def body(c, _):
+            out = fn(*[a + 0.0 * c for a in args[:1]], *args[1:])
+            return c + out, None
+        c0 = jnp.zeros((), jnp.float32)
+        c, _ = jax.lax.scan(body, c0, jnp.arange(N_REP))
+        return c
+    return wrapped
+
+
+def main():
+    import paddle_tpu.ops.flash_attention as fa
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy_fn, shifted_labels
+
+    B, S, NH, D, H, V = 8, 1024, 12, 64, 768, 32768
+    rng = np.random.RandomState(0)
+    bf = lambda *sh: jnp.asarray(rng.randn(*sh).astype(np.float32)).astype(jnp.bfloat16)
+    q, k, v = bf(B, S, NH, D), bf(B, S, NH, D), bf(B, S, NH, D)
+
+    base = timeit(lambda x: jnp.sum(x.astype(jnp.float32)), q)
+    print(f"dispatch floor (trivial jit): {base:.3f} ms")
+
+    def fwd_l(q, k, v):
+        return jnp.sum(fa._flash_mha(q, k, v, True, None).astype(jnp.float32))
+
+    def fwdbwd_l(q, k, v):
+        l, g = jax.value_and_grad(fwd_l, argnums=(0, 1, 2))(q, k, v)
+        return l + sum(jnp.sum(x.astype(jnp.float32)) for x in g)
+
+    def ref_l(q, k, v):
+        return jnp.sum(fa.mha_reference(q, k, v, causal=True).astype(jnp.float32))
+
+    def ref_fwdbwd_l(q, k, v):
+        l, g = jax.value_and_grad(ref_l, argnums=(0, 1, 2))(q, k, v)
+        return l + sum(jnp.sum(x.astype(jnp.float32)) for x in g)
+
+    t = timeit(rep(fwd_l), q, k, v)
+    print(f"flash fwd x{N_REP}: {t:.2f} ms -> {(t-base)/N_REP:.3f} ms/layer "
+          f"(ideal 0.065)")
+    t = timeit(rep(fwdbwd_l), q, k, v)
+    print(f"flash fwd+bwd x{N_REP}: {t:.2f} ms -> {(t-base)/N_REP:.3f} ms/layer")
+    t = timeit(rep(ref_l), q, k, v)
+    print(f"unfused fwd x{N_REP}: {t:.2f} ms -> {(t-base)/N_REP:.3f} ms/layer")
+    t = timeit(rep(ref_fwdbwd_l), q, k, v)
+    print(f"unfused fwd+bwd x{N_REP}: {t:.2f} ms -> {(t-base)/N_REP:.3f} ms/layer")
+
+    # fused CE amortized x4
+    x, w = bf(B, S, H), bf(V, H)
+    tok = jnp.asarray(rng.randint(0, V, (B, S)).astype(np.int32))
+    lab = shifted_labels(tok)
+
+    def ce_l(x, w):
+        return jax.value_and_grad(
+            lambda x, w: fused_linear_cross_entropy_fn(x, w, lab, chunk=256),
+            argnums=(0, 1))(x, w)[0]
+
+    t = timeit(rep(ce_l), x, w)
+    print(f"fused CE fwd+bwd x{N_REP}: {t:.2f} ms -> {(t-base)/N_REP:.3f} ms "
+          f"(ideal ~{3*2*B*S*H*V/197e12*1e3:.2f})")
+
+    # embedding fwd+bwd
+    def emb_l(w):
+        return jax.value_and_grad(
+            lambda w: jnp.sum(w[tok].astype(jnp.float32)))(w)[0]
+
+    t = timeit(rep(emb_l), w)
+    print(f"embedding fwd+bwd x{N_REP}: {t:.2f} ms -> {(t-base)/N_REP:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Scratch: MFU ablations on the real chip (not part of the framework)."""
+import time, sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+
+def scalarize(r):
+    leaves = jax.tree_util.tree_leaves(r)
+    return sum(jnp.sum(jnp.abs(l.astype(jnp.float32))) if l.ndim else
+               l.astype(jnp.float32) for l in leaves)
+
+
+def timeit(f, *args, n=10):
+    g = jax.jit(lambda *a: scalarize(f(*a)))
+    float(np.asarray(g(*args)))   # compile + true sync (scalar fetch)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        r = g(*args)
+    float(np.asarray(r))
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid_gpt import GPTHybridTrainer
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.ops.flash_attention import _flash_mha
+    from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy_fn, shifted_labels
+
+    paddle.seed(0)
+    B, S, H, L, NH, V = 8, 1024, 768, 12, 12, 32768
+    rng = np.random.RandomState(0)
+
+    # 1. flash attention kernel alone (all layers' worth: L sequential calls)
+    q = jnp.asarray(rng.randn(B, S, NH, 64).astype(np.float32)).astype(jnp.bfloat16)
+
+    def attn_fwdbwd(q, k, v):
+        def f(q, k, v):
+            return _flash_mha(q, k, v, True, None).astype(jnp.float32).mean()
+        l, g = jax.value_and_grad(f, argnums=(0, 1, 2))(q, k, v)
+        return l, g
+
+    t = timeit(attn_fwdbwd, q, q, q)
+    print(f"attention fwd+bwd one layer: {t:.3f} ms -> x{L} = {t*L:.1f} ms")
+
+    # 2. fused CE alone
+    x = jnp.asarray(rng.randn(B, S, H).astype(np.float32)).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(V, H).astype(np.float32)).astype(jnp.bfloat16)
+    tok = jnp.asarray(rng.randint(0, V, (B, S)).astype(np.int32))
+
+    def ce_fwdbwd(x, w, tok):
+        lab = shifted_labels(tok)
+        return jax.value_and_grad(
+            lambda x, w: fused_linear_cross_entropy_fn(x, w, lab, chunk=256),
+            argnums=(0, 1))(x, w)
+
+    t = timeit(ce_fwdbwd, x, w, tok)
+    print(f"fused CE fwd+bwd: {t:.2f} ms")
+
+    # 3. dense block matmuls alone (qkv+proj+mlp, L layers, fwd+bwd, bf16)
+    w_qkv = jnp.asarray(rng.randn(L, H, 3*H).astype(np.float32)).astype(jnp.bfloat16)
+    w_o = jnp.asarray(rng.randn(L, H, H).astype(np.float32)).astype(jnp.bfloat16)
+    w_in = jnp.asarray(rng.randn(L, H, 4*H).astype(np.float32)).astype(jnp.bfloat16)
+    w_out = jnp.asarray(rng.randn(L, 4*H, H).astype(np.float32)).astype(jnp.bfloat16)
+
+    def mm_fwdbwd(x, ws):
+        def f(x, ws):
+            def body(h, w):
+                wq, wo, wi, wo2 = w
+                h = h + (h @ wq)[..., :H] @ wo
+                h = h + jax.nn.gelu(h @ wi) @ wo2
+                return h, None
+            h, _ = jax.lax.scan(body, x, ws)
+            return h.astype(jnp.float32).mean()
+        return jax.value_and_grad(f)(x, ws)
+
+    t = timeit(mm_fwdbwd, x, (w_qkv, w_o, w_in, w_out))
+    print(f"dense matmuls (scan, {L} layers) fwd+bwd: {t:.2f} ms")
+
+    # 4. embedding fwd+bwd (gather + scatter-add grad)
+    def emb_fwdbwd(w, tok):
+        def f(w):
+            return w[tok].astype(jnp.float32).mean()
+        return jax.value_and_grad(f)(w)
+
+    t = timeit(emb_fwdbwd, w, tok)
+    print(f"embedding gather+scatter bwd: {t:.2f} ms")
+
+    # 5. optimizer-style update: adamw over 111M params (fp32 m/v/p + bf16 grad)
+    P = 111_000_000
+    p = jnp.zeros((P//1000, 1000), jnp.float32)
+    m = jnp.zeros_like(p); v = jnp.zeros_like(p)
+    g = jnp.zeros((P//1000, 1000), jnp.float32)
+
+    def adam(p, m, v, g):
+        m = 0.9*m + 0.1*g
+        v = 0.999*v + 0.001*g*g
+        return p - 1e-4*(m/(jnp.sqrt(v)+1e-8) + 0.01*p), m, v
+
+    t = timeit(adam, p, m, v, g)
+    print(f"adamw update {P/1e6:.0f}M params: {t:.2f} ms")
+
+    # 6. full trainer step (reference point)
+    cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L,
+                    num_heads=NH, max_seq_len=S)
+    model = GPT(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    s = DistributedStrategy(); s.amp = True
+    mesh = create_mesh({"dp": 1, "pp": 1, "tp": 1, "sp": 1}, jax.devices()[:1])
+    tr = GPTHybridTrainer(model, opt, s, mesh, n_micro=1)
+    tokens = rng.randint(0, V, (B, S)).astype(np.int32)
+    float(np.asarray(tr.step(tokens)))
+    t0 = time.perf_counter()
+    for _ in range(10):
+        loss = tr.step(tokens)
+    float(np.asarray(loss))
+    print(f"full step: {(time.perf_counter()-t0)/10*1e3:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

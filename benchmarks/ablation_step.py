@@ -1,0 +1,85 @@
+"""Scratch: in-trainer ablations to find the missing step time."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+
+def step_time(tr, tokens, n=10):
+    float(np.asarray(tr.step(tokens)))
+    float(np.asarray(tr.step(tokens)))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = tr.step(tokens)
+    float(np.asarray(loss))
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def make(cfg_kw=None, strat_kw=None, n_micro=1):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid_gpt import GPTHybridTrainer
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(0)
+    kw = dict(vocab_size=32768, hidden_size=768, num_layers=12,
+              num_heads=12, max_seq_len=1024)
+    kw.update(cfg_kw or {})
+    cfg = GPTConfig(**kw)
+    model = GPT(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    s = DistributedStrategy()
+    s.amp = True
+    for k, v in (strat_kw or {}).items():
+        setattr(s, k, v)
+    mesh = create_mesh({"dp": 1, "pp": 1, "tp": 1, "sp": 1},
+                       jax.devices()[:1])
+    return GPTHybridTrainer(model, opt, s, mesh, n_micro=n_micro), cfg
+
+
+def main():
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 32768, (8, 1024)).astype(np.int32)
+
+    tr, cfg = make()
+    t_full = step_time(tr, tokens)
+    print(f"full step: {t_full:.2f} ms")
+
+    # ablate attention (unfused==flash swap shows reshape overhead instead)
+    import paddle_tpu.models.gpt as gptmod
+
+    orig_fwd = gptmod.GPTAttention.forward
+
+    def no_attn(self, x):
+        return self.out_proj(self.qkv_proj(x)[..., :x.shape[-1]])
+
+    gptmod.GPTAttention.forward = no_attn
+    tr2, _ = make()
+    t = step_time(tr2, tokens)
+    print(f"no-attention step: {t:.2f} ms (attention total = {t_full - t:.2f})")
+    gptmod.GPTAttention.forward = orig_fwd
+
+    # ablate loss head: mean instead of fused CE
+    from paddle_tpu.distributed import hybrid_gpt as hg
+    import paddle_tpu.ops.fused_ce as fce
+
+    orig_ce = fce.fused_linear_cross_entropy_fn
+    fce.fused_linear_cross_entropy_fn = \
+        lambda x, w, l, **kw: jnp.sum(x.astype(jnp.float32)) * 1e-6 + \
+        jnp.sum(w.astype(jnp.float32)) * 1e-9
+    tr3, _ = make()
+    t = step_time(tr3, tokens)
+    print(f"no-CE step: {t:.2f} ms (loss head total = {t_full - t:.2f})")
+    fce.fused_linear_cross_entropy_fn = orig_ce
+
+    # unfused attention for comparison
+    tr4, _ = make(cfg_kw={"use_flash_attention": False})
+    print(f"unfused-attention step: {step_time(tr4, tokens):.2f} ms")
+
+    # remat on (cheaper bwd memory, more flops)
+    tr5, _ = make(strat_kw={"recompute": True})
+    print(f"remat step: {step_time(tr5, tokens):.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,507 @@
+"""Sharded, async, multi-host checkpointing.
+
+Designed fresh for TPU (SURVEY.md §5 checkpoint/resume: the reference has
+only synchronous save ops — operators/save_op.cc, fluid/io.py:621
+save_persistables — and fleet's sharded save delegates to rank groups,
+fleet_base.py:518-550; there is no optimizer-state sharded checkpoint
+format for collective mode). Here:
+
+  - **keyed by mesh shard**: every jax.Array in the state tree is saved as
+    its device shards. Each process writes ONE shard file containing the
+    shards it owns (``replica_id == 0`` dedupes replicas), so a save is
+    embarrassingly parallel across hosts and never materializes a global
+    array.
+  - **async**: device→host copies happen inline (cheap, HBM→RAM), file
+    writes stream through the native background writer
+    (native/src/file_writer.cc, AsyncWriter) — training resumes while
+    bytes hit disk. ``SaveHandle.wait()`` / ``CheckpointManager.wait()``
+    joins, fsyncs, and commits.
+  - **crash-consistent**: a step directory is only valid once its COMMIT
+    marker exists; the marker is written after every writer has fsync'd
+    (file + parent dir). ``latest_step`` ignores uncommitted directories,
+    so a kill mid-save resumes from the previous step.
+  - **resume-exact**: restore targets a template pytree (arrays or
+    ShapeDtypeStructs carrying shardings). The fast path feeds each
+    target shard straight from the matching saved shard (local reads
+    only); a topology change falls back to assembling the global array.
+  - metadata rides along (step, RNG key, data-pipeline cursor, anything
+    JSON-serializable) for deterministic resume.
+
+Layout::
+
+    dir/step_00000100/
+        shard_p0.bin manifest_p0.json   # per process
+        meta.json COMMIT                # process 0
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.native import AsyncWriter, available as _native_available
+
+_STEP_FMT = "step_{:08d}"
+_COMMIT = "COMMIT"
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _dtype_name(dt) -> str:
+    return str(np.dtype(dt)) if not str(dt).startswith("bfloat16") \
+        else "bfloat16"
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _norm_index(index, shape) -> List[List[int]]:
+    """Tuple-of-slices → [[start, stop], ...] on the global shape."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        assert step == 1, "strided shards unsupported"
+        out.append([int(start), int(stop)])
+    # index may be shorter than rank (trailing full dims)
+    for dim in shape[len(out):]:
+        out.append([0, int(dim)])
+    return out
+
+
+def _flatten(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        key = "/".join(_path_elem(p) for p in path)
+        out.append((key, leaf))
+    return out
+
+
+def _path_elem(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    if hasattr(p, "name"):
+        return str(p.name)
+    return str(p)
+
+
+class _PyWriter:
+    """Pure-python fallback for AsyncWriter (same contract)."""
+
+    def __init__(self, path: str, depth: int = 8):
+        self._f = open(path, "wb")
+        self._total = 0
+        self._crc = 0
+
+    def write(self, data) -> None:
+        import zlib
+
+        b = memoryview(data).cast("B")
+        self._f.write(b)
+        self._crc = zlib.crc32(b, self._crc)
+        self._total += len(b)
+
+    def close(self):
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        return (self._total, self._crc)
+
+
+def _open_writer(path: str):
+    if _native_available():
+        return AsyncWriter(path, depth=16)
+    return _PyWriter(path)
+
+
+# ---------------------------------------------------------------------------
+# save
+# ---------------------------------------------------------------------------
+
+
+class SaveHandle:
+    """In-flight async save. ``wait()`` blocks until the checkpoint is
+    durable and (on process 0) committed.
+
+    The cross-host barrier and the COMMIT marker happen inside ``wait()``
+    on the CALLER's thread: a collective issued from a background thread
+    could interleave with training collectives in different orders on
+    different hosts and deadlock XLA."""
+
+    def __init__(self, step_dir: str, step: int, thread: threading.Thread,
+                 errbox: list):
+        self._dir = step_dir
+        self._step = step
+        self._thread = thread
+        self._err = errbox
+        self._done = False
+
+    def wait(self) -> None:
+        if self._done:
+            return
+        self._thread.join()
+        self._done = True
+        # the barrier runs even on the local-error path — skipping it would
+        # leave the other hosts blocked in sync_global_devices forever
+        _barrier(f"ckpt_save_{self._step}")
+        if self._err:
+            raise self._err[0]
+        if jax.process_index() == 0:
+            with open(os.path.join(self._dir, _COMMIT), "w") as f:
+                f.write("ok\n")
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(self._dir)
+        # all hosts agree the step is committed before anyone reads it
+        _barrier(f"ckpt_commit_{self._step}")
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+
+def save(directory: str, state, step: int, meta: Optional[dict] = None,
+         async_: bool = True) -> SaveHandle:
+    """Save a pytree of jax.Arrays as a sharded checkpoint.
+
+    Returns a SaveHandle; the checkpoint is valid only after ``wait()``
+    (CheckpointManager calls it for you at the next save/exit).
+    """
+    proc = jax.process_index()
+    nproc = jax.process_count()
+    step_dir = os.path.join(directory, _STEP_FMT.format(step))
+    os.makedirs(step_dir, exist_ok=True)
+
+    # inline part: device→host copies of owned shards (snapshot semantics —
+    # training may mutate device state the moment this returns)
+    entries: Dict[str, dict] = {}
+    buffers: List[Tuple[str, np.ndarray]] = []
+    offset = 0
+    for key, arr in _flatten(state):
+        if arr is None:
+            continue
+        arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
+        info = {"shape": [int(d) for d in arr.shape],
+                "dtype": _dtype_name(arr.dtype), "shards": []}
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                continue
+            host = np.ascontiguousarray(np.asarray(sh.data))
+            nbytes = host.nbytes
+            info["shards"].append({
+                "index": _norm_index(sh.index, arr.shape),
+                "offset": offset, "nbytes": int(nbytes)})
+            buffers.append((key, host))
+            offset += nbytes
+        entries[key] = info
+
+    manifest = {"format": 1, "process": proc, "nprocs": nproc,
+                "step": int(step), "file": f"shard_p{proc}.bin",
+                "arrays": entries}
+    errbox: list = []
+
+    def _finish():
+        try:
+            w = _open_writer(os.path.join(step_dir, f"shard_p{proc}.bin"))
+            for _, host in buffers:
+                # byte view: memoryview can't express bf16, uint8 always
+                # works (reshape first — 0-d arrays can't change dtype)
+                w.write(host.reshape(-1).view(np.uint8).data)
+            total, crc = w.close()
+            manifest["file_crc32"] = int(crc)
+            manifest["file_bytes"] = int(total)
+            _write_json_durable(
+                step_dir, f"manifest_p{proc}.json", manifest)
+            if meta is not None and proc == 0:
+                _write_json_durable(step_dir, "meta.json", meta)
+            _fsync_dir(step_dir)
+        except BaseException as e:  # surfaced by wait()
+            errbox.append(e)
+
+    t = threading.Thread(target=_finish, name=f"ckpt-save-{step}",
+                         daemon=False)
+    t.start()
+    handle = SaveHandle(step_dir, step, t, errbox)
+    if not async_:
+        handle.wait()
+    return handle
+
+
+def _write_json_durable(dirname: str, name: str, obj) -> None:
+    """write-tmp → fsync → rename: the data blocks are on disk before the
+    directory entry appears (COMMIT must never point at partial json)."""
+    tmp = os.path.join(dirname, f".{name}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dirname, name))
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
+
+
+# ---------------------------------------------------------------------------
+# restore
+# ---------------------------------------------------------------------------
+
+
+def all_steps(directory: str) -> List[int]:
+    """Committed checkpoint steps, ascending."""
+    steps = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for n in names:
+        if n.startswith("step_") and os.path.exists(
+                os.path.join(directory, n, _COMMIT)):
+            try:
+                steps.append(int(n[len("step_"):]))
+            except ValueError:
+                pass
+    return sorted(steps)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    s = all_steps(directory)
+    return s[-1] if s else None
+
+
+def load_meta(directory: str, step: int) -> Optional[dict]:
+    p = os.path.join(directory, _STEP_FMT.format(step), "meta.json")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+class _ShardSource:
+    """All saved shards of one step, indexed by array key."""
+
+    def __init__(self, step_dir: str, verify: bool = False):
+        self.step_dir = step_dir
+        self.arrays: Dict[str, dict] = {}
+        self._files: Dict[str, Any] = {}
+        manifests = sorted(n for n in os.listdir(step_dir)
+                           if n.startswith("manifest_p"))
+        if not manifests:
+            raise FileNotFoundError(f"no manifests in {step_dir}")
+        for mn in manifests:
+            with open(os.path.join(step_dir, mn)) as f:
+                m = json.load(f)
+            if verify:
+                self._verify(m)
+            for key, info in m["arrays"].items():
+                tgt = self.arrays.setdefault(
+                    key, {"shape": info["shape"], "dtype": info["dtype"],
+                          "shards": []})
+                for sh in info["shards"]:
+                    tgt["shards"].append(dict(sh, file=m["file"]))
+
+    def _verify(self, manifest: dict) -> None:
+        import zlib
+
+        path = os.path.join(self.step_dir, manifest["file"])
+        crc = 0
+        with open(path, "rb") as f:
+            while True:
+                b = f.read(1 << 22)
+                if not b:
+                    break
+                crc = zlib.crc32(b, crc)
+        if manifest.get("file_crc32") and crc != manifest["file_crc32"]:
+            raise IOError(f"checkpoint corrupt: crc mismatch in {path}")
+
+    def _read(self, fname: str, offset: int, nbytes: int) -> bytes:
+        f = self._files.get(fname)
+        if f is None:
+            f = open(os.path.join(self.step_dir, fname), "rb")
+            self._files[fname] = f
+        f.seek(offset)
+        return f.read(nbytes)
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+    # -- reading ----------------------------------------------------------
+    def exact(self, key: str, index: List[List[int]]) -> Optional[np.ndarray]:
+        info = self.arrays[key]
+        for sh in info["shards"]:
+            if sh["index"] == index:
+                shape = [b - a for a, b in index]
+                raw = self._read(sh["file"], sh["offset"], sh["nbytes"])
+                return np.frombuffer(raw, _np_dtype(info["dtype"])) \
+                    .reshape(shape)
+        return None
+
+    def assemble(self, key: str) -> np.ndarray:
+        info = self.arrays[key]
+        out = np.empty(info["shape"], _np_dtype(info["dtype"]))
+        for sh in info["shards"]:
+            idx = tuple(slice(a, b) for a, b in sh["index"])
+            shape = [b - a for a, b in sh["index"]]
+            raw = self._read(sh["file"], sh["offset"], sh["nbytes"])
+            out[idx] = np.frombuffer(
+                raw, _np_dtype(info["dtype"])).reshape(shape)
+        return out
+
+
+def restore(directory: str, template, step: Optional[int] = None,
+            verify: bool = False):
+    """Restore a checkpoint into the shapes/shardings of ``template``.
+
+    template: pytree of jax.Arrays or jax.ShapeDtypeStructs whose
+    ``.sharding`` describes the wanted placement. Returns the restored
+    pytree (same structure).
+    """
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no committed checkpoint in {directory}")
+    step_dir = os.path.join(directory, _STEP_FMT.format(step))
+    src = _ShardSource(step_dir, verify=verify)
+    flat = _flatten(template)
+    restored: Dict[str, Any] = {}
+    try:
+        for key, tgt in flat:
+            if tgt is None:
+                restored[key] = None
+                continue
+            if key not in src.arrays:
+                raise KeyError(f"checkpoint {step_dir} missing array {key!r}")
+            info = src.arrays[key]
+            shape = tuple(tgt.shape)
+            if list(shape) != list(info["shape"]):
+                raise ValueError(
+                    f"{key}: checkpoint shape {info['shape']} != template "
+                    f"shape {list(shape)}")
+            sharding = getattr(tgt, "sharding", None)
+            if sharding is None or not hasattr(sharding, "addressable_devices"):
+                restored[key] = src.assemble(key).astype(
+                    _np_dtype(_dtype_name(tgt.dtype)), copy=False)
+                continue
+            glob: list = []          # lazy global assembly (shared)
+
+            def cb(index, key=key, info=info, glob=glob):
+                norm = _norm_index(index, info["shape"])
+                hit = src.exact(key, norm)
+                if hit is not None:
+                    return hit
+                if not glob:
+                    glob.append(src.assemble(key))
+                return glob[0][tuple(slice(a, b) for a, b in norm)]
+
+            restored[key] = jax.make_array_from_callback(
+                shape, sharding, cb)
+    finally:
+        src.close()
+    out_flat = [restored[k] for k, _ in flat]
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    return jax.tree_util.tree_unflatten(treedef, out_flat)
+
+
+# ---------------------------------------------------------------------------
+# manager
+# ---------------------------------------------------------------------------
+
+
+class CheckpointManager:
+    """Rolling async checkpoints with retention.
+
+    ``save`` returns immediately (previous in-flight save is joined
+    first); ``restore_latest`` reads the newest committed step.
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        self._pending: Optional[SaveHandle] = None
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, step: int, state, meta: Optional[dict] = None,
+             async_: bool = True) -> SaveHandle:
+        self.wait()
+        h = save(self.directory, state, step, meta=meta, async_=async_)
+        self._pending = h
+
+        if not async_:
+            self._gc()
+        return h
+
+    def wait(self) -> None:
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+            self._gc()
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.directory)
+
+    def restore(self, template, step: Optional[int] = None,
+                verify: bool = False):
+        return restore(self.directory, template, step=step, verify=verify)
+
+    def restore_latest(self, template, verify: bool = False):
+        step = self.latest_step()
+        if step is None:
+            return None, None
+        state = self.restore(template, step=step, verify=verify)
+        return state, load_meta(self.directory, step)
+
+    def _gc(self) -> None:
+        if jax.process_index() != 0:
+            return
+        steps = all_steps(self.directory)
+        for s in steps[:-self.keep] if self.keep else []:
+            shutil.rmtree(
+                os.path.join(self.directory, _STEP_FMT.format(s)),
+                ignore_errors=True)
+        # uncommitted debris older than the newest committed step
+        for n in os.listdir(self.directory):
+            if not n.startswith("step_"):
+                continue
+            p = os.path.join(self.directory, n)
+            if os.path.exists(os.path.join(p, _COMMIT)):
+                continue
+            try:
+                s = int(n[len("step_"):])
+            except ValueError:
+                continue
+            if steps and s < steps[-1]:
+                shutil.rmtree(p, ignore_errors=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.wait()

@@ -84,14 +84,45 @@ class Fleet:
         paddle.DataParallel)."""
         from ..parallel import DataParallel
 
+        self._model = model
         return DataParallel(model)
 
-    # checkpoint delegation (reference fleet_base.py:518-550)
+    # checkpoint delegation (reference fleet_base.py:518-550 — fleet
+    # delegates sharded save to the runtime; here the runtime is
+    # distributed.checkpoint: per-mesh-shard async files)
     def save_persistables(self, exe=None, dirname=None, main_program=None,
-                          mode=0):
-        raise NotImplementedError(
-            "static-program save: use paddle_tpu.save(state_dict) or "
-            "distributed.checkpoint for sharded saves")
+                          mode=0, trainer=None, model=None, optimizer=None,
+                          step=0):
+        """Save training persistables (params + opt state).
+
+        trainer: a hybrid trainer exposing device_state() → sharded async
+        checkpoint keyed by mesh shard. model/optimizer: eager state_dict
+        save (rank 0 writes; other ranks no-op, matching the reference's
+        should_save_model gating).
+        """
+        if dirname is None:
+            dirname = exe if isinstance(exe, str) else None
+        if dirname is None:
+            raise ValueError("save_persistables needs dirname")
+        if trainer is not None and hasattr(trainer, "device_state"):
+            from .. import checkpoint as dck
+
+            h = dck.save(dirname, trainer.device_state(), step=step,
+                         meta={"step": step}, async_=False)
+            return h.directory
+        model = model or getattr(self, "_model", None)
+        if model is None:
+            raise ValueError(
+                "save_persistables needs trainer= or model= on the TPU "
+                "stack (no global static program exists)")
+        if self.is_first_worker():
+            from ...framework import io as fio
+
+            state = {"model": model.state_dict()}
+            if optimizer is not None:
+                state["optimizer"] = optimizer.state_dict()
+            fio.save(state, os.path.join(dirname, "persistables.pdparams"))
+        return dirname
 
     def stop_worker(self):
         pass

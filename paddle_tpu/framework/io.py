@@ -3,7 +3,9 @@ pickled per-tensor numpy state dicts; C++ save/load ops operators/save_op.cc).
 
 Format-compatible idea: a dict of numpy arrays pickled to disk. Sharded /
 async multi-host checkpointing for the distributed path lives in
-paddle_tpu.distributed.checkpoint (orbax/tensorstore-backed).
+paddle_tpu.distributed.checkpoint — per-mesh-shard files streamed through
+the native async writer (native/src/file_writer.cc), commit-marker
+crash consistency, resume-exact restore.
 """
 from __future__ import annotations
 

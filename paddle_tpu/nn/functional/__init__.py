@@ -8,6 +8,7 @@ from .activation import (celu, elu, gelu, gumbel_softmax, hardshrink,  # noqa: F
                          softshrink, softsign, swish, tanh, tanhshrink,
                          thresholded_relu)
 from .attention import scaled_dot_product_attention  # noqa: F401
+from ...ops.fused_ce import fused_linear_cross_entropy  # noqa: F401
 from .common import (alpha_dropout, bilinear, cosine_similarity,  # noqa: F401
                      dropout, dropout2d, dropout3d, embedding, interpolate,
                      label_smooth, linear, pad, pixel_shuffle, unfold,

@@ -16,9 +16,12 @@ MFU make-or-break (SURVEY.md §7 "Hard parts"), so it is first-class here:
     dQ (grid over q blocks), one accumulates dK/dV (grid over k blocks) —
     using the saved logsumexp and the precomputed row dot delta = sum(dO·O).
   - causal masking: fully-masked tiles skip all compute (the MXU never sees
-    them) and tiles below the diagonal skip mask evaluation; K/V block DMA
-    for dead tiles is not yet elided (a fori_loop-over-HBM rewrite would —
-    future work).
+    them) and tiles below the diagonal skip mask evaluation. Dead-tile K/V
+    DMA is elided by clamping the K-block index map to the diagonal
+    (``lax.min(j, i)``): Pallas only issues a copy when a block index
+    changes between grid steps, so once the k index saturates at the
+    diagonal no further HBM traffic happens for that q row — causal
+    attention reads half the K/V bytes of full attention.
 
 All kernel math is f32 (MXU accumulates f32 even for bf16 inputs via
 preferred_element_type); outputs are cast back to the input dtype.
@@ -43,6 +46,8 @@ _BLOCK_K = 512         # amortize grid/DMA overhead; equal q/k tiles under
                        # causal so the diagonal block covers its own row.
 _SEQ_ALIGN = 128
 _NEG_INF = -1e30
+_LOG2E = 1.4426950408889634   # softmax runs in base 2: exp(x) = exp2(x·log2e)
+_LN2 = 0.6931471805599453     # (exp2 is the TPU-native transcendental)
 
 
 def _interpret() -> bool:
@@ -109,16 +114,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         q = q_ref[0]                                     # [bq, d]
         k = k_ref[0]                                     # [bk, d]
         v = v_ref[0]
+        # base-2 logits: one fused scale, exp2 on the VPU (cheaper than exp)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if masked:
             mask = _causal_mask(iq, ik, block_q, block_k)
             s = jnp.where(mask, s, _NEG_INF)
         m_prev = m_ref[:]                                # [bq, 1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)
+        alpha = jnp.exp2(m_prev - m_cur)
+        p = jnp.exp2(s - m_cur)
         if masked:
             p = jnp.where(mask, p, 0.0)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
@@ -141,7 +147,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[:] + jnp.log(l_safe)          # [bq, 1]
+        # m is base-2; export the natural-log lse (bwd/ring contract)
+        lse_ref[0] = (m_ref[:] + jnp.log2(l_safe)) * _LN2   # [bq, 1]
 
 
 def _fwd(q3, k3, v3, scale, causal, block_q, block_k):
@@ -152,13 +159,19 @@ def _fwd(q3, k3, v3, scale, causal, block_q, block_k):
     grid = (bh, nq, nk)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=block_q, block_k=block_k)
+    if causal:
+        # dead tiles (j past the diagonal) re-reference the diagonal block;
+        # an unchanged block index between grid steps elides the DMA
+        kv_idx = lambda b, i, j: (b, jax.lax.min(j, i), 0)
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -203,15 +216,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]                                  # [bq, 1]
+        lse = lse_ref[0]                                  # [bq, 1] natural
         delta = delta_ref[0]                              # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if masked:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s,
                           _NEG_INF)
-        p = jnp.exp(s - lse)                              # [bq, bk]
+        p = jnp.exp2(s - lse * _LOG2E)                    # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -248,15 +261,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]                                  # [bq, 1]
+        lse = lse_ref[0]                                  # [bq, 1] natural
         delta = delta_ref[0]                              # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if masked:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s,
                           _NEG_INF)
-        p = jnp.exp(s - lse)                              # [bq, bk]
+        p = jnp.exp2(s - lse * _LOG2E)                    # [bq, bk]
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # p^T @ do
@@ -298,6 +311,14 @@ def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
     dk_dtype = out_dtype or k3.dtype
     dv_dtype = out_dtype or v3.dtype
 
+    if causal:
+        # same dead-tile DMA elision as the forward (see module docstring)
+        kv_idx = lambda b, i, j: (b, jax.lax.min(j, i), 0)
+        q_row_idx = lambda b, j, i: (b, jax.lax.max(i, j), 0)
+    else:
+        kv_idx = lambda b, i, j: (b, j, 0)
+        q_row_idx = lambda b, j, i: (b, i, 0)
+
     dq_kern = functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                                 block_q=block_q, block_k=block_k)
     dq = pl.pallas_call(
@@ -305,8 +326,8 @@ def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_idx),
+            pl.BlockSpec((1, block_k, d), kv_idx),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
@@ -325,12 +346,12 @@ def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
         dkv_kern,
         grid=(bh, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_row_idx),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_row_idx),
+            pl.BlockSpec((1, block_q, 1), q_row_idx),
+            pl.BlockSpec((1, block_q, 1), q_row_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),

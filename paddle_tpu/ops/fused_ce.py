@@ -1,0 +1,100 @@
+"""Fused lm-head + softmax cross-entropy (chunked, logits never in HBM).
+
+The reference fuses softmax+CE in one CUDA kernel
+(reference paddle/fluid/operators/softmax_with_cross_entropy_op.cu) but
+still materializes the full [tokens, vocab] logits produced by the
+preceding matmul. On TPU the HBM traffic of those logits dominates the
+loss computation for LM-scale vocabularies (batch 8 × seq 1024 × vocab
+32768 in f32 is >1 GB per direction), so here the *projection itself* is
+fused into the loss:
+
+  - ``lax.scan`` over sequence chunks; each chunk computes its logits
+    tile ``x_chunk @ W^T`` (f32 MXU accumulation), reduces it to
+    logsumexp + the gold-label logit, and discards it — peak logits
+    footprint is one chunk, not the full sequence.
+  - the scan body is ``jax.checkpoint``-ed: backward rematerializes each
+    chunk's logits instead of storing them, trading one extra matmul
+    pass for O(seq/chunk) memory.
+  - grads flow to both ``x`` and the (possibly vocab-sharded) weight
+    through the scan transpose; under GSPMD a tp-sharded vocab axis
+    turns the logsumexp into a psum automatically.
+
+Used by the hybrid trainer's loss head (distributed/hybrid_gpt.py) and
+exposed as ``paddle_tpu.nn.functional.fused_linear_cross_entropy``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor._helper import apply
+
+IGNORE = -100
+
+
+def _fused_ce(x, w, labels, ignore_index, chunk, w_is_vh):
+    """x: [B, S, H]; w: [V, H] (embedding layout) or [H, V]; labels [B, S].
+
+    Returns mean CE over non-ignored positions, f32 scalar.
+    """
+    b, s, h = x.shape
+    if chunk is None or chunk >= s:
+        nc, cs = 1, s
+    else:
+        cs = chunk
+        while s % cs:            # shrink to a divisor (seq is 128-aligned)
+            cs //= 2
+        nc = s // cs
+    xs = x.reshape(b, nc, cs, h).transpose(1, 0, 2, 3)       # [nc, B, cs, H]
+    ls = labels.reshape(b, nc, cs).transpose(1, 0, 2)        # [nc, B, cs]
+    v = w.shape[0] if w_is_vh else w.shape[1]
+
+    def body(carry, inp):
+        xc, lc = inp                                          # [B,cs,H] [B,cs]
+        # contract H: w is [V, H] when transpose_w else [H, V]
+        wdim = 1 if w_is_vh else 0
+        logits = jax.lax.dot_general(
+            xc, w, (((2,), (wdim,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [B, cs, V]
+        m = jnp.max(logits, axis=-1, keepdims=True)
+        lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[..., 0]
+        mask = lc != ignore_index
+        safe = jnp.clip(lc, 0, v - 1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        loss = jnp.where(mask, lse - gold, 0.0)
+        acc, n = carry
+        return (acc + jnp.sum(loss),
+                n + jnp.sum(mask.astype(jnp.int32))), None
+
+    (total, n), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (xs, ls))
+    return total / jnp.maximum(n, 1).astype(jnp.float32)
+
+
+def fused_linear_cross_entropy_fn(x, w, labels, ignore_index=IGNORE,
+                                  chunk=256, transpose_w=False):
+    """Pure-jax entry (used inside jitted trainers).
+
+    ``transpose_w=False``: w is [V, H] (tied-embedding layout, logits =
+    x @ w.T). ``transpose_w=True``: w is [H, V] (Linear layout).
+    """
+    return _fused_ce(x, w, labels, ignore_index, chunk, not transpose_w)
+
+
+def shifted_labels(tokens, ignore_index=IGNORE):
+    """Next-token labels: tokens shifted left, last position ignored."""
+    return jnp.concatenate(
+        [tokens[:, 1:],
+         jnp.full((tokens.shape[0], 1), ignore_index, tokens.dtype)], axis=1)
+
+
+def fused_linear_cross_entropy(x, weight, labels, ignore_index=IGNORE,
+                               chunk=256, transpose_w=False, name=None):
+    """Tape-level entry (Tensor in/out)."""
+    def f(xv, wv, lv):
+        return fused_linear_cross_entropy_fn(
+            xv, wv, lv, ignore_index=ignore_index, chunk=chunk,
+            transpose_w=transpose_w)
+
+    return apply(f, x, weight, labels, name="fused_linear_cross_entropy")
