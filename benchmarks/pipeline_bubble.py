@@ -1,0 +1,54 @@
+"""Measure GPipe vs interleaved pipeline schedules on the 8-device CPU
+mesh (VERDICT r1 item 6: step-time win at pp>=2, n_micro>=4).
+
+Run: python benchmarks/pipeline_bubble.py
+"""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_num_cpu_devices", 8)
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+    from paddle_tpu.distributed.strategy_compiler import \
+        build_mesh_from_strategy
+    from paddle_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=8,
+                    num_heads=4, max_seq_len=128)
+    toks = np.random.RandomState(0).randint(
+        0, 512, (16, 128)).astype(np.int32)
+
+    def run(v, n_micro=8, steps=6):
+        paddle.seed(1)
+        net = GPT(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        s = DistributedStrategy()
+        s.hybrid_configs = {"pp_degree": 4, "dp_degree": 2}
+        mesh = build_mesh_from_strategy(s)
+        tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=n_micro,
+                                   v_virtual=v)
+        float(np.asarray(tr.step(toks)))
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = tr.step(toks)
+        float(np.asarray(loss))
+        return (time.perf_counter() - t0) / steps * 1e3
+
+    t1 = run(1)
+    t2 = run(2)
+    print(f"pp=4 n_micro=8: gpipe {t1:.1f} ms | interleaved v=2 {t2:.1f} ms "
+          f"| win {100 * (1 - t2 / t1):.1f}%")
+    print(f"theoretical bubble: gpipe {3 / 11:.3f} vs v=2 {3 / 19:.3f}")
+
+
+if __name__ == "__main__":
+    main()

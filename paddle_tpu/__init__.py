@@ -44,9 +44,15 @@ from . import distributed  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import ops  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402
+from . import text  # noqa: F401,E402
+from . import quantization  # noqa: F401,E402
+from . import onnx  # noqa: F401,E402
+from . import utils  # noqa: F401,E402
+from .distributed.parallel import DataParallel  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402
 from .hapi.model import Model  # noqa: F401,E402
 from .jit.api import to_static  # noqa: F401,E402
+from . import inference  # noqa: F401,E402
 
 # paddle.disable_static / enable_static compat: this framework is always
 # "dygraph" at the API level; jit/pjit is the static path.

@@ -2,7 +2,7 @@
 
 TPU-native analogue of the reference L0 platform layer
 (reference: paddle/fluid/platform/)."""
-from . import dtype, errors, flags, place, profiler, rng  # noqa: F401
+from . import dtype, errors, flags, memory, place, profiler, rng  # noqa: F401
 from .dtype import (bfloat16, bool_, complex64, complex128,  # noqa: F401
                     convert_dtype, float16, float32, float64,
                     get_default_dtype, int8, int16, int32, int64,

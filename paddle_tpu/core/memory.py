@@ -1,0 +1,69 @@
+"""Device-memory usage stats (reference: the RecordedCudaMallocHelper
+per-device malloc accounting, platform/gpu_info.cc:461, and the
+STAT_gpuN_mem_size monitor registry, platform/monitor.h:77 / monitor.cc:21).
+
+TPU translation: XLA owns allocation, so accounting is READ from the
+runtime (PjRt ``memory_stats``) instead of intercepted at malloc. The
+paddle ``paddle.device.cuda.*`` accounting surface is kept with the same
+semantics: current/peak bytes, per device.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["memory_stats", "memory_allocated", "max_memory_allocated",
+           "memory_reserved", "device_memory_summary"]
+
+
+def _device(device_id: Optional[int] = None):
+    devs = jax.local_devices()
+    return devs[device_id or 0]
+
+
+def memory_stats(device_id: Optional[int] = None) -> dict:
+    """Raw PjRt memory stats for one local device ({} when the backend
+    does not report — e.g. CPU)."""
+    try:
+        return dict(_device(device_id).memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device_id: Optional[int] = None) -> int:
+    """Bytes currently held by live buffers (reference:
+    paddle.device.cuda.memory_allocated)."""
+    return int(memory_stats(device_id).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device_id: Optional[int] = None) -> int:
+    """High-water mark of bytes_in_use (reference:
+    paddle.device.cuda.max_memory_allocated / RecordedCudaMallocHelper
+    peak tracking)."""
+    s = memory_stats(device_id)
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device_id: Optional[int] = None) -> int:
+    """Bytes reserved from the system by the allocator pool (reference:
+    memory_reserved — the auto-growth allocator's pool size)."""
+    s = memory_stats(device_id)
+    return int(s.get("bytes_reserved", s.get("pool_bytes", 0)))
+
+
+def device_memory_summary() -> str:
+    """Human-readable per-device table (reference: the monitor stats
+    printed by StatRegistry)."""
+    lines = []
+    for i, d in enumerate(jax.local_devices()):
+        s = memory_stats(i)
+        if not s:
+            lines.append(f"{d}: (backend reports no memory stats)")
+            continue
+        used = s.get("bytes_in_use", 0) / 2**20
+        peak = s.get("peak_bytes_in_use", 0) / 2**20
+        limit = s.get("bytes_limit", 0) / 2**20
+        lines.append(f"{d}: in_use={used:.1f}MiB peak={peak:.1f}MiB "
+                     f"limit={limit:.1f}MiB")
+    return "\n".join(lines)

@@ -156,11 +156,18 @@ class SaveHandle:
             return
         self._thread.join()
         self._done = True
-        # the barrier runs even on the local-error path — skipping it would
-        # leave the other hosts blocked in sync_global_devices forever
-        _barrier(f"ckpt_save_{self._step}")
-        if self._err:
-            raise self._err[0]
+        # exchange error status BEFORE committing: a host whose shard
+        # write failed must veto the COMMIT on every host (otherwise
+        # process 0 marks a step committed whose manifests are missing),
+        # and the exchange itself keeps the hosts barrier-aligned even on
+        # the error path.
+        n_failed = _sum_across_hosts(1 if self._err else 0)
+        if n_failed:
+            if self._err:
+                raise self._err[0]
+            raise IOError(
+                f"checkpoint step {self._step}: shard write failed on "
+                f"{n_failed} host(s); step NOT committed")
         if jax.process_index() == 0:
             with open(os.path.join(self._dir, _COMMIT), "w") as f:
                 f.write("ok\n")
@@ -268,6 +275,18 @@ def _barrier(tag: str) -> None:
         multihost_utils.sync_global_devices(tag)
 
 
+def _sum_across_hosts(value: int) -> int:
+    """Sum a small host-local int over all processes (doubles as a
+    barrier); single-process returns it unchanged."""
+    if jax.process_count() <= 1:
+        return int(value)
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray([value], np.int32))
+    return int(np.sum(gathered))
+
+
 # ---------------------------------------------------------------------------
 # restore
 # ---------------------------------------------------------------------------
@@ -367,12 +386,22 @@ class _ShardSource:
     def assemble(self, key: str) -> np.ndarray:
         info = self.arrays[key]
         out = np.empty(info["shape"], _np_dtype(info["dtype"]))
+        covered = 0
         for sh in info["shards"]:
             idx = tuple(slice(a, b) for a, b in sh["index"])
             shape = [b - a for a, b in sh["index"]]
             raw = self._read(sh["file"], sh["offset"], sh["nbytes"])
             out[idx] = np.frombuffer(
                 raw, _np_dtype(info["dtype"])).reshape(shape)
+            covered += int(np.prod(shape))
+        # saved shards are disjoint (replica-0 dedupe), so element count
+        # proves coverage; a missing manifest must fail loudly, never
+        # hand back uninitialized memory as weights
+        total = int(np.prod(info["shape"])) if info["shape"] else 1
+        if covered != total:
+            raise IOError(
+                f"checkpoint incomplete for {key!r}: shards cover "
+                f"{covered}/{total} elements (missing per-host manifest?)")
         return out
 
 

@@ -1,6 +1,7 @@
 """paddle.distributed.fleet equivalent."""
 from .distributed_strategy import DistributedStrategy  # noqa: F401
 from .fleet_base import DistributedOptimizer, Fleet, fleet  # noqa: F401
+from . import metrics  # noqa: F401
 
 init = fleet.init
 distributed_optimizer = fleet.distributed_optimizer

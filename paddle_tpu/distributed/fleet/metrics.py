@@ -1,0 +1,95 @@
+"""Distributed metric aggregation (reference:
+python/paddle/distributed/fleet/metrics/metric.py:22-195 — sum/max/min/auc
+over the RoleMaker's Gloo allreduce).
+
+TPU-native translation: the aggregation rides the eager collective API
+(distributed/collective.py — host-staged allreduce over the jax
+coordination service), so it works in every regime the reference's Gloo
+path did; inside a pjit'd eval loop the same reductions are jnp.sum +
+lax.psum and need no helper.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ..collective import ReduceOp, all_reduce
+from ..env import get_world_size
+
+__all__ = ["sum", "max", "min", "acc", "auc"]
+
+_builtin_sum, _builtin_max, _builtin_min = sum, max, min
+
+
+def _allreduce_np(arr: np.ndarray, op) -> np.ndarray:
+    if get_world_size() <= 1:
+        return arr
+    t = Tensor(np.ascontiguousarray(arr))
+    all_reduce(t, op=op)
+    return np.asarray(t._value)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001
+    """reference: fleet/metrics/metric.py sum(:22)."""
+    arr = np.asarray(input._value if isinstance(input, Tensor) else input,
+                     np.float64)
+    return _allreduce_np(arr, ReduceOp.SUM)
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    """reference: fleet/metrics/metric.py max(:57)."""
+    arr = np.asarray(input._value if isinstance(input, Tensor) else input,
+                     np.float64)
+    return _allreduce_np(arr, ReduceOp.MAX)
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    """reference: fleet/metrics/metric.py min(:92)."""
+    arr = np.asarray(input._value if isinstance(input, Tensor) else input,
+                     np.float64)
+    return _allreduce_np(arr, ReduceOp.MIN)
+
+
+def acc(correct, total, scope=None, util=None):
+    """reference: fleet/metrics/metric.py acc(:127) — global correct/total."""
+    c = sum(correct)
+    t = sum(total)
+    return float(c) / _builtin_max(float(t), 1.0)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """reference: fleet/metrics/metric.py auc(:162) — allreduce the
+    positive/negative histograms then integrate (same math as
+    paddle_tpu.metric.Auc.accumulate)."""
+    pos = _allreduce_np(np.asarray(stat_pos, np.int64), ReduceOp.SUM)
+    neg = _allreduce_np(np.asarray(stat_neg, np.int64), ReduceOp.SUM)
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[i]
+        new_neg = tot_neg + neg[i]
+        area += (new_pos + tot_pos) * (new_neg - tot_neg) / 2
+        tot_pos, tot_neg = new_pos, new_neg
+    return area / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+
+def distributed_metric(metric):
+    """Aggregate a paddle_tpu.metric.Metric across processes in place
+    (Accuracy/Precision/Recall/Auc), then return metric.accumulate()."""
+    from ...metric import Accuracy, Auc, Precision, Recall
+
+    if isinstance(metric, Accuracy):
+        metric.total = [int(x) for x in sum(np.asarray(metric.total))]
+        metric.count = [int(x) for x in sum(np.asarray(metric.count))]
+    elif isinstance(metric, Precision):
+        metric.tp = int(sum(np.asarray(metric.tp)))
+        metric.fp = int(sum(np.asarray(metric.fp)))
+    elif isinstance(metric, Recall):
+        metric.tp = int(sum(np.asarray(metric.tp)))
+        metric.fn = int(sum(np.asarray(metric.fn)))
+    elif isinstance(metric, Auc):
+        metric._stat_pos = sum(metric._stat_pos).astype(np.int64)
+        metric._stat_neg = sum(metric._stat_neg).astype(np.int64)
+    else:
+        raise TypeError(f"unsupported metric {type(metric).__name__}")
+    return metric.accumulate()

@@ -1,0 +1,421 @@
+"""Model-agnostic hybrid-parallel trainer: dp × tp × pp × sp × ZeRO in ONE
+pjit program.
+
+The reference composes parallelism with a chain of meta-optimizers that
+rewrite per-rank programs around USER model code (reference:
+fleet/meta_optimizers/{sharding,pipeline,amp,recompute}_optimizer.py chained
+by fleet/base/strategy_compiler.py; the pipeline splitter keys on per-op
+device attributes, pipeline_optimizer.py:136) — model-agnostic by operating
+on the program graph. Here the trainer is model-agnostic by a three-method
+protocol any stacked-block model declares (models/gpt.py, models/bert.py):
+
+  pipeline_stem(*batch)  -> activations       (embeddings)
+  pipeline_blocks()      -> list of identical blocks (stackable params)
+  pipeline_head(x, *batch) -> scalar loss     (norm + head + loss)
+
+The trainer stacks block params to [pp, layers_per_stage, ...], shards the
+stage axis over 'pp' (pipeline.py shard_map), scans/unrolls layers within a
+stage, shards batch dim 0 over 'dp' (+ seq dim 1 over 'sp'), applies ZeRO
+1/2/3 by adding a 'dp' axis to opt-state/param shardings, bf16-casts under
+amp, and wraps blocks in jax.checkpoint under recompute — all in one jitted
+step XLA can schedule globally.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.tensor import Tensor
+from ..static.functional import _swapped_state, state_tensors
+from .fleet.distributed_strategy import DistributedStrategy
+from .pipeline import pipeline_apply
+from .strategy_compiler import (_add_axis, _local_check_shape,
+                                build_mesh_from_strategy,
+                                resolve_param_specs)
+
+
+def _check_protocol(model):
+    for m in ("pipeline_stem", "pipeline_blocks", "pipeline_head"):
+        if not hasattr(model, m):
+            raise TypeError(
+                f"{type(model).__name__} does not implement the pipeline "
+                f"protocol ({m}); see distributed/hybrid.py docstring")
+
+
+class HybridPipelineTrainer:
+    """Compiled hybrid-parallel trainer for any pipeline-protocol model."""
+
+    def __init__(self, model, optimizer,
+                 strategy: Optional[DistributedStrategy] = None,
+                 mesh: Optional[Mesh] = None, n_micro: Optional[int] = None,
+                 v_virtual: Optional[int] = None,
+                 remat_policy: Optional[str] = None):
+        _check_protocol(model)
+        self.model = model
+        self.optimizer = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self.mesh = mesh if mesh is not None else \
+            build_mesh_from_strategy(self.strategy)
+        self.pp = self.mesh.shape.get("pp", 1)
+        self.n_micro = n_micro or max(
+            self.strategy.pipeline_configs.accumulate_steps,
+            self.strategy.pipeline_configs.micro_batch, self.pp)
+        # interleaved/circular schedule degree (pipeline.py): v virtual
+        # stages per device shrink the bubble v×
+        self.v = v_virtual or getattr(self.strategy.pipeline_configs,
+                                      "virtual_pipeline_degree", 1) or 1
+        self.amp = self.strategy.amp
+        self.remat = self.strategy.recompute
+        # remat_policy "dots": selective remat — matmul outputs are saved,
+        # elementwise/softmax recomputed. Most of full remat's memory win
+        # at a fraction of its FLOP cost (full remat re-runs the matmuls
+        # too, reference RecomputeOptimizer semantics).
+        self.remat_policy = remat_policy
+        self.zero = self.strategy.sharding_configs.sharding_stage \
+            if self.strategy.sharding else 0
+
+        blocks = list(model.pipeline_blocks())
+        L = len(blocks)
+        if L % (self.pp * self.v) != 0:
+            raise ValueError(
+                f"{L} blocks must be divisible by pp_degree×v_virtual="
+                f"{self.pp}×{self.v}")
+        self.lps = L // self.pp
+        self.n_layers = L
+
+        # --- split state: block params (stacked) vs the rest --------------
+        pn, pt, bn, bt = state_tensors(model)
+        name_by_id = {id(t): n for n, t in zip(pn, pt)}
+        base_specs = resolve_param_specs(model, self.mesh, zero_stage=0)
+
+        sfx0, t0 = state_tensors(blocks[0])[:2]
+        self.block_suffixes = list(sfx0)
+        self._blk0_tensors = list(t0)
+        self._blk0_fullnames = [name_by_id[id(t)] for t in t0]
+        per_block_tensors: List[List[Tensor]] = [t0]
+        block_ids = set(id(t) for t in t0)
+        for b in blocks[1:]:
+            sfx_i, t_i = state_tensors(b)[:2]
+            if list(sfx_i) != self.block_suffixes:
+                raise ValueError(
+                    "pipeline blocks must have identical structure; "
+                    f"{sfx_i} != {self.block_suffixes}")
+            per_block_tensors.append(list(t_i))
+            block_ids.update(id(t) for t in t_i)
+
+        self.other_names = [n for n, t in zip(pn, pt)
+                            if id(t) not in block_ids]
+        name2t = dict(zip(pn, pt))
+        self._name2tensor = name2t
+        self._per_block_tensors = per_block_tensors
+
+        dp = self.mesh.shape.get("dp", 1)
+
+        # stacked block params: [pp, lps, ...] (GPipe) or
+        # [pp, v, lps/v, ...] (interleaved: stage s circuit c owns layers
+        # (c·pp + s)·lps_v .. +lps_v — the circular assignment)
+        self.block_vals: Dict[str, jax.Array] = {}
+        self.block_specs: Dict[str, P] = {}
+        for j, sfx in enumerate(self.block_suffixes):
+            per_layer = [per_block_tensors[i][j]._value for i in range(L)]
+            stacked = jnp.stack(per_layer, 0)
+            if self.v == 1:
+                stacked = stacked.reshape(
+                    (self.pp, self.lps) + per_layer[0].shape)
+                extra = (None,)
+            else:
+                lps_v = self.lps // self.v
+                stacked = stacked.reshape(
+                    (self.v, self.pp, lps_v) + per_layer[0].shape)
+                stacked = jnp.swapaxes(stacked, 0, 1)   # [pp, v, lps_v,...]
+                extra = (None, None)
+            spec0 = base_specs[self._blk0_fullnames[j]]
+            pp_ax = "pp" if "pp" in self.mesh.axis_names else None
+            spec = P(pp_ax, *extra, *spec0)
+            if self.zero >= 3:
+                shape = _local_check_shape(stacked.shape, spec, self.mesh)
+                spec = _add_axis(spec, stacked.ndim, shape, "dp", dp)
+            self.block_specs[sfx] = spec
+            self.block_vals[sfx] = jax.device_put(
+                stacked, NamedSharding(self.mesh, spec))
+
+        self.other_vals: List[jax.Array] = []
+        self.other_specs: List[P] = []
+        for n in self.other_names:
+            spec = base_specs[n]
+            t = name2t[n]
+            if self.zero >= 3:
+                shape = _local_check_shape(t._value.shape, spec, self.mesh)
+                spec = _add_axis(spec, t._value.ndim, shape, "dp", dp)
+            self.other_specs.append(spec)
+            self.other_vals.append(jax.device_put(
+                t._value, NamedSharding(self.mesh, spec)))
+
+        # --- optimizer state ----------------------------------------------
+        def opt_state_spec(spec, shape, ndim):
+            if self.zero >= 1:
+                local = _local_check_shape(shape, spec, self.mesh)
+                return _add_axis(spec, ndim, local, "dp", dp)
+            return spec
+
+        class _FakeParam:
+            def __init__(self, v):
+                self._value = v
+
+        self.block_opt: Dict[str, dict] = {}
+        self.block_opt_specs: Dict[str, dict] = {}
+        for sfx, v in self.block_vals.items():
+            s = optimizer._init_state(_FakeParam(v))
+            sp = opt_state_spec(self.block_specs[sfx], v.shape, v.ndim)
+            self.block_opt[sfx] = jax.device_put(
+                s, {k: NamedSharding(self.mesh, sp) for k in s})
+            self.block_opt_specs[sfx] = {k: sp for k in s}
+        self.other_opt: List[dict] = []
+        self.other_opt_specs: List[dict] = []
+        for n, v, spec in zip(self.other_names, self.other_vals,
+                              self.other_specs):
+            s = optimizer._init_state(_FakeParam(v))
+            sp = opt_state_spec(spec, v.shape, v.ndim)
+            self.other_opt.append(jax.device_put(
+                s, {k: NamedSharding(self.mesh, sp) for k in s}))
+            self.other_opt_specs.append({k: sp for k in s})
+
+        self._step = 0
+        self._n_batch_args: Optional[int] = None
+        self._step_fn = None
+
+    # ---------------------------------------------------------------------
+    def _forward_loss(self, block_params, other_params, batch, key):
+        model = self.model
+        from ..core import rng as rng_mod
+
+        if self.amp:
+            castf = lambda v: v.astype(jnp.bfloat16) if \
+                jnp.issubdtype(v.dtype, jnp.floating) else v
+        else:
+            castf = lambda v: v
+        other_cast = [castf(v) for v in other_params]
+        block_cast = {k: castf(v) for k, v in block_params.items()}
+
+        other_tensors = [self._name2tensor[n] for n in self.other_names]
+        blk0_tensors = self._blk0_tensors
+        sp = self.mesh.shape.get("sp", 1)
+
+        def seq_constraint(h):
+            """Keep activations sequence-sharded between ring attentions.
+            Skipped for bf16 on XLA:CPU (tests): resharding constraints on
+            bf16 trip a CPU-backend crash; TPU is unaffected."""
+            if sp > 1 and not (jax.default_backend() == "cpu"
+                               and h.dtype == jnp.bfloat16):
+                return jax.lax.with_sharding_constraint(
+                    h, NamedSharding(self.mesh, P("dp", "sp", None)))
+            return h
+
+        from . import context as dctx
+        manual_sp = sp > 1 and self.pp > 1
+        block0 = model.pipeline_blocks()[0]
+
+        def block_apply(stage_local, x):
+            """Apply one stage's lps blocks (lax.scan over layers)."""
+            def one_block(h, layer_params):
+                vals = [layer_params[s] for s in self.block_suffixes]
+                with _swapped_state(blk0_tensors, vals):
+                    if manual_sp:
+                        with dctx.manual_sequence_parallel_scope():
+                            out = block0(Tensor(h))._value
+                    else:
+                        out = block0(Tensor(h))._value
+                return out
+
+            if self.remat:
+                if self.remat_policy == "dots":
+                    one_block = jax.checkpoint(
+                        one_block,
+                        policy=jax.checkpoint_policies
+                        .dots_with_no_batch_dims_saveable)
+                else:
+                    one_block = jax.checkpoint(one_block)
+
+            def body(h, layer_params):
+                return one_block(h, layer_params), None
+
+            # unrolling removes the scan's dynamic-update-slice residual
+            # bookkeeping on TPU; CPU (tests) keeps compile times sane
+            out, _ = jax.lax.scan(body, x, stage_local,
+                                  unroll=jax.default_backend() != "cpu")
+            return out
+
+        batch_tensors = [Tensor(b) for b in batch]
+        # loss-inside-pipeline: the head runs in the manual region and only
+        # a SCALAR crosses 'pp' (vs the full activation buffer). Disabled
+        # under manual sp (head must see the sp-sharded output), under
+        # CPU+amp (bf16 cotangent psum trips XLA:CPU), and under tp>1
+        # (GSPMD-auto tp collectives for the vocab-sharded head inside the
+        # manual region abort the XLA:CPU backend; legacy egress is
+        # correct everywhere, just costlier).
+        head_inside = not manual_sp and self.pp > 1 and \
+            self.mesh.shape.get("tp", 1) == 1 and not (
+                jax.default_backend() == "cpu" and self.amp)
+        with _swapped_state(other_tensors, other_cast), \
+                dctx.sequence_parallel_scope(self.mesh):
+            with rng_mod.key_scope(key):
+                x = model.pipeline_stem(*batch_tensors)._value
+                x = seq_constraint(x)
+                if head_inside:
+                    # head params + batch enter the manual region as
+                    # explicit inputs; blocks' swapped values are local
+                    def head_fn(full, other_vals, batch_vals):
+                        with _swapped_state(other_tensors,
+                                            list(other_vals)):
+                            return model.pipeline_head(
+                                Tensor(full),
+                                *[Tensor(b) for b in batch_vals])._value
+                    loss_v = pipeline_apply(
+                        self.mesh, block_apply, block_cast, x,
+                        self.n_micro, v_virtual=self.v, head_fn=head_fn,
+                        head_args=(tuple(other_cast), tuple(batch)))
+                    return loss_v.astype(jnp.float32)
+                x = pipeline_apply(self.mesh, block_apply, block_cast, x,
+                                   self.n_micro, v_virtual=self.v,
+                                   sp_axis="sp" if manual_sp else None)
+                x = Tensor(seq_constraint(x))
+                loss = model.pipeline_head(x, *batch_tensors)
+        return loss._value.astype(jnp.float32)
+
+    def _build(self, n_batch_args: int):
+        from .strategy_compiler import functional_clip, make_param_update
+
+        opt = self.optimizer
+        clip = opt._grad_clip
+        mesh = self.mesh
+        wd_other = tuple(opt._decoupled_wd(self._name2tensor[n])
+                         for n in self.other_names)
+        lr_other = tuple(
+            self._name2tensor[n].optimize_attr.get("learning_rate", 1.0)
+            for n in self.other_names)
+        wd_block = {s: opt._decoupled_wd(t) for s, t in
+                    zip(self.block_suffixes, self._blk0_tensors)}
+        lr_block = {s: t.optimize_attr.get("learning_rate", 1.0)
+                    for s, t in zip(self.block_suffixes,
+                                    self._blk0_tensors)}
+        upd = make_param_update(opt)
+
+        def step_fn(block_params, other_params, block_opt, other_opt,
+                    batch, lr, step_no, key):
+            def loss_of(bp, op):
+                return self._forward_loss(bp, op, batch, key)
+
+            loss, (g_blk, g_oth) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(block_params, other_params)
+            g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
+
+            new_blk, new_blk_opt = {}, {}
+            for sfx in block_params:
+                np_, ns = upd(block_params[sfx], g_blk[sfx],
+                              block_opt[sfx], lr, step_no,
+                              plr=lr_block[sfx], wd=wd_block[sfx])
+                new_blk[sfx] = np_
+                new_blk_opt[sfx] = ns
+            new_oth, new_oth_opt = [], []
+            for p, g, s, plr, wd in zip(other_params, g_oth, other_opt,
+                                        lr_other, wd_other):
+                np_, ns = upd(p, g, s, lr, step_no, plr=plr, wd=wd)
+                new_oth.append(np_)
+                new_oth_opt.append(ns)
+            return loss, new_blk, new_oth, new_blk_opt, new_oth_opt
+
+        ns = lambda spec: NamedSharding(mesh, spec)
+        blk_sh = {k: ns(v) for k, v in self.block_specs.items()}
+        oth_sh = [ns(s) for s in self.other_specs]
+        blk_opt_sh = {k: {kk: ns(vv) for kk, vv in v.items()}
+                      for k, v in self.block_opt_specs.items()}
+        oth_opt_sh = [{kk: ns(vv) for kk, vv in d.items()}
+                      for d in self.other_opt_specs]
+        sp = mesh.shape.get("sp", 1)
+
+        def batch_spec(ndim):
+            if ndim >= 2 and sp > 1:
+                return P("dp", "sp")
+            return P("dp") if ndim >= 1 else P()
+
+        self._batch_spec = batch_spec
+        self._step_fn = jax.jit(
+            step_fn,
+            in_shardings=(blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
+                          None, None, None, None),
+            out_shardings=(ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh),
+            donate_argnums=(0, 1, 2, 3))
+        self._n_batch_args = n_batch_args
+
+    def step(self, *batch) -> jax.Array:
+        from ..core import rng as rng_mod
+
+        if self._step_fn is None or self._n_batch_args != len(batch):
+            self._build(len(batch))
+        self._step += 1
+        vs = []
+        for b in batch:
+            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+            vs.append(jax.device_put(v, NamedSharding(
+                self.mesh, self._batch_spec(v.ndim))))
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        loss, self.block_vals, self.other_vals, self.block_opt, \
+            self.other_opt = self._step_fn(
+                self.block_vals, self.other_vals, self.block_opt,
+                self.other_opt, tuple(vs), lr,
+                jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+        self.optimizer._global_step = self._step
+        return loss
+
+    __call__ = step
+
+    # -- sharded checkpoint integration (distributed/checkpoint.py) -------
+    def device_state(self):
+        """The trainer's on-device state as one pytree of sharded arrays
+        (params + optimizer state), for distributed.checkpoint.save."""
+        return {"block": dict(self.block_vals),
+                "other": list(self.other_vals),
+                "block_opt": {k: dict(v) for k, v in self.block_opt.items()},
+                "other_opt": [dict(d) for d in self.other_opt]}
+
+    def load_device_state(self, st, step: Optional[int] = None):
+        """Inverse of device_state (resume-exact: same values, shardings)."""
+        self.block_vals = dict(st["block"])
+        self.other_vals = list(st["other"])
+        self.block_opt = {k: dict(v) for k, v in st["block_opt"].items()}
+        self.other_opt = [dict(d) for d in st["other_opt"]]
+        if step is not None:
+            self._step = int(step)
+            self.optimizer._global_step = int(step)
+
+    def sync_to_layer(self):
+        """Unstack device state (params AND optimizer accumulators) back
+        into the eager model/optimizer, so state_dict/checkpoints see the
+        trained values."""
+        L = self.n_layers
+
+        def unstack(a):
+            if self.v == 1:
+                return a.reshape((L,) + tuple(a.shape[2:]))
+            # invert the circular assignment: [pp, v, lps_v, ...] -> [L,...]
+            return jnp.swapaxes(a, 0, 1).reshape((L,) + tuple(a.shape[3:]))
+
+        for sfx_i, sfx in enumerate(self.block_suffixes):
+            stacked = self.block_vals[sfx]
+            flat = unstack(stacked)
+            opt_flat = {k: unstack(v)
+                        for k, v in self.block_opt[sfx].items()}
+            for i in range(L):
+                t = self._per_block_tensors[i][sfx_i]
+                t._value = flat[i]
+                self.optimizer._accumulators[id(t)] = {
+                    k: v[i] for k, v in opt_flat.items()}
+        for n, v, s in zip(self.other_names, self.other_vals,
+                           self.other_opt):
+            t = self._name2tensor[n]
+            t._value = v
+            self.optimizer._accumulators[id(t)] = s
+        return self.model

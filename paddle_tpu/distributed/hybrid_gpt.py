@@ -1,336 +1,20 @@
-"""GPT hybrid-parallel trainer: dp × tp × pp × ZeRO in ONE pjit program.
+"""GPT hybrid-parallel trainer — back-compat name for the generic
+HybridPipelineTrainer (distributed/hybrid.py).
 
-This is the TPU-native composition the reference achieved with a chain of
-meta-optimizers rewriting programs per rank (reference:
-sharding_optimizer.py + pipeline_optimizer.py + amp/recompute optimizers,
-chained by strategy_compiler.py) — here it's sharding specs + shard_map:
-
-  - embeddings / final-norm / lm-head params: GSPMD (tp/zero specs)
-  - transformer blocks: params stacked to [pp, layers_per_stage, ...],
-    stage axis shard_map'd over 'pp' (pipeline.py), layers scanned within a
-    stage, each block optionally rematerialized (jax.checkpoint ==
-    reference RecomputeOptimizer),
-  - batch sharded over 'dp'; XLA derives gradient reduce-scatter from the
-    ZeRO opt-state shardings,
-  - bf16 compute / fp32 master params when strategy.amp.
+Round-1 shipped this trainer hardwired to the GPT block layout (the
+"blocks.0." name contract); the generalization moved the machinery into
+distributed/hybrid.py behind the pipeline protocol
+(pipeline_stem/pipeline_blocks/pipeline_head, declared by models/gpt.py,
+models/bert.py). This module keeps the public name and the GPT-specific
+docstrings' reference citations alive: the reference achieved the same
+composition with per-rank program rewriting chained by
+fleet/base/strategy_compiler.py (sharding_optimizer.py +
+pipeline_optimizer.py + amp/recompute meta-optimizers).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ..framework.tensor import Tensor
-from ..models.gpt import GPT
-from ..static.functional import _swapped_state, state_tensors
-from .fleet.distributed_strategy import DistributedStrategy
-from .pipeline import pipeline_apply
-from .strategy_compiler import (_add_axis, _local_check_shape,
-                                build_mesh_from_strategy,
-                                resolve_param_specs)
+from .hybrid import HybridPipelineTrainer
 
 
-class GPTHybridTrainer:
-    def __init__(self, model: GPT, optimizer,
-                 strategy: Optional[DistributedStrategy] = None,
-                 mesh: Optional[Mesh] = None, n_micro: Optional[int] = None):
-        self.model = model
-        self.optimizer = optimizer
-        self.strategy = strategy or DistributedStrategy()
-        self.mesh = mesh if mesh is not None else \
-            build_mesh_from_strategy(self.strategy)
-        self.pp = self.mesh.shape.get("pp", 1)
-        self.n_micro = n_micro or max(
-            self.strategy.pipeline_configs.accumulate_steps,
-            self.strategy.pipeline_configs.micro_batch, self.pp)
-        self.amp = self.strategy.amp
-        self.remat = self.strategy.recompute
-        self.zero = self.strategy.sharding_configs.sharding_stage \
-            if self.strategy.sharding else 0
-
-        L = model.config.num_layers
-        if L % self.pp != 0:
-            raise ValueError(
-                f"num_layers={L} must be divisible by pp_degree={self.pp}")
-        self.lps = L // self.pp
-
-        # --- split state: block params (stacked) vs the rest --------------
-        pn, pt, bn, bt = state_tensors(model)
-        self.all_names = pn
-        base_specs = resolve_param_specs(model, self.mesh, zero_stage=0)
-
-        blk0 = [n for n in pn if n.startswith("blocks.0.")]
-        self.block_suffixes = [n[len("blocks.0."):] for n in blk0]
-        self.other_names = [n for n in pn if not n.startswith("blocks.")]
-        name2t = dict(zip(pn, pt))
-        self._name2tensor = name2t
-
-        dp = self.mesh.shape.get("dp", 1)
-
-        # stacked block params: [pp, lps, ...]
-        self.block_vals: Dict[str, jax.Array] = {}
-        self.block_specs: Dict[str, P] = {}
-        for sfx in self.block_suffixes:
-            per_layer = [name2t[f"blocks.{i}.{sfx}"]._value
-                         for i in range(L)]
-            stacked = jnp.stack(per_layer, 0).reshape(
-                (self.pp, self.lps) + per_layer[0].shape)
-            spec0 = base_specs[f"blocks.0.{sfx}"]
-            spec = P("pp", None, *spec0)
-            if self.zero >= 3:
-                shape = _local_check_shape(stacked.shape, spec, self.mesh)
-                spec = _add_axis(spec, stacked.ndim, shape, "dp", dp)
-            self.block_specs[sfx] = spec
-            self.block_vals[sfx] = jax.device_put(
-                stacked, NamedSharding(self.mesh, spec))
-
-        self.other_vals: List[jax.Array] = []
-        self.other_specs: List[P] = []
-        for n in self.other_names:
-            spec = base_specs[n]
-            t = name2t[n]
-            if self.zero >= 3:
-                shape = _local_check_shape(t._value.shape, spec, self.mesh)
-                spec = _add_axis(spec, t._value.ndim, shape, "dp", dp)
-            self.other_specs.append(spec)
-            self.other_vals.append(jax.device_put(
-                t._value, NamedSharding(self.mesh, spec)))
-
-        # --- optimizer state ----------------------------------------------
-        def opt_state_spec(spec, shape, ndim):
-            if self.zero >= 1:
-                local = _local_check_shape(shape, spec, self.mesh)
-                return _add_axis(spec, ndim, local, "dp", dp)
-            return spec
-
-        class _FakeParam:
-            def __init__(self, v):
-                self._value = v
-
-        self.block_opt: Dict[str, dict] = {}
-        self.block_opt_specs: Dict[str, dict] = {}
-        for sfx, v in self.block_vals.items():
-            s = optimizer._init_state(_FakeParam(v))
-            sp = opt_state_spec(self.block_specs[sfx], v.shape, v.ndim)
-            self.block_opt[sfx] = jax.device_put(
-                s, {k: NamedSharding(self.mesh, sp) for k in s})
-            self.block_opt_specs[sfx] = {k: sp for k in s}
-        self.other_opt: List[dict] = []
-        self.other_opt_specs: List[dict] = []
-        for n, v, spec in zip(self.other_names, self.other_vals,
-                              self.other_specs):
-            s = optimizer._init_state(_FakeParam(v))
-            sp = opt_state_spec(spec, v.shape, v.ndim)
-            self.other_opt.append(jax.device_put(
-                s, {k: NamedSharding(self.mesh, sp) for k in s}))
-            self.other_opt_specs.append({k: sp for k in s})
-
-        self._step = 0
-        self._build()
-
-    # ---------------------------------------------------------------------
-    def _forward_loss(self, block_params, other_params, tokens, key):
-        model = self.model
-        cfg = model.config
-        from ..core import rng as rng_mod
-
-        if self.amp:
-            castf = lambda v: v.astype(jnp.bfloat16) if \
-                jnp.issubdtype(v.dtype, jnp.floating) else v
-        else:
-            castf = lambda v: v
-        other_cast = [castf(v) for v in other_params]
-        block_cast = {k: castf(v) for k, v in block_params.items()}
-
-        other_tensors = [self._name2tensor[n] for n in self.other_names]
-        blk0_tensors = [self._name2tensor[f"blocks.0.{s}"]
-                        for s in self.block_suffixes]
-        sp = self.mesh.shape.get("sp", 1)
-
-        def seq_constraint(h):
-            """Keep activations sequence-sharded between ring attentions.
-            Skipped for bf16 on XLA:CPU (tests): resharding constraints on
-            bf16 trip a CPU-backend crash; TPU is unaffected."""
-            if sp > 1 and not (jax.default_backend() == "cpu"
-                               and h.dtype == jnp.bfloat16):
-                return jax.lax.with_sharding_constraint(
-                    h, NamedSharding(self.mesh, P("dp", "sp", None)))
-            return h
-
-        from . import context as dctx
-        manual_sp = sp > 1 and self.pp > 1
-
-        def block_apply(stage_local, x):
-            """Apply one stage's lps blocks (lax.scan over layers)."""
-            def one_block(h, layer_params):
-                vals = [layer_params[s] for s in self.block_suffixes]
-                with _swapped_state(blk0_tensors, vals):
-                    if manual_sp:
-                        # pipeline shard_map is manual over sp too:
-                        # attention runs the in-context ring directly
-                        with dctx.manual_sequence_parallel_scope():
-                            out = model.blocks[0](Tensor(h))._value
-                    else:
-                        out = model.blocks[0](Tensor(h))._value
-                return out
-
-            if self.remat:
-                one_block = jax.checkpoint(one_block)
-
-            def body(h, layer_params):
-                return one_block(h, layer_params), None
-
-            # unrolling the layer loop on TPU removes the scan's
-            # dynamic-update-slice residual bookkeeping (~11% step time at
-            # GPT-125M); CPU (tests) keeps the rolled scan for compile time
-            out, _ = jax.lax.scan(body, x, stage_local,
-                                  unroll=jax.default_backend() != "cpu")
-            return out
-
-        with _swapped_state(other_tensors, other_cast), \
-                dctx.sequence_parallel_scope(self.mesh):
-            with rng_mod.key_scope(key):
-                x = model.embeddings(Tensor(tokens))._value
-                x = seq_constraint(x)
-                x = pipeline_apply(self.mesh, block_apply, block_cast, x,
-                                   self.n_micro,
-                                   sp_axis="sp" if manual_sp else None)
-                x = Tensor(seq_constraint(x))
-                x = model.ln_f(x)
-                # fused lm-head + CE: logits never hit HBM (ops/fused_ce.py).
-                # Chunking over seq would fight an sp sharding, so sp>1 runs
-                # one chunk (GSPMD already divides the logits tile by sp).
-                from ..ops.fused_ce import (fused_linear_cross_entropy_fn,
-                                            shifted_labels)
-
-                labels = shifted_labels(tokens)
-                ck = None if sp > 1 else 256
-                if cfg.tie_word_embeddings:
-                    w = model.embeddings.wte.weight._value       # [V, H]
-                    loss = fused_linear_cross_entropy_fn(
-                        x._value, w, labels, chunk=ck)
-                else:
-                    w = model.lm_head.weight._value              # [H, V]
-                    loss = fused_linear_cross_entropy_fn(
-                        x._value, w, labels, chunk=ck, transpose_w=True)
-        return loss.astype(jnp.float32)
-
-    def _build(self):
-        from .strategy_compiler import functional_clip, make_param_update
-
-        opt = self.optimizer
-        clip = opt._grad_clip
-        mesh = self.mesh
-        wd_other = tuple(opt._decoupled_wd(self._name2tensor[n])
-                         for n in self.other_names)
-        lr_other = tuple(
-            self._name2tensor[n].optimize_attr.get("learning_rate", 1.0)
-            for n in self.other_names)
-        wd_block = {s: opt._decoupled_wd(
-            self._name2tensor[f"blocks.0.{s}"])
-            for s in self.block_suffixes}
-        lr_block = {s: self._name2tensor[
-            f"blocks.0.{s}"].optimize_attr.get("learning_rate", 1.0)
-            for s in self.block_suffixes}
-        upd = make_param_update(opt)
-
-        def step_fn(block_params, other_params, block_opt, other_opt,
-                    tokens, lr, step_no, key):
-            def loss_of(bp, op):
-                return self._forward_loss(bp, op, tokens, key)
-
-            loss, (g_blk, g_oth) = jax.value_and_grad(
-                loss_of, argnums=(0, 1))(block_params, other_params)
-            g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
-
-            new_blk, new_blk_opt = {}, {}
-            for sfx in block_params:
-                np_, ns = upd(block_params[sfx], g_blk[sfx],
-                              block_opt[sfx], lr, step_no,
-                              plr=lr_block[sfx], wd=wd_block[sfx])
-                new_blk[sfx] = np_
-                new_blk_opt[sfx] = ns
-            new_oth, new_oth_opt = [], []
-            for p, g, s, plr, wd in zip(other_params, g_oth, other_opt,
-                                        lr_other, wd_other):
-                np_, ns = upd(p, g, s, lr, step_no, plr=plr, wd=wd)
-                new_oth.append(np_)
-                new_oth_opt.append(ns)
-            return loss, new_blk, new_oth, new_blk_opt, new_oth_opt
-
-        ns = lambda spec: NamedSharding(mesh, spec)
-        blk_sh = {k: ns(v) for k, v in self.block_specs.items()}
-        oth_sh = [ns(s) for s in self.other_specs]
-        blk_opt_sh = {k: {kk: ns(vv) for kk, vv in v.items()}
-                      for k, v in self.block_opt_specs.items()}
-        oth_opt_sh = [{kk: ns(vv) for kk, vv in d.items()}
-                      for d in self.other_opt_specs]
-        tok_spec = P("dp", "sp") if mesh.shape.get("sp", 1) > 1 else P("dp")
-        self._token_sharding = ns(tok_spec)
-        self._step_fn = jax.jit(
-            step_fn,
-            in_shardings=(blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
-                          self._token_sharding, None, None, None),
-            out_shardings=(ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh),
-            donate_argnums=(0, 1, 2, 3))
-
-    def step(self, tokens) -> jax.Array:
-        from ..core import rng as rng_mod
-
-        self._step += 1
-        v = tokens._value if isinstance(tokens, Tensor) else \
-            jnp.asarray(tokens)
-        v = jax.device_put(v, self._token_sharding)
-        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.block_vals, self.other_vals, self.block_opt, \
-            self.other_opt = self._step_fn(
-                self.block_vals, self.other_vals, self.block_opt,
-                self.other_opt, v, lr, jnp.asarray(self._step, jnp.int32),
-                rng_mod.next_key())
-        self.optimizer._global_step = self._step
-        return loss
-
-    __call__ = step
-
-    # -- sharded checkpoint integration (distributed/checkpoint.py) -------
-    def device_state(self):
-        """The trainer's on-device state as one pytree of sharded arrays
-        (params + optimizer state), for distributed.checkpoint.save."""
-        return {"block": dict(self.block_vals),
-                "other": list(self.other_vals),
-                "block_opt": {k: dict(v) for k, v in self.block_opt.items()},
-                "other_opt": [dict(d) for d in self.other_opt]}
-
-    def load_device_state(self, st, step: Optional[int] = None):
-        """Inverse of device_state (resume-exact: same values, shardings)."""
-        self.block_vals = dict(st["block"])
-        self.other_vals = list(st["other"])
-        self.block_opt = {k: dict(v) for k, v in st["block_opt"].items()}
-        self.other_opt = [dict(d) for d in st["other_opt"]]
-        if step is not None:
-            self._step = int(step)
-            self.optimizer._global_step = int(step)
-
-    def sync_to_layer(self):
-        """Unstack device state (params AND optimizer accumulators) back
-        into the eager model/optimizer, so state_dict/checkpoints see the
-        trained values."""
-        L = self.model.config.num_layers
-        for sfx, stacked in self.block_vals.items():
-            flat = stacked.reshape((L,) + tuple(stacked.shape[2:]))
-            opt_flat = {k: v.reshape((L,) + tuple(v.shape[2:]))
-                        for k, v in self.block_opt[sfx].items()}
-            for i in range(L):
-                t = self._name2tensor[f"blocks.{i}.{sfx}"]
-                t._value = flat[i]
-                self.optimizer._accumulators[id(t)] = {
-                    k: v[i] for k, v in opt_flat.items()}
-        for n, v, s in zip(self.other_names, self.other_vals,
-                           self.other_opt):
-            t = self._name2tensor[n]
-            t._value = v
-            self.optimizer._accumulators[id(t)] = s
-        return self.model
+class GPTHybridTrainer(HybridPipelineTrainer):
+    """``HybridPipelineTrainer`` under its round-1 name; ``step(tokens)``."""

@@ -7,25 +7,37 @@ section_worker.cc:34 F-then-B thread-per-stage schedule).
 
 Here the whole pipeline is ONE compiled SPMD computation:
   - transformer blocks' params are stacked into [pp, layers_per_stage, ...]
-    with the stage axis sharded over mesh axis 'pp' (shard_map manual);
+    (or [pp, v, layers_per_virtual, ...] when interleaved) with the stage
+    axis sharded over mesh axis 'pp' (shard_map manual);
   - microbatches stream through stages with lax.ppermute — the XLA
     collective-permute that replaces the reference's per-microbatch
     ncclSend/ncclRecv (send_v2_op.cu.cc);
-  - the fill/drain loop is a lax.scan, so forward AND backward of the whole
+  - the schedule loop is a lax.scan, so forward AND backward of the whole
     schedule differentiate through the permute chain — no per-stage
     hand-written backward passes (section_worker.cc:77-93);
   - other mesh axes (dp/tp/sp) stay in GSPMD 'auto' mode inside the stage
     body, composing pipeline with tensor/data parallelism.
 
-Bubble note: this is the GPipe fill-drain schedule (n_micro + pp - 1
-ticks). The reference syncs every microbatch with cudaDeviceSynchronize
-(section_worker.cc:73); here XLA overlaps the permute with compute, and
-raising n_micro amortizes the bubble exactly as in GPipe.
+Schedules:
+  - v_virtual=1: GPipe fill-drain — n_micro + pp - 1 ticks of a full
+    stage's layers each; bubble fraction (pp-1)/(n_micro+pp-1).
+  - v_virtual=v>1: interleaved/circular (each device owns v non-contiguous
+    "virtual stages"; microbatches circle the ring v times) —
+    v·n_micro + pp - 1 ticks of 1/v the work each; bubble fraction
+    (pp-1)/(v·n_micro+pp-1), i.e. v× smaller than GPipe AND than the
+    reference's F-then-B. Requires n_micro >= pp.
+
+Loss egress: when ``head_fn`` is given, the loss head runs INSIDE the
+manual region — every stage computes it in SPMD lockstep (no wall-clock
+cost vs one stage computing while the rest idle), the last stage's value
+is selected, and only the SCALAR is psum'd across 'pp'. Without head_fn
+the full activation buffer is shared via masked psum (needed by the
+manual-sp composition, where the head must see the sp-sharded output).
 """
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -41,12 +53,19 @@ def stack_block_params(block_param_lists):
 
 def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                    x, n_micro: int, pp_axis: str = "pp",
-                   sp_axis: str = None):
-    """Run x [batch, ...] through pp×layers_per_stage stacked blocks.
+                   sp_axis: str = None, v_virtual: int = 1,
+                   head_fn: Optional[Callable] = None,
+                   head_args: tuple = ()):
+    """Run x [batch, ...] through the pipelined stacked blocks.
 
-    stage_fn(params_one_stage, x_mb) -> y_mb applies one stage's layers to
-    one microbatch. stacked_params leaves are [pp, ...]; x is split into
-    n_micro microbatches along dim 0.
+    stage_fn(params_one_chunk, x_mb) -> y_mb applies one (virtual) stage's
+    layers to one microbatch. stacked_params leaves are [pp, ...] for
+    v_virtual=1 or [pp, v, ...] for interleaved; x is split into n_micro
+    microbatches along dim 0.
+
+    head_fn(full_output) -> scalar: optional loss head computed inside the
+    region (see module docstring); returns the scalar instead of the
+    activations.
 
     sp_axis: when set (sequence parallelism composed with pipeline), the
     shard_map is manual over BOTH axes — x's seq dim (dim 1) stays sharded
@@ -55,14 +74,24 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     shard_maps over the same axis are rejected by the partitioner, so
     manual-over-both is the composition mechanism.
     """
-    pp = mesh.shape[pp_axis]
+    pp = mesh.shape.get(pp_axis, 1)
+    v = v_virtual
     if sp_axis is not None and mesh.shape.get(sp_axis, 1) <= 1:
         sp_axis = None
+    if sp_axis is not None and head_fn is not None:
+        raise ValueError("head_fn is not supported under manual sp "
+                         "(the head must see the sp-sharded output)")
+    if v > 1 and n_micro < pp:
+        raise ValueError(
+            f"interleaved schedule needs n_micro >= pp ({n_micro} < {pp})")
     if pp == 1:
-        sliced = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        sliced = jax.tree_util.tree_map(
+            lambda a: a[0] if v == 1 else a[0].reshape(
+                (-1,) + tuple(a.shape[3:])), stacked_params)
         mbs = _to_microbatches(x, n_micro)
         out = jax.lax.map(lambda mb: stage_fn(sliced, mb), mbs)
-        return _from_microbatches(out, x.shape)
+        full = _from_microbatches(out, x.shape)
+        return head_fn(full, *head_args) if head_fn is not None else full
 
     compute_dtype = x.dtype
     # XLA:CPU's AllReducePromotion pass crashes on bf16 all-reduce; the
@@ -85,50 +114,93 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                                          and a.dtype == jnp.bfloat16) else a
     # xs is [n_micro, mb, seq, ...]: seq (dim 2) sharded over sp when set
     x_spec = P() if sp_axis is None else P(None, None, sp_axis)
+    out_spec = P() if head_fn is not None else x_spec
+    # head params/batch enter as explicit inputs (replicated over the
+    # manual axes; their dp/tp shardings ride the auto axes) — closures
+    # over outer-traced sharded values are rejected inside shard_map
+    head_specs = jax.tree_util.tree_map(lambda _: P(), head_args)
 
     @partial(jax.shard_map, mesh=mesh,
-             in_specs=(param_specs, x_spec), out_specs=x_spec,
+             in_specs=(param_specs, x_spec, head_specs), out_specs=out_spec,
              check_vma=False, axis_names=manual)
-    def pipelined(params, xs):
+    def pipelined(params, xs, head_args):
         # params leaves: [1, ...] local slice; xs: [n_micro, mb, ...]
         local = jax.tree_util.tree_map(
             lambda a: a[0].astype(compute_dtype)
             if (param_f32 and a.dtype == jnp.float32
                 and compute_dtype == jnp.bfloat16) else a[0], params)
         stage = jax.lax.axis_index(pp_axis)
-        n_ticks = n_micro + pp - 1
+        n_ticks = v * n_micro + pp - 1
         mb_shape = xs.shape[1:]
         # carry dtype: f32 on CPU+bf16 so the inter-stage ppermute (a
         # collective inside the manual region) never runs in bf16
         carry_dtype = jnp.float32 if boundary_f32 else compute_dtype
         state0 = jnp.zeros(mb_shape, carry_dtype)
         outputs0 = jnp.zeros(xs.shape, carry_dtype)
+        # circuit-return buffer (interleaved: finished circuits wait here
+        # until stage 0 re-injects them); unused for v == 1
+        ret0 = jnp.zeros(xs.shape, carry_dtype)
 
         def tick(carry, t):
-            prev_out, outputs = carry
+            prev_out, ret, outputs = carry
             # stage i receives stage i-1's last output (ring; stage 0's
-            # recv is garbage and masked below)
+            # recv feeds the circuit-return buffer)
             recv = jax.lax.ppermute(
                 prev_out, pp_axis,
                 [(i, (i + 1) % pp) for i in range(pp)])
-            mb_idx = jnp.clip(t, 0, n_micro - 1)
-            inp = jnp.where(stage == 0,
-                            jax.lax.dynamic_index_in_dim(
-                                xs, mb_idx, 0,
-                                keepdims=False).astype(carry_dtype),
-                            recv)
-            out = stage_fn(local, inp.astype(compute_dtype)) \
+            if v > 1:
+                # a completed circuit item arrives back at stage 0 at tick
+                # t with microbatch id (t - pp) mod n_micro
+                ret_idx = jnp.clip((t - pp) % n_micro, 0, n_micro - 1)
+                cur_ret = jax.lax.dynamic_index_in_dim(
+                    ret, ret_idx, 0, keepdims=False)
+                ret = jax.lax.dynamic_update_index_in_dim(
+                    ret, jnp.where((stage == 0) & (t >= pp), recv, cur_ret),
+                    ret_idx, 0)
+            # stage 0 at tick t processes (circuit c, microbatch m)
+            mb_idx = jnp.clip(t % n_micro, 0, n_micro - 1) if v > 1 else \
+                jnp.clip(t, 0, n_micro - 1)
+            circuit0 = t // n_micro if v > 1 else jnp.zeros_like(t)
+            fresh = jax.lax.dynamic_index_in_dim(
+                xs, mb_idx, 0, keepdims=False).astype(carry_dtype)
+            if v > 1:
+                returned = jax.lax.dynamic_index_in_dim(
+                    ret, mb_idx, 0, keepdims=False)
+                stage0_in = jnp.where(circuit0 == 0, fresh, returned)
+            else:
+                stage0_in = fresh
+            inp = jnp.where(stage == 0, stage0_in, recv)
+            # params for this tick: the circuit this stage is working on
+            if v > 1:
+                c_s = jnp.clip((t - stage) // n_micro, 0, v - 1)
+                chunk = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, c_s, 0, keepdims=False), local)
+            else:
+                chunk = local
+            out = stage_fn(chunk, inp.astype(compute_dtype)) \
                 .astype(carry_dtype)
-            out_idx = jnp.clip(t - (pp - 1), 0, n_micro - 1)
-            valid = (t >= pp - 1)
+            # the last stage finishing the LAST circuit produces output
+            done_t = t - (pp - 1) - (v - 1) * n_micro
+            out_idx = jnp.clip(done_t % n_micro if v > 1 else done_t,
+                               0, n_micro - 1)
+            valid = done_t >= 0
             cur = jax.lax.dynamic_index_in_dim(outputs, out_idx, 0,
                                                keepdims=False)
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(valid, out, cur), out_idx, 0)
-            return (out, outputs), None
+            return (out, ret, outputs), None
 
-        (last, outputs), _ = jax.lax.scan(tick, (state0, outputs0),
-                                          jnp.arange(n_ticks))
+        (last, _, outputs), _ = jax.lax.scan(
+            tick, (state0, ret0, outputs0), jnp.arange(n_ticks))
+        if head_fn is not None:
+            # loss head on every stage in lockstep; only the last stage's
+            # value is real — egress is ONE scalar, not the activations
+            full = outputs.reshape((outputs.shape[0] * outputs.shape[1],)
+                                   + tuple(outputs.shape[2:]))
+            loss = head_fn(full.astype(compute_dtype), *head_args)
+            loss = jnp.where(stage == pp - 1, loss, 0.0)
+            return jax.lax.psum(loss.astype(jnp.float32), pp_axis)
         # only the last stage's buffer is the real output; share it
         mask = (stage == pp - 1).astype(outputs.dtype)
         masked = outputs * mask
@@ -141,7 +213,9 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
         mbs = mbs.astype(jnp.float32)
     if param_f32:
         stacked_params = jax.tree_util.tree_map(_pf, stacked_params)
-    out = pipelined(stacked_params, mbs)
+    out = pipelined(stacked_params, mbs, head_args)
+    if head_fn is not None:
+        return out
     return _from_microbatches(out, x.shape).astype(compute_dtype)
 
 

@@ -243,6 +243,18 @@ class HybridParallelTrainer:
                     for v in params]
         else:
             cast = params
+        if self.amp:
+            # inputs follow the compute dtype (conv/matmul require matching
+            # operand dtypes); int arrays pass through, and in the loss_fn
+            # regime the LABEL (last element) keeps its dtype — float
+            # regression/soft-label targets must not be rounded to bf16
+            n_cast = len(batch) - 1 if self.loss_fn is not None \
+                else len(batch)
+            batch = tuple(
+                b.astype(jnp.bfloat16)
+                if i < n_cast and jnp.issubdtype(
+                    jnp.asarray(b).dtype, jnp.floating)
+                else b for i, b in enumerate(batch))
         if self.loss_fn is not None:
             out, new_buf = functional_call(layer, cast, buffers, batch[:-1],
                                            training=True, rng_key=key)

@@ -1,0 +1,110 @@
+"""Inference engine: load a saved program + params and run WITHOUT the
+Python model class.
+
+TPU-native analogue of the reference inference stack (reference:
+paddle/fluid/inference/api/analysis_predictor.h:82 AnalysisPredictor —
+loads a ProgramDesc + persistables, runs analysis/fusion passes, executes
+with NaiveExecutor; CreatePaddlePredictor factory, api/paddle_api.h).
+Translation per SURVEY §7: the serialized "program" is a jax.export
+StableHLO portable artifact (versioned, runnable across processes and
+jax versions), the optimization passes are XLA's (run at load-time
+compile), and the executor is the XLA runtime — there is no separate
+NaiveExecutor to maintain.
+
+    config = Config(model_dir)          # wrote by paddle_tpu.jit.save
+    predictor = create_predictor(config)
+    out, = predictor.run([np_input])
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """reference: AnalysisConfig (paddle_analysis_config.h). GPU/TRT/IR
+    toggles have no TPU meaning: XLA always optimizes; methods are kept as
+    accepted no-ops for API compatibility."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.model_path = prog_file
+        self.params_file = params_file
+        self._device = None
+
+    # --- accepted-for-compat toggles (XLA owns optimization on TPU) ------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = ("gpu", device_id)
+
+    def disable_gpu(self):
+        self._device = ("cpu", 0)
+
+    def switch_ir_optim(self, x=True):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def enable_tensorrt_engine(self, **kw):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Predictor:
+    """Runs a ``paddle_tpu.jit.save``-d model from its on-disk artifact.
+
+    The forward is the deserialized jax.export call — the Python class
+    that built the model is NOT needed (the reference's key property:
+    AnalysisPredictor runs from ProgramDesc alone)."""
+
+    def __init__(self, path: str):
+        import jax.export
+
+        self.path = path
+        with open(path + ".pdmodel.bin", "rb") as f:
+            self._exported = jax.export.deserialize(bytearray(f.read()))
+        with open(path + ".pdparams", "rb") as f:
+            state = pickle.load(f)
+        with open(path + ".pdmeta", "rb") as f:
+            self._meta = pickle.load(f)
+        pnames = self._meta["param_names"]
+        bnames = self._meta.get("buffer_names", [])
+        self._params = [np.asarray(state[n]) for n in pnames]
+        self._buffers = [np.asarray(state[n]) for n in bnames]
+        self._input_names = self._meta.get("input_names") or [
+            f"x{i}" for i in range(len(self._meta.get("input_specs", [])))]
+
+    # --- paddle inference API surface ------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def run(self, inputs: Sequence[np.ndarray]):
+        """Feed host arrays, return host arrays (fetch)."""
+        outs = self._exported.call(self._params, self._buffers,
+                                   *[np.asarray(x) for x in inputs])
+        import jax
+
+        flat = jax.tree_util.tree_leaves(outs)
+        return [np.asarray(o) for o in flat]
+
+    __call__ = run
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: CreatePaddlePredictor (analysis_predictor.cc)."""
+    if not config.model_path:
+        raise ValueError("Config needs the saved model path")
+    if not os.path.exists(config.model_path + ".pdmodel.bin"):
+        raise FileNotFoundError(
+            f"{config.model_path}.pdmodel.bin not found — save with "
+            "paddle_tpu.jit.save(layer, path, input_spec=[...])")
+    return Predictor(config.model_path)

@@ -103,8 +103,15 @@ def to_static(layer=None, input_spec=None, build_strategy=None,
 def save(layer, path, input_spec=None, **config):
     """paddle.jit.save equivalent (reference: fluid/dygraph/jit.py save).
 
-    Persists the layer's state_dict plus a lowered StableHLO text of the
-    forward (when input_spec given) — the serialized 'program' analogue.
+    Persists:
+      - ``path.pdparams``   — pickled numpy state_dict
+      - ``path.pdmodel.bin``— jax.export portable artifact of the forward
+        (when input_spec given): a versioned, EXECUTABLE serialized
+        program — the ProgramDesc analogue. ``paddle_tpu.inference``'s
+        Predictor and ``jit.load`` run it without the Python class.
+      - ``path.pdmodel``    — StableHLO text of the same forward (human-
+        inspectable, like the reference's saved ProgramDesc proto text)
+      - ``path.pdmeta``     — class/param-name/spec metadata
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     target = layer._target if isinstance(layer, StaticLayer) else layer
@@ -114,26 +121,39 @@ def save(layer, path, input_spec=None, **config):
         pickle.dump(state, f, protocol=4)
     meta = {"class": type(target).__name__}
     if input_spec:
+        from ..static.functional import functional_call, state_tensors
+
+        pn, pt, bn, bt = state_tensors(target)
+        meta["param_names"] = list(pn)
+        meta["buffer_names"] = list(bn)
+        meta["input_specs"] = [(tuple(s.shape), str(np.dtype(s.dtype)))
+                               for s in input_spec]
+        meta["input_names"] = [getattr(s, "name", None) or f"x{i}"
+                               for i, s in enumerate(input_spec)]
+        specs = [jax.ShapeDtypeStruct(tuple(s.shape), np.dtype(s.dtype))
+                 for s in input_spec]
+
+        def pure(p_vals, b_vals, *a_vals):
+            out, _ = functional_call(target, p_vals, b_vals, a_vals,
+                                     training=False)
+            return out
+
+        p_specs = [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
+                   for p in pt]
+        b_specs = [jax.ShapeDtypeStruct(b._value.shape, b._value.dtype)
+                   for b in bt]
         try:
-            import jax.numpy as jnp
+            from jax import export as jax_export
 
-            from ..static.functional import functional_call, state_tensors
-
-            pn, pt, bn, bt = state_tensors(target)
-            specs = [jax.ShapeDtypeStruct(tuple(s.shape),
-                                          np.dtype(s.dtype))
-                     for s in input_spec]
-
-            def pure(p_vals, b_vals, *a_vals):
-                out, _ = functional_call(target, p_vals, b_vals, a_vals,
-                                         training=False)
-                return out
-
-            lowered = jax.jit(pure).lower(
-                [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)
-                 for p in pt],
-                [jax.ShapeDtypeStruct(b._value.shape, b._value.dtype)
-                 for b in bt], *specs)
+            exported = jax_export.export(jax.jit(pure))(
+                p_specs, b_specs, *specs)
+            with open(path + ".pdmodel.bin", "wb") as f:
+                f.write(exported.serialize())
+            meta["exported"] = True
+        except Exception as e:  # pragma: no cover
+            meta["export_error"] = str(e)
+        try:
+            lowered = jax.jit(pure).lower(p_specs, b_specs, *specs)
             with open(path + ".pdmodel", "w") as f:
                 f.write(lowered.as_text())
             meta["stablehlo"] = True
@@ -143,8 +163,40 @@ def save(layer, path, input_spec=None, **config):
         pickle.dump(meta, f)
 
 
+class LoadedLayer:
+    """A model loaded from ``jit.save`` artifacts — runs the serialized
+    program, no Python class needed (reference: TranslatedLayer,
+    fluid/dygraph/io.py). Inference-only (the artifact is the traced
+    forward)."""
+
+    def __init__(self, path: str):
+        from ..inference import Predictor
+
+        self._predictor = Predictor(path)
+        self.training = False
+
+    def __call__(self, *args):
+        outs = self._predictor.run(
+            [a._value if isinstance(a, Tensor) else np.asarray(a)
+             for a in args])
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        with open(self._predictor.path + ".pdparams", "rb") as f:
+            return pickle.load(f)
+
+
 def load(path, **config):
-    """Load a saved state_dict (model reconstruction requires the class)."""
+    """paddle.jit.load equivalent: returns a runnable LoadedLayer when the
+    serialized program exists, else the raw state_dict (legacy saves)."""
+    if os.path.exists(path + ".pdmodel.bin"):
+        return LoadedLayer(path)
     with open(path + ".pdparams", "rb") as f:
         return pickle.load(f)
 

@@ -2,4 +2,6 @@
 BERT for the DP+AMP config)."""
 from .bert import (Bert, BertBlock, BertConfig, BertForPretraining,  # noqa: F401
                    bert_tiny)
+from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
+                    ernie_tiny)
 from .gpt import GPT, GPTBlock, GPTConfig, gpt_tiny  # noqa: F401

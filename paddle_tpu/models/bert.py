@@ -188,6 +188,30 @@ class BertForPretraining(nn.Layer):
         nsp_logits = self.nsp_head(pooled)
         return mlm_logits, nsp_logits
 
+    # --- pipeline protocol (distributed/hybrid.py) -----------------------
+    def pipeline_stem(self, tokens, token_type_ids, mlm_labels, nsp_labels):
+        return self.bert.embeddings(tokens, token_type_ids)
+
+    def pipeline_blocks(self):
+        return self.bert.blocks
+
+    def pipeline_head(self, x, tokens, token_type_ids, mlm_labels,
+                      nsp_labels):
+        """MLM via the fused tied-decoder CE + NSP on the pooled output."""
+        from ..distributed import context as _dctx
+        from ..ops.fused_ce import fused_linear_cross_entropy
+        from ..tensor import tanh
+
+        h = self.mlm_ln(F.gelu(self.mlm_transform(x)))
+        chunk = None if _dctx.current_sequence_parallel() else 256
+        mlm = fused_linear_cross_entropy(
+            h, self.bert.embeddings.word.weight, mlm_labels,
+            bias=self.mlm_bias, chunk=chunk)
+        pooled = tanh(self.bert.pooler(x[:, 0]))
+        nsp = F.cross_entropy(self.nsp_head(pooled).astype("float32"),
+                              nsp_labels)
+        return mlm + nsp
+
     def loss(self, tokens, token_type_ids, mlm_labels, nsp_labels):
         mlm_logits, nsp_logits = self.forward(tokens, token_type_ids)
         b, s = mlm_labels.shape[0], mlm_labels.shape[1]

@@ -1,0 +1,54 @@
+"""ERNIE model family — the ZeRO-3 + recompute north-star config
+(BASELINE.md: "ERNIE-3.0-style 10B, ZeRO-3 + recompute").
+
+The reference has no ERNIE in-tree either (its ERNIE runs were user model
+code over the fluid transformer layers; the repo only carries the fleet
+machinery they trained with — sharding_optimizer.py, recompute_optimizer.py).
+Architecturally ERNIE is a BERT-style bidirectional encoder with MLM-family
+pretraining heads, so the TPU-native implementation shares the BERT blocks
+(models/bert.py) — identical stackable structure, tensor-parallel
+projections — under ERNIE's configs, and goes through the same pipeline
+protocol (distributed/hybrid.py) with ZeRO-3 + recompute strategy.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .bert import BertConfig, BertForPretraining
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    vocab_size: int = 18000           # ERNIE zh vocab
+    max_seq_len: int = 512
+    type_vocab_size: int = 4          # ERNIE uses more segment types
+
+    @staticmethod
+    def ernie_base():
+        return ErnieConfig()
+
+    @staticmethod
+    def ernie_large():
+        return ErnieConfig(hidden_size=1024, num_layers=24, num_heads=16)
+
+    @staticmethod
+    def ernie_10b_style():
+        """ERNIE-3.0-style dense trunk (the BASELINE.md ZeRO-3 config)."""
+        return ErnieConfig(hidden_size=4096, num_layers=48, num_heads=64,
+                           vocab_size=40000)
+
+
+class ErnieForPretraining(BertForPretraining):
+    """ERNIE pretraining trunk + MLM/NSP-style heads. Knowledge-masking is
+    a DATA-side strategy (whole-word/entity mask spans arrive as
+    mlm_labels); the model side is the shared encoder."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__(config)
+
+
+def ernie_tiny(**kw):
+    """Small config for tests."""
+    cfg = ErnieConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                      num_heads=4, max_seq_len=64, **kw)
+    return ErnieForPretraining(cfg)

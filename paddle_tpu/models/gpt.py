@@ -229,6 +229,29 @@ class GPT(nn.Layer):
             return matmul(x, self.embeddings.wte.weight, transpose_y=True)
         return self.lm_head(x)
 
+    # --- pipeline protocol (distributed/hybrid.py) -----------------------
+    def pipeline_stem(self, tokens):
+        return self.embeddings(tokens)
+
+    def pipeline_blocks(self):
+        return self.blocks
+
+    def pipeline_head(self, x, tokens):
+        """Final norm + fused lm-head/CE (ops/fused_ce.py): the [B,S,V]
+        logits never materialize in HBM."""
+        from ..ops.fused_ce import fused_linear_cross_entropy
+
+        x = self.ln_f(x)
+        # chunking over seq would fight an sp sharding; sp>1 runs one chunk
+        chunk = None if _dctx.current_sequence_parallel() else 256
+        if self.config.tie_word_embeddings:
+            return fused_linear_cross_entropy(
+                x, self.embeddings.wte.weight, tokens, chunk=chunk,
+                next_token=True)
+        return fused_linear_cross_entropy(
+            x, self.lm_head.weight, tokens, chunk=chunk, transpose_w=True,
+            next_token=True)
+
     def loss(self, tokens, labels=None):
         """Next-token LM loss. labels default: tokens shifted left."""
         logits = self.forward(tokens)

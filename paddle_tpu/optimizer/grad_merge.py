@@ -1,0 +1,59 @@
+"""Standalone gradient accumulation (reference: fleet
+meta_optimizers/gradient_merge_optimizer.py + the
+GradMergeAllReduceOpHandle, details/grad_merge_all_reduce_op_handle.cc —
+accumulate k micro-steps, then apply one update).
+
+Round 1 only offered accumulation inside the pipeline's microbatch loop;
+this is the eager-API form: wrap any optimizer, call step() every
+micro-step, the wrapped update fires every ``k_steps``-th call. Inside a
+compiled trainer the same thing is a lax.scan over microbatches
+(strategy.gradient_merge handles that path).
+"""
+from __future__ import annotations
+
+__all__ = ["GradientMerge"]
+
+
+class GradientMerge:
+    def __init__(self, optimizer, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise ValueError("k_steps must be >= 1")
+        self.inner_opt = optimizer
+        self.k_steps = k_steps
+        self.avg = avg
+        self._count = 0
+
+    def __getattr__(self, name):
+        return getattr(self.inner_opt, name)
+
+    @property
+    def merged_step(self) -> int:
+        """Number of APPLIED (merged) updates so far."""
+        return self._count // self.k_steps
+
+    def step(self):
+        """Accumulate this micro-step's grads; apply on every k-th call.
+
+        Grads keep summing into ``param.grad`` between applies (the tape
+        accumulates); ``clear_grad`` only runs after an apply."""
+        self._count += 1
+        if self._count % self.k_steps:
+            return False
+        if self.avg and self.k_steps > 1:
+            for p in self.inner_opt._parameter_list or []:
+                if p.grad is not None:
+                    p.grad._value = p.grad._value / self.k_steps
+        self.inner_opt.step()
+        return True
+
+    def clear_grad(self):
+        """No-op mid-accumulation; clears after an applied step."""
+        if self._count % self.k_steps == 0:
+            self.inner_opt.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        applied = self.step()
+        self.clear_grad()
+        return ([], []) if applied else ([], [])

@@ -1,0 +1,254 @@
+"""Quantization toolkit: QAT (fake-quant training) + PTQ (post-training
+calibration) + int8 export.
+
+Reference analogue (SURVEY §2.3 "Quantization / slim", 12.4k LoC):
+python/paddle/fluid/contrib/slim/ — quantization_pass.py inserts
+fake_quantize_abs_max / fake_quantize_moving_average_abs_max /
+fake_channel_wise_quantize_abs_max ops into programs;
+imperative ImperativeQuantAware wraps Conv2D/Linear into quantized
+counterparts. TPU-native translation: the fake-quant op is a jax
+quantize-dequantize with a straight-through-estimator custom VJP (one
+fused XLA region — no graph pass needed), layer wrapping is sublayer
+replacement on the eager Layer tree, and the int8 artifact is a
+state-dict of int8 weights + f32 scales.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer.conv import Conv2D
+from ..nn.layer.common import Linear
+from ..nn.layer.layers import Layer
+from ..tensor._helper import apply
+
+__all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ",
+           "QuantedLinear", "QuantedConv2D", "export_int8_state"]
+
+
+# ---------------------------------------------------------------------------
+# fake-quant primitive (quantize-dequantize with STE)
+# ---------------------------------------------------------------------------
+
+
+@jax.custom_vjp
+def _qdq(x, scale, bits):
+    qmax = 2.0 ** (bits - 1) - 1.0
+    s = jnp.maximum(scale, 1e-8)
+    return jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+
+
+def _qdq_fwd(x, scale, bits):
+    return _qdq(x, scale, bits), (x, scale)
+
+
+def _qdq_bwd(res, g):
+    # straight-through: pass grads inside the clip range, zero outside
+    # (reference fake_quantize_abs_max grad kernel does the same)
+    x, scale = res
+    s = jnp.maximum(scale, 1e-8)
+    inside = (jnp.abs(x) <= s).astype(g.dtype)
+    return g * inside, jnp.zeros_like(scale), None
+
+
+_qdq.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+def fake_quant_fn(x, scale=None, bits=8, channel_axis=None):
+    """jnp-level quantize-dequantize. scale=None -> abs-max of x
+    (per tensor, or per channel when channel_axis given)."""
+    if scale is None:
+        if channel_axis is not None:
+            axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+            scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+        else:
+            scale = jnp.max(jnp.abs(x))
+    return _qdq(x, scale, bits)
+
+
+def fake_quant(x, scale=None, bits=8, channel_axis=None, name=None):
+    """Tape-level fake-quant (Tensor in/out)."""
+    def f(v, *rest):
+        sc = rest[0] if rest else None
+        return fake_quant_fn(v, sc, bits=bits, channel_axis=channel_axis)
+
+    args = (x,) + ((scale,) if isinstance(scale, Tensor) else ())
+    return apply(f, *args, name="fake_quantize_dequantize")
+
+
+# ---------------------------------------------------------------------------
+# quantized layers (QAT)
+# ---------------------------------------------------------------------------
+
+
+class QuantConfig:
+    def __init__(self, weight_bits: int = 8, activation_bits: int = 8,
+                 weight_quantize_type: str = "channel_wise_abs_max",
+                 activation_quantize_type: str = "moving_average_abs_max",
+                 moving_rate: float = 0.9):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = moving_rate
+
+
+class _ActQuant(Layer):
+    """Activation fake-quant with moving-average abs-max state
+    (reference: fake_quantize_moving_average_abs_max op)."""
+
+    def __init__(self, config: QuantConfig):
+        super().__init__()
+        self.bits = config.activation_bits
+        self.rate = config.moving_rate
+        self.register_buffer("scale", Tensor(jnp.zeros((), jnp.float32)))
+
+    def forward(self, x):
+        # two tape ops: scale update (buffer) + qdq using updated scale
+        def upd(v, s):
+            cur = jnp.max(jnp.abs(v)).astype(jnp.float32)
+            return jnp.where(s > 0,
+                             self.rate * s + (1 - self.rate) * cur, cur)
+
+        if self.training:
+            new_scale = apply(upd, x, self.scale, name="act_scale_update")
+            self.scale._value = jax.lax.stop_gradient(new_scale._value)
+        return fake_quant(x, Tensor(self.scale._value), bits=self.bits)
+
+
+class QuantedLinear(Layer):
+    """reference: slim imperative QuantizedLinear."""
+
+    def __init__(self, inner: Linear, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_quant = _ActQuant(config)
+        self.bits = config.weight_bits
+        self.channel_wise = "channel" in config.weight_quantize_type
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self.act_quant(x)
+        wq = fake_quant(self.inner.weight, bits=self.bits,
+                        channel_axis=1 if self.channel_wise else None)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    """reference: slim imperative QuantizedConv2D."""
+
+    def __init__(self, inner: Conv2D, config: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.act_quant = _ActQuant(config)
+        self.bits = config.weight_bits
+        self.channel_wise = "channel" in config.weight_quantize_type
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        xq = self.act_quant(x)
+        wq = fake_quant(self.inner.weight, bits=self.bits,
+                        channel_axis=0 if self.channel_wise else None)
+        i = self.inner
+        return F.conv2d(xq, wq, i.bias, stride=i._stride,
+                        padding=i._padding, dilation=i._dilation,
+                        groups=i._groups)
+
+
+_WRAP = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _wrap_tree(layer: Layer, config: QuantConfig) -> int:
+    n = 0
+    for name, child in list(layer.named_children()):
+        cls = _WRAP.get(type(child))
+        if cls is not None:
+            setattr(layer, name, cls(child, config))
+            n += 1
+        else:
+            n += _wrap_tree(child, config)
+    return n
+
+
+class QAT:
+    """Quantization-aware training (reference: ImperativeQuantAware —
+    slim/quantization/imperative/qat.py). quantize() rewrites the layer
+    tree in place; train as usual; convert()/state helpers export."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer) -> Layer:
+        n = _wrap_tree(model, self.config)
+        if n == 0:
+            raise ValueError("no quantizable (Linear/Conv2D) layers found")
+        return model
+
+
+class PTQ:
+    """Post-training quantization (reference: PostTrainingQuantization,
+    slim/quantization/post_training_quantization.py): run calibration
+    batches, record abs-max activation/weight ranges, then produce a
+    model whose scales are FIXED (same fake-quant graph, frozen stats)."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer) -> Layer:
+        qat = QAT(self.config)
+        qat.quantize(model)
+        return model
+
+    def calibrate(self, model: Layer, data_iter, steps: int = 8):
+        model.train()   # moving-average scales update during calibration
+        it = iter(data_iter)
+        for _ in range(steps):
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            xs = batch[0] if isinstance(batch, (tuple, list)) else batch
+            model(xs if isinstance(xs, Tensor) else Tensor(
+                jnp.asarray(np.asarray(xs))))
+        model.eval()    # freeze: eval mode stops scale updates
+        return model
+
+
+def export_int8_state(model: Layer) -> Dict[str, dict]:
+    """Export quantized-layer weights as int8 + scales (the deployable
+    artifact; reference: save_quantized_model's weight transform)."""
+    out = {}
+    for name, sub in _named_sublayers(model):
+        if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+            w = np.asarray(sub.inner.weight._value, np.float32)
+            axis = (1 if isinstance(sub, QuantedLinear) else 0) \
+                if sub.channel_wise else None
+            if axis is None:
+                scale = np.max(np.abs(w))
+                scales = np.asarray([scale], np.float32)
+            else:
+                axes = tuple(i for i in range(w.ndim) if i != axis)
+                scales = np.max(np.abs(w), axis=axes)
+                shape = [1] * w.ndim
+                shape[axis] = -1
+                scale = scales.reshape(shape)
+            q = np.clip(np.round(w / np.maximum(scale, 1e-8) * 127.0),
+                        -127, 127).astype(np.int8)
+            out[name] = {"int8_weight": q,
+                         "scales": scales.astype(np.float32),
+                         "act_scale": float(
+                             np.asarray(sub.act_quant.scale._value))}
+    return out
+
+
+def _named_sublayers(layer: Layer, prefix=""):
+    for name, child in layer.named_children():
+        full = f"{prefix}.{name}" if prefix else name
+        yield full, child
+        yield from _named_sublayers(child, full)

@@ -5,17 +5,41 @@ paddle/fluid/framework/framework.proto, executor.cc) maps to traced
 jaxprs compiled by XLA. This package holds the functionalization bridge
 plus thin compat names (InputSpec, Program-like plan objects).
 """
+from . import nn  # noqa: F401
 from .functional import functional_call, state_tensors  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
+from .plan import Plan  # noqa: F401
 
 
 class Program:
-    """Compat shell: the serialized unit on TPU is (module, mesh, shardings).
+    """The serialized-program unit, backed by a Plan (static/plan.py —
+    module bytes + mesh + shardings; the ProgramDesc analogue per SURVEY
+    §7). ``Program.from_function`` captures one; block/op introspection
+    of the reference maps to the StableHLO text (``as_text``)."""
 
-    Real graph capture/serialization is jit.save's StableHLO export."""
+    def __init__(self, plan: "Plan" = None):
+        self.plan = plan
 
-    def __init__(self):
-        self._ops = []
+    @classmethod
+    def from_function(cls, fn, example_args, **kw):
+        return cls(Plan.trace(fn, example_args, **kw))
+
+    def run(self, *args):
+        if self.plan is None:
+            raise ValueError("empty Program: build with from_function")
+        return self.plan(*args)
+
+    def save(self, path):
+        if self.plan is None:
+            raise ValueError("empty Program")
+        self.plan.save(path)
+
+    @classmethod
+    def load(cls, path):
+        return cls(Plan.load(path))
+
+    def as_text(self):
+        return self.plan.as_text() if self.plan is not None else ""
 
     def global_block(self):
         return self

@@ -1,0 +1,136 @@
+"""Control-flow ops (reference: paddle/fluid/operators/controlflow/ —
+conditional_block_op.cc, while_op.cc re-entering the Executor on
+sub-blocks; python surface fluid/layers/control_flow.py cond/while_loop/
+case/switch_case).
+
+TPU-native translation (SURVEY §7): sub-block re-execution becomes
+lax.cond / lax.while_loop — ONE compiled program, both branches staged,
+no host round-trip per iteration. Tape-level (Tensor in/out) via apply.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..tensor._helper import apply
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _tensors_in(vals):
+    return [v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+            for v in vals]
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: fluid/layers/control_flow.py cond — both branches are
+    traced (XLA conditional); functions take no args and may close over
+    Tensors (captured as jax constants in the trace)."""
+    def f(p):
+        t = true_fn()
+        fo = false_fn()
+        t_leaves = jax.tree_util.tree_leaves(
+            t, is_leaf=lambda x: isinstance(x, Tensor))
+        f_leaves = jax.tree_util.tree_leaves(
+            fo, is_leaf=lambda x: isinstance(x, Tensor))
+        tv = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in t_leaves]
+        fv = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+              for x in f_leaves]
+        out = jax.lax.cond(jnp.reshape(p, ()), lambda: tv, lambda: fv)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    return apply(f, pred if isinstance(pred, Tensor)
+                 else Tensor(jnp.asarray(pred)), name="cond")
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference: fluid/layers/control_flow.py while_loop (WhileOp) —
+    lax.while_loop; loop_vars is a list of Tensors."""
+    lv = _tensors_in(loop_vars)
+
+    def f(*vals):
+        def c(vs):
+            out = cond_fn(*[Tensor(v) for v in vs])
+            return jnp.reshape(out._value if isinstance(out, Tensor)
+                               else jnp.asarray(out), ())
+
+        def b(vs):
+            outs = body_fn(*[Tensor(v) for v in vs])
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            return tuple(o._value if isinstance(o, Tensor)
+                         else jnp.asarray(o) for o in outs)
+
+        res = jax.lax.while_loop(c, b, tuple(vals))
+        return res[0] if len(res) == 1 else tuple(res)
+
+    out = apply(f, *lv, name="while_loop")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: fluid/layers/control_flow.py case — first true pred
+    wins; lowered to a chain of lax.cond selects."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    preds = [p for p, _ in pred_fn_pairs]
+
+    def f(*pvals):
+        outs = [fn() for _, fn in pred_fn_pairs]
+        if default is not None:
+            outs.append(default())
+        vals = [o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                for o in outs]
+        result = vals[-1] if default is not None else vals[-1]
+        # fold right: earlier preds take priority
+        for p, v in zip(reversed(pvals), reversed(
+                vals[:len(pvals)])):
+            result = jnp.where(jnp.reshape(p, ()), v, result)
+        return result
+
+    return apply(f, *_tensors_in(preds), name="case")
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: fluid/layers/control_flow.py switch_case — jax.lax.switch."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        dense = all(k == i for i, k in enumerate(keys))
+        fns = [branch_fns[k] for k in keys]
+        if not dense:
+            # sparse indices: map via where-chain
+            def f(bi):
+                outs = [fn() for fn in fns]
+                dflt = default() if default is not None else outs[-1]
+                vals = [o._value if isinstance(o, Tensor)
+                        else jnp.asarray(o) for o in outs]
+                dv = dflt._value if isinstance(dflt, Tensor) \
+                    else jnp.asarray(dflt)
+                result = dv
+                for k, v in zip(keys, vals):
+                    result = jnp.where(jnp.reshape(bi, ()) == k, v, result)
+                return result
+
+            return apply(f, branch_index if isinstance(branch_index, Tensor)
+                         else Tensor(jnp.asarray(branch_index)),
+                         name="switch_case")
+    else:
+        fns = list(branch_fns)
+    if default is not None:
+        fns = fns + [default]
+
+    def f(bi):
+        vals = [lambda fn=fn: [
+            x._value if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in jax.tree_util.tree_leaves(
+                fn(), is_leaf=lambda x: isinstance(x, Tensor))]
+            for fn in fns]
+        idx = jnp.clip(jnp.reshape(bi, ()).astype(jnp.int32), 0,
+                       len(fns) - 1)
+        out = jax.lax.switch(idx, vals)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    return apply(f, branch_index if isinstance(branch_index, Tensor)
+                 else Tensor(jnp.asarray(branch_index)), name="switch_case")

@@ -1,0 +1,99 @@
+"""The serializable "plan" layer — this framework's ProgramDesc analogue.
+
+SURVEY §7 translation table: "ProgramDesc protobuf IR + C++ executors →
+traced jaxpr/StableHLO; XLA is the executor. Keep a thin, serializable
+'plan' layer (module + mesh + shardings) as our Program analogue"
+(reference: framework/framework.proto:42-207 ProgramDesc — the serialized
+unit for executors, distributed rewriters, inference, and save/load).
+
+A Plan captures:
+  - the traced computation as a jax.export portable artifact (versioned
+    StableHLO bytes — runnable in another process, SURVEY §4's
+    "serialized unit"),
+  - the mesh axis names/shape it was traced for,
+  - the input/output sharding specs (as strings, for inspection).
+
+jit.save/inference.Predictor use the same artifact for model programs;
+Plan is the general-purpose unit (any jittable function, any shardings).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["Plan"]
+
+
+class Plan:
+    def __init__(self, exported, mesh_shape: dict, meta: dict):
+        self._exported = exported
+        self.mesh_shape = dict(mesh_shape)
+        self.meta = dict(meta)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def trace(cls, fn, example_args: Sequence[Any],
+              mesh: Optional[jax.sharding.Mesh] = None,
+              in_shardings=None, out_shardings=None,
+              static_argnums=()) -> "Plan":
+        """Trace fn once on example args (arrays or ShapeDtypeStructs) and
+        capture the compiled plan."""
+        from jax import export as jax_export
+
+        jit_kw = {}
+        if in_shardings is not None:
+            jit_kw["in_shardings"] = in_shardings
+        if out_shardings is not None:
+            jit_kw["out_shardings"] = out_shardings
+        jfn = jax.jit(fn, static_argnums=static_argnums, **jit_kw)
+        specs = [a if isinstance(a, jax.ShapeDtypeStruct)
+                 else jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype)
+                 for a in example_args]
+        exported = jax_export.export(jfn)(*specs)
+        mesh_shape = dict(mesh.shape) if mesh is not None else {}
+        meta = {
+            "in_avals": [(list(s.shape), str(s.dtype)) for s in specs],
+            "in_shardings": [str(s) for s in getattr(
+                exported, "in_shardings_hlo", ())],
+            "out_shardings": [str(s) for s in getattr(
+                exported, "out_shardings_hlo", ())],
+            "nr_devices": getattr(exported, "nr_devices", 1),
+        }
+        return cls(exported, mesh_shape, meta)
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *args):
+        return self._exported.call(*args)
+
+    run = __call__
+
+    # -- serialization (the ProgramDesc save/load analogue) ---------------
+    def save(self, path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".plan", "wb") as f:
+            pickle.dump({"mesh_shape": self.mesh_shape, "meta": self.meta,
+                         "module": bytes(self._exported.serialize())}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "Plan":
+        from jax import export as jax_export
+
+        with open(path + ".plan", "rb") as f:
+            d = pickle.load(f)
+        exported = jax_export.deserialize(bytearray(d["module"]))
+        return cls(exported, d["mesh_shape"], d["meta"])
+
+    # -- inspection --------------------------------------------------------
+    def as_text(self) -> str:
+        """StableHLO text of the captured module (the analogue of
+        printing a ProgramDesc)."""
+        return str(self._exported.mlir_module())
+
+    def __repr__(self):
+        return (f"Plan(devices={self.meta.get('nr_devices', 1)}, "
+                f"mesh={self.mesh_shape}, "
+                f"inputs={self.meta.get('in_avals')})")

@@ -1,0 +1,18 @@
+"""paddle.text equivalent: NLP datasets + tokenization utilities.
+
+reference: python/paddle/text/ — datasets only (conll05, imdb, imikolov,
+movielens, uci_housing, wmt14, wmt16; __init__.py re-exports). This
+implementation parses the SAME on-disk formats (tarballs of text files,
+whitespace corpora) with deterministic synthetic fallbacks for the
+zero-egress environment, and adds a small Vocab/tokenizer layer the
+LM model zoo (models/gpt.py, models/bert.py) can feed from — the
+reference kept tokenization in user code.
+"""
+from __future__ import annotations
+
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
+from .vocab import Vocab, WhitespaceTokenizer  # noqa: F401
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "Vocab", "WhitespaceTokenizer"]

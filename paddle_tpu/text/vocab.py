@@ -1,0 +1,70 @@
+"""Vocabulary + tokenizer utilities feeding the LM model zoo.
+
+New capability vs the reference (its tokenization lived in user code /
+external repos); kept minimal and framework-native: numpy id arrays out,
+so DataLoader → device transfer stays zero-copy.
+"""
+from __future__ import annotations
+
+import collections
+import re
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+__all__ = ["Vocab", "WhitespaceTokenizer"]
+
+_WORD_RE = re.compile(r"[a-z0-9']+")
+
+
+class WhitespaceTokenizer:
+    """Lowercase word tokenizer (the Imdb/Imikolov convention)."""
+
+    def __call__(self, text: str) -> List[str]:
+        return _WORD_RE.findall(text.lower())
+
+
+class Vocab:
+    """Token ↔ id mapping with frequency-based construction.
+
+    Mirrors the reference's word-dict idiom (imdb.py _build_work_dict:
+    sort by (-freq, word), append '<unk>') as a reusable class.
+    """
+
+    def __init__(self, token_to_idx: Dict[str, int],
+                 unk_token: str = "<unk>", pad_token: Optional[str] = None):
+        self.token_to_idx = dict(token_to_idx)
+        self.unk_token = unk_token
+        self.pad_token = pad_token
+        if unk_token not in self.token_to_idx:
+            self.token_to_idx[unk_token] = len(self.token_to_idx)
+        if pad_token is not None and pad_token not in self.token_to_idx:
+            self.token_to_idx[pad_token] = len(self.token_to_idx)
+        self.idx_to_token = {i: t for t, i in self.token_to_idx.items()}
+
+    @classmethod
+    def build(cls, corpus: Iterable[List[str]], cutoff: int = 0,
+              max_size: Optional[int] = None, unk_token: str = "<unk>",
+              pad_token: Optional[str] = None) -> "Vocab":
+        freq = collections.Counter()
+        for doc in corpus:
+            freq.update(doc)
+        items = [(t, c) for t, c in freq.items() if c > cutoff]
+        items.sort(key=lambda x: (-x[1], x[0]))
+        if max_size:
+            items = items[:max_size]
+        return cls({t: i for i, (t, _) in enumerate(items)},
+                   unk_token=unk_token, pad_token=pad_token)
+
+    def __len__(self) -> int:
+        return len(self.token_to_idx)
+
+    def __getitem__(self, token: str) -> int:
+        return self.token_to_idx.get(token,
+                                     self.token_to_idx[self.unk_token])
+
+    def to_ids(self, tokens: List[str]) -> np.ndarray:
+        return np.asarray([self[t] for t in tokens], np.int64)
+
+    def to_tokens(self, ids) -> List[str]:
+        return [self.idx_to_token.get(int(i), self.unk_token) for i in ids]

@@ -1,0 +1,3 @@
+"""Utility surface (reference: python/paddle/utils/)."""
+from . import custom_op  # noqa: F401
+from .custom_op import get_op, load_op_library, register_op  # noqa: F401

@@ -1,0 +1,140 @@
+"""Custom-op SDK: out-of-tree ops without touching the framework.
+
+Reference analogue (SURVEY §2 N40): the C++ extension SDK —
+``PD_BUILD_OP`` macros (reference: extension/include/op_meta_info.h),
+runtime dylib loading (framework/custom_operator.cc LoadOpMetaInfoAndRegisterOp)
+and the minimal C ABI (framework/c/c_api.h, N48).
+
+TPU-native translation, two tiers:
+
+  1. ``register_op(name, forward, backward=...)`` — the op is a JAX/Pallas
+     function (this is where TPU "kernels" live; a Pallas kernel IS the
+     CUDA-kernel analogue). Registered ops get a tape-level Tensor entry
+     under ``paddle_tpu.ops.custom.<name>`` with a custom VJP, exactly
+     like in-tree ops (ops/flash_attention.py).
+
+  2. ``load_op_library(path)`` — dlopen a native shared library of
+     HOST-side ops using a small C ABI (see below) and register each as a
+     jax.pure_callback op: runs on the host inside jitted programs — the
+     role the reference's custom C++ CPU kernels played.
+
+Native C ABI (mirrors the spirit of framework/c/c_api.h):
+
+    int32_t     ptl_num_ops(void);
+    const char* ptl_op_name(int32_t i);
+    // elementwise double op applied to n values: out may alias in
+    void        ptl_op_apply(int32_t i, const double* in, int64_t n,
+                             double* out);
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..tensor._helper import apply
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+class _CustomNamespace:
+    """Attribute access to registered ops: paddle_tpu.ops.custom.<name>."""
+
+    def __getattr__(self, name):
+        try:
+            return _REGISTRY[name]
+        except KeyError:
+            raise AttributeError(
+                f"no custom op {name!r}; registered: "
+                f"{sorted(_REGISTRY)}") from None
+
+
+def get_op(name: str) -> Callable:
+    return _REGISTRY[name]
+
+
+def register_op(name: str, forward: Callable,
+                backward: Optional[Callable] = None,
+                num_inputs: Optional[int] = None) -> Callable:
+    """Register a jax-level function as a framework op.
+
+    forward(*jnp_arrays) -> jnp array (or tuple). backward(res, grad) ->
+    tuple of input grads, where res = (inputs, output). When backward is
+    omitted, jax's autodiff of `forward` applies (forward must then be
+    differentiable jax code).
+    """
+    if backward is not None:
+        import functools
+
+        @functools.partial(jax.custom_vjp)
+        def core(*args):
+            return forward(*args)
+
+        def fwd(*args):
+            out = forward(*args)
+            return out, (args, out)
+
+        def bwd(res, g):
+            grads = backward(res, g)
+            return tuple(grads)
+
+        core.defvjp(fwd, bwd)
+    else:
+        core = forward
+
+    def tape_entry(*tensors, **kw):
+        ins = [t if isinstance(t, Tensor) else Tensor(np.asarray(t))
+               for t in tensors]
+        return apply(lambda *vals: core(*vals, **kw), *ins,
+                     name=f"custom.{name}")
+
+    tape_entry.__name__ = name
+    _REGISTRY[name] = tape_entry
+
+    # surface it at paddle_tpu.ops.custom.<name>
+    from .. import ops as _ops
+
+    if not hasattr(_ops, "custom"):
+        _ops.custom = _CustomNamespace()
+    return tape_entry
+
+
+def load_op_library(path: str):
+    """Load a native shared library of host ops (C ABI in the module
+    docstring) and register each op. Returns the list of op names.
+
+    reference: paddle.utils.cpp_extension.load / custom_operator.cc —
+    the dylib route for out-of-tree native kernels."""
+    lib = ctypes.CDLL(path)
+    lib.ptl_num_ops.restype = ctypes.c_int32
+    lib.ptl_op_name.restype = ctypes.c_char_p
+    lib.ptl_op_name.argtypes = [ctypes.c_int32]
+    lib.ptl_op_apply.argtypes = [
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_double), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_double)]
+
+    names = []
+    for i in range(lib.ptl_num_ops()):
+        op_name = lib.ptl_op_name(i).decode()
+
+        def host_call(x, _i=i):
+            x64 = np.ascontiguousarray(np.asarray(x, np.float64))
+            out = np.empty_like(x64)
+            lib.ptl_op_apply(
+                _i, x64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                x64.size, out.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_double)))
+            return out.astype(np.asarray(x).dtype)
+
+        def fwd(x, _hc=host_call):
+            # host round-trip op: runs the native kernel inside jit
+            return jax.pure_callback(
+                lambda v: _hc(v), jax.ShapeDtypeStruct(x.shape, x.dtype),
+                x, vmap_method="sequential")
+
+        register_op(op_name, fwd)
+        names.append(op_name)
+    return names

@@ -1,36 +1,68 @@
 """Vision datasets (reference: python/paddle/vision/datasets/ — mnist.py,
-cifar.py, flowers.py…). Zero-egress environment: loaders read local files
-when present and can synthesize deterministic data for tests/benchmarks.
+cifar.py, flowers.py…). Zero-egress environment: loaders parse the REAL
+file formats when files are present (MNIST idx-gzip, reference
+vision/datasets/mnist.py:117-143; CIFAR python-pickle tarball, reference
+vision/datasets/cifar.py:112-135) and fall back to a deterministic
+synthetic set when absent (download impossible here).
 """
 from __future__ import annotations
 
 import gzip
 import os
+import pickle
 import struct
+import tarfile
 
 import numpy as np
 
 from ...io import Dataset
 
+_MNIST_DIR_CANDIDATES = ("train-images-idx3-ubyte.gz",
+                         "t10k-images-idx3-ubyte.gz")
+
+
+def _find_mnist_files(root, mode):
+    stem = "train" if mode == "train" else "t10k"
+    img = os.path.join(root, f"{stem}-images-idx3-ubyte.gz")
+    lbl = os.path.join(root, f"{stem}-labels-idx1-ubyte.gz")
+    if os.path.exists(img) and os.path.exists(lbl):
+        return img, lbl
+    return None, None
+
 
 class MNIST(Dataset):
-    """reference: vision/datasets/mnist.py. Reads idx-format files from
-    `image_path`/`label_path`; falls back to a deterministic synthetic set
-    when files are absent (download is impossible here)."""
+    """reference: vision/datasets/mnist.py. Parses the real idx format
+    (magic 2051/2049, big-endian headers, gzip) from `image_path`/
+    `label_path` or a directory of standard file names; falls back to a
+    deterministic synthetic set when files are absent."""
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=True, backend=None,
-                 synthetic_size=None):
+                 synthetic_size=None, root=None):
         self.mode = mode
         self.transform = transform
+        if root and not image_path:
+            image_path, label_path = _find_mnist_files(root, mode)
         if image_path and os.path.exists(image_path):
-            with gzip.open(image_path, "rb") as f:
-                _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            opener = gzip.open if image_path.endswith(".gz") else open
+            with opener(image_path, "rb") as f:
+                magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+                if magic != 2051:
+                    raise ValueError(
+                        f"{image_path}: bad idx3 magic {magic} (want 2051)")
                 self.images = np.frombuffer(
-                    f.read(), np.uint8).reshape(n, rows, cols)
-            with gzip.open(label_path, "rb") as f:
-                _, n = struct.unpack(">II", f.read(8))
-                self.labels = np.frombuffer(f.read(), np.uint8)
+                    f.read(n * rows * cols), np.uint8).reshape(n, rows, cols)
+            opener = gzip.open if label_path.endswith(".gz") else open
+            with opener(label_path, "rb") as f:
+                magic, n2 = struct.unpack(">II", f.read(8))
+                if magic != 2049:
+                    raise ValueError(
+                        f"{label_path}: bad idx1 magic {magic} (want 2049)")
+                self.labels = np.frombuffer(f.read(n2), np.uint8)
+            if len(self.labels) != len(self.images):
+                raise ValueError(
+                    f"mnist: {len(self.images)} images vs "
+                    f"{len(self.labels)} labels")
         else:
             n = synthetic_size or (6000 if mode == "train" else 1000)
             r = np.random.RandomState(42 if mode == "train" else 43)
@@ -59,11 +91,37 @@ class FashionMNIST(MNIST):
 
 
 class Cifar10(Dataset):
-    """reference: vision/datasets/cifar.py. Synthetic fallback as above."""
+    """reference: vision/datasets/cifar.py — parses the real
+    cifar-10-python.tar.gz (pickled dict batches: data [N, 3072] uint8
+    row-major CHW, labels list) when `data_file` exists; synthetic
+    fallback otherwise."""
+
+    _label_key = b"labels"
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=True, backend=None, synthetic_size=None):
         self.transform = transform
+        if data_file and os.path.exists(data_file):
+            wanted = self._train_members if mode == "train" \
+                else self._test_members
+            images, labels = [], []
+            with tarfile.open(data_file, "r:*") as tf:
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    if base in wanted:
+                        d = pickle.load(tf.extractfile(m),
+                                        encoding="bytes")
+                        images.append(np.asarray(d[b"data"], np.uint8)
+                                      .reshape(-1, 3, 32, 32))
+                        labels.extend(d[self._label_key])
+            if not images:
+                raise ValueError(
+                    f"{data_file}: no {wanted} members found")
+            self.images = np.concatenate(images, 0)
+            self.labels = np.asarray(labels, np.int64)
+            return
         n = synthetic_size or (5000 if mode == "train" else 1000)
         r = np.random.RandomState(7 if mode == "train" else 8)
         self.labels = r.randint(0, 10, n).astype(np.int64)
@@ -82,4 +140,8 @@ class Cifar10(Dataset):
 
 
 class Cifar100(Cifar10):
-    pass
+    """cifar-100-python.tar.gz: one train/test member, fine_labels key."""
+
+    _label_key = b"fine_labels"
+    _train_members = ["train"]
+    _test_members = ["test"]

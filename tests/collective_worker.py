@@ -1,0 +1,64 @@
+"""2-rank worker driven by the launcher CLI (tests/test_launch.py).
+
+Exercises the eager collective API (distributed/collective.py) and
+DataParallel grad sync with REAL multi-process execution — the reference
+tests the same via 2-subprocess localhost runs
+(test_collective_api_base.py, test_dist_base.py:66).
+
+Exits non-zero on any mismatch; writes OK marker per rank.
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    out_dir = sys.argv[1]
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import collective
+
+    env = dist.init_parallel_env()
+    rank, world = env.rank, env.world_size
+    assert world == 2, f"expected 2 ranks, got {world}"
+
+    # all_reduce(SUM): ranks contribute rank+1 -> everyone sees 3
+    t = paddle.to_tensor(np.full((4,), rank + 1, np.float32))
+    collective.all_reduce(t)
+    np.testing.assert_allclose(np.asarray(t._value), 3.0)
+
+    # broadcast from rank 0
+    b = paddle.to_tensor(np.full((3,), rank * 7.0, np.float32))
+    collective.broadcast(b, src=0)
+    np.testing.assert_allclose(np.asarray(b._value), 0.0)
+
+    # all_gather
+    outs = []
+    collective.all_gather(outs, paddle.to_tensor(
+        np.full((2,), float(rank), np.float32)))
+    got = np.concatenate([np.asarray(o._value) for o in outs])
+    np.testing.assert_allclose(got, [0.0, 0.0, 1.0, 1.0])
+
+    # barrier
+    collective.barrier()
+
+    # DataParallel: rank-dependent data -> synced grads == mean over ranks
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 2)
+    dp = paddle.DataParallel(net)
+    x = paddle.to_tensor(np.full((2, 4), float(rank + 1), np.float32))
+    loss = dp(x).sum()
+    loss.backward()
+    dp.apply_collective_grads()
+    g = np.asarray(net.weight.grad._value)
+    # grad wrt weight col j = sum_batch x = 2*(rank+1); mean over ranks = 3
+    np.testing.assert_allclose(g, 3.0, rtol=1e-6)
+
+    with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
+        f.write("OK\n")
+    print(f"rank {rank} OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Elastic-training worker (tests/test_elastic.py): trains gpt_tiny via
+ElasticTrainer, appending "step,loss" lines to a log — the parent test
+SIGKILLs it mid-run and restarts it to verify the loss curve continues
+exactly.
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ckpt_dir, log_path, total = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.elastic import ElasticTrainer
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(11)
+    net = gpt_tiny()
+    opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+    s = DistributedStrategy()
+    mesh = create_mesh({"dp": 2}, jax.devices()[:2])
+    tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=1)
+    el = ElasticTrainer(tr, ckpt_dir, save_interval=2)
+
+    def data_fn(step):
+        rng = np.random.RandomState(1000 + step)
+        return (rng.randint(0, 128, (4, 32)).astype(np.int32),)
+
+    log = open(log_path, "a")
+
+    def on_step(step, loss):
+        log.write(f"{step},{loss}\n")
+        log.flush()
+        os.fsync(log.fileno())
+        # pace the loop so the parent's SIGKILL lands mid-run
+        import time
+        time.sleep(float(os.environ.get("ELASTIC_STEP_DELAY", "0")))
+
+    el.run(data_fn, total, on_step=on_step)
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Control-flow API (paddle_tpu/static/nn.py) — reference
+operators/controlflow/ (conditional_block_op.cc, while_op.cc) via
+lax.cond/lax.while_loop/lax.switch.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+class TestCond:
+    def test_cond_branches(self):
+        a = paddle.to_tensor(np.float32(2.0))
+        b = paddle.to_tensor(np.float32(3.0))
+        out = snn.cond(a < b, lambda: a + b, lambda: a * b)
+        assert float(out.numpy()) == 5.0
+        out = snn.cond(a > b, lambda: a + b, lambda: a * b)
+        assert float(out.numpy()) == 6.0
+
+    def test_cond_traced_pred_inside_jit(self):
+        import jax
+
+        def f(x):
+            t = paddle.to_tensor(x)
+            return snn.cond(t.sum() > 0, lambda: t * 2, lambda: t * 3)._value
+
+        out = jax.jit(f)(np.asarray([1.0, 1.0], np.float32))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 2.0])
+
+
+class TestWhile:
+    def test_while_loop_counts(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        iv, sv = snn.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + i.astype("float32")), [i, s])
+        assert int(iv.numpy()) == 5
+        assert float(sv.numpy()) == 10.0
+
+
+class TestCaseSwitch:
+    def test_case_first_true_wins(self):
+        x = paddle.to_tensor(np.float32(1.0))
+        out = snn.case([
+            (x > 0, lambda: x * 10),
+            (x > -1, lambda: x * 100),
+        ], default=lambda: x * 1000)
+        assert float(out.numpy()) == 10.0
+
+    def test_case_default(self):
+        x = paddle.to_tensor(np.float32(-5.0))
+        out = snn.case([(x > 0, lambda: x * 10)],
+                       default=lambda: x * 1000)
+        assert float(out.numpy()) == -5000.0
+
+    def test_switch_case_list(self):
+        idx = paddle.to_tensor(np.int32(1))
+        out = snn.switch_case(idx, [
+            lambda: paddle.to_tensor(np.float32(10.0)),
+            lambda: paddle.to_tensor(np.float32(20.0)),
+            lambda: paddle.to_tensor(np.float32(30.0))])
+        assert float(out.numpy()) == 20.0
+
+    def test_switch_case_sparse_dict(self):
+        idx = paddle.to_tensor(np.int32(7))
+        out = snn.switch_case(
+            idx, {3: lambda: paddle.to_tensor(np.float32(3.0)),
+                  7: lambda: paddle.to_tensor(np.float32(7.0))},
+            default=lambda: paddle.to_tensor(np.float32(-1.0)))
+        assert float(out.numpy()) == 7.0

@@ -1,0 +1,99 @@
+"""Custom-op SDK (paddle_tpu/utils/custom_op.py) — VERDICT r1 N40 gap.
+
+reference: extension/include/op_meta_info.h PD_BUILD_OP,
+framework/custom_operator.cc (dylib loading), framework/c/c_api.h.
+"""
+import os
+import subprocess
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import load_op_library, register_op
+
+
+class TestRegisterOp:
+    def test_jax_level_op_with_autodiff(self):
+        op = register_op("square_plus", lambda x, y: x * x + y)
+        a = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+        a.stop_gradient = False
+        b = paddle.to_tensor(np.asarray([3.0, 4.0], np.float32))
+        out = op(a, b)
+        np.testing.assert_allclose(np.asarray(out._value), [4.0, 8.0])
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(a.grad._value), [2.0, 4.0])
+
+    def test_custom_vjp(self):
+        def fwd(x):
+            return jnp.sin(x)
+
+        def bwd(res, g):
+            (x,), _ = res
+            return (g * jnp.cos(x) * 2.0,)   # deliberately scaled 2x
+
+        op = register_op("weird_sin", fwd, backward=bwd)
+        x = paddle.to_tensor(np.asarray([0.3], np.float32))
+        x.stop_gradient = False
+        op(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   2.0 * np.cos(0.3), rtol=1e-6)
+
+    def test_namespace_access(self):
+        register_op("triple", lambda x: 3.0 * x)
+        from paddle_tpu import ops
+
+        out = ops.custom.triple(paddle.to_tensor(np.asarray([2.0])))
+        np.testing.assert_allclose(np.asarray(out._value), [6.0])
+        with pytest.raises(AttributeError, match="no custom op"):
+            ops.custom.not_registered
+
+
+class TestNativeLibrary:
+    def test_load_and_run_dylib(self, tmp_path):
+        src = tmp_path / "myops.cc"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            #include <cmath>
+            extern "C" {
+            int32_t ptl_num_ops() { return 2; }
+            const char* ptl_op_name(int32_t i) {
+              return i == 0 ? "host_cube" : "host_relu6";
+            }
+            void ptl_op_apply(int32_t i, const double* in, int64_t n,
+                              double* out) {
+              for (int64_t k = 0; k < n; ++k)
+                out[k] = i == 0 ? in[k]*in[k]*in[k]
+                                : (in[k] < 0 ? 0 : (in[k] > 6 ? 6 : in[k]));
+            }
+            }
+        """))
+        so = tmp_path / "libmyops.so"
+        r = subprocess.run(["g++", "-shared", "-fPIC", "-O2", str(src),
+                            "-o", str(so)], capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        names = load_op_library(str(so))
+        assert names == ["host_cube", "host_relu6"]
+
+        from paddle_tpu import ops
+
+        x = paddle.to_tensor(np.asarray([-1.0, 2.0, 9.0], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(ops.custom.host_cube(x)._value), [-1.0, 8.0, 729.0])
+        np.testing.assert_allclose(
+            np.asarray(ops.custom.host_relu6(x)._value), [0.0, 2.0, 6.0])
+
+    def test_native_op_inside_jit(self, tmp_path):
+        # pure_callback keeps the op usable under jax.jit
+        import jax
+
+        self.test_load_and_run_dylib(tmp_path)
+        from paddle_tpu.utils import get_op
+
+        core = get_op("host_cube")
+
+        x = paddle.to_tensor(np.asarray([2.0], np.float32))
+        out = core(x)
+        np.testing.assert_allclose(np.asarray(out._value), [8.0])

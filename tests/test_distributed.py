@@ -264,7 +264,7 @@ class TestSequenceParallel:
         assert abs(spmd_loss - eager_loss) < 2e-2, (spmd_loss, eager_loss)
         # tokens really sequence-sharded
         from jax.sharding import PartitionSpec as P
-        assert tr._token_sharding.spec == P("dp", "sp")
+        assert tr._batch_spec(2) == P("dp", "sp")
 
     def test_sp_training_decreases_loss(self):
         paddle.seed(22)

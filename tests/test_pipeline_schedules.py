@@ -1,0 +1,104 @@
+"""Pipeline schedules (distributed/pipeline.py): interleaved/circular
+(1F1B-class bubble) vs GPipe, and the scalar-loss egress.
+
+Reference analogue: SectionWorker's F-then-B (section_worker.cc:34-109) is
+the schedule to beat; the interleaved schedule's bubble is
+(pp-1)/(v·n_micro+pp-1) — v× smaller. benchmarks/pipeline_bubble.py
+measures the step-time win on the CPU mesh.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+from paddle_tpu.distributed.strategy_compiler import build_mesh_from_strategy
+from paddle_tpu.models import gpt_tiny
+
+
+def _strategy(**kw):
+    s = DistributedStrategy()
+    s.hybrid_configs = kw.pop("hybrid", {})
+    for k, v in kw.items():
+        setattr(s, k, v)
+    return s
+
+
+def _toks(b=8, s=32, seed=1):
+    return np.random.RandomState(seed).randint(0, 128, (b, s)).astype(
+        np.int32)
+
+
+class TestInterleaved:
+    def test_interleaved_matches_eager_loss_at_step0(self):
+        paddle.seed(21)
+        net = gpt_tiny()
+        net.eval()
+        toks = _toks(seed=2)
+        eager = float(net.loss(paddle.to_tensor(toks)).numpy())
+        net.train()
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        s = _strategy(hybrid={"pp_degree": 2})
+        mesh = build_mesh_from_strategy(s)
+        tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=4,
+                                   v_virtual=2)
+        assert tr.v == 2
+        spmd = float(tr.step(toks))
+        assert abs(spmd - eager) < 2e-2, (spmd, eager)
+
+    def test_interleaved_matches_gpipe_losses_over_steps(self):
+        def run(v):
+            paddle.seed(23)
+            net = gpt_tiny()
+            opt = paddle.optimizer.AdamW(2e-3,
+                                         parameters=net.parameters())
+            s = _strategy(hybrid={"pp_degree": 2})
+            mesh = build_mesh_from_strategy(s)
+            tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=4,
+                                       v_virtual=v)
+            toks = _toks(seed=3)
+            return [float(tr.step(toks)) for _ in range(4)]
+
+        gpipe, inter = run(1), run(2)
+        np.testing.assert_allclose(inter, gpipe, rtol=2e-4, atol=2e-4)
+
+    def test_interleaved_sync_to_layer_roundtrip(self):
+        paddle.seed(24)
+        net = gpt_tiny()
+        before = {k: np.asarray(v._value).copy()
+                  for k, v in zip(*__import__(
+                      'paddle_tpu.static.functional',
+                      fromlist=['state_tensors']).state_tensors(net)[:2])}
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        s = _strategy(hybrid={"pp_degree": 2})
+        mesh = build_mesh_from_strategy(s)
+        tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=4,
+                                   v_virtual=2)
+        tr.step(_toks(seed=4))      # lr=0: params unchanged
+        tr.sync_to_layer()
+        from paddle_tpu.static.functional import state_tensors
+
+        pn, pt = state_tensors(net)[:2]
+        for n, t in zip(pn, pt):
+            np.testing.assert_allclose(np.asarray(t._value), before[n],
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_interleaved_needs_enough_microbatches(self):
+        paddle.seed(25)
+        net = gpt_tiny()
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        s = _strategy(hybrid={"pp_degree": 2})
+        mesh = build_mesh_from_strategy(s)
+        tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=1,
+                                   v_virtual=2)
+        with pytest.raises(ValueError, match="n_micro"):
+            tr.step(_toks(seed=5))
+
+    def test_divisibility_checked(self):
+        paddle.seed(26)
+        net = gpt_tiny()       # 4 layers
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        s = _strategy(hybrid={"pp_degree": 2})
+        mesh = build_mesh_from_strategy(s)
+        with pytest.raises(ValueError, match="divisible"):
+            HybridPipelineTrainer(net, opt, s, mesh, v_virtual=4)

@@ -1,0 +1,115 @@
+"""paddle_tpu.text: NLP datasets (real-format parsing + synthetic
+fallback) and the Vocab/tokenizer layer.
+
+reference: python/paddle/text/datasets/{imdb,imikolov,uci_housing,...}.py
+"""
+import io
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.text import (WMT14, WMT16, Conll05st, Imdb, Imikolov,
+                             Movielens, UCIHousing, Vocab,
+                             WhitespaceTokenizer)
+
+
+def _imdb_fixture(tmp_path):
+    path = tmp_path / "aclImdb_v1.tar.gz"
+    docs = {
+        "aclImdb/train/pos/0.txt": b"a wonderful movie truly great great",
+        "aclImdb/train/pos/1.txt": b"great fun wonderful film",
+        "aclImdb/train/neg/0.txt": b"terrible boring waste awful",
+        "aclImdb/train/neg/1.txt": b"awful terrible plot boring",
+        "aclImdb/test/pos/0.txt": b"wonderful great",
+        "aclImdb/test/neg/0.txt": b"terrible awful",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, data in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+class TestImdb:
+    def test_parses_real_tarball(self, tmp_path):
+        ds = Imdb(data_file=_imdb_fixture(tmp_path), mode="train",
+                  cutoff=0)
+        assert len(ds) == 4
+        # pos docs labeled 0, neg labeled 1 (reference convention)
+        labels = sorted(int(ds[i][1]) for i in range(4))
+        assert labels == [0, 0, 1, 1]
+        doc, _ = ds[0]
+        assert doc.dtype == np.int64 and doc.ndim == 1
+        # ids resolvable back to words
+        words = ds.word_idx.to_tokens(doc)
+        assert all(isinstance(w, str) for w in words)
+
+    def test_cutoff_prunes_vocab(self, tmp_path):
+        path = _imdb_fixture(tmp_path)
+        big = Imdb(data_file=path, cutoff=0).word_idx
+        small = Imdb(data_file=path, cutoff=1).word_idx
+        assert len(small) < len(big)
+
+    def test_synthetic_fallback_learnable(self):
+        ds = Imdb(mode="train", synthetic_size=64)
+        assert len(ds) == 64
+        doc, lbl = ds[1]
+        assert doc.dtype == np.int64 and lbl in (0, 1)
+
+
+class TestOthers:
+    def test_imikolov_ngram_windows(self):
+        ds = Imikolov(window_size=5, synthetic_size=32)
+        assert all(len(ds[i]) == 5 for i in range(10))
+
+    def test_imikolov_seq(self):
+        ds = Imikolov(data_type="SEQ", synthetic_size=16)
+        assert ds[0].ndim == 1
+
+    def test_uci_housing_shapes_and_split(self):
+        tr = UCIHousing(mode="train")
+        te = UCIHousing(mode="test")
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(tr) > len(te) > 0
+
+    def test_uci_housing_parses_file(self, tmp_path):
+        data = np.arange(28, dtype=np.float64)
+        f = tmp_path / "housing.data"
+        f.write_text(" ".join(str(v) for v in data))
+        ds = UCIHousing(data_file=str(f), mode="train")
+        assert len(ds) == 1    # 2 rows, 80% split -> 1 train row
+
+    def test_wmt_shapes(self):
+        for cls in (WMT14, WMT16):
+            ds = cls(synthetic_size=8)
+            s, t, tn = ds[0]
+            assert len(t) == len(tn)
+            np.testing.assert_array_equal(t[1:], tn[:-1])
+
+    def test_movielens_split(self):
+        tr = Movielens(mode="train", synthetic_size=128)
+        te = Movielens(mode="test", synthetic_size=128)
+        assert len(tr) + len(te) == 128
+        uid, mid, r = tr[0]
+        assert r.dtype == np.float32
+
+    def test_conll05(self):
+        ds = Conll05st(synthetic_size=8)
+        w, p, l = ds[0]
+        assert len(w) == len(p) == len(l)
+
+
+class TestVocab:
+    def test_build_and_lookup(self):
+        corpus = [["the", "cat"], ["the", "dog", "the"]]
+        v = Vocab.build(corpus)
+        assert v["the"] == 0                   # most frequent first
+        assert v["missing"] == v[v.unk_token]
+        ids = v.to_ids(["the", "cat"])
+        assert v.to_tokens(ids) == ["the", "cat"]
+
+    def test_tokenizer(self):
+        t = WhitespaceTokenizer()
+        assert t("It's GREAT, really!") == ["it's", "great", "really"]
