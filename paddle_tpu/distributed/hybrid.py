@@ -54,6 +54,13 @@ class HybridPipelineTrainer:
                  v_virtual: Optional[int] = None,
                  remat_policy: Optional[str] = None):
         _check_protocol(model)
+        if getattr(getattr(model, "config", None), "moe_num_experts", 0):
+            raise NotImplementedError(
+                "MoE models are not supported by the pipeline trainer yet "
+                "(the per-block load-balance aux loss cannot cross the "
+                "pipeline block contract); train MoE configs with "
+                "distributed.strategy_compiler.compile_train_step "
+                "(dp × tp × ep)")
         self.model = model
         self.optimizer = optimizer
         self.strategy = strategy or DistributedStrategy()

@@ -1,0 +1,139 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+New capability vs the reference (SURVEY §2.2 confirms: "no expert
+parallelism" anywhere in the tree — its MoE era came later with
+incubate.distributed.models.moe built on manual alltoall ops). Designed
+TPU-first per the GShard/Switch pattern:
+
+  - experts' FFN params are stacked [E, ...] and sharded over mesh axis
+    'ep' (PartitionSpec("ep", ...)); token dispatch/combine are einsums
+    against a [tokens, E, capacity] one-hot — GSPMD lowers the
+    expert-sharded einsum pair to the all-to-all exchange the reference
+    era would have hand-written with NCCL alltoall,
+  - top-1 (Switch) or top-2 (GShard) routing with a capacity factor;
+    overflow tokens fall through the residual (standard Switch behavior),
+  - the Switch load-balance auxiliary loss (E * Σ_e fraction_e · prob_e)
+    is exposed as ``layer.aux_loss`` for the model to add.
+
+Composes with dp/tp/ep through the strategy compiler
+(compile_train_step picks up the P("ep", ...) param_shardings and the
+model.loss aux term). Pipeline composition is NOT yet supported — the
+per-block aux loss can't cross the pipeline region's (h -> h) block
+contract; HybridPipelineTrainer rejects MoE models explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..tensor._helper import apply
+
+__all__ = ["MoEMLP", "switch_moe"]
+
+
+def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
+               capacity_factor=1.25, train=True):
+    """Pure-jax MoE FFN. x: [T, H]; gate_w: [H, E]; experts stacked
+    w_in [E, H, F], b_in [E, F], w_out [E, F, H], b_out [E, H].
+
+    Returns (y [T, H], aux_loss scalar).
+    """
+    t, h = x.shape
+    e = gate_w.shape[1]
+    cap = max(1, int(np.ceil(capacity_factor * top_k * t / e)))
+
+    logits = jnp.dot(x, gate_w.astype(x.dtype))            # [T, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((t, e, cap), jnp.float32)
+    remaining = probs
+    aux_fraction = jnp.zeros((e,), jnp.float32)
+    taken = jnp.zeros((e,), jnp.float32)   # slots used across rounds
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)               # [T]
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        # position within the expert's capacity, offset by the slots
+        # earlier routing rounds already consumed (otherwise a round-1
+        # and a round-2 token on the same expert collide on slot 0)
+        pos = (jnp.cumsum(onehot, axis=0) - onehot
+               + taken[None, :]) * onehot                   # [T, E]
+        keep = (pos < cap).astype(jnp.float32) * onehot
+        taken = taken + jnp.sum(keep, axis=0)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                dtype=jnp.float32)          # [T, E, C]
+        gate_p = jnp.sum(remaining * onehot, axis=-1, keepdims=True)
+        combine = combine + keep[..., None] * pos_oh * gate_p[..., None]
+        aux_fraction = aux_fraction + jnp.mean(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot)
+
+    dispatch = (combine > 0).astype(x.dtype)               # [T, E, C]
+
+    xe = jnp.einsum("tec,th->ech", dispatch, x)            # [E, C, H]
+    hmid = jax.nn.gelu(
+        jnp.einsum("ech,ehf->ecf", xe, w_in.astype(x.dtype))
+        + b_in.astype(x.dtype)[:, None, :])
+    ye = jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype)) \
+        + b_out.astype(x.dtype)[:, None, :]                # [E, C, H]
+    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+
+    # Switch aux loss: E * sum_e fraction_e * mean-prob_e
+    aux = e * jnp.sum((aux_fraction / top_k)
+                      * jnp.mean(probs, axis=0))
+    return y, aux.astype(jnp.float32)
+
+
+class MoEMLP(nn.Layer):
+    """Drop-in MoE replacement for a transformer FFN block.
+
+    forward(x [B, S, H]) -> [B, S, H]; the load-balance loss of the last
+    forward is at ``self.aux_loss`` (Tensor scalar).
+    """
+
+    def __init__(self, hidden_size: int, ffn_hidden_size: int,
+                 num_experts: int, top_k: int = 1,
+                 capacity_factor: float = 1.25,
+                 initializer_range: float = 0.02):
+        super().__init__()
+        init = I.Normal(0.0, initializer_range)
+        zeros = I.Constant(0.0)
+        e, h, f = num_experts, hidden_size, ffn_hidden_size
+        self.num_experts = e
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gate = self.create_parameter([h, e], default_initializer=init)
+        self.w_in = self.create_parameter([e, h, f],
+                                          default_initializer=init)
+        self.b_in = self.create_parameter([e, f],
+                                          default_initializer=zeros)
+        self.w_out = self.create_parameter([e, f, h],
+                                           default_initializer=init)
+        self.b_out = self.create_parameter([e, h],
+                                           default_initializer=zeros)
+        # expert dim sharded over 'ep' (strategy compiler consumes these)
+        self.param_shardings = {
+            "gate": P(), "w_in": P("ep", None, None),
+            "b_in": P("ep", None), "w_out": P("ep", None, None),
+            "b_out": P("ep", None)}
+        self.aux_loss = Tensor(jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        b, s, h = x.shape[0], x.shape[1], x.shape[2]
+
+        def f(xv, gw, wi, bi, wo, bo):
+            y, aux = switch_moe(
+                xv.reshape(b * s, h), gw, wi, bi, wo, bo,
+                top_k=self.top_k, capacity_factor=self.capacity_factor,
+                train=self.training)
+            return y.reshape(b, s, h), aux
+
+        out = apply(f, x, self.gate, self.w_in, self.b_in, self.w_out,
+                    self.b_out, name="moe_mlp")
+        y, aux = out
+        self.aux_loss = aux
+        return y

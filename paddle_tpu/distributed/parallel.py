@@ -2,14 +2,18 @@
 DataParallel:322 + imperative Reducer reducer.cc).
 
 On TPU, eager multi-process DP syncs grads at step time (see
-fleet_base.DistributedOptimizer.step); the Reducer's bucketing/overlap
-machinery is unnecessary — XLA fuses gradient reductions in the compiled
-path, and eager sync is one fused host call. DataParallel therefore only
-needs to (a) broadcast initial params, (b) mark the model so optimizers
-know to sync.
+fleet_base.DistributedOptimizer.step). The Reducer's overlap-with-backward
+machinery is unnecessary (XLA fuses reductions in the compiled path), but
+its BUCKETING survives in spirit: apply_collective_grads flattens every
+gradient into one fused buffer and performs a SINGLE allreduce — one host
+round-trip per step instead of one per parameter (the eager collective
+backend is host-staged, collective.py).
 """
 from __future__ import annotations
 
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 from .collective import all_reduce, broadcast
 from .env import get_world_size, init_parallel_env  # noqa: F401
@@ -35,10 +39,25 @@ class DataParallel(Layer):
         if get_world_size() <= 1:
             return
         n = get_world_size()
-        for p in self._layers.parameters():
-            if p.grad is not None:
-                all_reduce(p.grad)
-                p.grad._value = p.grad._value / n
+        with_grad = [p for p in self._layers.parameters()
+                     if p.grad is not None]
+        if not with_grad:
+            return
+        # fused-bucket allreduce (reference Reducer::MarkGroupReady
+        # concat-and-allreduce, reducer.cc:463-559): ONE collective for
+        # the whole model
+        flats = [jnp.ravel(p.grad._value).astype(jnp.float32)
+                 for p in with_grad]
+        sizes = [int(f.size) for f in flats]
+        bucket = Tensor(jnp.concatenate(flats))
+        all_reduce(bucket)
+        merged = bucket._value / n
+        offset = 0
+        for p, size in zip(with_grad, sizes):
+            piece = merged[offset:offset + size]
+            p.grad._value = piece.reshape(p.grad._value.shape).astype(
+                p.grad._value.dtype)
+            offset += size
 
     def state_dict(self, *args, **kwargs):
         return self._layers.state_dict(*args, **kwargs)

@@ -38,15 +38,19 @@ from .mesh import create_mesh
 
 def build_mesh_from_strategy(strategy: DistributedStrategy,
                              devices=None) -> Mesh:
-    """hybrid_configs degrees → Mesh with axes (dp, pp, tp, sp)."""
+    """hybrid_configs degrees → Mesh with axes (dp, pp, tp, sp, ep)."""
     devs = list(devices if devices is not None else jax.devices())
     h = strategy.hybrid_configs
     tp = max(1, h.mp_degree)
     pp = max(1, h.pp_degree)
     sp = max(1, h.sp_degree)
+    ep = max(1, getattr(h, "ep_degree", 1))
     dp = h.dp_degree if h.dp_degree > 0 else \
-        len(devs) // (tp * pp * sp)
-    return create_mesh({"dp": dp, "pp": pp, "tp": tp, "sp": sp}, devs)
+        len(devs) // (tp * pp * sp * ep)
+    axes = {"dp": dp, "pp": pp, "tp": tp, "sp": sp}
+    if ep > 1:
+        axes["ep"] = ep
+    return create_mesh(axes, devs)
 
 
 def _spec_axes(spec: P) -> set:

@@ -43,6 +43,12 @@ class GPTConfig:
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
     use_flash_attention: bool = True
+    # MoE (beyond-reference capability, distributed/moe.py): >0 replaces
+    # every block's FFN with a num-experts MoE sharded over 'ep'
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         if not self.ffn_hidden_size:
@@ -173,7 +179,16 @@ class GPTBlock(nn.Layer):
         self.attn = GPTAttention(config)
         self.ln_2 = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_eps)
-        self.mlp = GPTMLP(config)
+        if config.moe_num_experts > 0:
+            from ..distributed.moe import MoEMLP
+
+            self.mlp = MoEMLP(config.hidden_size, config.ffn_hidden_size,
+                              config.moe_num_experts,
+                              top_k=config.moe_top_k,
+                              capacity_factor=config.moe_capacity_factor,
+                              initializer_range=config.initializer_range)
+        else:
+            self.mlp = GPTMLP(config)
 
     def forward(self, x):
         x = x + self.attn(self.ln_1(x))
@@ -253,7 +268,8 @@ class GPT(nn.Layer):
             next_token=True)
 
     def loss(self, tokens, labels=None):
-        """Next-token LM loss. labels default: tokens shifted left."""
+        """Next-token LM loss (+ MoE load-balance aux when configured).
+        labels default: tokens shifted left."""
         logits = self.forward(tokens)
         if labels is None:
             lg = logits[:, :-1]
@@ -261,7 +277,12 @@ class GPT(nn.Layer):
         else:
             lg, lb = logits, labels
         b, s = lb.shape[0], lb.shape[1]
-        return F.cross_entropy(lg.reshape([b * s, -1]), lb.reshape([b * s]))
+        loss = F.cross_entropy(lg.reshape([b * s, -1]),
+                               lb.reshape([b * s]))
+        if self.config.moe_num_experts > 0:
+            for blk in self.blocks:
+                loss = loss + self.config.moe_aux_weight * blk.mlp.aux_loss
+        return loss
 
 
 def gpt_tiny(**kw):

@@ -41,8 +41,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..tensor._helper import apply
 
-_BLOCK_Q = 512         # default tile edges (capped by seq len). Large tiles
-_BLOCK_K = 512         # amortize grid/DMA overhead; equal q/k tiles under
+_BLOCK_Q = 1024        # default tile edges (capped by seq len). Large tiles
+_BLOCK_K = 1024        # amortize grid/DMA overhead and, at 1024, collapse
+                       # seq<=1024 to ONE tile per (batch,head) — no
+                       # running-softmax rescale passes (measured +5% MFU
+                       # on GPT-350M vs 512 tiles; logits tile is 4 MiB
+                       # f32, comfortably in VMEM). Equal q/k tiles under
                        # causal so the diagonal block covers its own row.
 _SEQ_ALIGN = 128
 _NEG_INF = -1e30

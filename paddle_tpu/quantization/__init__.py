@@ -71,12 +71,17 @@ def fake_quant_fn(x, scale=None, bits=8, channel_axis=None):
 
 
 def fake_quant(x, scale=None, bits=8, channel_axis=None, name=None):
-    """Tape-level fake-quant (Tensor in/out)."""
+    """Tape-level fake-quant (Tensor in/out). scale: None (abs-max),
+    Tensor, or a plain scalar/array."""
     def f(v, *rest):
         sc = rest[0] if rest else None
         return fake_quant_fn(v, sc, bits=bits, channel_axis=channel_axis)
 
-    args = (x,) + ((scale,) if isinstance(scale, Tensor) else ())
+    if scale is None:
+        args = (x,)
+    else:
+        args = (x, scale if isinstance(scale, Tensor)
+                else Tensor(jnp.asarray(scale, jnp.float32)))
     return apply(f, *args, name="fake_quantize_dequantize")
 
 

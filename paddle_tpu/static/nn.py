@@ -83,7 +83,8 @@ def case(pred_fn_pairs, default=None, name=None):
             outs.append(default())
         vals = [o._value if isinstance(o, Tensor) else jnp.asarray(o)
                 for o in outs]
-        result = vals[-1] if default is not None else vals[-1]
+        # fallback: the default when given, else the last branch
+        result = vals[-1]
         # fold right: earlier preds take priority
         for p, v in zip(reversed(pvals), reversed(
                 vals[:len(pvals)])):

@@ -245,3 +245,51 @@ def einsum(equation, *operands, name=None):
 def increment(x, value=1.0, name=None):
     x._value = x._value + value
     return x
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """reference: paddle.diff (finite differences along an axis)."""
+    args = [x]
+    if prepend is not None:
+        args.append(prepend)
+    if append is not None:
+        args.append(append)
+
+    def f(v, *rest):
+        i = 0
+        pre = post = None
+        if prepend is not None:
+            pre = rest[i]
+            i += 1
+        if append is not None:
+            post = rest[i]
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=post)
+
+    return apply(f, *args, name="diff")
+
+
+def deg2rad(x, name=None):
+    return apply(jnp.deg2rad, x, name="deg2rad")
+
+
+def rad2deg(x, name=None):
+    return apply(jnp.rad2deg, x, name="rad2deg")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(
+        v, axis=axis_arg(axis), keepdims=keepdim), x, name="count_nonzero")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize sub-tensors along ``axis`` to at most ``max_norm`` in
+    p-norm (reference: paddle.renorm)."""
+    def f(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims,
+                        keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return v * factor
+
+    return apply(f, x, name="renorm")

@@ -51,30 +51,28 @@ class Imdb(Dataset):
         assert mode in ("train", "test")
         self.mode = mode
         if data_file:
-            pattern = re.compile(
+            mode_pattern = re.compile(
                 rf"aclImdb/{mode}/((pos)|(neg))/.*\.txt$")
             all_pattern = re.compile(
                 r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
-            corpus = []
-            with tarfile.open(data_file) as tf:
-                members = [m for m in tf.getmembers()]
-                for m in members:
-                    if all_pattern.match(m.name):
-                        text = tf.extractfile(m).read().decode(
-                            "utf-8", "ignore")
-                        corpus.append(_TOK(text))
-            self.word_idx = Vocab.build(corpus, cutoff=cutoff)
-            self.docs, self.labels = [], []
+            # single decompression pass: tokenize every doc once, keep the
+            # current mode's (a subset) for labeling
+            corpus, mode_docs = [], []
             with tarfile.open(data_file) as tf:
                 for m in tf.getmembers():
-                    mt = pattern.match(m.name)
-                    if mt:
-                        text = tf.extractfile(m).read().decode(
-                            "utf-8", "ignore")
-                        self.docs.append(
-                            self.word_idx.to_ids(_TOK(text)))
-                        self.labels.append(0 if "/pos/" in m.name else 1)
-            self.labels = np.asarray(self.labels, np.int64)
+                    if not all_pattern.match(m.name):
+                        continue
+                    toks = _TOK(tf.extractfile(m).read().decode(
+                        "utf-8", "ignore"))
+                    corpus.append(toks)
+                    if mode_pattern.match(m.name):
+                        mode_docs.append(
+                            (toks, 0 if "/pos/" in m.name else 1))
+            self.word_idx = Vocab.build(corpus, cutoff=cutoff)
+            self.docs = [self.word_idx.to_ids(toks)
+                         for toks, _ in mode_docs]
+            self.labels = np.asarray([lbl for _, lbl in mode_docs],
+                                     np.int64)
         else:
             n = synthetic_size or (512 if mode == "train" else 128)
             self.docs, self.labels = _synthetic_docs(
@@ -106,15 +104,18 @@ class Imikolov(Dataset):
             with tarfile.open(data_file) as tf:
                 train_f = tf.extractfile(
                     "./simple-examples/data/ptb.train.txt")
-                corpus = [_TOK(line.decode("utf-8", "ignore"))
-                          for line in train_f]
+                # reference convention (imikolov.py word_count): each
+                # sentence is <s> ... <e>, with both markers REAL vocab
+                # entries counted from the corpus
+                corpus = [["<s>"] + _TOK(line.decode("utf-8", "ignore"))
+                          + ["<e>"] for line in train_f]
                 vocab = Vocab.build(corpus, cutoff=min_word_freq - 1,
                                     unk_token="<unk>")
                 f = tf.extractfile(path)
                 lines = [_TOK(line.decode("utf-8", "ignore"))
                          for line in f]
             self.word_idx = vocab
-            sents = [vocab.to_ids(["<s>"] * 0 + ln + ["<e>"])
+            sents = [vocab.to_ids(["<s>"] + ln + ["<e>"])
                      for ln in lines if ln]
         else:
             n = synthetic_size or 256
