@@ -21,6 +21,7 @@ truthful synchronization.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -199,27 +200,13 @@ def main():
     peak = peak_flops_per_chip()
     paddle.seed(0)
     configs = {}
+    t_start = time.perf_counter()
+    # soft wall budget for the EXTRA configs: the headline must always be
+    # measured and printed even if the driver enforces a timeout
+    budget_s = float(os.environ.get("PADDLE_BENCH_BUDGET_S", "780"))
 
+    # headline FIRST
     if on_tpu:
-        configs["lenet_mnist"] = bench_lenet(paddle, steps=20)
-        configs["resnet50_dp_amp"] = bench_resnet50(paddle, steps=10,
-                                                    batch=64)
-        from paddle_tpu.models import BertForPretraining, ErnieForPretraining
-
-        configs["bert_base_dp_amp"] = bench_mlm(
-            paddle, BertForPretraining,
-            BertConfig(vocab_size=32768, max_seq_len=512),
-            batch=16, seq=512, steps=10, peak=peak)
-        configs["gpt_125m_hybrid_amp"] = bench_gpt(
-            paddle, GPTConfig(vocab_size=32768, hidden_size=768,
-                              num_layers=12, num_heads=12,
-                              max_seq_len=1024),
-            batch=8, seq=1024, steps=15, peak=peak)
-        configs["ernie_zero3_recompute"] = bench_mlm(
-            paddle, ErnieForPretraining,
-            ErnieConfig(vocab_size=32768, hidden_size=1024,
-                        num_layers=24, num_heads=16, max_seq_len=512),
-            batch=16, seq=512, steps=10, peak=peak, zero3=True, remat=True)
         head_cfg = GPTConfig(vocab_size=32768, hidden_size=1024,
                              num_layers=24, num_heads=16, max_seq_len=1024)
         head = bench_gpt(paddle, head_cfg, batch=8, seq=1024, steps=10,
@@ -229,8 +216,40 @@ def main():
                              num_heads=4, max_seq_len=128)
         head = bench_gpt(paddle, head_cfg, batch=2, seq=64, steps=2,
                          peak=peak)
-
     configs["gpt_350m_hybrid_amp"] = head
+
+    def extra(name, fn):
+        if time.perf_counter() - t_start > budget_s:
+            configs[name] = {"skipped": "bench wall budget exhausted"}
+            return
+        try:
+            configs[name] = fn()
+        except Exception as e:  # one broken config must not kill the line
+            configs[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    if on_tpu:
+        from paddle_tpu.models import (BertForPretraining,
+                                       ErnieForPretraining)
+
+        extra("gpt_125m_hybrid_amp", lambda: bench_gpt(
+            paddle, GPTConfig(vocab_size=32768, hidden_size=768,
+                              num_layers=12, num_heads=12,
+                              max_seq_len=1024),
+            batch=8, seq=1024, steps=15, peak=peak))
+        extra("bert_base_dp_amp", lambda: bench_mlm(
+            paddle, BertForPretraining,
+            BertConfig(vocab_size=32768, max_seq_len=512),
+            batch=16, seq=512, steps=10, peak=peak))
+        extra("ernie_zero3_recompute", lambda: bench_mlm(
+            paddle, ErnieForPretraining,
+            ErnieConfig(vocab_size=32768, hidden_size=1024,
+                        num_layers=24, num_heads=16, max_seq_len=512),
+            batch=16, seq=512, steps=10, peak=peak, zero3=True,
+            remat=True))
+        extra("resnet50_dp_amp", lambda: bench_resnet50(
+            paddle, steps=10, batch=64))
+        extra("lenet_mnist", lambda: bench_lenet(paddle, steps=20))
+
     print(json.dumps({
         "metric": "gpt_350m_train_tokens_per_sec_per_chip",
         "value": head["tokens_per_sec"],
@@ -240,6 +259,7 @@ def main():
         "extra": {"mfu": head["mfu"], "step_ms": head["step_ms"],
                   "device": str(jax.devices()[0]),
                   "peak_flops": peak,
+                  "bench_wall_s": round(time.perf_counter() - t_start, 1),
                   "configs": configs},
     }))
 

@@ -47,6 +47,13 @@ from . import incubate  # noqa: F401,E402
 from . import text  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import onnx  # noqa: F401,E402
+from . import batch as _batch_mod  # noqa: E402
+from .batch import batch  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import distribution  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import regularizer  # noqa: F401,E402
+from . import sysconfig  # noqa: F401,E402
 from . import utils  # noqa: F401,E402
 from .distributed.parallel import DataParallel  # noqa: F401,E402
 from .framework.io import load, save  # noqa: F401,E402

@@ -1,0 +1,14 @@
+"""Legacy reader-style dataset namespace (reference:
+python/paddle/dataset/ — mnist.py, cifar.py, imdb.py, uci_housing.py…
+each exposing train()/test() generator factories consumed by
+paddle.batch / paddle.reader decorators).
+
+Thin adapters over the modern Dataset classes (vision/datasets,
+text/datasets): same reader-function contract, one sample tuple per
+yield.
+"""
+from __future__ import annotations
+
+from . import cifar, imdb, imikolov, mnist, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing"]

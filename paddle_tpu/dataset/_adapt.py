@@ -1,0 +1,16 @@
+"""Shared adapter: Dataset class -> legacy reader factory."""
+from __future__ import annotations
+
+
+def reader_from(dataset_factory):
+    def make(*args, **kwargs):
+        def reader():
+            ds = dataset_factory(*args, **kwargs)
+            for i in range(len(ds)):
+                item = ds[i]
+                yield tuple(item) if isinstance(item, (tuple, list)) \
+                    else (item,)
+
+        return reader
+
+    return make

@@ -1,0 +1,22 @@
+"""reference: python/paddle/dataset/cifar.py (cifar10/100 readers)."""
+from ..vision.datasets import Cifar10, Cifar100
+from ._adapt import reader_from
+
+_make10 = reader_from(Cifar10)
+_make100 = reader_from(Cifar100)
+
+
+def train10(**kw):
+    return _make10(mode="train", **kw)
+
+
+def test10(**kw):
+    return _make10(mode="test", **kw)
+
+
+def train100(**kw):
+    return _make100(mode="train", **kw)
+
+
+def test100(**kw):
+    return _make100(mode="test", **kw)

@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/imdb.py."""
+from ..text.datasets import Imdb
+from ._adapt import reader_from
+
+_make = reader_from(Imdb)
+
+
+def train(word_idx=None, **kw):
+    return _make(mode="train", **kw)
+
+
+def test(word_idx=None, **kw):
+    return _make(mode="test", **kw)

@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/imikolov.py."""
+from ..text.datasets import Imikolov
+from ._adapt import reader_from
+
+_make = reader_from(Imikolov)
+
+
+def train(word_idx=None, n=5, **kw):
+    return _make(mode="train", window_size=n, **kw)
+
+
+def test(word_idx=None, n=5, **kw):
+    return _make(mode="test", window_size=n, **kw)

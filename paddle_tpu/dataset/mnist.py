@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/mnist.py (train/test readers)."""
+from ..vision.datasets import MNIST
+from ._adapt import reader_from
+
+_make = reader_from(MNIST)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/uci_housing.py."""
+from ..text.datasets import UCIHousing
+from ._adapt import reader_from
+
+_make = reader_from(UCIHousing)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

@@ -38,7 +38,7 @@ __all__ = ["MoEMLP", "switch_moe"]
 
 
 def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
-               capacity_factor=1.25, train=True):
+               capacity_factor=1.25):
     """Pure-jax MoE FFN. x: [T, H]; gate_w: [H, E]; experts stacked
     w_in [E, H, F], b_in [E, F], w_out [E, F, H], b_out [E, H].
 
@@ -120,7 +120,7 @@ class MoEMLP(nn.Layer):
             "gate": P(), "w_in": P("ep", None, None),
             "b_in": P("ep", None), "w_out": P("ep", None, None),
             "b_out": P("ep", None)}
-        self.aux_loss = Tensor(jnp.zeros((), jnp.float32))
+        self._aux = Tensor(jnp.zeros((), jnp.float32))
 
     def forward(self, x):
         b, s, h = x.shape[0], x.shape[1], x.shape[2]
@@ -128,12 +128,32 @@ class MoEMLP(nn.Layer):
         def f(xv, gw, wi, bi, wo, bo):
             y, aux = switch_moe(
                 xv.reshape(b * s, h), gw, wi, bi, wo, bo,
-                top_k=self.top_k, capacity_factor=self.capacity_factor,
-                train=self.training)
+                top_k=self.top_k, capacity_factor=self.capacity_factor)
             return y.reshape(b, s, h), aux
 
         out = apply(f, x, self.gate, self.w_in, self.b_in, self.w_out,
                     self.b_out, name="moe_mlp")
         y, aux = out
-        self.aux_loss = aux
+        self._aux = aux
         return y
+
+    @property
+    def aux_loss(self):
+        """Load-balance loss of the last forward. Inside the same trace
+        (GPT.loss under jit) this is the traced value; reading a value
+        LEFT OVER from a finished compiled step eagerly is an error —
+        raise a clear message instead of jax's UnexpectedTracerError."""
+        from jax._src.core import trace_state_clean
+
+        v = self._aux
+        if isinstance(v._value, jax.core.Tracer) and trace_state_clean():
+            raise RuntimeError(
+                "MoEMLP.aux_loss of the last compiled step is not "
+                "readable eagerly: the value lived inside the jit trace. "
+                "Fold it into the jitted loss (models/gpt.py GPT.loss "
+                "does) or run the layer eagerly.")
+        return v
+
+    @aux_loss.setter
+    def aux_loss(self, v):
+        self._aux = v

@@ -1,0 +1,167 @@
+"""Probability distributions (reference: python/paddle/distribution.py —
+Distribution:41, Uniform:168, Normal:390, Categorical:640; the v2.0 API:
+sample / entropy / log_prob / probs / kl_divergence).
+
+TPU-native: sampling draws from the framework RNG stream
+(core/rng.py — the same stream checkpoints/elastic restore), math is
+jnp through the eager tape so log_prob/entropy are differentiable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import rng as _rng
+from .framework.tensor import Tensor
+from .tensor._helper import apply
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _as_tensor(x):
+    """Keep user Tensors intact (grads flow to distribution params —
+    reference parameters are Variables too); wrap raw scalars/arrays."""
+    return x if isinstance(x, Tensor) else Tensor(
+        jnp.asarray(x, jnp.float32))
+
+
+class Distribution:
+    """Base (reference: distribution.py:41)."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high) (reference: distribution.py:168)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _as_tensor(low)
+        self.high = _as_tensor(high)
+
+    def sample(self, shape=(), seed=0):
+        key = _rng.next_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.low._value.shape, self.high._value.shape)
+        u = jax.random.uniform(key, shape, jnp.float32)
+        return Tensor(self.low._value
+                      + u * (self.high._value - self.low._value))
+
+    def entropy(self):
+        return apply(lambda lo, hi: jnp.log(hi - lo),
+                     self.low, self.high)
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+
+        return apply(f, _as_tensor(value), self.low, self.high)
+
+    def probs(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference: distribution.py:390)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_tensor(loc)
+        self.scale = _as_tensor(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = _rng.next_key()
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.loc._value.shape, self.scale._value.shape)
+        z = jax.random.normal(key, shape, jnp.float32)
+        return Tensor(self.loc._value + z * self.scale._value)
+
+    def entropy(self):
+        return apply(
+            lambda s: 0.5 + 0.5 * np.log(2 * np.pi) + jnp.log(s),
+            self.scale)
+
+    def log_prob(self, value):
+        def f(v, mu, s):
+            var = s * s
+            return -((v - mu) ** 2) / (2 * var) - jnp.log(s) \
+                - 0.5 * np.log(2 * np.pi)
+
+        return apply(f, _as_tensor(value), self.loc, self.scale)
+
+    def probs(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+    def kl_divergence(self, other):
+        """KL(self || other) for two Normals (reference
+        distribution.py:~600 kl_divergence)."""
+        def f(mu0, s0, mu1, s1):
+            var_ratio = (s0 / s1) ** 2
+            t1 = ((mu0 - mu1) / s1) ** 2
+            return 0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio))
+
+        return apply(f, self.loc, self.scale,
+                     other.loc, other.scale)
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference:
+    distribution.py:640 — takes logits, normalizes internally)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _as_tensor(logits)
+
+    def _probs(self):
+        # reference semantics: logits are unnormalized PROBABILITIES
+        # (non-negative weights); normalize by their sum
+        w = self.logits._value
+        return w / jnp.sum(w, axis=-1, keepdims=True)
+
+    def sample(self, shape=()):
+        key = _rng.next_key()
+        p = self._probs()
+        logp = jnp.log(jnp.maximum(p, 1e-38))
+        return Tensor(jax.random.categorical(
+            key, logp, shape=tuple(shape) + logp.shape[:-1]))
+
+    def entropy(self):
+        def f(w):
+            p = w / jnp.sum(w, axis=-1, keepdims=True)
+            return -jnp.sum(p * jnp.log(jnp.maximum(p, 1e-38)), axis=-1)
+
+        return apply(f, self.logits)
+
+    def probs(self, value):
+        def f(w, idx):
+            p = w / jnp.sum(w, axis=-1, keepdims=True)
+            return jnp.take_along_axis(
+                p, idx.astype(jnp.int32)[..., None], -1)[..., 0]
+
+        return apply(f, self.logits, _as_tensor(value))
+
+    def log_prob(self, value):
+        return apply(lambda p: jnp.log(jnp.maximum(p, 1e-38)),
+                     self.probs(value))
+
+    def kl_divergence(self, other):
+        def f(w0, w1):
+            p = w0 / jnp.sum(w0, axis=-1, keepdims=True)
+            q = w1 / jnp.sum(w1, axis=-1, keepdims=True)
+            return jnp.sum(p * (jnp.log(jnp.maximum(p, 1e-38))
+                                - jnp.log(jnp.maximum(q, 1e-38))), -1)
+
+        return apply(f, self.logits, other.logits)

@@ -104,10 +104,15 @@ class Optimizer:
         p_vals = [p._value for p in params]
         g_vals = [p.grad._value for p in params]
         lrs = tuple(p.optimize_attr.get("learning_rate", 1.0) for p in params)
-        regs = tuple(
-            float(getattr(p.regularizer, "_coeff",
-                          getattr(p.regularizer, "coeff", 0.0)))
-            if p.regularizer is not None else -1.0 for p in params)
+        def _reg_sig(p):
+            if p.regularizer is None:
+                return -1.0, "l2"
+            coeff = float(getattr(p.regularizer, "_coeff",
+                                  getattr(p.regularizer, "coeff", 0.0)))
+            kind = "l1" if "L1" in type(p.regularizer).__name__ else "l2"
+            return coeff, kind
+
+        regs = tuple(_reg_sig(p) for p in params)
         wds = tuple(self._decoupled_wd(p) for p in params)
 
         sig = (lrs, regs, wds, tuple(id(p) for p in params))
@@ -124,8 +129,11 @@ class Optimizer:
                                                   fused._lrs, fused._regs,
                                                   fused._wds):
                     g = g.astype(jnp.float32) if g.dtype == jnp.bfloat16 else g
-                    if reg >= 0.0:
-                        g = g + reg * p            # per-param regularizer
+                    rcoeff, rkind = reg
+                    if rcoeff >= 0.0:
+                        # per-param regularizer (regularizer.py L1/L2Decay)
+                        g = g + (rcoeff * jnp.sign(p) if rkind == "l1"
+                                 else rcoeff * p)
                     elif decay_mode == "l2" and wd:
                         g = g + wd * p
                     np_, ns = update(p, g, s, lr * plr, step_no, wd=pwd)

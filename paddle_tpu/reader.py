@@ -1,0 +1,101 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py —
+the v1 generator-combinator API kept alive in 2.0: map_readers, shuffle,
+chain, compose, buffered, firstn; plus paddle.batch in batch.py).
+
+These are plain-python generator transforms; the performant path is
+paddle_tpu.io.DataLoader (native prefetch engine), but the combinators
+remain for API parity and quick scripting.
+"""
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "firstn", "cache"]
+
+
+def cache(reader):
+    all_data = list(reader())
+
+    def __impl__():
+        yield from all_data
+
+    return __impl__
+
+
+def map_readers(func, *readers):
+    def __impl__():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return __impl__
+
+
+def shuffle(reader, buf_size):
+    def __impl__():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return __impl__
+
+
+def chain(*readers):
+    def __impl__():
+        yield from itertools.chain(*[r() for r in readers])
+
+    return __impl__
+
+
+def compose(*readers, check_alignment=True):
+    def __impl__():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return __impl__
+
+
+def buffered(reader, size):
+    """Background-thread prefetch (reference decorator.py buffered)."""
+    end = object()
+
+    def __impl__():
+        q: Queue = Queue(maxsize=size)
+
+        def fill():
+            for item in reader():
+                q.put(item)
+            q.put(end)
+
+        t = Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                break
+            yield item
+
+    return __impl__
+
+
+def firstn(reader, n):
+    def __impl__():
+        yield from itertools.islice(reader(), n)
+
+    return __impl__

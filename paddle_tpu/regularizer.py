@@ -1,0 +1,40 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay, applied per-param via ParamAttr or globally via the
+optimizer's weight_decay argument).
+
+TPU-native application: instead of the reference's appended decay ops in
+the program (fluid regularizer append_regularization_ops), the decay
+folds into the fused optimizer update — pass an instance as
+``weight_decay=`` to any optimizer, or attach via ParamAttr(
+regularizer=...) for per-param override.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Decay:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __float__(self):
+        return self.coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L2Decay(_Decay):
+    """grad += coeff * param (reference regularizer.py L2Decay)."""
+
+    def grad_term(self, param_value):
+        return self.coeff * param_value
+
+
+class L1Decay(_Decay):
+    """grad += coeff * sign(param) (reference regularizer.py L1Decay)."""
+
+    def grad_term(self, param_value):
+        import jax.numpy as jnp
+
+        return self.coeff * jnp.sign(param_value)

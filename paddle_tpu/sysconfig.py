@@ -1,0 +1,20 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py —
+get_include/get_lib for building C++ extensions against the install)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of native headers (the custom-op C ABI lives in
+    utils/custom_op.py's docstring; native sources under native/)."""
+    return os.path.join(os.path.dirname(_ROOT), "native", "src")
+
+
+def get_lib() -> str:
+    """Directory containing the built native runtime library."""
+    return os.path.join(_ROOT, "_native")

@@ -1,0 +1,122 @@
+"""Top-level compat namespaces (reference python/paddle/:
+distribution.py, regularizer.py, batch.py, reader/, dataset/,
+sysconfig.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestDistribution:
+    def test_normal(self):
+        n = paddle.distribution.Normal(0.0, 1.0)
+        s = n.sample([2000])
+        assert abs(float(s.numpy().mean())) < 0.15
+        assert abs(float(s.numpy().std()) - 1.0) < 0.15
+        assert abs(float(n.entropy().numpy()) - 1.41894) < 1e-3
+        lp = n.log_prob(paddle.to_tensor(np.float32(0.0)))
+        assert abs(float(lp.numpy()) + 0.91894) < 1e-3
+
+    def test_normal_kl(self):
+        a = paddle.distribution.Normal(0.0, 1.0)
+        b = paddle.distribution.Normal(1.0, 1.0)
+        assert abs(float(a.kl_divergence(b).numpy()) - 0.5) < 1e-5
+        assert abs(float(a.kl_divergence(a).numpy())) < 1e-7
+
+    def test_uniform(self):
+        u = paddle.distribution.Uniform(1.0, 3.0)
+        s = u.sample([1000]).numpy()
+        assert s.min() >= 1.0 and s.max() < 3.0
+        assert abs(float(u.entropy().numpy()) - np.log(2.0)) < 1e-6
+        inside = u.log_prob(paddle.to_tensor(np.float32(2.0)))
+        outside = u.log_prob(paddle.to_tensor(np.float32(5.0)))
+        assert abs(float(inside.numpy()) + np.log(2.0)) < 1e-6
+        assert np.isinf(float(outside.numpy()))
+
+    def test_categorical(self):
+        c = paddle.distribution.Categorical(
+            paddle.to_tensor(np.asarray([1.0, 1.0, 2.0], np.float32)))
+        assert abs(float(c.probs(
+            paddle.to_tensor(np.int64(2)).numpy() if False else
+            paddle.to_tensor(np.int64(2))).numpy()) - 0.5) < 1e-6
+        s = c.sample([500]).numpy()
+        assert set(np.unique(s)) <= {0, 1, 2}
+        # entropy of [.25,.25,.5]
+        ref = -(0.25 * np.log(0.25) * 2 + 0.5 * np.log(0.5))
+        assert abs(float(c.entropy().numpy()) - ref) < 1e-5
+
+    def test_log_prob_differentiable(self):
+        mu = paddle.to_tensor(np.float32(0.5))
+        mu.stop_gradient = False
+        n = paddle.distribution.Normal(mu, 1.0)
+        lp = n.log_prob(paddle.to_tensor(np.float32(1.0)))
+        lp.backward()
+        assert abs(float(mu.grad.numpy()) - 0.5) < 1e-5   # (x-mu)/var
+
+
+class TestReaderBatch:
+    def test_batch_sizes(self):
+        b = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(x) for x in b()] == [3, 3, 1]
+        b = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(x) for x in b()] == [3, 3]
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter([]), 0)
+
+    def test_reader_combinators(self):
+        r = paddle.reader.shuffle(lambda: iter(range(10)), 4)
+        assert sorted(r()) == list(range(10))
+        c = paddle.reader.chain(lambda: iter([1, 2]), lambda: iter([3]))
+        assert list(c()) == [1, 2, 3]
+        m = paddle.reader.map_readers(lambda a, b: a + b,
+                                      lambda: iter([1, 2]),
+                                      lambda: iter([10, 20]))
+        assert list(m()) == [11, 22]
+        f = paddle.reader.firstn(lambda: iter(range(100)), 3)
+        assert list(f()) == [0, 1, 2]
+        buf = paddle.reader.buffered(lambda: iter(range(5)), 2)
+        assert list(buf()) == [0, 1, 2, 3, 4]
+
+    def test_legacy_dataset_readers(self):
+        tr = paddle.dataset.uci_housing.train()()
+        x, y = next(tr)
+        assert x.shape == (13,) and y.shape == (1,)
+        m = paddle.dataset.mnist.test(synthetic_size=8)()
+        img, lbl = next(m)
+        assert img.shape == (1, 28, 28)
+
+
+class TestRegularizerSysconfig:
+    def test_decay_terms(self):
+        import jax.numpy as jnp
+
+        w = jnp.asarray([-2.0, 3.0])
+        l2 = paddle.regularizer.L2Decay(0.1)
+        np.testing.assert_allclose(np.asarray(l2.grad_term(w)),
+                                   [-0.2, 0.3])
+        l1 = paddle.regularizer.L1Decay(0.1)
+        np.testing.assert_allclose(np.asarray(l1.grad_term(w)),
+                                   [-0.1, 0.1])
+        assert float(l2) == 0.1
+
+    def test_sysconfig_paths(self):
+        import os
+
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        assert "data_engine.cc" in os.listdir(
+            paddle.sysconfig.get_include())
+
+    def test_l1_regularizer_applied_by_optimizer(self):
+        from paddle_tpu.framework.param_attr import ParamAttr
+        from paddle_tpu.regularizer import L1Decay
+
+        net = paddle.nn.Linear(
+            2, 2, weight_attr=ParamAttr(regularizer=L1Decay(0.5)))
+        w0 = np.asarray(net.weight._value).copy()
+        opt = paddle.optimizer.SGD(1.0, parameters=net.parameters())
+        x = paddle.to_tensor(np.zeros((1, 2), np.float32))
+        net(x).sum().backward()      # weight grad 0 at x=0; reg remains
+        opt.step()
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   w0 - 0.5 * np.sign(w0), atol=1e-6)
