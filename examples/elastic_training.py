@@ -1,0 +1,35 @@
+"""Elastic training: periodic async checkpoints + resume-from-latest.
+Kill this script at any point and re-run it — the loss curve continues
+exactly where the last COMMITTED checkpoint left off."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.elastic import ElasticTrainer
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+from paddle_tpu.distributed.strategy_compiler import build_mesh_from_strategy
+from paddle_tpu.models import gpt_tiny
+
+
+def main():
+    paddle.seed(11)
+    net = gpt_tiny()
+    opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+    s = DistributedStrategy()
+    mesh = build_mesh_from_strategy(s)
+    trainer = HybridPipelineTrainer(net, opt, s, mesh, n_micro=1)
+    elastic = ElasticTrainer(trainer, "/tmp/elastic_ckpt",
+                             save_interval=10)
+
+    def data_fn(step):
+        rng = np.random.RandomState(1000 + step)   # deterministic cursor
+        return (rng.randint(0, 128, (4, 32)).astype(np.int32),)
+
+    elastic.run(data_fn, total_steps=50,
+                on_step=lambda s, l: print(f"step {s}: loss {l:.4f}"))
+
+
+if __name__ == "__main__":
+    main()

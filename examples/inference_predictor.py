@@ -1,0 +1,34 @@
+"""Save a model with jit.save, then run it through the inference
+Predictor — no Python model class needed (the AnalysisPredictor
+analogue)."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.static.input_spec import InputSpec
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(1)
+    net = LeNet()
+    net.eval()
+    paddle.jit.save(net, "/tmp/lenet_infer",
+                    input_spec=[InputSpec([1, 1, 28, 28], "float32", "x")])
+
+    predictor = create_predictor(Config("/tmp/lenet_infer"))
+    x = np.random.RandomState(0).randn(1, 1, 28, 28).astype(np.float32)
+    logits, = predictor.run([x])
+    print("input names:", predictor.get_input_names())
+    print("prediction:", int(np.argmax(logits)))
+
+    # eager parity check
+    ref = np.asarray(net(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(logits, ref, rtol=1e-5, atol=1e-5)
+    print("matches eager forward ✓")
+
+
+if __name__ == "__main__":
+    main()

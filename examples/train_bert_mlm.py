@@ -1,0 +1,47 @@
+"""BERT MLM+NSP pretraining through the model-agnostic pipeline trainer
+(the same trainer that runs GPT — the pipeline protocol)."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax
+
+if jax.default_backend() == "cpu" and len(jax.devices()) < 8:
+    raise SystemExit("run with 8 virtual devices (see examples/README.md)")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+from paddle_tpu.distributed.strategy_compiler import build_mesh_from_strategy
+from paddle_tpu.models import BertConfig, BertForPretraining
+
+
+def mlm_batch(rng, vocab, b, s):
+    tokens = rng.randint(0, vocab, (b, s)).astype(np.int32)
+    token_type = rng.randint(0, 2, (b, s)).astype(np.int32)
+    mlm_labels = np.where(rng.rand(b, s) < 0.15,
+                          rng.randint(0, vocab, (b, s)), -100) \
+        .astype(np.int32)
+    nsp_labels = rng.randint(0, 2, (b,)).astype(np.int32)
+    return tokens, token_type, mlm_labels, nsp_labels
+
+
+def main():
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                     num_heads=4, max_seq_len=128)
+    model = BertForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(3e-4, parameters=model.parameters())
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    mesh = build_mesh_from_strategy(s)
+    trainer = HybridPipelineTrainer(model, opt, s, mesh, n_micro=2)
+
+    rng = np.random.RandomState(0)
+    for step in range(8):
+        loss = trainer.step(*mlm_batch(rng, 512, 8, 128))
+        print(f"step {step}: loss {float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Eager training: LeNet on MNIST (synthetic fallback when no files)."""
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import DataLoader
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(7)
+    net = LeNet()
+    opt = paddle.optimizer.Adam(2e-3, parameters=net.parameters())
+    train = DataLoader(MNIST(mode="train", synthetic_size=512),
+                       batch_size=64, shuffle=True, drop_last=True)
+    acc = Accuracy()
+    for epoch in range(2):
+        acc.reset()
+        for x, y in train:
+            logits = net(x)
+            loss = F.cross_entropy(logits, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            acc.update(acc.compute(logits.numpy(), y.numpy()).numpy())
+        print(f"epoch {epoch}: loss {float(loss.numpy()):.4f} "
+              f"acc {acc.accumulate():.3f}")
+    paddle.save(net.state_dict(), "/tmp/lenet.pdparams")
+    net.set_state_dict(paddle.load("/tmp/lenet.pdparams"))
+
+
+if __name__ == "__main__":
+    main()

@@ -143,7 +143,11 @@ class MoEMLP(nn.Layer):
         (GPT.loss under jit) this is the traced value; reading a value
         LEFT OVER from a finished compiled step eagerly is an error —
         raise a clear message instead of jax's UnexpectedTracerError."""
-        from jax._src.core import trace_state_clean
+        try:  # private jax API; on a rename fall back to jax's own error
+            from jax._src.core import trace_state_clean
+        except ImportError:
+            def trace_state_clean():
+                return False
 
         v = self._aux
         if isinstance(v._value, jax.core.Tracer) and trace_state_clean():

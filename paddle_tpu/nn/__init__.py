@@ -30,7 +30,8 @@ from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa: F401
                          LocalResponseNorm, RMSNorm, SpectralNorm,
                          SyncBatchNorm)
 from .layer.pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D,  # noqa: F401
-                            AdaptiveMaxPool1D, AdaptiveMaxPool2D, AvgPool1D,
+                            AdaptiveAvgPool3D, AdaptiveMaxPool1D,
+                            AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D,
                             AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D,
                             MaxPool3D)
 from .layer.rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell,  # noqa: F401

@@ -24,6 +24,7 @@ from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # no
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
                    local_response_norm, normalize, rms_norm)
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
+                      adaptive_avg_pool3d, adaptive_max_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, avg_pool1d,
                       avg_pool2d, avg_pool3d, max_pool1d, max_pool2d,
                       max_pool3d)

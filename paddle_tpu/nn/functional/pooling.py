@@ -153,3 +153,56 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
         return v.reshape(b, c, o, l // o).max(axis=3)
 
     return apply(f, x, name="adaptive_max_pool1d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """reference: nn/functional/pooling.py adaptive_avg_pool3d (divisible
+    sizes reshape-reduce; general sizes via per-region bounds)."""
+    od, oh, ow = _tuple(output_size, 3)
+    chan_last = not data_format.startswith("NC")
+
+    def f(v):
+        if chan_last:                      # NDHWC → pool in NCDHW layout
+            v = v.transpose(0, 4, 1, 2, 3)
+        b, c, d, h, w = v.shape
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            vv = v.reshape(b, c, od, d // od, oh, h // oh, ow, w // ow)
+            out = vv.mean(axis=(3, 5, 7))
+            return out.transpose(0, 2, 3, 4, 1) if chan_last else out
+        import jax.numpy as jnp
+
+        def pool_axis(vv, axis, n_out):
+            size = vv.shape[axis]
+            starts = (jnp.arange(n_out) * size) // n_out
+            ends = ((jnp.arange(n_out) + 1) * size + n_out - 1) // n_out
+            idx = jnp.arange(size)
+            mask = (idx[None, :] >= starts[:, None]) & \
+                (idx[None, :] < ends[:, None])
+            mask = mask.astype(vv.dtype)
+            mask = mask / mask.sum(axis=1, keepdims=True)
+            # region-mean as a matmul over the pooled axis
+            return jnp.moveaxis(
+                jnp.tensordot(jnp.moveaxis(vv, axis, -1), mask.T,
+                              axes=[[-1], [0]]), -1, axis)
+
+        out = pool_axis(v, 2, od)
+        out = pool_axis(out, 3, oh)
+        out = pool_axis(out, 4, ow)
+        return out.transpose(0, 2, 3, 4, 1) if chan_last else out
+
+    return apply(f, x, name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    od, oh, ow = _tuple(output_size, 3)
+
+    def f(v):
+        b, c, d, h, w = v.shape
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            vv = v.reshape(b, c, od, d // od, oh, h // oh, ow, w // ow)
+            return vv.max(axis=(3, 5, 7))
+        raise NotImplementedError(
+            "adaptive_max_pool3d requires output_size to divide the "
+            "spatial dims (general sizes: use adaptive_avg_pool3d)")
+
+    return apply(f, x, name="adaptive_max_pool3d")

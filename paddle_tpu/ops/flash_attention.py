@@ -298,6 +298,78 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _bwd_single_tile_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, dq_ref, dk_ref, dv_ref,
+                            *, scale, causal):
+    """Merged backward for the single-tile regime (whole sequence fits
+    one q×k tile — the default at seq<=1024): s and p = exp2(s−lse) are
+    computed ONCE and reused for dq, dk, and dv, where the two-kernel
+    path recomputes them per kernel. Saves a full logits recompute per
+    layer per step."""
+    q = q_ref[0]
+    k = k_ref[0]
+    v = v_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]                                      # [bq, 1] natural
+    delta = delta_ref[0]                                  # [bq, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * (scale * _LOG2E)
+    if causal:
+        s = jnp.where(_causal_mask(0, 0, q.shape[0], k.shape[0]), s,
+                      _NEG_INF)
+    p = jnp.exp2(s - lse * _LOG2E)                        # [bq, bk]
+    dv_ref[0] = jax.lax.dot_general(
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta) * scale
+    dsq = ds.astype(q.dtype)
+    dq_ref[0] = jax.lax.dot_general(
+        dsq, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        dsq, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def _bwd_single_tile(scale, causal, res, do3, delta, dtypes):
+    q3, k3, v3, lse = res
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    dq_dtype, dk_dtype, dv_dtype = dtypes
+    kern = functools.partial(_bwd_single_tile_kernel, scale=scale,
+                             causal=causal)
+    dq, dk, dv = pl.pallas_call(
+        kern,
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda b: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), dq_dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), dk_dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), dv_dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_interpret(),
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
 def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
          out_dtype=None):
     """delta/out_dtype are overridable for the ring-attention caller
@@ -314,6 +386,10 @@ def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
     dq_dtype = out_dtype or q3.dtype
     dk_dtype = out_dtype or k3.dtype
     dv_dtype = out_dtype or v3.dtype
+
+    if nq == 1 and nk == 1:
+        return _bwd_single_tile(scale, causal, (q3, k3, v3, lse), do3,
+                                delta, (dq_dtype, dk_dtype, dv_dtype))
 
     if causal:
         # same dead-tile DMA elision as the forward (see module docstring)

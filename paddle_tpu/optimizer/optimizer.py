@@ -41,11 +41,12 @@ class Optimizer:
         elif isinstance(weight_decay, (int, float)):
             self._weight_decay = float(weight_decay)
             self._decay_mode = "l2"          # L2 regularizer → grad += wd * p
-        else:  # L2Decay object
+        else:  # L1Decay / L2Decay object (regularizer.py)
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay,
                                                        "coeff", 0.0)))
-            self._decay_mode = "l2"
+            self._decay_mode = "l1" if "L1" in \
+                type(weight_decay).__name__ else "l2"
         self._accumulators: Dict[int, dict] = {}
         self._global_step = 0
         self._jitted = None
@@ -136,6 +137,8 @@ class Optimizer:
                                  else rcoeff * p)
                     elif decay_mode == "l2" and wd:
                         g = g + wd * p
+                    elif decay_mode == "l1" and wd:
+                        g = g + wd * jnp.sign(p)
                     np_, ns = update(p, g, s, lr * plr, step_no, wd=pwd)
                     new_ps.append(np_)
                     new_ss.append(ns)

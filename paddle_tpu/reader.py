@@ -57,9 +57,24 @@ def chain(*readers):
     return __impl__
 
 
+class ComposeNotAligned(ValueError):
+    """reference: reader/decorator.py ComposeNotAligned."""
+
+
 def compose(*readers, check_alignment=True):
     def __impl__():
-        for items in zip(*[r() for r in readers]):
+        sentinel = object()
+        iters = [iter(r()) for r in readers]
+        while True:
+            items = [next(it, sentinel) for it in iters]
+            done = [it is sentinel for it in items]
+            if all(done):
+                return
+            if any(done):
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "composed readers have different lengths")
+                return
             out = []
             for it in items:
                 if isinstance(it, tuple):
@@ -79,9 +94,12 @@ def buffered(reader, size):
         q: Queue = Queue(maxsize=size)
 
         def fill():
-            for item in reader():
-                q.put(item)
-            q.put(end)
+            try:
+                for item in reader():
+                    q.put(item)
+                q.put(end)
+            except BaseException as e:  # surface, never hang the consumer
+                q.put(e)
 
         t = Thread(target=fill, daemon=True)
         t.start()
@@ -89,6 +107,8 @@ def buffered(reader, size):
             item = q.get()
             if item is end:
                 break
+            if isinstance(item, BaseException):
+                raise item
             yield item
 
     return __impl__

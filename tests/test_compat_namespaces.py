@@ -38,7 +38,6 @@ class TestDistribution:
         c = paddle.distribution.Categorical(
             paddle.to_tensor(np.asarray([1.0, 1.0, 2.0], np.float32)))
         assert abs(float(c.probs(
-            paddle.to_tensor(np.int64(2)).numpy() if False else
             paddle.to_tensor(np.int64(2))).numpy()) - 0.5) < 1e-6
         s = c.sample([500]).numpy()
         assert set(np.unique(s)) <= {0, 1, 2}
@@ -120,3 +119,40 @@ class TestRegularizerSysconfig:
         opt.step()
         np.testing.assert_allclose(np.asarray(net.weight._value),
                                    w0 - 0.5 * np.sign(w0), atol=1e-6)
+
+    def test_compose_alignment_and_buffered_error(self):
+        from paddle_tpu.reader import ComposeNotAligned
+
+        c = paddle.reader.compose(lambda: iter(range(3)),
+                                  lambda: iter(range(2)))
+        with pytest.raises(ComposeNotAligned):
+            list(c())
+
+        def bad():
+            yield 1
+            raise IOError("corrupt sample")
+
+        buf = paddle.reader.buffered(bad, 2)
+        with pytest.raises(IOError, match="corrupt"):
+            list(buf())
+
+    def test_l1_weight_decay_global(self):
+        from paddle_tpu.regularizer import L1Decay
+
+        net = paddle.nn.Linear(2, 2)
+        w0 = np.asarray(net.weight._value).copy()
+        opt = paddle.optimizer.SGD(1.0, parameters=net.parameters(),
+                                   weight_decay=L1Decay(0.5))
+        x = paddle.to_tensor(np.zeros((1, 2), np.float32))
+        net(x).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   w0 - 0.5 * np.sign(w0), atol=1e-6)
+
+    def test_adaptive_pool3d_channels_last(self):
+        x = np.random.RandomState(3).rand(1, 4, 4, 4, 2).astype(np.float32)
+        out = paddle.nn.functional.adaptive_avg_pool3d(
+            paddle.to_tensor(x), 2, data_format="NDHWC")
+        assert tuple(out.shape) == (1, 2, 2, 2, 2)
+        ref = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(2, 4, 6))
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)

@@ -57,3 +57,21 @@ class TestMemoryStats:
         assert memory.max_memory_allocated() >= memory.memory_allocated() \
             or memory.max_memory_allocated() == 0
         assert isinstance(memory.device_memory_summary(), str)
+
+
+class TestAdaptivePool3D:
+    def test_divisible_and_general(self):
+        x = paddle.to_tensor(np.arange(2 * 3 * 4 * 4 * 4, dtype=np.float32)
+                             .reshape(2, 3, 4, 4, 4))
+        out = paddle.nn.AdaptiveAvgPool3D(2)(x)
+        ref = np.asarray(x._value).reshape(2, 3, 2, 2, 2, 2, 2, 2) \
+            .mean(axis=(3, 5, 7))
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+        mx = paddle.nn.AdaptiveMaxPool3D(2)(x)
+        refm = np.asarray(x._value).reshape(2, 3, 2, 2, 2, 2, 2, 2) \
+            .max(axis=(3, 5, 7))
+        np.testing.assert_allclose(np.asarray(mx._value), refm)
+        g = paddle.nn.functional.adaptive_avg_pool3d(
+            paddle.to_tensor(np.random.RandomState(0)
+                             .rand(1, 2, 5, 5, 5).astype(np.float32)), 2)
+        assert tuple(g.shape) == (1, 2, 2, 2, 2)
