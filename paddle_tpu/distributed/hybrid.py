@@ -54,13 +54,11 @@ class HybridPipelineTrainer:
                  v_virtual: Optional[int] = None,
                  remat_policy: Optional[str] = None):
         _check_protocol(model)
-        if getattr(getattr(model, "config", None), "moe_num_experts", 0):
-            raise NotImplementedError(
-                "MoE models are not supported by the pipeline trainer yet "
-                "(the per-block load-balance aux loss cannot cross the "
-                "pipeline block contract); train MoE configs with "
-                "distributed.strategy_compiler.compile_train_step "
-                "(dp × tp × ep)")
+        # MoE composes with pp: blocks return (h, aux) and pipeline_apply
+        # carries the load-balance scalar across the schedule (stage_aux)
+        cfg = getattr(model, "config", None)
+        self.moe = bool(getattr(cfg, "moe_num_experts", 0))
+        self.moe_aux_weight = float(getattr(cfg, "moe_aux_weight", 0.0))
         self.model = model
         self.optimizer = optimizer
         self.strategy = strategy or DistributedStrategy()
@@ -225,8 +223,13 @@ class HybridPipelineTrainer:
         manual_sp = sp > 1 and self.pp > 1
         block0 = model.pipeline_blocks()[0]
 
+        moe = self.moe
+        aux_w = self.moe_aux_weight
+
         def block_apply(stage_local, x):
-            """Apply one stage's lps blocks (lax.scan over layers)."""
+            """Apply one stage's lps blocks (lax.scan over layers).
+            MoE models: returns (out, weighted aux-loss sum of the
+            stage's blocks) — the pipeline's stage_aux contract."""
             def one_block(h, layer_params):
                 vals = [layer_params[s] for s in self.block_suffixes]
                 with _swapped_state(blk0_tensors, vals):
@@ -235,7 +238,8 @@ class HybridPipelineTrainer:
                             out = block0(Tensor(h))._value
                     else:
                         out = block0(Tensor(h))._value
-                return out
+                    aux = block0.mlp._aux._value if moe else None
+                return (out, aux) if moe else out
 
             if self.remat:
                 if self.remat_policy == "dots":
@@ -246,13 +250,21 @@ class HybridPipelineTrainer:
                 else:
                     one_block = jax.checkpoint(one_block)
 
-            def body(h, layer_params):
-                return one_block(h, layer_params), None
+            def body(carry, layer_params):
+                if moe:
+                    h, a = carry
+                    out, aux = one_block(h, layer_params)
+                    return (out, a + aux.astype(jnp.float32)), None
+                return one_block(carry, layer_params), None
 
+            init = (x, jnp.zeros((), jnp.float32)) if moe else x
             # unrolling removes the scan's dynamic-update-slice residual
             # bookkeeping on TPU; CPU (tests) keeps compile times sane
-            out, _ = jax.lax.scan(body, x, stage_local,
+            out, _ = jax.lax.scan(body, init, stage_local,
                                   unroll=jax.default_backend() != "cpu")
+            if moe:
+                h, a = out
+                return h, a * aux_w
             return out
 
         batch_tensors = [Tensor(b) for b in batch]
@@ -283,13 +295,23 @@ class HybridPipelineTrainer:
                     loss_v = pipeline_apply(
                         self.mesh, block_apply, block_cast, x,
                         self.n_micro, v_virtual=self.v, head_fn=head_fn,
-                        head_args=(tuple(other_cast), tuple(batch)))
+                        head_args=(tuple(other_cast), tuple(batch)),
+                        stage_aux=moe)
+                    if moe:
+                        loss_v, aux = loss_v
+                        return (loss_v + aux).astype(jnp.float32)
                     return loss_v.astype(jnp.float32)
                 x = pipeline_apply(self.mesh, block_apply, block_cast, x,
                                    self.n_micro, v_virtual=self.v,
-                                   sp_axis="sp" if manual_sp else None)
+                                   sp_axis="sp" if manual_sp else None,
+                                   stage_aux=moe)
+                aux = None
+                if moe:
+                    x, aux = x
                 x = Tensor(seq_constraint(x))
                 loss = model.pipeline_head(x, *batch_tensors)
+                if aux is not None:
+                    loss = loss + Tensor(aux)
         return loss._value.astype(jnp.float32)
 
     def _build(self, n_batch_args: int):

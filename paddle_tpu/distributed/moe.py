@@ -6,10 +6,15 @@ incubate.distributed.models.moe built on manual alltoall ops). Designed
 TPU-first per the GShard/Switch pattern:
 
   - experts' FFN params are stacked [E, ...] and sharded over mesh axis
-    'ep' (PartitionSpec("ep", ...)); token dispatch/combine are einsums
-    against a [tokens, E, capacity] one-hot — GSPMD lowers the
-    expert-sharded einsum pair to the all-to-all exchange the reference
-    era would have hand-written with NCCL alltoall,
+    'ep' (PartitionSpec("ep", ...)); token dispatch/combine are a sorted
+    scatter/gather pair — tokens are argsorted by routed expert, assigned
+    capacity slots by position within their expert's segment, scattered
+    into the [E, capacity, H] expert buffer and gathered back weighted by
+    their gate. O(T·K·log + E·C·H) work and memory; no [T, E, C] one-hot
+    ever materializes (the dense-dispatch design is ruinous at real
+    expert counts). GSPMD lowers the expert-sharded scatter/gather to the
+    data exchange the reference era would have hand-written with NCCL
+    alltoall,
   - top-1 (Switch) or top-2 (GShard) routing with a capacity factor;
     overflow tokens fall through the residual (standard Switch behavior),
   - the Switch load-balance auxiliary loss (E * Σ_e fraction_e · prob_e)
@@ -17,9 +22,10 @@ TPU-first per the GShard/Switch pattern:
 
 Composes with dp/tp/ep through the strategy compiler
 (compile_train_step picks up the P("ep", ...) param_shardings and the
-model.loss aux term). Pipeline composition is NOT yet supported — the
-per-block aux loss can't cross the pipeline region's (h -> h) block
-contract; HybridPipelineTrainer rejects MoE models explicitly.
+model.loss aux term) AND with pipeline parallelism: blocks return
+``(h, aux)`` and ``pipeline_apply(stage_aux=True)`` carries the
+load-balance scalar across the schedule (fill/drain ticks masked,
+psum over 'pp', per-microbatch mean) — see distributed/hybrid.py.
 """
 from __future__ import annotations
 
@@ -51,36 +57,52 @@ def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
     logits = jnp.dot(x, gate_w.astype(x.dtype))            # [T, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
-    combine = jnp.zeros((t, e, cap), jnp.float32)
+    # -- routing: top_k rounds over [T, E] (never [T, E, C]) --------------
+    expert_rounds, gate_rounds = [], []
     remaining = probs
     aux_fraction = jnp.zeros((e,), jnp.float32)
-    taken = jnp.zeros((e,), jnp.float32)   # slots used across rounds
     for _ in range(top_k):
         idx = jnp.argmax(remaining, axis=-1)               # [T]
         onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
-        # position within the expert's capacity, offset by the slots
-        # earlier routing rounds already consumed (otherwise a round-1
-        # and a round-2 token on the same expert collide on slot 0)
-        pos = (jnp.cumsum(onehot, axis=0) - onehot
-               + taken[None, :]) * onehot                   # [T, E]
-        keep = (pos < cap).astype(jnp.float32) * onehot
-        taken = taken + jnp.sum(keep, axis=0)
-        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
-                                dtype=jnp.float32)          # [T, E, C]
-        gate_p = jnp.sum(remaining * onehot, axis=-1, keepdims=True)
-        combine = combine + keep[..., None] * pos_oh * gate_p[..., None]
+        expert_rounds.append(idx.astype(jnp.int32))
+        gate_rounds.append(jnp.sum(remaining * onehot, axis=-1))
         aux_fraction = aux_fraction + jnp.mean(onehot, axis=0)
         remaining = remaining * (1.0 - onehot)
 
-    dispatch = (combine > 0).astype(x.dtype)               # [T, E, C]
+    # -- dispatch: sort (token, round) pairs by expert --------------------
+    # round-major flattening + stable sort = earlier routing rounds get
+    # earlier capacity slots, tokens in order within a round (so a round-1
+    # and a round-2 token on the same expert never collide on a slot)
+    expert_flat = jnp.concatenate(expert_rounds)           # [K*T]
+    gate_flat = jnp.concatenate(gate_rounds)               # [K*T] f32
+    token_flat = jnp.tile(jnp.arange(t, dtype=jnp.int32), top_k)
 
-    xe = jnp.einsum("tec,th->ech", dispatch, x)            # [E, C, H]
+    order = jnp.argsort(expert_flat, stable=True)
+    e_sorted = expert_flat[order]
+    tok_sorted = token_flat[order]
+    gate_sorted = gate_flat[order]
+    # slot within the expert = position within its sorted segment
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(e_sorted), e_sorted, num_segments=e,
+        indices_are_sorted=True)                           # [E]
+    seg_start = jnp.cumsum(counts) - counts                # exclusive
+    pos = jnp.arange(top_k * t, dtype=jnp.int32) - seg_start[e_sorted]
+    keep = pos < cap
+    # overflow entries target row E*cap, dropped by scatter mode="drop"
+    slot = jnp.where(keep, e_sorted * cap + pos, e * cap)
+
+    xe = jnp.zeros((e * cap, h), x.dtype).at[slot].set(
+        x[tok_sorted], mode="drop").reshape(e, cap, h)
     hmid = jax.nn.gelu(
         jnp.einsum("ech,ehf->ecf", xe, w_in.astype(x.dtype))
         + b_in.astype(x.dtype)[:, None, :])
-    ye = jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype)) \
-        + b_out.astype(x.dtype)[:, None, :]                # [E, C, H]
-    y = jnp.einsum("tec,ech->th", combine.astype(x.dtype), ye)
+    ye = (jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype))
+          + b_out.astype(x.dtype)[:, None, :]).reshape(e * cap, h)
+
+    # -- combine: gather each entry's expert output, weight by its gate ---
+    w = (gate_sorted * keep).astype(x.dtype)[:, None]
+    contrib = ye[jnp.minimum(slot, e * cap - 1)] * w
+    y = jnp.zeros((t, h), x.dtype).at[tok_sorted].add(contrib)
 
     # Switch aux loss: E * sum_e fraction_e * mean-prob_e
     aux = e * jnp.sum((aux_fraction / top_k)

@@ -55,7 +55,7 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                    x, n_micro: int, pp_axis: str = "pp",
                    sp_axis: str = None, v_virtual: int = 1,
                    head_fn: Optional[Callable] = None,
-                   head_args: tuple = ()):
+                   head_args: tuple = (), stage_aux: bool = False):
     """Run x [batch, ...] through the pipelined stacked blocks.
 
     stage_fn(params_one_chunk, x_mb) -> y_mb applies one (virtual) stage's
@@ -66,6 +66,13 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     head_fn(full_output) -> scalar: optional loss head computed inside the
     region (see module docstring); returns the scalar instead of the
     activations.
+
+    stage_aux: when True, stage_fn returns ``(y_mb, aux_scalar)`` — a
+    per-microbatch auxiliary scalar (e.g. the MoE load-balance loss of the
+    stage's blocks). Aux values are accumulated over the ticks where the
+    stage holds REAL data (fill/drain garbage ticks masked out), psum'd
+    over 'pp' so every stage's layers contribute, and averaged over
+    microbatches. pipeline_apply then returns ``(out, aux)``.
 
     sp_axis: when set (sequence parallelism composed with pipeline), the
     shard_map is manual over BOTH axes — x's seq dim (dim 1) stays sharded
@@ -90,8 +97,12 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                 (-1,) + tuple(a.shape[3:])), stacked_params)
         mbs = _to_microbatches(x, n_micro)
         out = jax.lax.map(lambda mb: stage_fn(sliced, mb), mbs)
+        if stage_aux:
+            out, auxs = out
+            aux = jnp.sum(auxs.astype(jnp.float32)) / n_micro
         full = _from_microbatches(out, x.shape)
-        return head_fn(full, *head_args) if head_fn is not None else full
+        res = head_fn(full, *head_args) if head_fn is not None else full
+        return (res, aux) if stage_aux else res
 
     compute_dtype = x.dtype
     # XLA:CPU's AllReducePromotion pass crashes on bf16 all-reduce; the
@@ -115,6 +126,8 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     # xs is [n_micro, mb, seq, ...]: seq (dim 2) sharded over sp when set
     x_spec = P() if sp_axis is None else P(None, None, sp_axis)
     out_spec = P() if head_fn is not None else x_spec
+    if stage_aux:
+        out_spec = (out_spec, P())
     # head params/batch enter as explicit inputs (replicated over the
     # manual axes; their dp/tp shardings ride the auto axes) — closures
     # over outer-traced sharded values are rejected inside shard_map
@@ -142,7 +155,7 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
         ret0 = jnp.zeros(xs.shape, carry_dtype)
 
         def tick(carry, t):
-            prev_out, ret, outputs = carry
+            prev_out, ret, outputs, aux_acc = carry
             # stage i receives stage i-1's last output (ring; stage 0's
             # recv feeds the circuit-return buffer)
             recv = jax.lax.ppermute(
@@ -178,8 +191,15 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                         a, c_s, 0, keepdims=False), local)
             else:
                 chunk = local
-            out = stage_fn(chunk, inp.astype(compute_dtype)) \
-                .astype(carry_dtype)
+            out = stage_fn(chunk, inp.astype(compute_dtype))
+            if stage_aux:
+                out, aux = out
+                # fill/drain ticks run on garbage zeros — mask their aux.
+                # stage s holds real data from tick s to s + v*n_micro - 1.
+                busy = (t >= stage) & (t < stage + v * n_micro)
+                aux_acc = aux_acc + jnp.where(
+                    busy, aux.astype(jnp.float32), 0.0)
+            out = out.astype(carry_dtype)
             # the last stage finishing the LAST circuit produces output
             done_t = t - (pp - 1) - (v - 1) * n_micro
             out_idx = jnp.clip(done_t % n_micro if v > 1 else done_t,
@@ -189,10 +209,17 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                                                keepdims=False)
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs, jnp.where(valid, out, cur), out_idx, 0)
-            return (out, ret, outputs), None
+            return (out, ret, outputs, aux_acc), None
 
-        (last, _, outputs), _ = jax.lax.scan(
-            tick, (state0, ret0, outputs0), jnp.arange(n_ticks))
+        (last, _, outputs, aux_acc), _ = jax.lax.scan(
+            tick, (state0, ret0, outputs0, jnp.zeros((), jnp.float32)),
+            jnp.arange(n_ticks))
+        # every stage's layers contribute their own aux; per-microbatch mean
+        aux_total = jax.lax.psum(aux_acc, pp_axis) / n_micro \
+            if stage_aux else None
+        if sp_axis is not None and stage_aux:
+            # local routing groups per sp shard: average their aux
+            aux_total = jax.lax.pmean(aux_total, sp_axis)
         if head_fn is not None:
             # loss head on every stage in lockstep; only the last stage's
             # value is real — egress is ONE scalar, not the activations
@@ -200,13 +227,15 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                                    + tuple(outputs.shape[2:]))
             loss = head_fn(full.astype(compute_dtype), *head_args)
             loss = jnp.where(stage == pp - 1, loss, 0.0)
-            return jax.lax.psum(loss.astype(jnp.float32), pp_axis)
+            loss = jax.lax.psum(loss.astype(jnp.float32), pp_axis)
+            return (loss, aux_total) if stage_aux else loss
         # only the last stage's buffer is the real output; share it
         mask = (stage == pp - 1).astype(outputs.dtype)
         masked = outputs * mask
         if boundary_f32:
-            return jax.lax.psum(masked.astype(jnp.float32), pp_axis)
-        return jax.lax.psum(masked, pp_axis)
+            masked = masked.astype(jnp.float32)
+        shared = jax.lax.psum(masked, pp_axis)
+        return (shared, aux_total) if stage_aux else shared
 
     mbs = _to_microbatches(x, n_micro)
     if boundary_f32:
@@ -214,9 +243,12 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     if param_f32:
         stacked_params = jax.tree_util.tree_map(_pf, stacked_params)
     out = pipelined(stacked_params, mbs, head_args)
-    if head_fn is not None:
-        return out
-    return _from_microbatches(out, x.shape).astype(compute_dtype)
+    aux = None
+    if stage_aux:
+        out, aux = out
+    if head_fn is None:
+        out = _from_microbatches(out, x.shape).astype(compute_dtype)
+    return (out, aux) if stage_aux else out
 
 
 def _to_microbatches(x, n_micro):

@@ -23,6 +23,7 @@ from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # no
                    square_error_cost, triplet_margin_loss)
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
                    local_response_norm, normalize, rms_norm)
+from .vision import affine_grid, grid_sample  # noqa: F401
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
                       adaptive_avg_pool3d, adaptive_max_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, avg_pool1d,

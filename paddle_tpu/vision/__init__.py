@@ -1,5 +1,5 @@
 """paddle.vision equivalent."""
-from . import datasets, models, transforms  # noqa: F401
+from . import datasets, models, ops, transforms  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401
 
 

@@ -1,0 +1,304 @@
+"""Detection / region ops: roi_align, psroi_pool, prior_box, yolo_box.
+
+TPU-native equivalents of the reference detection op family
+(reference: paddle/fluid/operators/roi_align_op.cc,
+operators/detection/prior_box_op.cc, operators/detection/yolo_box_op.cc,
+python API fluid/layers/nn.py:6964 roi_align, fluid/layers/detection.py:1134
+yolo_box, :1764 prior_box). All ops are vectorized gathers/elementwise over
+static shapes — jittable, and roi_align/psroi_pool differentiable w.r.t. the
+feature map via jax AD (the reference hand-writes the scatter-add backward).
+
+Deviation (documented): the reference's ``sampling_ratio=-1`` picks a
+per-RoI adaptive sample count (ceil(roi_size/pooled_size)) — a data-
+dependent shape that cannot live under jit. Here ``sampling_ratio<=0``
+falls back to a fixed 2x2 sampling grid per bin (the detectron default).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..tensor._helper import apply, unwrap, wrap
+
+__all__ = ["roi_align", "psroi_pool", "prior_box", "yolo_box"]
+
+
+def _bilinear_gather(xf, b, gy, gx, h, w):
+    """Sample xf [N, C, H*W] at continuous (gy, gx) with batch index b.
+    Reference bilinear_interpolate semantics (roi_align_op.h): samples
+    outside [-1, size] are zero; in-range coords are clamped to
+    [0, size-1] (the high corner collapses with weight 0 at the far
+    edge). b broadcasts against gy/gx; returns [..., C]."""
+    valid = ((gy >= -1.0) & (gy <= h) & (gx >= -1.0) & (gx <= w))
+    gy = jnp.clip(gy, 0.0, h - 1.0)
+    gx = jnp.clip(gx, 0.0, w - 1.0)
+    y0 = jnp.floor(gy)
+    x0 = jnp.floor(gx)
+    wy = gy - y0
+    wx = gx - x0
+    y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+
+    def at(iy, ix):
+        idx = jnp.minimum(iy, h - 1) * w + jnp.minimum(ix, w - 1)
+        return xf[b, :, idx]                      # [..., C]
+
+    out = (at(y0i, x0i) * ((1 - wx) * (1 - wy))[..., None]
+           + at(y0i, x0i + 1) * (wx * (1 - wy))[..., None]
+           + at(y0i + 1, x0i) * ((1 - wx) * wy)[..., None]
+           + at(y0i + 1, x0i + 1) * (wx * wy)[..., None])
+    return out * valid[..., None].astype(xf.dtype)
+
+
+def _roi_batch_index(rois_num, num_rois):
+    """rois_num [N] (rois per image) -> batch index per roi [R]."""
+    reps = np.asarray(rois_num).astype(np.int64).reshape(-1)
+    return jnp.asarray(np.repeat(np.arange(len(reps)), reps)
+                       .astype(np.int32))
+
+
+def _sample_coords(rois, pooled_h, pooled_w, spatial_scale, ratio,
+                   aligned=False):
+    """Per-bin sampling point coordinates [R, PH, PW, s, s] (y and x)."""
+    x1 = rois[:, 0] * spatial_scale
+    y1 = rois[:, 1] * spatial_scale
+    x2 = rois[:, 2] * spatial_scale
+    y2 = rois[:, 3] * spatial_scale
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        # legacy semantics clamp degenerate rois to 1px; aligned mode
+        # (detectron2) keeps the true size
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    bin_w = roi_w / pooled_w
+    bin_h = roi_h / pooled_h
+    s = ratio
+    ph = jnp.arange(pooled_h, dtype=rois.dtype)
+    pw = jnp.arange(pooled_w, dtype=rois.dtype)
+    iy = jnp.arange(s, dtype=rois.dtype)
+    # y = y1 + ph*bin_h + (iy+0.5)*bin_h/s  (reference roi_align_op.h)
+    gy = (y1[:, None, None] + ph[None, :, None] * bin_h[:, None, None]
+          + (iy[None, None, :] + 0.5) * bin_h[:, None, None] / s)
+    gx = (x1[:, None, None] + pw[None, :, None] * bin_w[:, None, None]
+          + (iy[None, None, :] + 0.5) * bin_w[:, None, None] / s)
+    # [R, PH, 1, s, 1] and [R, 1, PW, 1, s]
+    return gy[:, :, None, :, None], gx[:, None, :, None, :]
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=False, name=None,
+              # legacy fluid aliases (fluid/layers/nn.py:6964)
+              pooled_height=None, pooled_width=None, rois_num=None):
+    """RoI Align (Mask R-CNN): average of bilinear samples per output bin.
+
+    x [N, C, H, W]; boxes [R, 4] as [x1, y1, x2, y2]; boxes_num [N] rois
+    per image. Returns [R, C, ph, pw]. ``aligned=True`` shifts sampling by
+    -0.5 (the detectron2 convention; reference gained it post-2.0)."""
+    if pooled_height is not None or pooled_width is not None:
+        ph, pw = int(pooled_height or 1), int(pooled_width or 1)
+    elif isinstance(output_size, (tuple, list)):
+        ph, pw = int(output_size[0]), int(output_size[1])
+    else:
+        ph = pw = int(output_size)
+    if rois_num is not None and boxes_num is None:
+        boxes_num = rois_num
+    ratio = int(sampling_ratio) if int(sampling_ratio) > 0 else 2
+
+    boxes_v = unwrap(boxes)
+    num_rois = int(boxes_v.shape[0])
+    if boxes_num is None:
+        b_idx = jnp.zeros((num_rois,), jnp.int32)
+    else:
+        bn = boxes_num.numpy() if hasattr(boxes_num, "numpy") else boxes_num
+        b_idx = _roi_batch_index(bn, num_rois)
+
+    def f(xv, rois):
+        n, c, h, w = xv.shape
+        rois = rois.astype(jnp.float32)
+        if aligned:
+            rois = rois - 0.5 / spatial_scale
+        gy, gx = _sample_coords(rois, ph, pw, spatial_scale, ratio,
+                                aligned=aligned)
+        gy = jnp.broadcast_to(gy, (num_rois, ph, pw, ratio, ratio))
+        gx = jnp.broadcast_to(gx, (num_rois, ph, pw, ratio, ratio))
+        xf = xv.reshape(n, c, h * w)
+        b = b_idx[:, None, None, None, None]
+        vals = _bilinear_gather(xf, jnp.broadcast_to(b, gy.shape),
+                                gy, gx, h, w)
+        pooled = jnp.mean(vals, axis=(3, 4))          # [R, PH, PW, C]
+        return jnp.transpose(pooled, (0, 3, 1, 2))
+
+    return apply(f, x, boxes, name="roi_align")
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN): output channel (c, i, j)
+    average-pools input channel c*ph*pw + i*pw + j over bin (i, j)
+    (reference: operators/detection/... psroi_pool_op.cc)."""
+    if isinstance(output_size, (tuple, list)):
+        ph, pw = int(output_size[0]), int(output_size[1])
+    else:
+        ph = pw = int(output_size)
+    boxes_v = unwrap(boxes)
+    num_rois = int(boxes_v.shape[0])
+    if boxes_num is None:
+        b_idx = jnp.zeros((num_rois,), jnp.int32)
+    else:
+        bn = boxes_num.numpy() if hasattr(boxes_num, "numpy") else boxes_num
+        b_idx = _roi_batch_index(bn, num_rois)
+    ratio = 2   # fixed sampling grid per bin (see module docstring)
+
+    def f(xv, rois):
+        n, c, h, w = xv.shape
+        out_c = c // (ph * pw)
+        rois = rois.astype(jnp.float32)
+        gy, gx = _sample_coords(rois, ph, pw, spatial_scale, ratio)
+        gy = jnp.broadcast_to(gy, (num_rois, ph, pw, ratio, ratio))
+        gx = jnp.broadcast_to(gx, (num_rois, ph, pw, ratio, ratio))
+        xf = xv.reshape(n, c, h * w)
+        b = b_idx[:, None, None, None, None]
+        vals = _bilinear_gather(xf, jnp.broadcast_to(b, gy.shape),
+                                gy, gx, h, w)          # [R,PH,PW,s,s,C]
+        pooled = jnp.mean(vals, axis=(3, 4))           # [R, PH, PW, C]
+        # position-sensitive channel select: out[r, k, i, j] uses input
+        # channel k*ph*pw + i*pw + j
+        pooled = jnp.transpose(pooled, (0, 3, 1, 2))   # [R, C, PH, PW]
+        pooled = pooled.reshape(num_rois, out_c, ph, pw, ph, pw)
+        ii = jnp.arange(ph)
+        jj = jnp.arange(pw)
+        return pooled[:, :, ii[:, None], jj[None, :], ii[:, None],
+                      jj[None, :]]
+
+    return apply(f, x, boxes, name="psroi_pool")
+
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """Reference ExpandAspectRatios (prior_box_op.h): start from [1.0],
+    append each new ratio (and its flip)."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - e) < 1e-6 for e in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior (anchor) boxes for one feature map.
+
+    input [N, C, H, W] (feature map), image [N, C, imgH, imgW]. Returns
+    (boxes [H, W, P, 4] in normalized [x1, y1, x2, y2], variances same
+    shape). Reference: operators/detection/prior_box_op.{cc,h},
+    fluid/layers/detection.py:1764."""
+    feat = unwrap(input)
+    img = unwrap(image)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] \
+        if max_sizes else []
+    ars = _expand_aspect_ratios(list(np.atleast_1d(aspect_ratios)), flip)
+    step_w = float(steps[0]) if steps and steps[0] > 0 else iw / fw
+    step_h = float(steps[1]) if steps and steps[1] > 0 else ih / fh
+
+    boxes = []     # per-position list of [4]
+    for k, ms in enumerate(min_sizes):
+        prio = []
+        for ar in ars:
+            bw = ms * math.sqrt(ar) / 2.0
+            bh = ms / math.sqrt(ar) / 2.0
+            prio.append((bw, bh))
+        sq = []
+        if max_sizes:
+            s = math.sqrt(ms * max_sizes[k])
+            sq.append((s / 2.0, s / 2.0))
+        if min_max_aspect_ratios_order:
+            # min box first, then the sqrt(min*max) box, then ratios
+            order = [prio[0]] + sq + prio[1:]
+        else:
+            order = prio + sq
+        boxes.extend(order)
+    p = len(boxes)
+
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    gcx, gcy = np.meshgrid(cx, cy)                     # [H, W]
+    half = np.asarray(boxes, np.float32)               # [P, 2]
+    out = np.empty((fh, fw, p, 4), np.float32)
+    out[..., 0] = (gcx[:, :, None] - half[None, None, :, 0]) / iw
+    out[..., 1] = (gcy[:, :, None] - half[None, None, :, 1]) / ih
+    out[..., 2] = (gcx[:, :, None] + half[None, None, :, 0]) / iw
+    out[..., 3] = (gcy[:, :, None] + half[None, None, :, 1]) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return wrap(jnp.asarray(out)), wrap(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0, name=None):
+    """Decode YOLOv3 head output into boxes + scores.
+
+    x [N, A*(5+classes), H, W]; img_size [N, 2] as (h, w). Returns
+    (boxes [N, H*W*A, 4] in x1y1x2y2 image coords,
+     scores [N, H*W*A, class_num]). Boxes whose objectness is below
+    conf_thresh are zeroed. Reference: operators/detection/yolo_box_op.h,
+    fluid/layers/detection.py:1134."""
+    anchors = [int(a) for a in anchors]
+    na = len(anchors) // 2
+    cn = int(class_num)
+
+    def f(xv, imgs):
+        n, _, h, w = xv.shape
+        dt = jnp.float32
+        xv = xv.astype(dt)
+        v = xv.reshape(n, na, 5 + cn, h, w)
+        tx, ty, tw, th = v[:, :, 0], v[:, :, 1], v[:, :, 2], v[:, :, 3]
+        obj = 1.0 / (1.0 + jnp.exp(-v[:, :, 4]))
+        cls = 1.0 / (1.0 + jnp.exp(-v[:, :, 5:]))      # [N, A, cn, H, W]
+
+        gx = jnp.arange(w, dtype=dt)[None, None, None, :]
+        gy = jnp.arange(h, dtype=dt)[None, None, :, None]
+        sx = 1.0 / (1.0 + jnp.exp(-tx)) * scale_x_y - 0.5 * (scale_x_y - 1)
+        sy = 1.0 / (1.0 + jnp.exp(-ty)) * scale_x_y - 0.5 * (scale_x_y - 1)
+        img_h = imgs[:, 0].astype(dt)[:, None, None, None]
+        img_w = imgs[:, 1].astype(dt)[:, None, None, None]
+        # anchor sizes are in input-image pixels; input size = grid *
+        # downsample
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        aw = jnp.asarray(anchors[0::2], dt)[None, :, None, None]
+        ah = jnp.asarray(anchors[1::2], dt)[None, :, None, None]
+        cx = (sx + gx) / w * img_w                     # center, image px
+        cy = (sy + gy) / h * img_h
+        bw = jnp.exp(tw) * aw / in_w * img_w
+        bh = jnp.exp(th) * ah / in_h * img_h
+        x1 = cx - bw / 2.0
+        y1 = cy - bh / 2.0
+        x2 = cx + bw / 2.0
+        y2 = cy + bh / 2.0
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1)
+            y1 = jnp.clip(y1, 0.0, img_h - 1)
+            x2 = jnp.clip(x2, 0.0, img_w - 1)
+            y2 = jnp.clip(y2, 0.0, img_h - 1)
+        keep = (obj >= conf_thresh).astype(dt)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) * keep[..., None]
+        scores = cls * (obj * keep)[:, :, None]
+        # reference layout is anchor-major: box row a*H*W + i*W + j
+        # (yolo_box_op.h box_idx = j*stride + k*w + l, stride = H*W)
+        boxes = boxes.reshape(n, -1, 4)                # [N, A, H, W, 4]
+        scores = jnp.transpose(scores, (0, 1, 3, 4, 2)).reshape(n, -1, cn)
+        return boxes, scores
+
+    return apply(f, x, img_size, name="yolo_box")

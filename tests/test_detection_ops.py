@@ -1,0 +1,383 @@
+"""Detection / spatial op family: grid_sample, affine_grid, roi_align,
+psroi_pool, prior_box, yolo_box.
+
+NumPy-golden forward + finite-difference gradients, per the reference
+OpTest contract (reference: unittests/test_grid_sampler_op.py,
+test_roi_align_op.py, test_prior_box_op.py, test_yolo_box_op.py style).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+from tests.op_test import check_output, numeric_grad
+
+
+# --------------------------- numpy goldens ------------------------------
+def np_affine_grid(theta, size, align_corners=True):
+    n, _, h, w = size
+    if align_corners:
+        xs = np.linspace(-1, 1, w) if w > 1 else np.zeros(1)
+        ys = np.linspace(-1, 1, h) if h > 1 else np.zeros(1)
+    else:
+        xs = (2 * np.arange(w) + 1) / w - 1
+        ys = (2 * np.arange(h) + 1) / h - 1
+    out = np.empty((n, h, w, 2), np.float64)
+    for b in range(n):
+        for i in range(h):
+            for j in range(w):
+                v = np.array([xs[j], ys[i], 1.0])
+                out[b, i, j] = theta[b] @ v
+    return out
+
+
+def np_grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                   align_corners=True):
+    n, c, h, w = x.shape
+    _, hg, wg, _ = grid.shape
+    out = np.zeros((n, c, hg, wg), np.float64)
+
+    def unnorm(v, size):
+        return (v + 1) / 2 * (size - 1) if align_corners \
+            else ((v + 1) * size - 1) / 2
+
+    def reflect(v, lo, span):
+        if span <= 0:
+            return 0.0
+        d = abs(v - lo) % (2 * span)
+        return lo + (span - abs(d - span))
+
+    def fetch(b, ch, iy, ix):
+        if 0 <= iy < h and 0 <= ix < w:
+            return x[b, ch, iy, ix]
+        return 0.0
+
+    for b in range(n):
+        for i in range(hg):
+            for j in range(wg):
+                gx = unnorm(grid[b, i, j, 0], w)
+                gy = unnorm(grid[b, i, j, 1], h)
+                if padding_mode == "border":
+                    gx = min(max(gx, 0), w - 1)
+                    gy = min(max(gy, 0), h - 1)
+                elif padding_mode == "reflection":
+                    if align_corners:
+                        gx = reflect(gx, 0, w - 1)
+                        gy = reflect(gy, 0, h - 1)
+                    else:
+                        gx = min(max(reflect(gx, -0.5, w), 0), w - 1)
+                        gy = min(max(reflect(gy, -0.5, h), 0), h - 1)
+                if mode == "nearest":
+                    ix = int(np.round(gx))
+                    iy = int(np.round(gy))
+                    for ch in range(c):
+                        out[b, ch, i, j] = fetch(b, ch, iy, ix)
+                    continue
+                x0, y0 = math.floor(gx), math.floor(gy)
+                wx, wy = gx - x0, gy - y0
+                for ch in range(c):
+                    out[b, ch, i, j] = (
+                        fetch(b, ch, y0, x0) * (1 - wx) * (1 - wy)
+                        + fetch(b, ch, y0, x0 + 1) * wx * (1 - wy)
+                        + fetch(b, ch, y0 + 1, x0) * (1 - wx) * wy
+                        + fetch(b, ch, y0 + 1, x0 + 1) * wx * wy)
+    return out
+
+
+def np_roi_align(x, rois, b_idx, ph, pw, scale, ratio, aligned=False):
+    r = rois.shape[0]
+    n, c, h, w = x.shape
+    out = np.zeros((r, c, ph, pw), np.float64)
+
+    def bilinear(b, ch, gy, gx):
+        # reference bilinear_interpolate: zero outside [-1, size], clamp
+        # in-range coords to [0, size-1] (far-edge corner gets weight 0)
+        if gy < -1 or gy > h or gx < -1 or gx > w:
+            return 0.0
+        gy = min(max(gy, 0.0), h - 1.0)
+        gx = min(max(gx, 0.0), w - 1.0)
+        y0, x0 = math.floor(gy), math.floor(gx)
+        wy, wx = gy - y0, gx - x0
+        tot = 0.0
+        for dy, fy in ((0, 1 - wy), (1, wy)):
+            for dx, fx in ((0, 1 - wx), (1, wx)):
+                iy, ix = min(y0 + dy, h - 1), min(x0 + dx, w - 1)
+                tot += x[b, ch, iy, ix] * fy * fx
+        return tot
+
+    for ri in range(r):
+        off = 0.5 / scale if aligned else 0.0
+        x1, y1, x2, y2 = (rois[ri] - off) * scale
+        rw, rh = x2 - x1, y2 - y1
+        if not aligned:       # reference clamps only in legacy mode
+            rw, rh = max(rw, 1.0), max(rh, 1.0)
+        bw, bh = rw / pw, rh / ph
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(c)
+                for sy in range(ratio):
+                    for sx in range(ratio):
+                        gy = y1 + i * bh + (sy + 0.5) * bh / ratio
+                        gx = x1 + j * bw + (sx + 0.5) * bw / ratio
+                        for ch in range(c):
+                            acc[ch] += bilinear(int(b_idx[ri]), ch, gy, gx)
+                out[ri, :, i, j] = acc / (ratio * ratio)
+    return out
+
+
+# ------------------------------- tests ----------------------------------
+class TestAffineGrid:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_numpy(self, align):
+        theta = np.random.RandomState(0).randn(2, 2, 3).astype(np.float32)
+        got = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5],
+                            align_corners=align).numpy()
+        want = np_affine_grid(theta, (2, 3, 4, 5), align)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_grad(self):
+        theta = np.random.RandomState(1).randn(1, 2, 3).astype(np.float32)
+        g = numeric_grad(
+            lambda t: F.affine_grid(t, [1, 1, 3, 3]), {"theta": theta},
+            "theta")
+        t = paddle.to_tensor(theta)
+        t.stop_gradient = False
+        F.affine_grid(t, [1, 1, 3, 3]).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), g, rtol=1e-3, atol=5e-4)
+
+
+class TestGridSample:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_numpy(self, mode, pad, align):
+        r = np.random.RandomState(2)
+        x = r.randn(2, 3, 5, 6).astype(np.float32)
+        grid = (r.rand(2, 4, 4, 2).astype(np.float32) * 2.4 - 1.2)
+        got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                            mode=mode, padding_mode=pad,
+                            align_corners=align).numpy()
+        want = np_grid_sample(x, grid, mode, pad, align)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_grad_wrt_input_and_grid(self):
+        r = np.random.RandomState(3)
+        x = r.randn(1, 2, 4, 4).astype(np.float32)
+        # keep sample points away from integer lattice (grad of floor
+        # boundaries is undefined there, like the reference test)
+        grid = (r.rand(1, 3, 3, 2).astype(np.float32) * 1.4 - 0.7) + 0.013
+        for wrt in ("x", "grid"):
+            g = numeric_grad(lambda a, b: F.grid_sample(a, b),
+                             {"x": x, "grid": grid}, wrt)
+            tx, tg = paddle.to_tensor(x), paddle.to_tensor(grid)
+            tx.stop_gradient = tg.stop_gradient = False
+            F.grid_sample(tx, tg).sum().backward()
+            got = (tx if wrt == "x" else tg).grad.numpy()
+            np.testing.assert_allclose(got, g, rtol=2e-3, atol=1e-3)
+
+    def test_affine_grid_sample_composition_identity(self):
+        """Identity theta + grid_sample reproduces the input."""
+        x = np.random.RandomState(4).randn(1, 2, 6, 6).astype(np.float32)
+        theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+        grid = F.affine_grid(paddle.to_tensor(theta), [1, 2, 6, 6])
+        y = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(y.numpy(), x, rtol=1e-4, atol=1e-5)
+
+
+class TestRoiAlign:
+    def test_matches_numpy(self):
+        r = np.random.RandomState(5)
+        x = r.randn(2, 3, 8, 8).astype(np.float32)
+        rois = np.array([[0, 0, 6, 6], [1, 1, 5, 7], [2, 0, 7, 5]],
+                        np.float32)
+        bn = np.array([2, 1], np.int32)
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                          boxes_num=bn, output_size=2, spatial_scale=0.5,
+                          sampling_ratio=2).numpy()
+        want = np_roi_align(x, rois, [0, 0, 1], 2, 2, 0.5, 2)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_aligned_offset(self):
+        r = np.random.RandomState(6)
+        x = r.randn(1, 1, 8, 8).astype(np.float32)
+        rois = np.array([[1, 1, 6, 6]], np.float32)
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                          boxes_num=np.array([1]), output_size=2,
+                          spatial_scale=1.0, sampling_ratio=2,
+                          aligned=True).numpy()
+        want = np_roi_align(x, rois, [0], 2, 2, 1.0, 2, aligned=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_aligned_subpixel_roi_keeps_true_size(self):
+        """aligned=True must not clamp a sub-pixel roi to 1px (detectron2
+        semantics; the reference clamps only in legacy mode)."""
+        r = np.random.RandomState(11)
+        x = r.randn(1, 1, 8, 8).astype(np.float32)
+        rois = np.array([[4.0, 4.0, 4.4, 4.4]], np.float32)
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(rois),
+                          boxes_num=np.array([1]), output_size=2,
+                          spatial_scale=1.0, sampling_ratio=2,
+                          aligned=True).numpy()
+        want = np_roi_align(x, rois, [0], 2, 2, 1.0, 2, aligned=True)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+        # sanity: samples stay inside the 0.4px box around (4, 4)
+        legacy = np_roi_align(x, rois, [0], 2, 2, 1.0, 2, aligned=False)
+        assert not np.allclose(got, legacy)
+
+    def test_grad_wrt_features(self):
+        r = np.random.RandomState(7)
+        x = r.randn(1, 2, 6, 6).astype(np.float32)
+        rois = np.array([[0.3, 0.7, 4.2, 5.1]], np.float32)
+        g = numeric_grad(
+            lambda a: V.roi_align(a, paddle.to_tensor(rois),
+                                  boxes_num=np.array([1]), output_size=2,
+                                  spatial_scale=1.0, sampling_ratio=2),
+            {"x": x}, "x")
+        t = paddle.to_tensor(x)
+        t.stop_gradient = False
+        V.roi_align(t, paddle.to_tensor(rois), boxes_num=np.array([1]),
+                    output_size=2, spatial_scale=1.0,
+                    sampling_ratio=2).sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(), g, rtol=2e-3, atol=1e-3)
+
+    def test_fluid_alias_signature(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        rois = paddle.to_tensor(np.array([[0, 0, 3, 3]], np.float32))
+        out = V.roi_align(x, rois, pooled_height=2, pooled_width=2,
+                          rois_num=np.array([1]))
+        assert tuple(out.shape) == (1, 1, 2, 2)
+        np.testing.assert_allclose(out.numpy(), np.ones((1, 1, 2, 2)),
+                                   rtol=1e-5)
+
+
+class TestPsroiPool:
+    def test_position_sensitive_channels(self):
+        """Each output bin (i, j) pools its own channel group."""
+        ph = pw = 2
+        out_c = 3
+        c = out_c * ph * pw
+        x = np.zeros((1, c, 4, 4), np.float32)
+        # channel k has constant value k
+        for k in range(c):
+            x[0, k] = k
+        rois = np.array([[0, 0, 4, 4]], np.float32)
+        got = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                           boxes_num=np.array([1]), output_size=2).numpy()
+        for oc in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    expect = oc * ph * pw + i * pw + j
+                    np.testing.assert_allclose(got[0, oc, i, j], expect,
+                                               rtol=1e-5)
+
+
+class TestPriorBox:
+    def test_basic_geometry(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 100, 100), np.float32))
+        boxes, var = V.prior_box(feat, img, min_sizes=[20.0],
+                                 max_sizes=[40.0],
+                                 aspect_ratios=[2.0], flip=True)
+        # priors: ar 1.0, 2.0, 0.5, sqrt(min*max) = 4
+        assert tuple(boxes.shape) == (2, 2, 4, 4)
+        b = boxes.numpy()
+        # position (0,0): center (25, 25); min box is 20x20 normalized /100
+        np.testing.assert_allclose(b[0, 0, 0], [0.15, 0.15, 0.35, 0.35],
+                                   rtol=1e-5)
+        # ar=2 box: w = 20*sqrt(2), h = 20/sqrt(2)
+        w2 = 20 * math.sqrt(2) / 2 / 100
+        h2 = 20 / math.sqrt(2) / 2 / 100
+        np.testing.assert_allclose(b[0, 0, 1],
+                                   [0.25 - w2, 0.25 - h2, 0.25 + w2,
+                                    0.25 + h2], rtol=1e-5)
+        # sqrt(min*max) square box
+        s = math.sqrt(20 * 40) / 2 / 100
+        np.testing.assert_allclose(b[0, 0, 3],
+                                   [0.25 - s, 0.25 - s, 0.25 + s, 0.25 + s],
+                                   rtol=1e-5)
+        v = var.numpy()
+        np.testing.assert_allclose(v[1, 1, 2], [0.1, 0.1, 0.2, 0.2],
+                                   rtol=1e-6)
+
+    def test_clip_and_order(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 1, 1), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 10, 10), np.float32))
+        boxes, _ = V.prior_box(feat, img, min_sizes=[20.0], clip=True)
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0
+
+
+class TestYoloBox:
+    def test_decode_matches_numpy(self):
+        r = np.random.RandomState(8)
+        n, na, cn, h, w = 1, 2, 3, 2, 2
+        anchors = [10, 14, 23, 27]
+        down = 32
+        x = r.randn(n, na * (5 + cn), h, w).astype(np.float32) * 0.5
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = V.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), anchors, cn,
+            conf_thresh=0.0, downsample_ratio=down, clip_bbox=False)
+        bs, sc = boxes.numpy(), scores.numpy()
+        assert bs.shape == (n, h * w * na, 4)
+        assert sc.shape == (n, h * w * na, cn)
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        v = x.reshape(n, na, 5 + cn, h, w)
+        # check a specific cell/anchor
+        for a in range(na):
+            for i in range(h):
+                for j in range(w):
+                    cx = (sig(v[0, a, 0, i, j]) + j) / w * 64
+                    cy = (sig(v[0, a, 1, i, j]) + i) / h * 64
+                    bw = np.exp(v[0, a, 2, i, j]) * anchors[2 * a] / \
+                        (w * down) * 64
+                    bh = np.exp(v[0, a, 3, i, j]) * anchors[2 * a + 1] / \
+                        (h * down) * 64
+                    k = a * h * w + i * w + j   # anchor-major layout
+                    np.testing.assert_allclose(
+                        bs[0, k],
+                        [cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                         cy + bh / 2], rtol=1e-4, atol=1e-4)
+                    np.testing.assert_allclose(
+                        sc[0, k], sig(v[0, a, 5:, i, j])
+                        * sig(v[0, a, 4, i, j]), rtol=1e-4, atol=1e-5)
+
+    def test_conf_thresh_zeroes_boxes(self):
+        x = np.full((1, 2 * 6, 2, 2), -10.0, np.float32)  # obj ~ 0
+        img = np.array([[64, 64]], np.int32)
+        boxes, scores = V.yolo_box(
+            paddle.to_tensor(x), paddle.to_tensor(img), [10, 14, 23, 27],
+            1, conf_thresh=0.5, downsample_ratio=32)
+        assert float(np.abs(boxes.numpy()).max()) == 0.0
+        assert float(np.abs(scores.numpy()).max()) == 0.0
+
+
+class TestSSDHeadComposition:
+    def test_ssd_style_head_composes(self):
+        """prior_box + a conv head + roi_align compose into an SSD-ish
+        detection forward (smoke: shapes + finite grads)."""
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        feat_img = paddle.to_tensor(
+            np.random.RandomState(9).randn(1, 3, 16, 16).astype(np.float32))
+        feat = conv(feat_img)
+        boxes, var = V.prior_box(feat, feat_img, min_sizes=[4.0],
+                                 aspect_ratios=[2.0], flip=True, clip=True)
+        assert boxes.shape[0] == 16 and boxes.shape[2] == 3
+        rois = paddle.to_tensor(
+            np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32))
+        pooled = V.roi_align(feat, rois, boxes_num=np.array([2]),
+                             output_size=4, spatial_scale=1.0,
+                             sampling_ratio=2)
+        loss = pooled.sum()
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert np.isfinite(float(loss.numpy()))

@@ -179,6 +179,67 @@ class TestGPTMoE:
         assert losses[-1] < losses[0]
         assert all(np.isfinite(losses))
 
+    def test_moe_gpt_trains_through_pipeline_dp_ep_pp(self):
+        """MoE composes with pipeline parallelism: blocks return (h, aux)
+        and pipeline_apply carries the load-balance scalar across the
+        schedule (stage_aux), masked over fill/drain ticks."""
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+        from paddle_tpu.distributed.strategy_compiler import \
+            build_mesh_from_strategy
+        from paddle_tpu.models import GPT, GPTConfig
+
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                        num_heads=2, max_seq_len=32, moe_num_experts=4,
+                        moe_capacity_factor=8.0)
+        net = GPT(cfg)
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "pp_degree": 2, "ep_degree": 2}
+        s.pipeline = True
+        s.pipeline_configs = {"accumulate_steps": 2}
+        mesh = build_mesh_from_strategy(s)
+        assert dict(mesh.shape)["pp"] == 2 and dict(mesh.shape)["ep"] == 2
+        opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+        tr = HybridPipelineTrainer(net, opt, s, mesh)
+        toks = np.random.RandomState(12).randint(
+            0, 128, (8, 32)).astype(np.int32)
+        losses = [float(tr.step(toks)) for _ in range(5)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_moe_pipeline_aux_matches_nonpipeline(self):
+        """The pipelined aux accounting (masked ticks, psum over pp,
+        /n_micro) must equal the plain per-block sum on the same batch."""
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+        from paddle_tpu.distributed.strategy_compiler import \
+            build_mesh_from_strategy
+        from paddle_tpu.models import GPT, GPTConfig
+
+        paddle.seed(13)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=4,
+                        num_heads=2, max_seq_len=16, moe_num_experts=2,
+                        moe_capacity_factor=16.0)
+        net = GPT(cfg)
+        toks_np = np.random.RandomState(14).randint(
+            0, 64, (4, 16)).astype(np.int32)
+        # eager reference loss (CE + weighted aux), full batch
+        ref = float(net.loss(paddle.to_tensor(toks_np)).numpy())
+
+        s = DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 1, "pp_degree": 2, "ep_degree": 1}
+        s.pipeline = True
+        s.pipeline_configs = {"accumulate_steps": 2}
+        mesh = build_mesh_from_strategy(s, jax.devices()[:2])
+        opt = paddle.optimizer.SGD(0.0, parameters=net.parameters())
+        tr = HybridPipelineTrainer(net, opt, s, mesh)
+        first = float(tr.step(toks_np))
+        # fused-CE head + microbatched routing give slightly different
+        # capacity truncation than the monolithic eager pass; the aux
+        # bookkeeping itself must agree to ~1e-2 relative
+        assert abs(first - ref) / abs(ref) < 2e-2, (first, ref)
+
     def test_moe_gpt_eager_loss_includes_aux(self):
         from paddle_tpu.models import GPT, GPTConfig
 
