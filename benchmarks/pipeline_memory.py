@@ -1,0 +1,90 @@
+"""Pipeline activation-memory measurement (VERDICT r2 item 3).
+
+Question: does the whole-schedule-AD pipeline (distributed/pipeline.py —
+one lax.scan over ticks, differentiated end to end) retain activation
+memory that grows with n_micro (GPipe-like), or does remat bound it?
+
+Method: AOT-compile the hybrid trainer's full train step for a grid of
+(pp, n_micro, remat) on a virtual CPU mesh and read the XLA executable's
+`memory_analysis().temp_size_in_bytes` — the compiler's own peak
+temp-buffer accounting (the same quantity a real TPU HBM budget sees,
+modulo backend constants). The reference's comparable number is the
+per-microbatch scope pool in SectionWorker (section_worker.cc:34, one
+scope per microbatch held until backward — memory strictly ∝ n_micro).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+     PALLAS_AXON_POOL_IPS= python benchmarks/pipeline_memory.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def measure(pp: int, n_micro: int, remat: bool, batch: int = 16,
+            seq: int = 128, hidden: int = 128, layers: int = 8):
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import rng as rng_mod
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+    from paddle_tpu.distributed.strategy_compiler import \
+        build_mesh_from_strategy
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=hidden, num_layers=layers,
+                    num_heads=4, max_seq_len=seq)
+    net = GPT(cfg)
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": pp}
+    s.pipeline = pp > 1
+    s.recompute = remat
+    mesh = build_mesh_from_strategy(s, jax.devices()[:pp])
+    opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+    tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=n_micro)
+    tr._build(1)
+    tokens = jnp.asarray(np.random.RandomState(0).randint(
+        0, 512, (batch, seq)).astype(np.int32))
+    lowered = tr._step_fn.lower(
+        tr.block_vals, tr.other_vals, tr.block_opt, tr.other_opt,
+        (tokens,), jnp.asarray(1e-3, jnp.float32),
+        jnp.asarray(1, jnp.int32), rng_mod.next_key())
+    ma = lowered.compile().memory_analysis()
+    return {"pp": pp, "n_micro": n_micro, "remat": remat,
+            "temp_mb": round(ma.temp_size_in_bytes / 2**20, 1),
+            "arg_mb": round(ma.argument_size_in_bytes / 2**20, 1)}
+
+
+def main():
+    rows = []
+    for remat in (False, True):
+        for pp, micros in ((2, (2, 4, 8, 16)), (4, (4, 8, 16))):
+            for nm in micros:
+                r = measure(pp, nm, remat)
+                rows.append(r)
+                print(json.dumps(r), flush=True)
+    # growth verdict: fit temp ~ a + b*n_micro per (pp, remat) series
+    print("\n-- growth per extra microbatch (MB) --")
+    for remat in (False, True):
+        for pp in (2, 4):
+            series = [(r["n_micro"], r["temp_mb"]) for r in rows
+                      if r["pp"] == pp and r["remat"] == remat]
+            if len(series) >= 2:
+                xs, ys = zip(*series)
+                b = np.polyfit(xs, ys, 1)[0]
+                print(json.dumps({"pp": pp, "remat": remat,
+                                  "mb_per_microbatch": round(float(b), 2),
+                                  "series": series}))
+
+
+if __name__ == "__main__":
+    main()

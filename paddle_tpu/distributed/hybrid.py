@@ -270,14 +270,14 @@ class HybridPipelineTrainer:
         batch_tensors = [Tensor(b) for b in batch]
         # loss-inside-pipeline: the head runs in the manual region and only
         # a SCALAR crosses 'pp' (vs the full activation buffer). Disabled
-        # under manual sp (head must see the sp-sharded output), under
-        # CPU+amp (bf16 cotangent psum trips XLA:CPU), and under tp>1
-        # (GSPMD-auto tp collectives for the vocab-sharded head inside the
-        # manual region abort the XLA:CPU backend; legacy egress is
-        # correct everywhere, just costlier).
-        head_inside = not manual_sp and self.pp > 1 and \
-            self.mesh.shape.get("tp", 1) == 1 and not (
-                jax.default_backend() == "cpu" and self.amp)
+        # under manual sp (head must see the sp-sharded output) and under
+        # CPU+amp (bf16 cotangent psum trips XLA:CPU). tp>1 is supported:
+        # the vocab-sharded head's tp collectives ride GSPMD-auto inside
+        # the manual-pp region like the blocks' do.
+        import os
+        head_inside = not manual_sp and self.pp > 1 and not (
+            jax.default_backend() == "cpu" and self.amp) and \
+            os.environ.get("PADDLE_TPU_HEAD_INSIDE", "1") != "0"
         with _swapped_state(other_tensors, other_cast), \
                 dctx.sequence_parallel_scope(self.mesh):
             with rng_mod.key_scope(key):

@@ -12,6 +12,7 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import weakref
 from typing import Iterable, List, Optional
 
 import numpy as np
@@ -248,44 +249,158 @@ def default_collate_fn(batch):
     return batch
 
 
+class _PoolState:
+    """Shared state of a DataLoader worker pool. Lives OUTSIDE the
+    iterator so worker threads never hold a strong reference to it —
+    otherwise an abandoned iterator could never be garbage-collected
+    (threads are GC roots) and its pool would leak forever."""
+
+    END = object()
+
+    def __init__(self, nw, prefetch):
+        self.nw = nw
+        self.stop = threading.Event()
+        self.cond = threading.Condition()
+        self.results = {}
+        self.dispatched = 0
+        self.dispatch_done = False
+        self.inflight = threading.Semaphore(prefetch * nw)
+        self.work_q = queue.Queue()
+
+    def publish(self, seq, item):
+        with self.cond:
+            self.results[seq] = item
+            self.cond.notify_all()
+
+    def finish_dispatch(self, count):
+        with self.cond:
+            self.dispatched = count
+            self.dispatch_done = True
+            self.cond.notify_all()
+        for _ in range(self.nw):
+            self.work_q.put((None, self.END))
+
+    def shutdown(self):
+        """Idempotent: unblock the dispatcher (parked in acquire) and the
+        workers (parked in get) so every pool thread exits."""
+        if self.stop.is_set():
+            return
+        self.stop.set()
+        for _ in range(self.nw + 1):
+            self.inflight.release()
+        for _ in range(self.nw):
+            self.work_q.put((None, self.END))
+        with self.cond:
+            self.cond.notify_all()
+
+
+def _pool_dispatch(state, index_iter):
+    seq = 0
+    try:
+        for indices in index_iter:
+            state.inflight.acquire()
+            if state.stop.is_set():
+                break
+            state.work_q.put((seq, indices))
+            seq += 1
+    finally:
+        state.finish_dispatch(seq)
+
+
+def _pool_map_worker(state, dataset, collate_fn):
+    while not state.stop.is_set():
+        seq, indices = state.work_q.get()
+        if indices is state.END:
+            break
+        try:
+            state.publish(seq, collate_fn([dataset[i] for i in indices]))
+        except BaseException as e:       # re-raised in the consumer
+            state.publish(seq, e)
+
+
+def _pool_iterable_worker(state, dataset, collate_fn, batch_size,
+                          drop_last):
+    seq = 0
+    try:
+        it = iter(dataset)
+        while not state.stop.is_set():
+            batch = list(itertools.islice(it, batch_size))
+            if not batch or (drop_last and len(batch) < batch_size):
+                break
+            state.inflight.acquire()
+            if state.stop.is_set():
+                break
+            state.publish(seq, collate_fn(batch))
+            seq += 1
+    except BaseException as e:
+        state.publish(seq, e)
+        seq += 1
+    finally:
+        state.finish_dispatch(seq)
+
+
 class _DataLoaderIter:
+    """num_workers > 0: a POOL of num_workers loader threads (the
+    reference runs N worker processes, fluid/reader.py:91; threads here —
+    numpy/host IO releases the GIL, and jax arrays are not fork-safe).
+    Batches are delivered IN ORDER via per-batch sequence numbers and a
+    reorder buffer, with at most prefetch_factor×workers in flight.
+    Iterable datasets use a single worker (one stream; the reference
+    shards an IterableDataset per worker process, which thread-sharing a
+    Python iterator cannot reproduce safely). Threads reference only the
+    _PoolState; a weakref.finalize shuts the pool down when the iterator
+    is dropped (early break / exception) so no thread ever leaks."""
+
     def __init__(self, loader):
         self.loader = loader
         self._index_iter = iter(loader.batch_sampler) \
             if not loader._iterable_mode else None
+        self._state = None
+        self._next_seq = 0
         if loader.num_workers > 0:
-            self._queue = queue.Queue(maxsize=max(2, loader.prefetch_factor))
-            self._stop = threading.Event()
-            self._thread = threading.Thread(target=self._worker, daemon=True)
-            self._thread.start()
+            nw = 1 if loader._iterable_mode else loader.num_workers
+            st = _PoolState(nw, max(2, loader.prefetch_factor))
+            self._state = st
+            self._finalizer = weakref.finalize(self, _PoolState.shutdown,
+                                               st)
+            if loader._iterable_mode:
+                threads = [threading.Thread(
+                    target=_pool_iterable_worker,
+                    args=(st, loader.dataset, loader.collate_fn,
+                          loader.batch_size, loader.drop_last),
+                    daemon=True)]
+            else:
+                threads = [threading.Thread(
+                    target=_pool_map_worker,
+                    args=(st, loader.dataset, loader.collate_fn),
+                    daemon=True) for _ in range(nw)]
+                threads.append(threading.Thread(
+                    target=_pool_dispatch, args=(st, self._index_iter),
+                    daemon=True))
+            for t in threads:
+                t.start()
 
     def _load_batch(self, indices):
         samples = [self.loader.dataset[i] for i in indices]
         return self.loader.collate_fn(samples)
 
-    def _worker(self):
-        try:
-            if self.loader._iterable_mode:
-                it = iter(self.loader.dataset)
-                while not self._stop.is_set():
-                    batch = list(itertools.islice(it, self.loader.batch_size))
-                    if not batch or (self.loader.drop_last and
-                                     len(batch) < self.loader.batch_size):
-                        break
-                    self._queue.put(self.loader.collate_fn(batch))
-            else:
-                for indices in self._index_iter:
-                    if self._stop.is_set():
-                        break
-                    self._queue.put(self._load_batch(indices))
-        finally:
-            self._queue.put(StopIteration)
-
     def __next__(self):
-        if self.loader.num_workers > 0:
-            item = self._queue.get()
-            if item is StopIteration:
-                raise StopIteration
+        st = self._state
+        if st is not None:
+            with st.cond:
+                while True:
+                    if self._next_seq in st.results:
+                        item = st.results.pop(self._next_seq)
+                        self._next_seq += 1
+                        break
+                    if st.dispatch_done and \
+                            self._next_seq >= st.dispatched:
+                        raise StopIteration
+                    st.cond.wait()
+            st.inflight.release()
+            if isinstance(item, BaseException):
+                st.shutdown()
+                raise item
             return item
         if self.loader._iterable_mode:
             if not hasattr(self, "_raw_iter"):
@@ -297,6 +412,10 @@ class _DataLoaderIter:
                 raise StopIteration
             return self.loader.collate_fn(batch)
         return self._load_batch(next(self._index_iter))
+
+    def close(self):
+        if self._state is not None:
+            self._state.shutdown()
 
     def __iter__(self):
         return self

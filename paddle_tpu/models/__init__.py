@@ -4,4 +4,5 @@ from .bert import (Bert, BertBlock, BertConfig, BertForPretraining,  # noqa: F40
                    bert_tiny)
 from .ernie import (ErnieConfig, ErnieForPretraining,  # noqa: F401
                     ernie_tiny)
-from .gpt import GPT, GPTBlock, GPTConfig, gpt_tiny  # noqa: F401
+from .gpt import (GPT, GPTBlock, GPTConfig, GPTForGeneration,  # noqa: F401
+                  gpt_cached_apply, gpt_tiny)

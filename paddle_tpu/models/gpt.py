@@ -18,6 +18,9 @@ import math
 from dataclasses import dataclass, field
 
 import numpy as np
+
+import jax
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
@@ -267,6 +270,97 @@ class GPT(nn.Layer):
             x, self.lm_head.weight, tokens, chunk=chunk, transpose_w=True,
             next_token=True)
 
+    # --- decoding (ops/decoding.py loops over the KV-cached forward) -----
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 decode_strategy: str = "greedy_search", top_k: int = 0,
+                 top_p: float = 1.0, temperature: float = 1.0,
+                 num_beams: int = 4, length_penalty: float = 0.0,
+                 eos_token_id=None, seed: int = 0):
+        """Autoregressive generation with a preallocated KV cache, as one
+        jitted program (prefill + lax.scan decode loop).
+
+        decode_strategy: 'greedy_search' | 'sampling' | 'beam_search'
+        (the paddlenlp generate() surface; the reference era only has
+        host-side beam_search ops, beam_search_op.cc). Returns
+        (ids [B, max_new_tokens], scores [B]).
+        """
+        import numpy as _np
+
+        from ..framework.tensor import Tensor as _T
+        from ..ops import decoding as D
+
+        ids_v = input_ids._value if isinstance(input_ids, _T) else \
+            jnp.asarray(input_ids)   # accepts np arrays AND jax tracers
+        b, t0 = ids_v.shape
+        smax = t0 + max_new_tokens
+        if smax > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt {t0} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_seq_len {self.config.max_seq_len}")
+        if decode_strategy not in ("greedy_search", "sampling",
+                                   "beam_search"):
+            raise ValueError(f"unknown decode_strategy {decode_strategy!r}")
+        stacked, other = self._decode_state()
+        cfg = self.config
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        L = cfg.num_layers
+        dt = other["embeddings.wte.weight"].dtype
+
+        # jit cache: retracing the whole prefill+scan program per call
+        # would cost seconds per generate() in a serving loop
+        jkey = (b, t0, max_new_tokens, decode_strategy, top_k, top_p,
+                temperature, num_beams, length_penalty, eos_token_id,
+                str(dt))
+        jit_cache = self.__dict__.setdefault("_gen_jit", {})
+        run = jit_cache.get(jkey)
+        if run is None:
+            def run_fn(stacked, other, tokens, rng):
+                n = tokens.shape[0]
+                ck = jnp.zeros((n, L, smax, nh, hd), dt)
+                cv = jnp.zeros((n, L, smax, nh, hd), dt)
+                logits, ck, cv = gpt_cached_apply(
+                    cfg, stacked, other, ck, cv, tokens, 0)
+
+                def step(cache, tok, pos):
+                    ck, cv = cache
+                    lg, ck, cv = gpt_cached_apply(
+                        cfg, stacked, other, ck, cv, tok[:, None], pos)
+                    return lg, (ck, cv)
+
+                if decode_strategy == "beam_search":
+                    cache = D.tile_cache_for_beams((ck, cv), num_beams)
+                    return D.beam_search_decode(
+                        step, cache, logits, t0, max_new_tokens,
+                        num_beams, length_penalty=length_penalty,
+                        eos_token_id=eos_token_id)
+                if decode_strategy == "sampling":
+                    ids, _ = D.sampling_decode(
+                        step, (ck, cv), logits, t0, max_new_tokens, rng,
+                        top_k=top_k, top_p=top_p, temperature=temperature,
+                        eos_token_id=eos_token_id)
+                else:
+                    ids, _ = D.greedy_decode(
+                        step, (ck, cv), logits, t0, max_new_tokens,
+                        eos_token_id=eos_token_id)
+                return ids, jnp.zeros((n,), jnp.float32)
+
+            run = jax.jit(run_fn)
+            jit_cache[jkey] = run
+
+        ids, scores = run(stacked, other, ids_v, jax.random.PRNGKey(seed))
+        return _T(ids), _T(scores)
+
+    def _decode_state(self):
+        """Cached (stacked, other) decode params; rebuilt only when the
+        underlying param values changed (training step replaces them)."""
+        token = id(self.embeddings.wte.weight._value)
+        cached = self.__dict__.get("_gen_state")
+        if cached is not None and cached[0] == token:
+            return cached[1], cached[2]
+        stacked, other = _gpt_decode_state(self)
+        self.__dict__["_gen_state"] = (token, stacked, other)
+        return stacked, other
+
     def loss(self, tokens, labels=None):
         """Next-token LM loss (+ MoE load-balance aux when configured).
         labels default: tokens shifted left."""
@@ -283,6 +377,113 @@ class GPT(nn.Layer):
             for blk in self.blocks:
                 loss = loss + self.config.moe_aux_weight * blk.mlp.aux_loss
         return loss
+
+
+def _ln(x, w, b, eps):
+    m = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - m), axis=-1, keepdims=True)
+    return (x - m) / jnp.sqrt(var + eps) * w + b
+
+
+def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0):
+    """Pure-jax KV-cached forward for decoding (reference has no KV cache
+    or generate() at all — its decoding is host-side beam_search ops,
+    beam_search_op.cc; here decode is one compiled program).
+
+    stacked: {block_suffix: [L, ...]} block params; other: {name: val};
+    ck/cv: [N, L, S_max, NH, D] caches; tokens [N, T] processed at
+    positions pos0..pos0+T. Returns (last-token logits [N, V], ck, cv).
+
+    Parity with GPT.forward is pinned by
+    tests/test_generation.py::test_cached_prefill_matches_forward.
+    """
+    n, t = tokens.shape
+    h = cfg.hidden_size
+    nh = cfg.num_heads
+    hd = h // nh
+    eps = cfg.layer_norm_eps
+    wte = other["embeddings.wte.weight"]
+    wpe = other["embeddings.wpe.weight"]
+    pos = pos0 + jnp.arange(t)
+    x = wte[tokens] + wpe[pos][None]
+    smax = ck.shape[2]
+    key_pos = jnp.arange(smax)
+    # causal-with-cache mask: query i sees cache positions <= pos0 + i
+    mask = key_pos[None, None, None, :] <= \
+        (pos0 + jnp.arange(t))[None, None, :, None]
+
+    ckl = jnp.swapaxes(ck, 0, 1)            # [L, N, S, NH, D]
+    cvl = jnp.swapaxes(cv, 0, 1)
+
+    def block(xc, inp):
+        p, k_c, v_c = inp
+        hn = _ln(xc, p["ln_1.weight"], p["ln_1.bias"], eps)
+        qkv = hn @ p["attn.qkv_proj.weight"] + p["attn.qkv_proj.bias"]
+        qkv = qkv.reshape(n, t, 3, nh, hd)
+        q, kk, vv = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_c = jax.lax.dynamic_update_slice(k_c, kk, (0, pos0, 0, 0))
+        v_c = jax.lax.dynamic_update_slice(v_c, vv, (0, pos0, 0, 0))
+        att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
+        att = jnp.where(mask, att, -1e9)
+        w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(xc.dtype)
+        o = jnp.einsum("bnts,bsnd->btnd", w, v_c).reshape(n, t, h)
+        xc = xc + o @ p["attn.out_proj.weight"] + p["attn.out_proj.bias"]
+        h2 = _ln(xc, p["ln_2.weight"], p["ln_2.bias"], eps)
+        mid = jax.nn.gelu(h2 @ p["mlp.fc_in.weight"] + p["mlp.fc_in.bias"],
+                          approximate=True)
+        xc = xc + mid @ p["mlp.fc_out.weight"] + p["mlp.fc_out.bias"]
+        return xc, (k_c, v_c)
+
+    x, (ckl, cvl) = jax.lax.scan(block, x, (stacked, ckl, cvl))
+    x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
+    last = x[:, -1]
+    if "lm_head.weight" in other:
+        logits = last @ other["lm_head.weight"]
+    else:
+        logits = last @ wte.T
+    return logits, jnp.swapaxes(ckl, 0, 1), jnp.swapaxes(cvl, 0, 1)
+
+
+def _gpt_decode_state(model: "GPT"):
+    """(stacked {sfx: [L, ...]}, other {name: val}) jnp dicts from the
+    eager model, for gpt_cached_apply."""
+    from ..static.functional import state_tensors
+
+    if model.config.moe_num_experts:
+        raise NotImplementedError(
+            "generate() supports dense GPT; MoE decode needs expert "
+            "routing in the cached path")
+    blocks = list(model.blocks)
+    sfx, t0 = state_tensors(blocks[0])[:2]
+    per_block = [state_tensors(b)[1] for b in blocks]   # one walk per block
+    stacked = {s: jnp.stack([pb[j]._value for pb in per_block], 0)
+               for j, s in enumerate(sfx)}
+    pn, pt, _, _ = state_tensors(model)
+    block_ids = {id(x) for pb in per_block for x in pb}
+    other = {n: p._value for n, p in zip(pn, pt) if id(p) not in block_ids}
+    return stacked, other
+
+
+class GPTForGeneration(nn.Layer):
+    """Export wrapper: forward(tokens) runs the full generate loop, so
+    ``paddle_tpu.jit.save`` serializes prefill + KV-cached decode as ONE
+    jax.export artifact runnable in a fresh process (the reference's
+    save_inference_model + beam-search-ops analogue, done compiler-side)."""
+
+    def __init__(self, gpt: GPT, max_new_tokens: int = 16,
+                 decode_strategy: str = "greedy_search", **gen_kw):
+        super().__init__()
+        self.gpt = gpt
+        self.max_new_tokens = max_new_tokens
+        self.decode_strategy = decode_strategy
+        self.gen_kw = gen_kw
+
+    def forward(self, tokens):
+        ids, _ = self.gpt.generate(tokens,
+                                   max_new_tokens=self.max_new_tokens,
+                                   decode_strategy=self.decode_strategy,
+                                   **self.gen_kw)
+        return ids
 
 
 def gpt_tiny(**kw):

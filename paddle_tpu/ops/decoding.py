@@ -1,0 +1,192 @@
+"""Autoregressive decoding loops: greedy, top-k/top-p sampling, beam search.
+
+TPU-native replacement for the reference decoding stack
+(reference: paddle/fluid/operators/beam_search_op.cc,
+beam_search_decode_op.cc, math/beam_search.cc and the python
+fluid/layers/rnn.py BeamSearchDecoder). The reference grows LoD tensors
+per step on the host; here the whole decode is ONE compiled program:
+
+  - static shapes everywhere — the KV cache is preallocated [S_max] and
+    written with dynamic_update_slice; the token loop is a lax.scan over
+    max_new_tokens ticks,
+  - beam reordering is a batched gather over the flattened [batch*beam]
+    cache leaves (the reference's per-step parent_idx host round-trip),
+  - everything is jittable and exportable (jax.export) so a saved
+    artifact can generate in a fresh process with no Python model class.
+
+The step contract, shared by all strategies:
+    step_fn(cache, tokens [N], pos) -> (logits [N, V], new_cache)
+cache is any pytree whose leaves lead with the batch(*beam) dim.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["greedy_decode", "sampling_decode", "beam_search_decode",
+           "apply_top_k_top_p"]
+
+NEG_INF = -1e9
+
+
+def _force_eos(logprobs, finished, eos_token_id):
+    """Finished rows: only EOS is allowed, at logprob 0 (score frozen)."""
+    if eos_token_id is None:
+        return logprobs
+    v = logprobs.shape[-1]
+    eos_row = jnp.full((v,), NEG_INF, logprobs.dtype).at[eos_token_id].set(0.0)
+    return jnp.where(finished[..., None], eos_row[None, :], logprobs)
+
+
+def greedy_decode(step_fn: Callable, cache: Any, first_logits, start_pos,
+                  max_new_tokens: int, eos_token_id: Optional[int] = None):
+    """Argmax decoding seeded from the prefill's last-token logits
+    ``first_logits`` [N, V] (the same seeding contract as
+    beam_search_decode). Each tick t picks the token for position
+    start_pos + t from the current logits, then advances the cache.
+    Returns (ids [N, max_new_tokens], cache)."""
+    n = first_logits.shape[0]
+    tdt = jnp.int32
+
+    def tick(carry, t):
+        cache, logits, fin = carry
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        lp = _force_eos(lp, fin, eos_token_id)
+        tok = jnp.argmax(lp, axis=-1).astype(tdt)
+        if eos_token_id is not None:
+            fin = fin | (tok == eos_token_id)
+        logits, cache = step_fn(cache, tok, start_pos + t)
+        return (cache, logits, fin), tok
+
+    (cache, _, _), ids = jax.lax.scan(
+        tick, (cache, first_logits, jnp.zeros((n,), bool)),
+        jnp.arange(max_new_tokens))
+    return jnp.swapaxes(ids, 0, 1), cache
+
+
+def apply_top_k_top_p(logits, top_k: int = 0, top_p: float = 1.0):
+    """Mask logits outside the top-k / nucleus top-p set (paddlenlp-style
+    filtering; the reference era exposes sampling via fluid.layers
+    sampling_id over user-filtered logits)."""
+    v = logits.shape[-1]
+    if top_k and top_k < v:
+        kth = jnp.sort(logits, axis=-1)[..., v - top_k]
+        logits = jnp.where(logits < kth[..., None], NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p
+        keep_sorted = cum - probs < top_p
+        kth = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf), axis=-1)
+        logits = jnp.where(logits < kth[..., None], NEG_INF, logits)
+    return logits
+
+
+def sampling_decode(step_fn: Callable, cache: Any, first_logits, start_pos,
+                    max_new_tokens: int, key, top_k: int = 0,
+                    top_p: float = 1.0, temperature: float = 1.0,
+                    eos_token_id: Optional[int] = None):
+    """Temperature + top-k/top-p sampling, seeded from the prefill's
+    last-token logits (same contract as greedy/beam — the first token's
+    filtering shares this tick, not a caller-side copy).
+    Returns (ids, cache)."""
+    n = first_logits.shape[0]
+
+    def tick(carry, t):
+        cache, logits, fin, key = carry
+        logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+        logits = apply_top_k_top_p(logits, top_k, top_p)
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        lp = _force_eos(lp, fin, eos_token_id)
+        key, sub = jax.random.split(key)
+        tok = jax.random.categorical(sub, lp, axis=-1).astype(jnp.int32)
+        if eos_token_id is not None:
+            fin = fin | (tok == eos_token_id)
+        logits, cache = step_fn(cache, tok, start_pos + t)
+        return (cache, logits, fin, key), tok
+
+    (cache, _, _, _), ids = jax.lax.scan(
+        tick, (cache, first_logits, jnp.zeros((n,), bool), key),
+        jnp.arange(max_new_tokens))
+    return jnp.swapaxes(ids, 0, 1), cache
+
+
+def beam_search_decode(step_fn: Callable, cache: Any, first_logits,
+                       start_pos, max_new_tokens: int, num_beams: int,
+                       length_penalty: float = 0.0,
+                       eos_token_id: Optional[int] = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Beam search (reference: beam_search_op.cc step semantics — top-k
+    over beam*vocab accumulated logprobs with parent reordering).
+
+    cache leaves must ALREADY be tiled to [B*K, ...] (tile_cache_for_beams)
+    and warmed by a prefill pass whose last-token logits are
+    ``first_logits`` [B, V] (from the original batch; beam 0 seeds the
+    search). step_fn operates on the flattened [B*K] batch.
+
+    Returns (ids [B, max_new_tokens] — best beam, scores [B]).
+    """
+    b, v = first_logits.shape
+    k = num_beams
+
+    lp0 = jax.nn.log_softmax(first_logits.astype(jnp.float32), axis=-1)
+    # seed: first expansion picks top-k tokens of beam 0
+    scores0, tok0 = jax.lax.top_k(lp0, k)                  # [B, K]
+    finished0 = jnp.zeros((b, k), bool) if eos_token_id is None else \
+        (tok0 == eos_token_id)
+    ids0 = jnp.zeros((b, k, max_new_tokens), jnp.int32)
+    ids0 = ids0.at[:, :, 0].set(tok0)
+
+    def tick(carry, t):
+        cache, scores, ids, cur, fin = carry
+        # the token fed at tick t was decoded at step t-1 and occupies
+        # sequence position start_pos + t - 1 (same slotting as greedy —
+        # regression: +t wrote KV one slot late, leaving an unmasked
+        # zero-KV row at start_pos that every later step attended to)
+        logits, cache = step_fn(cache, cur.reshape(b * k),
+                                start_pos + t - 1)
+        lp = jax.nn.log_softmax(
+            logits.astype(jnp.float32), axis=-1).reshape(b, k, v)
+        lp = _force_eos(lp, fin, eos_token_id)
+        total = scores[:, :, None] + lp                    # [B, K, V]
+        flat = total.reshape(b, k * v)
+        new_scores, flat_idx = jax.lax.top_k(flat, k)      # [B, K]
+        parent = flat_idx // v                             # [B, K]
+        token = (flat_idx % v).astype(jnp.int32)
+        # reorder histories + finished by parent beam
+        ids = jnp.take_along_axis(ids, parent[:, :, None], axis=1)
+        fin = jnp.take_along_axis(fin, parent, axis=1)
+        ids = ids.at[:, :, t].set(token)
+        if eos_token_id is not None:
+            fin = fin | (token == eos_token_id)
+        # reorder cache: leaf [B*K, ...] gathered at b*K + parent
+        gidx = (jnp.arange(b)[:, None] * k + parent).reshape(b * k)
+        cache = jax.tree_util.tree_map(lambda a: a[gidx], cache)
+        return (cache, new_scores, ids, token, fin), None
+
+    (cache, scores, ids, _, fin), _ = jax.lax.scan(
+        tick, (cache, scores0, ids0, tok0, finished0),
+        jnp.arange(1, max_new_tokens))
+
+    if length_penalty:
+        if eos_token_id is None:
+            lengths = jnp.full(scores.shape, max_new_tokens, jnp.float32)
+        else:
+            lengths = jnp.sum((ids != eos_token_id).astype(jnp.float32),
+                              axis=-1) + 1.0
+        norm = scores / lengths ** length_penalty
+    else:
+        norm = scores
+    best = jnp.argmax(norm, axis=1)                        # [B]
+    out = jnp.take_along_axis(ids, best[:, None, None], axis=1)[:, 0]
+    return out, jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+
+
+def tile_cache_for_beams(cache: Any, num_beams: int):
+    """Repeat each cache leaf's batch rows num_beams times ([B, ...] ->
+    [B*K, ...], beam-major within a batch row)."""
+    return jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, num_beams, axis=0), cache)

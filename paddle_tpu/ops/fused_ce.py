@@ -63,7 +63,14 @@ def _fused_ce(x, w, labels, ignore_index, chunk, w_is_vh, bias=None):
         lse = jnp.log(jnp.sum(jnp.exp(logits - m), axis=-1)) + m[..., 0]
         mask = lc != ignore_index
         safe = jnp.clip(lc, 0, v - 1)
-        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        # gold logit via one-hot contraction, not take_along_axis: XLA
+        # fuses it to a select+reduce (no [B,cs,V] materialization), and —
+        # load-bearing — GSPMD partitions it cleanly when V is tp-sharded
+        # and the batch dp-sharded inside a manual-pp shard_map region,
+        # where the equivalent gather crashes the SPMD partitioner
+        # (spmd_partitioner_util.cc partition-group check).
+        onehot = jax.nn.one_hot(safe, v, dtype=logits.dtype)
+        gold = jnp.einsum("bsv,bsv->bs", logits, onehot)
         loss = jnp.where(mask, lse - gold, 0.0)
         acc, n = carry
         return (acc + jnp.sum(loss),

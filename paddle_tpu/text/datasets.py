@@ -25,6 +25,20 @@ __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
 
 _TOK = WhitespaceTokenizer()
 
+def _synthetic_optin(cls_name: str, synthetic_size, default: int) -> int:
+    """Synthetic data is OPT-IN (round-3 fix: a typo'd path must not
+    silently train on fake data). Without a data_file, callers must pass
+    synthetic_size=N explicitly to acknowledge the corpus is synthetic."""
+    if synthetic_size is None:
+        raise ValueError(
+            f"{cls_name}: no data_file was given and downloading is not "
+            "possible here. Pass data_file=<path to the real dataset "
+            "archive>, or explicitly opt in to a deterministic FAKE "
+            f"corpus with synthetic_size=N (e.g. {default}) for "
+            "tests/smoke runs.")
+    return int(synthetic_size)
+
+
 
 def _synthetic_docs(n, seed, vocab_size=200, lo=8, hi=60):
     """Deterministic fake corpus: class-correlated token streams."""
@@ -74,7 +88,8 @@ class Imdb(Dataset):
             self.labels = np.asarray([lbl for _, lbl in mode_docs],
                                      np.int64)
         else:
-            n = synthetic_size or (512 if mode == "train" else 128)
+            n = _synthetic_optin("Imdb", synthetic_size,
+                                 512 if mode == "train" else 128)
             self.docs, self.labels = _synthetic_docs(
                 n, 11 if mode == "train" else 12)
             self.word_idx = Vocab({f"w{i}": i for i in range(200)})
@@ -118,7 +133,7 @@ class Imikolov(Dataset):
             sents = [vocab.to_ids(["<s>"] + ln + ["<e>"])
                      for ln in lines if ln]
         else:
-            n = synthetic_size or 256
+            n = _synthetic_optin("Imikolov", synthetic_size, 256)
             docs, _ = _synthetic_docs(n, 21 if mode == "train" else 22,
                                       lo=window_size + 1, hi=40)
             self.word_idx = Vocab({f"w{i}": i for i in range(200)})
@@ -150,7 +165,7 @@ class UCIHousing(Dataset):
         if data_file:
             raw = np.fromfile(data_file, sep=" ")
         else:
-            n = synthetic_size or 506
+            n = _synthetic_optin("UCIHousing", synthetic_size, 506)
             r = np.random.RandomState(31)
             feats = r.rand(n, self.FEATURE_NUM - 1)
             target = feats @ r.rand(self.FEATURE_NUM - 1) + \
@@ -172,11 +187,15 @@ class UCIHousing(Dataset):
         return len(self.data)
 
 
+START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+
 class _ParallelCorpus(Dataset):
     """Shared WMT14/WMT16 shape: (src_ids, trg_ids[:-1], trg_ids[1:])."""
 
     def __init__(self, mode, synthetic_size, seed, bos=0, eos=1, unk=2):
-        n = synthetic_size or (256 if mode == "train" else 64)
+        n = _synthetic_optin(type(self).__name__, synthetic_size,
+                             256 if mode == "train" else 64)
         src, _ = _synthetic_docs(n, seed, lo=4, hi=30)
         trg, _ = _synthetic_docs(n, seed + 1, lo=4, hi=30)
         self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
@@ -185,6 +204,45 @@ class _ParallelCorpus(Dataset):
             self.src_ids.append(s + 3)
             self.trg_ids.append(t[:-1])
             self.trg_ids_next.append(t[1:])
+
+    def _load_pairs(self, lines, src_dict, trg_dict, src_col=0):
+        """(src\\ttrg) lines → id triples with <s>/<e>/<unk> semantics
+        (reference: wmt16.py:181-211 _load_data)."""
+        bos = src_dict[START_MARK]
+        eos = src_dict[END_MARK]
+        unk = src_dict[UNK_MARK]
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for line in lines:
+            if isinstance(line, bytes):
+                line = line.decode("utf-8", "ignore")
+            parts = line.strip().split("\t")
+            if len(parts) != 2:
+                continue
+            sw = parts[src_col].split()
+            tw = parts[1 - src_col].split()
+            src = [bos] + [src_dict.get(w, unk) for w in sw] + [eos]
+            trg = [trg_dict.get(w, unk) for w in tw]
+            self.src_ids.append(np.asarray(src, np.int64))
+            self.trg_ids.append(np.asarray([bos] + trg, np.int64))
+            self.trg_ids_next.append(np.asarray(trg + [eos], np.int64))
+
+    @staticmethod
+    def _build_dict(token_lines, size, col):
+        """Frequency dict capped at `size`, marks at ids 0/1/2
+        (reference: wmt16.py __build_dict)."""
+        from collections import Counter
+
+        freq = Counter()
+        for line in token_lines:
+            if isinstance(line, bytes):
+                line = line.decode("utf-8", "ignore")
+            parts = line.strip().split("\t")
+            if len(parts) == 2:
+                freq.update(parts[col].split())
+        d = {START_MARK: 0, END_MARK: 1, UNK_MARK: 2}
+        for w, _ in freq.most_common(max(0, size - 3)):
+            d[w] = len(d)
+        return d
 
     def __getitem__(self, idx):
         return (self.src_ids[idx], self.trg_ids[idx],
@@ -195,20 +253,55 @@ class _ParallelCorpus(Dataset):
 
 
 class WMT14(_ParallelCorpus):
-    """reference: text/datasets/wmt14.py (tokenized en-fr tarball)."""
+    """reference: text/datasets/wmt14.py — tarball with src.dict/trg.dict
+    members (word per line) + per-split files of src\\ttrg lines."""
 
     def __init__(self, data_file=None, mode="train", dict_size=30000,
                  download=True, synthetic_size=None):
-        super().__init__(mode, synthetic_size, seed=41)
+        assert mode in ("train", "test", "gen")
         self.dict_size = dict_size
+        if data_file:
+            with tarfile.open(data_file) as tf:
+                def read_dict(suffix):
+                    for m in tf.getmembers():
+                        if m.name.endswith(suffix):
+                            words = tf.extractfile(m).read().decode(
+                                "utf-8", "ignore").split("\n")
+                            return {w: i for i, w in
+                                    enumerate(words[:dict_size])}
+                    raise ValueError(f"no {suffix} member in {data_file}")
+
+                self.src_dict = read_dict("src.dict")
+                self.trg_dict = read_dict("trg.dict")
+                lines = []
+                for m in tf.getmembers():
+                    if f"{mode}/" in m.name and not m.isdir():
+                        lines += tf.extractfile(m).read().splitlines()
+            self._load_pairs(lines, self.src_dict, self.trg_dict)
+            return
+        super().__init__(mode, synthetic_size, seed=41)
 
 
 class WMT16(_ParallelCorpus):
-    """reference: text/datasets/wmt16.py (en-de multi30k tarball)."""
+    """reference: text/datasets/wmt16.py — multi30k tarball, member
+    wmt16/{mode} of src\\ttrg lines; dicts built from the train split."""
 
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en", download=True,
                  synthetic_size=None):
+        assert mode in ("train", "test", "val")
+        if data_file:
+            src_col = 0 if lang == "en" else 1
+            with tarfile.open(data_file) as tf:
+                train_lines = tf.extractfile("wmt16/train").read() \
+                    .splitlines()
+                self.src_dict = self._build_dict(train_lines,
+                                                 src_dict_size, src_col)
+                self.trg_dict = self._build_dict(train_lines,
+                                                 trg_dict_size, 1 - src_col)
+                lines = tf.extractfile(f"wmt16/{mode}").read().splitlines()
+            self._load_pairs(lines, self.src_dict, self.trg_dict, src_col)
+            return
         super().__init__(mode, synthetic_size, seed=43)
 
 
@@ -245,7 +338,7 @@ class Movielens(Dataset):
                                          float(rating)))
             self._users, self._movies = users, movies
         else:
-            n = synthetic_size or 512
+            n = _synthetic_optin("Movielens", synthetic_size, 512)
             r = np.random.RandomState(rand_seed + 5)
             rows = [(int(r.randint(1, 100)), int(r.randint(1, 200)),
                      float(r.randint(1, 6))) for _ in range(n)]
@@ -270,7 +363,7 @@ class Conll05st(Dataset):
 
     def __init__(self, data_file=None, mode="train", download=True,
                  synthetic_size: Optional[int] = None):
-        n = synthetic_size or 128
+        n = _synthetic_optin("Conll05st", synthetic_size, 128)
         r = np.random.RandomState(51)
         self.samples = []
         for _ in range(n):

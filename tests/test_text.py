@@ -68,8 +68,8 @@ class TestOthers:
         assert ds[0].ndim == 1
 
     def test_uci_housing_shapes_and_split(self):
-        tr = UCIHousing(mode="train")
-        te = UCIHousing(mode="test")
+        tr = UCIHousing(mode="train", synthetic_size=506)
+        te = UCIHousing(mode="test", synthetic_size=506)
         x, y = tr[0]
         assert x.shape == (13,) and y.shape == (1,)
         assert len(tr) > len(te) > 0
@@ -113,3 +113,72 @@ class TestVocab:
     def test_tokenizer(self):
         t = WhitespaceTokenizer()
         assert t("It's GREAT, really!") == ["it's", "great", "really"]
+
+
+class TestSyntheticOptIn:
+    def test_bare_construction_raises(self):
+        """Round-3 fix: a typo'd/missing data_file must not silently
+        train on fake data — synthetic corpora are opt-in."""
+        import pytest
+
+        for cls in (Imdb, Imikolov, UCIHousing, Movielens, Conll05st,
+                    WMT14, WMT16):
+            with pytest.raises(ValueError, match="synthetic_size"):
+                cls()
+
+
+def _wmt16_fixture(tmp_path):
+    import io
+    import tarfile as tar
+
+    lines = {
+        "train": "the cat\tdie katze\na dog\tein hund\n",
+        "val": "the dog\tder hund\n",
+        "test": "a cat\teine katze\n",
+    }
+    path = tmp_path / "wmt16.tar"
+    with tar.open(path, "w") as tf:
+        for split, text in lines.items():
+            data = text.encode()
+            info = tar.TarInfo(f"wmt16/{split}")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+class TestWMTRealFormat:
+    def test_wmt16_parses_tarball(self, tmp_path):
+        ds = WMT16(data_file=_wmt16_fixture(tmp_path), mode="train")
+        assert len(ds) == 2
+        s, t, tn = ds[0]
+        # <s> the cat <e>
+        assert s[0] == ds.src_dict["<s>"] and s[-1] == ds.src_dict["<e>"]
+        assert list(s[1:-1]) == [ds.src_dict["the"], ds.src_dict["cat"]]
+        assert list(t[1:]) == [ds.trg_dict["die"], ds.trg_dict["katze"]]
+        np.testing.assert_array_equal(t[1:], tn[:-1])
+        # val split shares the train-built dicts; unknown words -> <unk>
+        val = WMT16(data_file=_wmt16_fixture(tmp_path), mode="val")
+        sv, tv, _ = val[0]
+        assert val.trg_dict.get("der") is None  # not in train corpus
+        assert tv[1] == val.trg_dict["<unk>"]
+
+    def test_wmt14_parses_tarball(self, tmp_path):
+        import io
+        import tarfile as tar
+
+        path = tmp_path / "wmt14.tar"
+        with tar.open(path, "w") as tf:
+            def add(name, text):
+                data = text.encode()
+                info = tar.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+            add("data/src.dict", "<s>\n<e>\n<unk>\nthe\ncat")
+            add("data/trg.dict", "<s>\n<e>\n<unk>\nle\nchat")
+            add("data/train/part-00", "the cat\tle chat\n")
+        ds = WMT14(data_file=str(path), mode="train")
+        assert len(ds) == 1
+        s, t, tn = ds[0]
+        assert list(s) == [0, 3, 4, 1]
+        assert list(t) == [0, 3, 4]
+        assert list(tn) == [3, 4, 1]
