@@ -175,9 +175,17 @@ class DistributedOptimizer:
                 for p in self.inner_opt._parameter_list or []:
                     if p.grad is not None:
                         p.grad._value = p.grad._value / k
+        # LocalSGD (reference: meta_optimizers/localsgd_optimizer.py):
+        # SKIP the per-step grad sync; every k steps average the PARAMS
+        # across workers instead (one fused allreduce). adaptive variant
+        # grows k as the lr decays (k_t = round(init_k * sqrt(lr0/lr_t)),
+        # the Adaptive Communication Strategy schedule the reference's
+        # AdaptiveLocalSGDOptimizer implements).
+        localsgd = strategy is not None and (strategy.localsgd or
+                                             strategy.adaptive_localsgd)
         # data-parallel grad sync across processes (dygraph DDP semantics —
         # reference: imperative Reducer). Inside pjit this is XLA's psum.
-        if get_world_size() > 1:
+        if get_world_size() > 1 and not localsgd:
             from ..collective import all_reduce
 
             n = get_world_size()
@@ -186,6 +194,48 @@ class DistributedOptimizer:
                     all_reduce(p.grad)
                     p.grad._value = p.grad._value / n
         self.inner_opt.step()
+        if localsgd and get_world_size() > 1:
+            self._local_step = getattr(self, "_local_step", 0) + 1
+            if strategy.adaptive_localsgd:
+                cfg = strategy.adaptive_localsgd_configs
+                lr0 = getattr(self, "_localsgd_lr0", None)
+                if lr0 is None:
+                    lr0 = self._localsgd_lr0 = float(
+                        self.inner_opt.get_lr())
+                lr = max(float(self.inner_opt.get_lr()), 1e-12)
+                k = max(1, int(round(cfg.init_k_steps *
+                                     (lr0 / lr) ** 0.5)))
+                begin = cfg.begin_step
+            else:
+                cfg = strategy.localsgd_configs
+                k, begin = max(1, cfg.k_steps), cfg.begin_step
+            if self._local_step >= begin and self._local_step % k == 0:
+                self._average_parameters()
+
+    def _average_parameters(self):
+        """Fused-bucket allreduce-average of the PARAM VALUES (the
+        LocalSGD sync point; reference inserts c_allreduce on params,
+        localsgd_optimizer.py)."""
+        import jax.numpy as jnp
+
+        from ...framework.tensor import Tensor
+        from ..collective import all_reduce
+
+        params = [p for p in (self.inner_opt._parameter_list or [])
+                  if p is not None]
+        if not params:
+            return
+        n = get_world_size()
+        flats = [jnp.ravel(p._value).astype(jnp.float32) for p in params]
+        sizes = [int(f.size) for f in flats]
+        bucket = Tensor(jnp.concatenate(flats))
+        all_reduce(bucket)
+        merged = bucket._value / n
+        off = 0
+        for p, size in zip(params, sizes):
+            p._value = merged[off:off + size].reshape(
+                p._value.shape).astype(p._value.dtype)
+            off += size
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):

@@ -1,0 +1,61 @@
+"""2-rank LocalSGD worker (tests/test_launch.py): ranks train on
+DIFFERENT data with no per-step grad sync; params must diverge between
+sync points and be bitwise-identical right after each k-step averaging
+(reference: meta_optimizers/localsgd_optimizer.py semantics)."""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    out_dir = sys.argv[1]
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.fleet import (DistributedStrategy, fleet)
+
+    env = dist.init_parallel_env()
+    rank, world = env.rank, env.world_size
+    assert world == 2
+
+    paddle.seed(0)                       # same init on both ranks
+    net = paddle.nn.Linear(8, 4)
+    opt = paddle.optimizer.SGD(0.05, parameters=net.parameters())
+    s = DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 3, "begin_step": 1}
+    fleet.init(is_collective=True, strategy=s)
+    dopt = fleet.distributed_optimizer(opt, s)
+
+    rng = np.random.RandomState(100 + rank)   # DIFFERENT data per rank
+
+    def other_rank_params():
+        """Gather the peer's flattened params."""
+        import jax.numpy as jnp
+        me = jnp.concatenate([jnp.ravel(p._value)
+                              for p in net.parameters()])
+        outs = []
+        collective.all_gather(outs, paddle.to_tensor(me))
+        return np.asarray(outs[1 - rank]._value), np.asarray(me)
+
+    for step in range(1, 7):
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(4, 4).astype(np.float32))
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        dopt.step()
+        opt.clear_grad()
+        theirs, mine = other_rank_params()
+        synced = np.allclose(theirs, mine, atol=1e-6)
+        if step % 3 == 0:
+            assert synced, f"step {step}: params differ after sync point"
+        else:
+            assert not synced, f"step {step}: params equal between syncs" \
+                " (local steps are not local)"
+
+    open(os.path.join(out_dir, f"ok.{rank}"), "w").write("ok")
+
+
+if __name__ == "__main__":
+    main()
