@@ -183,9 +183,20 @@ class DistributedOptimizer:
         # AdaptiveLocalSGDOptimizer implements).
         localsgd = strategy is not None and (strategy.localsgd or
                                              strategy.adaptive_localsgd)
+        self._local_step = getattr(self, "_local_step", 0) + 1
+        if localsgd:
+            begin = (strategy.adaptive_localsgd_configs.begin_step
+                     if strategy.adaptive_localsgd
+                     else strategy.localsgd_configs.begin_step)
+            # before begin_step LocalSGD is plain synchronous SGD
+            # (reference localsgd_optimizer.py: grads allreduce every
+            # step until begin_step, then local steps start)
+            local_phase = self._local_step >= begin
+        else:
+            local_phase = False
         # data-parallel grad sync across processes (dygraph DDP semantics —
         # reference: imperative Reducer). Inside pjit this is XLA's psum.
-        if get_world_size() > 1 and not localsgd:
+        if get_world_size() > 1 and not local_phase:
             from ..collective import all_reduce
 
             n = get_world_size()
@@ -194,8 +205,7 @@ class DistributedOptimizer:
                     all_reduce(p.grad)
                     p.grad._value = p.grad._value / n
         self.inner_opt.step()
-        if localsgd and get_world_size() > 1:
-            self._local_step = getattr(self, "_local_step", 0) + 1
+        if local_phase and get_world_size() > 1:
             if strategy.adaptive_localsgd:
                 cfg = strategy.adaptive_localsgd_configs
                 lr0 = getattr(self, "_localsgd_lr0", None)
@@ -205,12 +215,14 @@ class DistributedOptimizer:
                 lr = max(float(self.inner_opt.get_lr()), 1e-12)
                 k = max(1, int(round(cfg.init_k_steps *
                                      (lr0 / lr) ** 0.5)))
-                begin = cfg.begin_step
             else:
-                cfg = strategy.localsgd_configs
-                k, begin = max(1, cfg.k_steps), cfg.begin_step
-            if self._local_step >= begin and self._local_step % k == 0:
+                k = max(1, strategy.localsgd_configs.k_steps)
+            # count steps SINCE THE LAST SYNC (a time-varying adaptive k
+            # gated on a global step modulo would fire erratically)
+            self._since_sync = getattr(self, "_since_sync", 0) + 1
+            if self._since_sync >= k:
                 self._average_parameters()
+                self._since_sync = 0
 
     def _average_parameters(self):
         """Fused-bucket allreduce-average of the PARAM VALUES (the

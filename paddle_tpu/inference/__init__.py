@@ -67,6 +67,7 @@ class Predictor:
     AnalysisPredictor runs from ProgramDesc alone)."""
 
     def __init__(self, path: str):
+        import jax
         import jax.export
 
         self.path = path
@@ -78,23 +79,99 @@ class Predictor:
             self._meta = pickle.load(f)
         pnames = self._meta["param_names"]
         bnames = self._meta.get("buffer_names", [])
-        self._params = [np.asarray(state[n]) for n in pnames]
-        self._buffers = [np.asarray(state[n]) for n in bnames]
+        params = [np.asarray(state[n]) for n in pnames]
+        # int8 sidecar (quantization.save_quantized_model): quantized
+        # weights ship as int8+scales; dequantize INTO the param slots
+        # (the slim→AnalysisPredictor handoff, contrib/slim/quantization)
+        self.quantized = os.path.exists(path + ".pdint8")
+        if self.quantized:
+            with open(path + ".pdint8", "rb") as f:
+                int8 = pickle.load(f)
+            by_name = dict(zip(pnames, range(len(pnames))))
+            for lname, ent in int8.items():
+                pidx = by_name.get(lname + ".inner.weight")
+                if pidx is None:
+                    # the fp32 copy was ZEROED at save time — serving
+                    # without the sidecar weight would be silently wrong
+                    raise ValueError(
+                        f"int8 sidecar layer {lname!r} has no matching "
+                        f"param {lname + '.inner.weight'!r} in the saved "
+                        "artifact; the artifact is inconsistent")
+                q = ent["int8_weight"].astype(np.float32)
+                scales = ent["scales"]
+                if scales.size > 1:        # channel-wise
+                    shape = [1] * q.ndim
+                    shape[ent["channel_axis"]] = -1
+                    scale = scales.reshape(shape)
+                else:
+                    scale = scales[0]
+                params[pidx] = (q * scale / 127.0).astype(
+                    params[pidx].dtype)
+        # weights live ON DEVICE across run() calls (serving: no
+        # host→device re-upload per request)
+        self._params = jax.device_put(params)
+        self._buffers = jax.device_put(
+            [np.asarray(state[n]) for n in bnames])
         self._input_names = self._meta.get("input_names") or [
             f"x{i}" for i in range(len(self._meta.get("input_specs", [])))]
+        # batch-size buckets: per-bucket artifacts, loaded lazily
+        self._buckets = sorted(self._meta.get("batch_buckets", []))
+        self._bucket_exec = {}
+        self._base_batch = None
+        specs = self._meta.get("input_specs")
+        if specs and len(specs[0][0]) > 0:
+            self._base_batch = int(specs[0][0][0])
+
+    def _executable_for(self, n: int):
+        """Smallest bucket >= n (or the base artifact when it fits)."""
+        import jax.export
+
+        if self._base_batch is not None and n == self._base_batch:
+            return self._exported, n
+        for b in self._buckets:
+            if b >= n:
+                if b not in self._bucket_exec:
+                    with open(f"{self.path}.pdmodel.b{b}.bin", "rb") as f:
+                        self._bucket_exec[b] = jax.export.deserialize(
+                            bytearray(f.read()))
+                return self._bucket_exec[b], b
+        if self._base_batch is not None and n < self._base_batch:
+            return self._exported, self._base_batch
+        raise ValueError(
+            f"batch {n} exceeds every saved bucket "
+            f"{self._buckets or [self._base_batch]}; re-save with a "
+            "larger batch_buckets entry")
 
     # --- paddle inference API surface ------------------------------------
     def get_input_names(self) -> List[str]:
         return list(self._input_names)
 
     def run(self, inputs: Sequence[np.ndarray]):
-        """Feed host arrays, return host arrays (fetch)."""
-        outs = self._exported.call(self._params, self._buffers,
-                                   *[np.asarray(x) for x in inputs])
+        """Feed host arrays, return host arrays (fetch). Requests whose
+        batch is not a saved size are padded up to the nearest bucket and
+        the outputs sliced back."""
         import jax
 
+        arrs = [np.asarray(x) for x in inputs]
+        n = int(arrs[0].shape[0]) if arrs and arrs[0].ndim else None
+        if n == 0:
+            raise ValueError("empty batch: no saved executable can run "
+                             "batch 0")
+        exe, bucket = (self._exported, None) if n is None else \
+            self._executable_for(n)
+        if bucket is not None and bucket != n:
+            # pad only BATCHED inputs (leading dim == request batch);
+            # unbatched aux inputs pass through untouched
+            arrs = [np.concatenate(
+                [a, np.repeat(a[-1:], bucket - n, axis=0)], axis=0)
+                if a.ndim and a.shape[0] == n else a for a in arrs]
+        outs = exe.call(self._params, self._buffers, *arrs)
         flat = jax.tree_util.tree_leaves(outs)
-        return [np.asarray(o) for o in flat]
+        res = [np.asarray(o) for o in flat]
+        if bucket is not None and bucket != n:
+            res = [r[:n] if r.ndim and r.shape[0] == bucket else r
+                   for r in res]
+        return res
 
     __call__ = run
 

@@ -100,7 +100,7 @@ def to_static(layer=None, input_spec=None, build_strategy=None,
     return wrap(layer)
 
 
-def save(layer, path, input_spec=None, **config):
+def save(layer, path, input_spec=None, batch_buckets=None, **config):
     """paddle.jit.save equivalent (reference: fluid/dygraph/jit.py save).
 
     Persists:
@@ -150,6 +150,24 @@ def save(layer, path, input_spec=None, **config):
             with open(path + ".pdmodel.bin", "wb") as f:
                 f.write(exported.serialize())
             meta["exported"] = True
+            if batch_buckets:
+                # one artifact per batch bucket: the serving Predictor
+                # pads a request up to the nearest bucket (reference
+                # predictors re-run shape inference per batch; XLA
+                # compiles per shape, so buckets bound the compile set).
+                # meta records only buckets whose file was WRITTEN — a
+                # mid-loop failure must not advertise missing artifacts.
+                done = []
+                for n in sorted(int(b) for b in batch_buckets):
+                    bspecs = [jax.ShapeDtypeStruct((n,) + tuple(s.shape[1:]),
+                                                   np.dtype(s.dtype))
+                              for s in input_spec]
+                    ex_n = jax_export.export(jax.jit(pure))(
+                        p_specs, b_specs, *bspecs)
+                    with open(f"{path}.pdmodel.b{n}.bin", "wb") as f:
+                        f.write(ex_n.serialize())
+                    done.append(n)
+                meta["batch_buckets"] = done
         except Exception as e:  # pragma: no cover
             meta["export_error"] = str(e)
         try:

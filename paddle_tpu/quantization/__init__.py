@@ -27,7 +27,8 @@ from ..nn.layer.layers import Layer
 from ..tensor._helper import apply
 
 __all__ = ["fake_quant", "QuantConfig", "QAT", "PTQ",
-           "QuantedLinear", "QuantedConv2D", "export_int8_state"]
+           "QuantedLinear", "QuantedConv2D", "export_int8_state",
+           "save_quantized_model"]
 
 
 # ---------------------------------------------------------------------------
@@ -247,9 +248,46 @@ def export_int8_state(model: Layer) -> Dict[str, dict]:
                         -127, 127).astype(np.int8)
             out[name] = {"int8_weight": q,
                          "scales": scales.astype(np.float32),
+                         "channel_axis": axis,
                          "act_scale": float(
                              np.asarray(sub.act_quant.scale._value))}
     return out
+
+
+def save_quantized_model(model: Layer, path: str, input_spec,
+                         batch_buckets=None):
+    """Save a QAT/PTQ model as a deployable int8 artifact
+    (reference: ImperativeQuantAware.save_quantized_model →
+    AnalysisPredictor int8 handoff, contrib/slim/quantization).
+
+    Writes the usual jit.save artifacts PLUS ``path.pdint8`` (int8
+    weights + scales), and ZEROES the quantized fp32 weights inside
+    ``path.pdparams`` — the int8 sidecar is the load-bearing copy, which
+    ``inference.Predictor`` dequantizes into device-resident params.
+    Note: quantized-weight fake-quant is exactly dequantize(quantize(w)),
+    so the Predictor's int8 path reproduces QAT eval outputs bit-for-bit
+    (up to f32 rounding).
+    """
+    import pickle
+
+    from .. import jit as pjit
+
+    int8 = export_int8_state(model)
+    if not int8:
+        raise ValueError("model has no QuantedLinear/QuantedConv2D "
+                         "layers; run QAT/PTQ .quantize() first")
+    pjit.save(model, path, input_spec=input_spec,
+              batch_buckets=batch_buckets)
+    with open(path + ".pdint8", "wb") as f:
+        pickle.dump(int8, f, protocol=4)
+    with open(path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for lname in int8:
+        key = lname + ".inner.weight"
+        if key in state:
+            state[key] = np.zeros_like(state[key])
+    with open(path + ".pdparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
 
 
 def _named_sublayers(layer: Layer, prefix=""):

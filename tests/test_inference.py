@@ -77,3 +77,64 @@ def test_create_predictor_missing_model(tmp_path):
         create_predictor(Config(str(tmp_path / "nope")))
     with pytest.raises(ValueError):
         create_predictor(Config())
+
+
+def test_predictor_batch_buckets(tmp_path):
+    """Serving: requests at non-saved batch sizes pad up to the nearest
+    bucket and slice back; weights stay device-resident across run()."""
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(4)
+    net = LeNet()
+    net.eval()
+    path = str(tmp_path / "lenet_b")
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([2, 1, 28, 28], "float32", "x")],
+                    batch_buckets=[1, 4, 8])
+    pred = create_predictor(Config(path))
+    for n in (1, 2, 3, 4, 7):
+        x = np.random.RandomState(n).randn(n, 1, 28, 28).astype(np.float32)
+        eager = np.asarray(net(paddle.to_tensor(x))._value)
+        out, = pred.run([x])
+        assert out.shape[0] == n
+        np.testing.assert_allclose(out, eager, rtol=1e-4, atol=1e-4)
+    # device residency: params are jax arrays, same objects across runs
+    import jax
+    p0 = pred._params[0]
+    pred.run([np.zeros((1, 1, 28, 28), np.float32)])
+    assert pred._params[0] is p0
+    assert isinstance(p0, jax.Array)
+
+
+def test_int8_predictor_matches_qat(tmp_path):
+    """VERDICT item 10: the Predictor consumes the int8 export. The fp32
+    quantized weights in .pdparams are ZEROED by save_quantized_model,
+    so correct outputs prove the int8 sidecar is load-bearing."""
+    import pickle
+
+    from paddle_tpu.quantization import QAT, save_quantized_model
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(5)
+    net = LeNet()
+    QAT().quantize(net)
+    x = np.random.RandomState(6).randn(2, 1, 28, 28).astype(np.float32)
+    net.train()
+    net(paddle.to_tensor(x))            # populate act scales
+    net.eval()
+    want = np.asarray(net(paddle.to_tensor(x))._value)
+
+    path = str(tmp_path / "lenet_int8")
+    save_quantized_model(net, path,
+                         input_spec=[InputSpec([2, 1, 28, 28], "float32",
+                                               "x")])
+    # the sidecar exists and pdparams quantized weights are zeroed
+    with open(path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    zeroed = [k for k in state if k.endswith(".inner.weight")]
+    assert zeroed and all(np.abs(state[k]).max() == 0 for k in zeroed)
+
+    pred = create_predictor(Config(path))
+    assert pred.quantized
+    out, = pred.run([x])
+    np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
