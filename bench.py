@@ -1,10 +1,13 @@
 """Driver benchmark: all five BASELINE.md configs on one chip.
 
 Prints ONE JSON line (driver contract). Headline metric: tokens/sec/chip +
-MFU training GPT-350M via the hybrid trainer (the BASELINE "GPT via
-hybrid-parallel" row scaled to a single v5e chip); the other four configs'
-measurements ride in extra.configs:
+MFU training GPT-3 **1.3B** via the hybrid trainer — the model class the
+BASELINE metric names ("GPT-3 1.3B-13B via hybrid-parallel"), on one v5e
+chip via bf16 state + full remat + fused lm-head/CE + layer-scan schedule
+(hybrid.py memory knobs). The other configs ride in extra.configs:
 
+  gpt_1p3b_f32master_offload — ZeRO-Offload fidelity path: f32 master in
+                       pinned_host, streamed through HBM per group
   lenet_mnist        — eager train step (correctness/latency baseline)
   resnet50_dp        — compiled DP train step, images/sec/chip
   bert_base_dp_amp   — hybrid trainer, DP+AMP(bf16), tokens/sec/chip
@@ -135,7 +138,7 @@ def bench_resnet50(paddle, steps, batch):
             "images_per_sec": round(batch / dt, 1)}
 
 
-def _hybrid(paddle, model, amp=True, zero3=False, remat=False):
+def _hybrid(paddle, model, amp=True, zero3=False, remat=False, **kw):
     import jax
     from paddle_tpu.distributed.fleet import DistributedStrategy
     from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
@@ -150,7 +153,59 @@ def _hybrid(paddle, model, amp=True, zero3=False, remat=False):
     s.recompute = remat
     mesh = create_mesh({"dp": 1, "pp": 1, "tp": 1, "sp": 1},
                        jax.devices()[:1])
-    return HybridPipelineTrainer(model, opt, s, mesh, n_micro=1)
+    return HybridPipelineTrainer(model, opt, s, mesh,
+                                 n_micro=kw.pop("n_micro", 1), **kw)
+
+
+def bench_gpt_1p3b(paddle, peak, steps=6, micro=2, n_micro=6,
+                   offload=False):
+    """The BASELINE metric's own model class on ONE 16 GB v5e chip.
+
+    Default (headline): bf16 master+moments resident in HBM, full remat,
+    layer-scan schedule, fused lm-head/CE, eager f32 params freed.
+    offload=True: ZeRO-Offload fidelity path — f32 master params +
+    bf16 moments in pinned_host, streamed through HBM around the
+    per-group update (bandwidth-bound at ~12 GB/s: lower MFU, full f32
+    master fidelity; the config for models that cannot fit otherwise).
+    """
+    from paddle_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig.gpt3_1_3b()
+    seq = cfg.max_seq_len
+    kw = dict(remat=True, n_micro=n_micro, free_eager=True)
+    if offload:
+        kw.update(offload_params=True, offload_optimizer=True,
+                  moment_dtype="bfloat16")
+    else:
+        kw.update(param_dtype="bfloat16", moment_dtype="bfloat16")
+    tr = _hybrid(paddle, GPT(cfg), **kw)
+    batch = micro * n_micro
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    dt = _time_steps(lambda: tr.step(tokens), steps)
+    toks = batch * seq / dt
+    mfu = toks * cfg.flops_per_token(seq) / peak
+    out = {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
+           "tokens_per_sec": round(toks, 1), "mfu": round(mfu, 4),
+           "params_m": round(cfg.num_params() / 1e6, 1)}
+    if offload:
+        # XLA memory_analysis folds pinned_host arguments into the same
+        # argument total, so the HBM split is not recoverable here; the
+        # resident-HBM story for this config is bf16 compute copies +
+        # grads + per-group f32 streaming transients.
+        out["hbm_note"] = "state host-resident (pinned_host); " \
+            "memory_analysis cannot split HBM vs host arguments"
+        return out
+    try:
+        ma = tr.memory_analysis(tokens)
+        if ma and "peak_bytes_est" in ma:
+            hbm = 15.75 * 1024**3        # v5e per-chip HBM
+            out["hbm_peak_gb"] = round(ma["peak_bytes_est"] / 1024**3, 2)
+            out["hbm_headroom_gb"] = round(
+                (hbm - ma["peak_bytes_est"]) / 1024**3, 2)
+    except Exception as e:
+        out["hbm_note"] = f"{type(e).__name__}: {e}"[:120]
+    return out
 
 
 def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False):
@@ -164,6 +219,41 @@ def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False):
     mfu = toks * cfg.flops_per_token(seq) / peak
     return {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
             "tokens_per_sec": round(toks, 1), "mfu": round(mfu, 4),
+            "params_m": round(cfg.num_params() / 1e6, 1)}
+
+
+def bench_moe(paddle, steps, peak):
+    """MoE-GPT via the sparse sort-based dispatch (distributed/moe.py):
+    tokens/sec + the dense-equivalent MFU (active params only — top-1
+    routing activates 1/E of expert FLOPs; VERDICT r2 item 5)."""
+    import jax
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.distributed.strategy_compiler import compile_train_step
+    from paddle_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
+                    num_heads=12, max_seq_len=1024, moe_num_experts=8)
+    net = GPT(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
+    s = DistributedStrategy()
+    s.amp = True
+    mesh = create_mesh({"dp": 1, "ep": 1}, jax.devices()[:1])
+    tr = compile_train_step(net, opt, s, mesh)
+    batch, seq = 8, 1024
+    tokens = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    dt = _time_steps(lambda: tr.step(tokens), steps)
+    toks = batch * seq / dt
+    # active-param FLOPs: each token runs top_k of the num_experts FFNs,
+    # so the dense-equivalent model has a top_k-wide FFN
+    dense = GPTConfig(vocab_size=cfg.vocab_size, hidden_size=768,
+                      num_layers=12, num_heads=12, max_seq_len=1024,
+                      ffn_hidden_size=cfg.ffn_hidden_size * cfg.moe_top_k)
+    mfu_active = toks * dense.flops_per_token(seq) / peak
+    return {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
+            "num_experts": 8, "tokens_per_sec": round(toks, 1),
+            "mfu_active_params": round(mfu_active, 4),
             "params_m": round(cfg.num_params() / 1e6, 1)}
 
 
@@ -203,20 +293,33 @@ def main():
     t_start = time.perf_counter()
     # soft wall budget for the EXTRA configs: the headline must always be
     # measured and printed even if the driver enforces a timeout
-    budget_s = float(os.environ.get("PADDLE_BENCH_BUDGET_S", "780"))
+    budget_s = float(os.environ.get("PADDLE_BENCH_BUDGET_S", "1050"))
 
-    # headline FIRST
+    # headline FIRST: the BASELINE metric's own model class (GPT-3 1.3B)
     if on_tpu:
-        head_cfg = GPTConfig(vocab_size=32768, hidden_size=1024,
-                             num_layers=24, num_heads=16, max_seq_len=1024)
-        head = bench_gpt(paddle, head_cfg, batch=8, seq=1024, steps=10,
-                         peak=peak)
+        head = bench_gpt_1p3b(paddle, peak)
+        head_name = "gpt_1p3b_hybrid_amp"
     else:  # CPU smoke fallback
         head_cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                              num_heads=4, max_seq_len=128)
         head = bench_gpt(paddle, head_cfg, batch=2, seq=64, steps=2,
                          peak=peak)
-    configs["gpt_350m_hybrid_amp"] = head
+        head_name = "gpt_350m_hybrid_amp"
+    configs[head_name] = head
+
+    def release_hbm():
+        """Drop the previous config's device state: a 1.3B trainer's HBM
+        footprint must not carry into the next config. Reference-cycle
+        GC + the jit/executable caches both pin device buffers."""
+        import gc
+
+        import jax as _jax
+
+        gc.collect()
+        _jax.clear_caches()
+        gc.collect()
+
+    release_hbm()
 
     def extra(name, fn):
         if time.perf_counter() - t_start > budget_s:
@@ -226,11 +329,18 @@ def main():
             configs[name] = fn()
         except Exception as e:  # one broken config must not kill the line
             configs[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        release_hbm()
 
     if on_tpu:
         from paddle_tpu.models import (BertForPretraining,
                                        ErnieForPretraining)
 
+        extra("lenet_mnist", lambda: bench_lenet(paddle, steps=20))
+        extra("gpt_350m_hybrid_amp", lambda: bench_gpt(
+            paddle, GPTConfig(vocab_size=32768, hidden_size=1024,
+                              num_layers=24, num_heads=16,
+                              max_seq_len=1024),
+            batch=8, seq=1024, steps=10, peak=peak))
         extra("gpt_125m_hybrid_amp", lambda: bench_gpt(
             paddle, GPTConfig(vocab_size=32768, hidden_size=768,
                               num_layers=12, num_heads=12,
@@ -248,10 +358,15 @@ def main():
             remat=True))
         extra("resnet50_dp_amp", lambda: bench_resnet50(
             paddle, steps=10, batch=64))
-        extra("lenet_mnist", lambda: bench_lenet(paddle, steps=20))
+        extra("moe_gpt_8experts", lambda: bench_moe(
+            paddle, steps=10, peak=peak))
+        # most expensive + skippable last: the ZeRO-Offload fidelity run
+        extra("gpt_1p3b_f32master_offload", lambda: bench_gpt_1p3b(
+            paddle, peak, steps=3, micro=2, n_micro=16, offload=True))
 
     print(json.dumps({
-        "metric": "gpt_350m_train_tokens_per_sec_per_chip",
+        "metric": head_name.replace("_hybrid_amp", "")
+        + "_train_tokens_per_sec_per_chip",
         "value": head["tokens_per_sec"],
         "unit": "tokens/s",
         # MFU vs the 0.45 north-star target (reference publishes no numbers)

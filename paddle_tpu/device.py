@@ -1,0 +1,32 @@
+"""paddle.device namespace (reference: python/paddle/device.py).
+
+The reference module multiplexes CUDA/XPU/CPU place selection; here the
+accelerator is the TPU and the real logic lives in core/place.py — this
+module preserves the importable surface (``paddle.device.set_device`` et
+al.) plus the capability probes, which answer for the TPU stack.
+"""
+from .core.place import (  # noqa: F401
+    CPUPlace, Place, TPUPlace, XPUPlace, device_count, get_device,
+    is_compiled_with_tpu, set_device)
+
+__all__ = ["get_cudnn_version", "get_device", "set_device",
+           "is_compiled_with_xpu", "is_compiled_with_cuda",
+           "is_compiled_with_tpu", "XPUPlace"]
+
+
+def is_compiled_with_xpu() -> bool:
+    """No Baidu-Kunlun XPU in the TPU stack."""
+    return False
+
+
+def is_compiled_with_cuda() -> bool:
+    """The TPU build carries no CUDA kernels (the reference's probe keys
+    feature fallbacks off this — False routes them to the portable
+    path)."""
+    return False
+
+
+def get_cudnn_version():
+    """None: no cuDNN in the TPU stack (reference returns None when not
+    compiled with CUDA, device.py:72)."""
+    return None

@@ -153,25 +153,53 @@ class Predictor:
         import jax
 
         arrs = [np.asarray(x) for x in inputs]
-        n = int(arrs[0].shape[0]) if arrs and arrs[0].ndim else None
+        # batched-input indices come from save-time meta (exact — the
+        # same rule jit.save bucketed with); heuristic only for legacy
+        # artifacts predating the field
+        bin_idx = self._meta.get("batched_inputs")
+        first_b = bin_idx[0] if bin_idx else 0
+        n = int(arrs[first_b].shape[0]) \
+            if len(arrs) > first_b and arrs[first_b].ndim else None
         if n == 0:
             raise ValueError("empty batch: no saved executable can run "
                              "batch 0")
         exe, bucket = (self._exported, None) if n is None else \
             self._executable_for(n)
         if bucket is not None and bucket != n:
-            # pad only BATCHED inputs (leading dim == request batch);
-            # unbatched aux inputs pass through untouched
+            def is_batched(i, a):
+                if bin_idx is not None:
+                    return i in bin_idx
+                return bool(a.ndim) and a.shape[0] == n
             arrs = [np.concatenate(
                 [a, np.repeat(a[-1:], bucket - n, axis=0)], axis=0)
-                if a.ndim and a.shape[0] == n else a for a in arrs]
+                if is_batched(i, a) else a for i, a in enumerate(arrs)]
         outs = exe.call(self._params, self._buffers, *arrs)
         flat = jax.tree_util.tree_leaves(outs)
         res = [np.asarray(o) for o in flat]
         if bucket is not None and bucket != n:
-            res = [r[:n] if r.ndim and r.shape[0] == bucket else r
-                   for r in res]
+            batched = self._meta.get("batched_outputs") \
+                or self._batched_outputs(exe, bucket)
+            res = [r[:n] if (batched[i] if batched and i < len(batched)
+                             else r.ndim and r.shape[0] == bucket) else r
+                   for i, r in enumerate(res)]
         return res
+
+    def _batched_outputs(self, exe, bucket):
+        """Legacy fallback (artifacts without meta['batched_outputs']):
+        compare this executable's output avals against the base
+        artifact's — dims that track the bucket size are batched. None
+        when the base batch equals the bucket (no signal; the caller
+        falls back to the shape-match heuristic)."""
+        if self._base_batch is None or self._base_batch == bucket or \
+                not hasattr(exe, "out_avals") or \
+                not hasattr(self._exported, "out_avals"):
+            return None
+        out = []
+        for a, b in zip(exe.out_avals, self._exported.out_avals):
+            out.append(len(a.shape) > 0 and a.shape[0] == bucket
+                       and b.shape[0] == self._base_batch
+                       and a.shape[1:] == b.shape[1:])
+        return out
 
     __call__ = run
 

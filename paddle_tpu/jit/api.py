@@ -150,6 +150,39 @@ def save(layer, path, input_spec=None, batch_buckets=None, **config):
             with open(path + ".pdmodel.bin", "wb") as f:
                 f.write(exported.serialize())
             meta["exported"] = True
+            # the batch dim is inputs[0]'s leading dim; only specs
+            # sharing it are re-bucketed/padded — unbatched aux inputs
+            # (lookup tables, per-class priors) keep their shape. The
+            # batched-INPUT indices and batched-OUTPUT positions are
+            # recorded in meta so the serving side pads/slices from the
+            # save-time truth, not runtime shape guessing (eval_shape at
+            # two batch sizes — abstract, no compile). Recorded for
+            # every export: the Predictor pads up to the BASE batch even
+            # when no buckets were requested.
+            base_b = tuple(input_spec[0].shape)[0] \
+                if len(input_spec[0].shape) else None
+            batched_in = [i for i, s in enumerate(input_spec)
+                          if len(s.shape) and s.shape[0] == base_b]
+
+            def specs_at(n):
+                return [jax.ShapeDtypeStruct(
+                    (n,) + tuple(s.shape[1:]), np.dtype(s.dtype))
+                    if i in batched_in else jax.ShapeDtypeStruct(
+                        tuple(s.shape), np.dtype(s.dtype))
+                    for i, s in enumerate(input_spec)]
+
+            meta["batched_inputs"] = batched_in
+            if base_b is not None:
+                try:
+                    o1 = jax.tree_util.tree_leaves(jax.eval_shape(
+                        pure, p_specs, b_specs, *specs_at(base_b)))
+                    o2 = jax.tree_util.tree_leaves(jax.eval_shape(
+                        pure, p_specs, b_specs, *specs_at(base_b + 1)))
+                    meta["batched_outputs"] = [
+                        len(a.shape) > 0 and a.shape != b.shape
+                        for a, b in zip(o1, o2)]
+                except Exception:
+                    pass             # serving falls back to heuristic
             if batch_buckets:
                 # one artifact per batch bucket: the serving Predictor
                 # pads a request up to the nearest bucket (reference
@@ -159,9 +192,7 @@ def save(layer, path, input_spec=None, batch_buckets=None, **config):
                 # mid-loop failure must not advertise missing artifacts.
                 done = []
                 for n in sorted(int(b) for b in batch_buckets):
-                    bspecs = [jax.ShapeDtypeStruct((n,) + tuple(s.shape[1:]),
-                                                   np.dtype(s.dtype))
-                              for s in input_spec]
+                    bspecs = specs_at(n)
                     ex_n = jax_export.export(jax.jit(pure))(
                         p_specs, b_specs, *bspecs)
                     with open(f"{path}.pdmodel.b{n}.bin", "wb") as f:

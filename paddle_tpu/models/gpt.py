@@ -83,7 +83,11 @@ class GPTConfig:
 
     def num_params(self) -> int:
         h, L, v = self.hidden_size, self.num_layers, self.vocab_size
-        per_block = 4 * h * h + 2 * h * self.ffn_hidden_size + 13 * h
+        e = max(self.moe_num_experts, 1)     # E expert FFNs + router
+        ffn = 2 * h * self.ffn_hidden_size * e \
+            + (e - 1) * (self.ffn_hidden_size + h) \
+            + (h * e if self.moe_num_experts else 0)
+        per_block = 4 * h * h + ffn + 13 * h
         return v * h + self.max_seq_len * h + L * per_block + 2 * h
 
     def flops_per_token(self, seq_len=None) -> float:

@@ -6,7 +6,7 @@ from .activation import (celu, elu, gelu, gumbel_softmax, hardshrink,  # noqa: F
                          log_sigmoid, log_softmax, maxout, mish, prelu, relu,
                          relu6, selu, sigmoid, silu, softmax, softplus,
                          softshrink, softsign, swish, tanh, tanhshrink,
-                         thresholded_relu)
+                         thresholded_relu, glu)
 from .attention import scaled_dot_product_attention  # noqa: F401
 from ...ops.fused_ce import fused_linear_cross_entropy  # noqa: F401
 from .common import (alpha_dropout, bilinear, cosine_similarity,  # noqa: F401
@@ -23,7 +23,8 @@ from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # no
                    square_error_cost, triplet_margin_loss)
 from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
                    local_response_norm, normalize, rms_norm)
-from .vision import affine_grid, grid_sample  # noqa: F401
+from .vision import (affine_grid, grid_sample, temporal_shift,  # noqa: F401
+                     deform_conv2d)
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
                       adaptive_avg_pool3d, adaptive_max_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, avg_pool1d,

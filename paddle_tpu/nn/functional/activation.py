@@ -143,3 +143,14 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
         return y
 
     return apply(f, x, name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    """Gated linear unit: split ``x`` in half along ``axis``,
+    ``a * sigmoid(b)`` (reference: fluid/nets.py:335 composes split +
+    sigmoid + elementwise_mul; one fused expression here)."""
+    def f(xv):
+        a, b = jnp.split(xv, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+
+    return apply(f, x, name="glu")

@@ -117,3 +117,120 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 + v10 * (1 - wx) * wy + v11 * wx * wy)
 
     return apply(f, x, grid, name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """Shift a fraction of channels across the time axis of a [N*T, C, H,
+    W] clip batch (TSM; reference: operators/temporal_shift_op.cc +
+    fluid/layers/nn.py:13337). The first ``C*ratio`` channels read from
+    t-1, the next ``C*ratio`` from t+1, the rest stay — expressed as two
+    static pads+slices over the folded [N, T, C, H, W] view (XLA fuses
+    them; the zero boundary frames fall out of the pad)."""
+    if not isinstance(seg_num, int):
+        raise TypeError("seg_num must be int type.")
+
+    def f(xv):
+        nt, c, h, w = xv.shape
+        n = nt // seg_num
+        v = xv.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        back = pad[:, :seg_num, :c1]               # channel k ← t-1
+        fwd = pad[:, 2:, c1:c2]                    # channel k ← t+1
+        keep = v[:, :, c2:]
+        return jnp.concatenate([back, fwd, keep], axis=2) \
+            .reshape(nt, c, h, w)
+
+    return apply(f, x, name="temporal_shift")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, groups=1, mask=None, name=None):
+    """Deformable convolution v1 (mask=None) / v2 (reference:
+    operators/deformable_conv_op.cc, python/paddle/vision/ops.py:394).
+
+    The reference's CUDA kernel im2col-gathers per sampling location;
+    here the K=kh*kw learned-offset taps are bilinearly sampled as one
+    vectorized gather producing [N, Cin, K, Ho, Wo], and the conv
+    reduces to a single einsum against [Cout, Cin/g, K] — MXU-friendly,
+    and jax AD derives the scatter-add backward for x/offset/mask."""
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(xv, off, wv, *rest):
+        i = 0
+        mv = bv = None
+        if mask is not None:
+            mv = rest[i]; i += 1
+        if bias is not None:
+            bv = rest[i]
+        n, cin, h, w = xv.shape
+        cout, cin_g, kh, kw = wv.shape
+        k = kh * kw
+        dg = off.shape[1] // (2 * k)                 # deformable groups
+        ho = (h + 2 * p[0] - (d[0] * (kh - 1) + 1)) // s[0] + 1
+        wo = (w + 2 * p[1] - (d[1] * (kw - 1) + 1)) // s[1] + 1
+
+        # base sampling grid per output position and tap: [K, Ho, Wo]
+        oy = jnp.arange(ho) * s[0] - p[0]
+        ox = jnp.arange(wo) * s[1] - p[1]
+        ky = (jnp.arange(kh) * d[0])[:, None].repeat(kw, 1).reshape(k)
+        kx = (jnp.arange(kw) * d[1])[None, :].repeat(kh, 0).reshape(k)
+        base_y = oy[None, :, None] + ky[:, None, None]   # [K, Ho, 1]
+        base_x = ox[None, None, :] + kx[:, None, None]   # [K, 1, Wo]
+
+        # learned offsets: [N, dg, K, 2, Ho, Wo] (reference layout:
+        # 2*dg*K channels ordered (dg, K, [y, x]))
+        off = off.reshape(n, dg, k, 2, ho, wo)
+        gy = base_y[None, None] + off[:, :, :, 0]        # [N, dg, K, Ho, Wo]
+        gx = base_x[None, None] + off[:, :, :, 1]
+
+        # bilinear sample x at (gy, gx) for every dg/tap: fold channels
+        # into their deformable group
+        xg = xv.reshape(n, dg, cin // dg, h * w)
+        y0 = jnp.floor(gy); x0 = jnp.floor(gx)
+        wy = (gy - y0).astype(xv.dtype)
+        wx = (gx - x0).astype(xv.dtype)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+
+        def at(iy, ix):
+            inb = ((iy >= 0) & (iy < h) & (ix >= 0) & (ix < w))
+            idx = (jnp.clip(iy, 0, h - 1) * w
+                   + jnp.clip(ix, 0, w - 1))             # [N,dg,K,Ho,Wo]
+            flat = idx.reshape(n, dg, 1, -1)
+            vals = jnp.take_along_axis(
+                xg, jnp.broadcast_to(
+                    flat, (n, dg, cin // dg, flat.shape[-1])), axis=3)
+            vals = vals.reshape(n, dg, cin // dg, k, ho, wo)
+            return vals * inb[:, :, None].astype(xv.dtype)
+
+        wy = wy[:, :, None]; wx = wx[:, :, None]
+        sampled = (at(y0i, x0i) * (1 - wy) * (1 - wx)
+                   + at(y0i, x0i + 1) * (1 - wy) * wx
+                   + at(y0i + 1, x0i) * wy * (1 - wx)
+                   + at(y0i + 1, x0i + 1) * wy * wx)
+        if mv is not None:                               # v2 modulation
+            m = mv.reshape(n, dg, 1, k, ho, wo).astype(xv.dtype)
+            sampled = sampled * m
+        sampled = sampled.reshape(n, cin, k, ho, wo)
+
+        # grouped contraction: [N, g, Cin/g, K, Ho, Wo] x
+        #                      [g, Cout/g, Cin/g, K] -> [N, g, Cout/g, ...]
+        sg = sampled.reshape(n, groups, cin // groups, k, ho, wo)
+        wg = wv.reshape(groups, cout // groups, cin_g, kh * kw)
+        out = jnp.einsum("ngckhw,gock->ngohw", sg, wg)
+        out = out.reshape(n, cout, ho, wo)
+        if bv is not None:
+            out = out + bv.reshape(1, cout, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply(f, *args, name="deform_conv2d")

@@ -278,6 +278,17 @@ def save_quantized_model(model: Layer, path: str, input_spec,
                          "layers; run QAT/PTQ .quantize() first")
     pjit.save(model, path, input_spec=input_spec,
               batch_buckets=batch_buckets)
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    if not meta.get("exported"):
+        # jit.save swallows export failures into meta; zeroing the fp32
+        # weights would then leave an artifact whose ONLY loadable
+        # weights are silently all-zero — fail loudly instead
+        raise RuntimeError(
+            "jit.save could not export the model "
+            f"({meta.get('export_error', 'no .pdmodel.bin written')}); "
+            "refusing to strip fp32 weights from an artifact with no "
+            "runnable executable")
     with open(path + ".pdint8", "wb") as f:
         pickle.dump(int8, f, protocol=4)
     with open(path + ".pdparams", "rb") as f:

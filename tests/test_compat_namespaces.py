@@ -78,7 +78,10 @@ class TestReaderBatch:
         assert list(buf()) == [0, 1, 2, 3, 4]
 
     def test_legacy_dataset_readers(self):
-        tr = paddle.dataset.uci_housing.train()()
+        # synthetic corpora are opt-in since round 3 (text/datasets.py
+        # _synthetic_optin): a missing data_file must not silently
+        # train on fake data, so the smoke reader acknowledges it
+        tr = paddle.dataset.uci_housing.train(synthetic_size=32)()
         x, y = next(tr)
         assert x.shape == (13,) and y.shape == (1,)
         m = paddle.dataset.mnist.test(synthetic_size=8)()

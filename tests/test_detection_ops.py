@@ -381,3 +381,238 @@ class TestSSDHeadComposition:
         loss.backward()
         assert conv.weight.grad is not None
         assert np.isfinite(float(loss.numpy()))
+
+
+class TestSpatialOpTail:
+    """Round-3 L5 op tail: glu, temporal_shift, deform_conv2d
+    (reference: fluid/nets.py:335, operators/temporal_shift_op.cc,
+    operators/deformable_conv_op.cc)."""
+
+    def test_glu_golden(self):
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        out = F.glu(paddle.to_tensor(x)).numpy()
+        a, b = np.split(x, 2, -1)
+        np.testing.assert_allclose(out, a / (1 + np.exp(-b)), rtol=1e-5)
+        out1 = F.glu(paddle.to_tensor(x), axis=0).numpy()
+        a, b = np.split(x, 2, 0)
+        np.testing.assert_allclose(out1, a / (1 + np.exp(-b)), rtol=1e-5)
+
+    def test_temporal_shift_golden(self):
+        """Matches the reference OpTest's python golden
+        (fluid/tests/unittests/test_temporal_shift_op.py:25)."""
+        x = np.random.RandomState(1).randn(6, 4, 3, 2).astype(np.float32)
+        seg, ratio = 2, 0.25
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=seg,
+                               shift_ratio=ratio).numpy()
+        v = x.reshape(-1, seg, 4, 3, 2)
+        pad = np.pad(v, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)))
+        c1 = int(4 * ratio)
+        c2 = int(4 * 2 * ratio)
+        exp = np.concatenate(
+            [pad[:, :seg, :c1], pad[:, 2:, c1:c2], v[:, :, c2:]],
+            axis=2).reshape(x.shape)
+        np.testing.assert_allclose(out, exp, rtol=1e-6)
+
+    def test_deform_conv2d_zero_offset_is_conv(self):
+        import jax
+
+        from paddle_tpu.vision.ops import DeformConv2D
+
+        paddle.seed(3)
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(2, 4, 8, 8).astype(np.float32))
+        off = paddle.to_tensor(np.zeros((2, 18, 8, 8), np.float32))
+        layer = DeformConv2D(4, 6, 3, padding=1)
+        y = layer(x, off)
+        ref = jax.lax.conv_general_dilated(
+            x._value, layer.weight._value, (1, 1), [(1, 1), (1, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(
+            np.asarray(y._value),
+            np.asarray(ref + layer.bias._value.reshape(1, -1, 1, 1)),
+            rtol=2e-5, atol=2e-5)
+
+    def test_deform_conv2d_v2_mask_and_grads(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.nn.functional import deform_conv2d
+
+        x = paddle.to_tensor(np.random.RandomState(4)
+                             .randn(1, 2, 6, 6).astype(np.float32))
+        w = paddle.to_tensor(np.random.RandomState(5)
+                             .randn(3, 2, 3, 3).astype(np.float32))
+        off = np.random.RandomState(6).randn(1, 18, 6, 6) \
+            .astype(np.float32) * 0.3
+        ones = paddle.to_tensor(np.ones((1, 9, 6, 6), np.float32))
+        y1 = deform_conv2d(x, paddle.to_tensor(off), w, padding=1)
+        y2 = deform_conv2d(x, paddle.to_tensor(off), w, padding=1,
+                           mask=ones)
+        np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-5)
+        # finite-difference on one offset element
+        def loss(o):
+            return deform_conv2d(x, o, w, padding=1)._value.sum()
+
+        g = jax.grad(loss)(jnp.asarray(off))
+        eps = 1e-3
+        o2 = off.copy()
+        o2[0, 4, 2, 2] += eps
+        fd = (loss(jnp.asarray(o2)) - loss(jnp.asarray(off))) / eps
+        np.testing.assert_allclose(float(g[0, 4, 2, 2]), float(fd),
+                                   rtol=5e-2, atol=5e-3)
+
+
+def np_yolo_loss(x, gb, gl, anchors, mask, C, ignore_thresh, ds,
+                 gs=None, smooth=True):
+    """Independent scalar-loop golden for yolo_loss (same math as
+    reference yolov3_loss_op.h, re-derived)."""
+    def sce(p, t):
+        return max(p, 0.0) - p * t + math.log1p(math.exp(-abs(p)))
+
+    def iou(b1, b2):
+        ow = min(b1[0] + b1[2] / 2, b2[0] + b2[2] / 2) - \
+            max(b1[0] - b1[2] / 2, b2[0] - b2[2] / 2)
+        oh = min(b1[1] + b1[3] / 2, b2[1] + b2[3] / 2) - \
+            max(b1[1] - b1[3] / 2, b2[1] - b2[3] / 2)
+        inter = 0.0 if ow < 0 or oh < 0 else ow * oh
+        return inter / (b1[2] * b1[3] + b2[2] * b2[3] - inter)
+
+    n, _, h, w = x.shape
+    S, B = len(mask), gb.shape[1]
+    isz = ds * h
+    v = x.reshape(n, S, 5 + C, h, w)
+    if gs is None:
+        gs = np.ones((n, B))
+    loss = np.zeros(n)
+    sm = min(1.0 / C, 1.0 / 40) if smooth else 0.0
+    pos_l, neg_l = 1.0 - sm, sm
+    obj = np.zeros((n, S, h, w))
+    for i in range(n):
+        valid = [gb[i, t, 2] > 1e-6 and gb[i, t, 3] > 1e-6
+                 for t in range(B)]
+        for j in range(S):
+            for k in range(h):
+                for l in range(w):
+                    px = (l + 1 / (1 + math.exp(-v[i, j, 0, k, l]))) / w
+                    py = (k + 1 / (1 + math.exp(-v[i, j, 1, k, l]))) / h
+                    pw = math.exp(v[i, j, 2, k, l]) * \
+                        anchors[2 * mask[j]] / isz
+                    ph = math.exp(v[i, j, 3, k, l]) * \
+                        anchors[2 * mask[j] + 1] / isz
+                    best = max((iou((px, py, pw, ph), gb[i, t])
+                                for t in range(B) if valid[t]),
+                               default=0.0)
+                    if best > ignore_thresh:
+                        obj[i, j, k, l] = -1
+        for t in range(B):
+            if not valid[t]:
+                continue
+            gx, gy, gw, gh = gb[i, t]
+            gi, gj = int(gx * w), int(gy * h)
+            best_iou, best_n = 0.0, 0
+            for a in range(len(anchors) // 2):
+                ai = iou((0, 0, anchors[2 * a] / isz,
+                          anchors[2 * a + 1] / isz), (0, 0, gw, gh))
+                if ai > best_iou:
+                    best_iou, best_n = ai, a
+            if best_n not in mask:
+                continue
+            mi = mask.index(best_n)
+            sc = gs[i, t]
+            bw = (2.0 - gw * gh) * sc
+            loss[i] += sce(v[i, mi, 0, gj, gi], gx * w - gi) * bw
+            loss[i] += sce(v[i, mi, 1, gj, gi], gy * h - gj) * bw
+            loss[i] += abs(v[i, mi, 2, gj, gi]
+                           - math.log(gw * isz / anchors[2 * best_n])) * bw
+            loss[i] += abs(v[i, mi, 3, gj, gi]
+                           - math.log(gh * isz
+                                      / anchors[2 * best_n + 1])) * bw
+            obj[i, mi, gj, gi] = sc
+            for c in range(C):
+                loss[i] += sce(v[i, mi, 5 + c, gj, gi],
+                               pos_l if c == gl[i, t] else neg_l) * sc
+    for i in range(n):
+        for j in range(S):
+            for k in range(h):
+                for l in range(w):
+                    o = obj[i, j, k, l]
+                    if o > 1e-5:
+                        loss[i] += sce(v[i, j, 4, k, l], 1.0) * o
+                    elif o > -0.5:
+                        loss[i] += sce(v[i, j, 4, k, l], 0.0)
+    return loss
+
+
+class TestYoloLoss:
+    ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45]
+    MASK = [0, 1, 2]
+
+    def _data(self, seed=0, n=2, b=4, c=6, h=5):
+        rng = np.random.RandomState(seed)
+        x = (rng.randn(n, len(self.MASK) * (5 + c), h, h) * 0.5) \
+            .astype(np.float32)
+        gb = (rng.rand(n, b, 4) * 0.4 + 0.1).astype(np.float32)
+        gb[0, -1, 2] = 0.0              # invalid box must be skipped
+        gl = rng.randint(0, c, (n, b)).astype(np.int32)
+        return x, gb, gl, c
+
+    def test_matches_numpy_golden(self):
+        x, gb, gl, c = self._data()
+        out = V.yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gb), paddle.to_tensor(gl),
+            anchors=self.ANCHORS, anchor_mask=self.MASK, class_num=c,
+            ignore_thresh=0.5, downsample_ratio=32).numpy()
+        exp = np_yolo_loss(x, gb, gl, self.ANCHORS, self.MASK, c, 0.5, 32)
+        np.testing.assert_allclose(out, exp, rtol=2e-4, atol=2e-4)
+
+    def test_gt_score_and_no_smooth(self):
+        x, gb, gl, c = self._data(seed=7)
+        gs = np.random.RandomState(8).rand(*gl.shape).astype(np.float32)
+        gs[0, 0] = 0.0      # mixup score 0: assigned cell must still
+        #                     take the reference's NEGATIVE obj branch
+        out = V.yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gb), paddle.to_tensor(gl),
+            anchors=self.ANCHORS, anchor_mask=self.MASK, class_num=c,
+            ignore_thresh=0.7, downsample_ratio=32,
+            gt_score=paddle.to_tensor(gs), use_label_smooth=False).numpy()
+        exp = np_yolo_loss(x, gb, gl, self.ANCHORS, self.MASK, c, 0.7, 32,
+                           gs=gs, smooth=False)
+        np.testing.assert_allclose(out, exp, rtol=2e-4, atol=2e-4)
+
+    def test_gradients_finite(self):
+        import jax
+        import jax.numpy as jnp
+
+        x, gb, gl, c = self._data(seed=3)
+
+        def loss(xv):
+            return V.yolo_loss(
+                xv, paddle.to_tensor(gb), paddle.to_tensor(gl),
+                anchors=self.ANCHORS, anchor_mask=self.MASK, class_num=c,
+                ignore_thresh=0.5, downsample_ratio=32)._value.sum()
+
+        g = jax.grad(lambda xv: loss(xv))(jnp.asarray(x))
+        assert np.isfinite(np.asarray(g)).all()
+        assert float(jnp.abs(g).sum()) > 0
+
+    def test_colliding_gts_last_write_wins(self):
+        """Two gt boxes landing on the same (cell, anchor): the
+        reference's sequential loop leaves the LATER box's score in the
+        objectness mask (last-write-wins), even when that score is 0."""
+        c = 4
+        x = (np.random.RandomState(9)
+             .randn(1, len(self.MASK) * (5 + c), 5, 5) * 0.5) \
+            .astype(np.float32)
+        # same center cell + same w/h => same best anchor; scores 0.9, 0
+        gb = np.array([[[0.31, 0.31, 0.2, 0.2],
+                        [0.33, 0.33, 0.2, 0.2]]], np.float32)
+        gl = np.array([[1, 2]], np.int32)
+        gs = np.array([[0.9, 0.0]], np.float32)
+        out = V.yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gb), paddle.to_tensor(gl),
+            anchors=self.ANCHORS, anchor_mask=self.MASK, class_num=c,
+            ignore_thresh=0.5, downsample_ratio=32,
+            gt_score=paddle.to_tensor(gs)).numpy()
+        exp = np_yolo_loss(x, gb, gl, self.ANCHORS, self.MASK, c, 0.5, 32,
+                           gs=gs)
+        np.testing.assert_allclose(out, exp, rtol=2e-4, atol=2e-4)

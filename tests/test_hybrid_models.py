@@ -149,3 +149,60 @@ class TestHeadInsideTP:
             0, 128, (8, 32)).astype(np.int32)
         losses = [float(tr.step(toks)) for _ in range(4)]
         assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+class TestMemoryKnobs:
+    """Round-3 billion-param knobs (hybrid.py): reduced-precision state,
+    layer-scan schedule, eager-buffer freeing. The pinned_host offload
+    knobs need a TPU memory space and are exercised by bench.py on
+    hardware (XLA:CPU has no pinned_host, jax 0.9)."""
+
+    def _train(self, **kw):
+        paddle.seed(11)
+        from paddle_tpu.models import GPT, GPTConfig
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=4, max_seq_len=32)
+        net = GPT(cfg)
+        opt = paddle.optimizer.AdamW(5e-3, parameters=net.parameters())
+        s = _strategy(amp=False, recompute=True)
+        mesh = build_mesh_from_strategy(s)
+        tr = HybridPipelineTrainer(net, opt, s, mesh, n_micro=2, **kw)
+        toks = np.random.RandomState(0).randint(
+            0, 128, (8, 32)).astype(np.int32)
+        losses = [float(tr.step(toks)) for _ in range(8)]
+        return tr, losses
+
+    def test_bf16_state_trains_and_sync_restores(self):
+        tr, losses = self._train(param_dtype="bfloat16",
+                                 moment_dtype="bfloat16",
+                                 unroll_layers=False)
+        assert losses[-1] < losses[0], losses
+        model = tr.sync_to_layer()
+        for _, t in model.named_parameters():
+            assert t._value is not None
+
+    def test_free_eager_releases_then_sync_restores(self):
+        tr, losses = self._train(param_dtype="bfloat16", free_eager=True)
+        assert losses[-1] < losses[0], losses
+        # eager buffers were dropped during training...
+        # ...and sync_to_layer rebuilds them for checkpointing
+        model = tr.sync_to_layer()
+        sd = model.state_dict()
+        assert all(v is not None for v in sd.values())
+
+    def test_bf16_state_matches_f32_early_steps(self):
+        """bf16 master+moments stays within loss-noise of f32 for the
+        first steps (per-step drift bounded; long-horizon parity is the
+        125M loss-curve artifact, LOSSCURVE_r03.json)."""
+        _, l32 = self._train()
+        _, l16 = self._train(param_dtype="bfloat16",
+                             moment_dtype="bfloat16")
+        assert abs(l16[0] - l32[0]) < 1e-2, (l16[0], l32[0])
+        assert abs(l16[-1] - l32[-1]) < 0.15, (l16[-1], l32[-1])
+
+    def test_offload_params_requires_amp(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="amp"):
+            self._train(offload_params=True)

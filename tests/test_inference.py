@@ -138,3 +138,92 @@ def test_int8_predictor_matches_qat(tmp_path):
     assert pred.quantized
     out, = pred.run([x])
     np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
+
+
+def test_predictor_buckets_aux_input_and_fixed_output(tmp_path):
+    """Code-review r3 regressions: (a) an UNBATCHED aux input must keep
+    its shape across bucket artifacts and pass through run() unpadded;
+    (b) a fixed-size output whose leading dim equals a bucket size must
+    NOT be sliced to the request batch (out-aval comparison, not the
+    shape-match heuristic)."""
+    import paddle_tpu.nn as nn
+
+    class WithAux(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(6, 4)
+
+        def forward(self, x, scale_table):
+            # scale_table: unbatched [6]; second output: fixed [4] stats
+            y = self.fc(x * scale_table)
+            return y, self.fc.weight.sum(axis=0)
+
+    paddle.seed(9)
+    net = WithAux()
+    net.eval()
+    path = str(tmp_path / "aux_b")
+    paddle.jit.save(net, path, input_spec=[
+        InputSpec([2, 6], "float32", "x"),
+        InputSpec([6], "float32", "scale_table"),
+    ], batch_buckets=[4])
+    pred = create_predictor(Config(path))
+    aux = np.linspace(0.5, 1.5, 6).astype(np.float32)
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    y, stats = pred.run([x, aux])
+    eager_y, eager_stats = net(paddle.to_tensor(x), paddle.to_tensor(aux))
+    assert y.shape == (3, 4)
+    # the fixed [4] output must come back whole even though 4 == bucket
+    assert stats.shape == (4,)
+    np.testing.assert_allclose(y, np.asarray(eager_y._value),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(stats, np.asarray(eager_stats._value),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_predictor_pad_to_base_batch_fixed_output(tmp_path):
+    """No buckets: a batch-2 request padded up to the BASE batch (4)
+    must not slice a fixed [4] output (meta['batched_outputs'] path),
+    and an aux input whose length equals the request batch must pass
+    through unpadded (meta['batched_inputs'] path)."""
+    import paddle_tpu.nn as nn
+
+    class WithAux(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(6, 4)
+
+        def forward(self, x, table):
+            return self.fc(x * table), self.fc.weight.sum(axis=0)
+
+    paddle.seed(10)
+    net = WithAux()
+    net.eval()
+    path = str(tmp_path / "base_pad")
+    paddle.jit.save(net, path, input_spec=[
+        InputSpec([4, 6], "float32", "x"),
+        InputSpec([6], "float32", "table"),
+    ])
+    pred = create_predictor(Config(path))
+    aux = np.linspace(0.5, 1.5, 6).astype(np.float32)
+    x2 = np.random.RandomState(1).randn(2, 6).astype(np.float32)
+    y, stats = pred.run([x2, aux])
+    assert y.shape == (2, 4)
+    assert stats.shape == (4,)          # fixed output NOT sliced to 2
+    e_y, e_s = net(paddle.to_tensor(x2), paddle.to_tensor(aux))
+    np.testing.assert_allclose(y, np.asarray(e_y._value),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(stats, np.asarray(e_s._value),
+                               rtol=1e-4, atol=1e-4)
+    # aux length == request batch (6) with a bigger bucket: unpadded
+    path2 = str(tmp_path / "aux_coincide")
+    paddle.jit.save(net, path2, input_spec=[
+        InputSpec([2, 6], "float32", "x"),
+        InputSpec([6], "float32", "table"),
+    ], batch_buckets=[8])
+    pred2 = create_predictor(Config(path2))
+    x6 = np.random.RandomState(2).randn(6, 6).astype(np.float32)
+    y6, s6 = pred2.run([x6, aux])
+    assert y6.shape == (6, 4) and s6.shape == (4,)
+    e_y6, _ = net(paddle.to_tensor(x6), paddle.to_tensor(aux))
+    np.testing.assert_allclose(y6, np.asarray(e_y6._value),
+                               rtol=1e-4, atol=1e-4)
