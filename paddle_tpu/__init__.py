@@ -20,7 +20,12 @@ from .core import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,  # noqa: F401
                    set_default_dtype, set_flags)
 from .core.place import device_count, get_device, set_device  # noqa: F401
 from .core.rng import get_rng_state, set_rng_state  # noqa: F401
-from .framework import Parameter, Tensor, to_tensor  # noqa: F401
+# the reference's CUDA-named rng accessors map to the device rng stream
+from .core.rng import get_rng_state as get_cuda_rng_state  # noqa: F401
+from .core.rng import set_rng_state as set_cuda_rng_state  # noqa: F401
+from .device import get_cudnn_version, is_compiled_with_xpu  # noqa: F401
+from .framework import ParamAttr, Parameter, Tensor, to_tensor  # noqa: F401
+from .framework.printoptions import set_printoptions  # noqa: F401
 
 # dtype names at top level (paddle.float32 style)
 from .core.dtype import (bfloat16, bool_, complex64, complex128,  # noqa: F401
@@ -38,8 +43,21 @@ from . import optimizer  # noqa: F401,E402
 from . import io  # noqa: F401,E402
 from . import metric  # noqa: F401,E402
 from . import amp  # noqa: F401,E402
+from . import device  # noqa: F401,E402
+
+
+def __getattr__(name):  # PEP 562: lazy fluid (it imports back into here)
+    if name == "fluid":
+        import importlib
+
+        mod = importlib.import_module(".fluid", __name__)
+        globals()["fluid"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 from . import jit  # noqa: F401,E402
+from .hapi import callbacks  # noqa: F401,E402
 from . import static  # noqa: F401,E402
+from .static import create_parameter  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import ops  # noqa: F401,E402

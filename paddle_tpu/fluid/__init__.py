@@ -1,0 +1,41 @@
+"""paddle.fluid compat namespace (reference: python/paddle/fluid/).
+
+The reference's 2.x API keeps the 1.x ``fluid`` package importable and
+most user code of the era reaches through it. Here it is a thin façade
+over the real modules: the dygraph engine is the tape (autograd/), the
+layer library is nn/, static programs are Plans (static/). Only names
+with a meaningful TPU translation are carried; the deleted-by-design
+machinery (Executor scopes, ParallelExecutor, transpilers) raises with
+pointers to the replacement (SURVEY §7).
+"""
+from .. import nn as _nn  # noqa: F401
+from ..core.flags import get_flags, set_flags  # noqa: F401
+from ..core.place import (CPUPlace, CUDAPinnedPlace, CUDAPlace,  # noqa: F401
+                          TPUPlace, XPUPlace, device_count, is_compiled_with_tpu)
+from ..framework.param_attr import ParamAttr  # noqa: F401
+from ..framework.tensor import Parameter, Tensor  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from ..static import (InputSpec, Program, default_main_program,  # noqa: F401
+                      default_startup_program)
+from .. import io  # noqa: F401
+from .. import metric as metrics  # noqa: F401
+from .. import optimizer  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..autograd import grad as gradients  # noqa: F401
+from . import dygraph, layers, nets  # noqa: F401
+from ..io import DataLoader  # noqa: F401
+
+is_compiled_with_cuda = is_compiled_with_tpu  # CUDA-era probe → TPU
+
+
+class Executor:
+    """The reference Executor runs ProgramDescs over Scopes
+    (fluid/executor.py). Functional XLA execution has no Scope; static
+    programs are ``paddle.static.Plan`` artifacts run via ``plan.run``/
+    ``jit.load``. Kept only to give 1.x scripts a clear error."""
+
+    def __init__(self, place=None):
+        raise NotImplementedError(
+            "fluid.Executor is superseded: trace the model with "
+            "paddle.jit.to_static / save, run via paddle.static.Plan or "
+            "paddle.inference.create_predictor (SURVEY §7 row N17)")

@@ -1,0 +1,30 @@
+"""fluid.dygraph compat (reference: fluid/dygraph/{base,layers,jit}.py).
+
+The tape IS always on in this framework (eager by default, like
+paddle 2.x), so ``guard()`` is a no-op context and ``enabled()`` is
+True; ``to_variable`` is ``to_tensor``.
+"""
+import contextlib
+
+from ..framework import to_tensor as to_variable  # noqa: F401
+from ..jit import TracedLayer  # noqa: F401
+from ..nn import Layer  # noqa: F401
+from ..nn import Embedding, Linear  # noqa: F401
+from ..nn.layer.container import LayerList, Sequential  # noqa: F401
+from ..autograd import no_grad  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Dygraph mode is the default; the guard is a compat no-op."""
+    yield
+
+
+def enabled():
+    return True
+
+
+def to_static(*a, **kw):
+    from ..jit import to_static as _ts
+
+    return _ts(*a, **kw)

@@ -1,0 +1,62 @@
+"""fluid.layers compat: the 1.x flat op namespace (reference:
+fluid/layers/{nn,tensor,control_flow,loss}.py — thousands of lines of
+LayerHelper plumbing whose TPU translation is simply the modern
+functional/tensor ops under their legacy names).
+"""
+import paddle_tpu as _p
+import paddle_tpu.nn.functional as _F
+from ..nn.functional import *  # noqa: F401,F403
+from ..tensor import *  # noqa: F401,F403
+from ..static.nn import case, cond, switch_case, while_loop  # noqa: F401
+from ..tensor.creation import (arange, assign, full, linspace,  # noqa: F401
+                               ones, ones_like, zeros, zeros_like)
+from ..tensor import concat, reshape, shape, slice, split, squeeze  # noqa: F401
+
+# 1.x names whose modern spelling differs
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None,
+                  name=None):
+    """1.x argument order (shape, dtype, value) vs modern full(shape,
+    value, dtype) (reference fluid/layers/tensor.py fill_constant)."""
+    return full(shape, value, dtype=dtype)
+reduce_sum = _p.sum
+reduce_mean = _p.mean
+reduce_max = _p.max
+reduce_min = _p.min
+elementwise_add = _p.add
+elementwise_sub = _p.subtract
+elementwise_mul = _p.multiply
+elementwise_div = _p.divide
+hard_sigmoid = _F.hardsigmoid
+hard_swish = _F.hardswish
+soft_relu = _F.softplus
+create_tensor = _p.zeros
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,  # noqa: A002
+       act=None, name=None):
+    """The 1.x fully-connected layer-op (reference fluid/layers/nn.py
+    fc): creates (or reuses under a ParamAttr name) a weight, matmuls,
+    adds bias, applies act. Eager translation: a fresh Linear module's
+    forward — for persistent weights use nn.Linear directly."""
+    import numpy as np
+
+    from .. import nn as _nn
+
+    feat = 1
+    for d in input.shape[num_flatten_dims:]:
+        feat *= int(d)
+    lin = _nn.Linear(feat, size, weight_attr=param_attr,
+                     bias_attr=bias_attr)
+    x = input.reshape(list(input.shape[:num_flatten_dims]) + [feat])
+    out = lin(x)
+    if act:
+        out = getattr(_F, act)(out)
+    return out
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    raise NotImplementedError(
+        "fluid.layers.data builds static graph feeds; trace with "
+        "paddle.jit.to_static + InputSpec instead (SURVEY §7)")

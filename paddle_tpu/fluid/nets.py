@@ -1,0 +1,28 @@
+"""fluid.nets compat: the 1.x composite blocks (reference:
+fluid/nets.py — simple_img_conv_pool, glu, scaled_dot_product_attention
+composed from layer ops).
+"""
+import paddle_tpu.nn.functional as _F
+from ..nn.functional import glu, scaled_dot_product_attention  # noqa: F401
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,  # noqa: A002
+                         pool_stride, pool_padding=0, pool_type="max",
+                         conv_stride=1, conv_padding=0, conv_dilation=1,
+                         conv_groups=1, param_attr=None, bias_attr=None,
+                         act=None, use_cudnn=True):
+    """conv2d → act → pool (reference nets.py:31, full parameter set).
+    Eager translation with a fresh conv; use nn.Conv2D for persistent
+    weights. ``use_cudnn`` is accepted for parity (XLA picks kernels)."""
+    from .. import nn as _nn
+
+    conv = _nn.Conv2D(int(input.shape[1]), num_filters, filter_size,
+                      stride=conv_stride, padding=conv_padding,
+                      dilation=conv_dilation, groups=conv_groups,
+                      weight_attr=param_attr, bias_attr=bias_attr)
+    out = conv(input)
+    if act:
+        out = getattr(_F, act)(out)
+    pool = _F.max_pool2d if pool_type == "max" else _F.avg_pool2d
+    return pool(out, kernel_size=pool_size, stride=pool_stride,
+                padding=pool_padding)

@@ -1,3 +1,4 @@
 """Framework core: Tensor/Parameter plus program-plan utilities
 (reference: paddle/fluid/framework/)."""
+from .param_attr import ParamAttr  # noqa: F401
 from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

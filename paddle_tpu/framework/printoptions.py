@@ -1,0 +1,22 @@
+"""paddle.set_printoptions (reference: python/paddle/tensor/to_string.py).
+
+Tensor __repr__ prints via numpy, so the implementation simply bridges
+to numpy's printoptions with the reference's parameter names.
+"""
+import numpy as np
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)

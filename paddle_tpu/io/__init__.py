@@ -88,6 +88,32 @@ class ChainDataset(IterableDataset):
             yield from d
 
 
+class ComposeDataset(Dataset):
+    """Zip same-length datasets: sample i concatenates the fields of
+    every dataset's sample i (reference: fluid/dataloader/dataset.py
+    ComposeDataset)."""
+
+    def __init__(self, datasets):
+        if not datasets:
+            raise ValueError("datasets cannot be empty")
+        self.datasets = list(datasets)
+        n = len(self.datasets[0])
+        for d in self.datasets[1:]:
+            if len(d) != n:
+                raise ValueError("ComposeDataset datasets must share "
+                                 "one length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            s = d[idx]
+            out.extend(s if isinstance(s, (tuple, list)) else [s])
+        return tuple(out)
+
+
 def random_split(dataset, lengths, generator=None):
     idx = rng._numpy_generator.permutation(len(dataset))
     out, ofs = [], 0

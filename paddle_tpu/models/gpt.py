@@ -72,6 +72,11 @@ class GPTConfig:
                          max_seq_len=2048)
 
     @staticmethod
+    def gpt3_2_7b():
+        return GPTConfig(hidden_size=2560, num_layers=32, num_heads=32,
+                         max_seq_len=2048)
+
+    @staticmethod
     def gpt3_6_7b():
         return GPTConfig(hidden_size=4096, num_layers=32, num_heads=32,
                          max_seq_len=2048)

@@ -8,7 +8,10 @@ from .layer.activation import (CELU, ELU, GELU, SELU, Hardshrink,  # noqa: F401
                                ReLU, ReLU6, Sigmoid, Silu, Softmax, Softplus,
                                Softshrink, Softsign, Swish, Tanh, Tanhshrink,
                                ThresholdedReLU)
+from . import utils  # noqa: F401
+from .layer.decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity,  # noqa: F401
+                           PairwiseDistance,
                            Dropout, Dropout2D, Dropout3D, Embedding, Flatten,
                            Identity, Linear, Pad1D, Pad2D, Pad3D,
                            PixelShuffle, Unfold, Upsample,
@@ -21,7 +24,7 @@ from .layer.conv import (Conv1D, Conv1DTranspose, Conv2D,  # noqa: F401
 from .layer.layers import Layer  # noqa: F401
 from .layer.loss import (BCELoss, BCEWithLogitsLoss,  # noqa: F401
                          CosineEmbeddingLoss, CrossEntropyLoss, CTCLoss,
-                         HingeEmbeddingLoss, KLDivLoss, L1Loss,
+                         HingeEmbeddingLoss, HSigmoidLoss, KLDivLoss, L1Loss,
                          MarginRankingLoss, MSELoss, NLLLoss, SmoothL1Loss,
                          TripletMarginLoss)
 from .layer.norm import (BatchNorm, BatchNorm1D, BatchNorm2D,  # noqa: F401

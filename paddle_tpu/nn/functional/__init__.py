@@ -6,7 +6,8 @@ from .activation import (celu, elu, gelu, gumbel_softmax, hardshrink,  # noqa: F
                          log_sigmoid, log_softmax, maxout, mish, prelu, relu,
                          relu6, selu, sigmoid, silu, softmax, softplus,
                          softshrink, softsign, swish, tanh, tanhshrink,
-                         thresholded_relu, glu)
+                         thresholded_relu, glu, relu_, elu_, softmax_,
+                         tanh_)
 from .attention import scaled_dot_product_attention  # noqa: F401
 from ...ops.fused_ce import fused_linear_cross_entropy  # noqa: F401
 from .common import (alpha_dropout, bilinear, cosine_similarity,  # noqa: F401
@@ -25,6 +26,9 @@ from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F
                    local_response_norm, normalize, rms_norm)
 from .vision import (affine_grid, grid_sample, temporal_shift,  # noqa: F401
                      deform_conv2d)
+from . import extension  # noqa: F401
+from .extension import diag_embed, gather_tree  # noqa: F401
+from .loss import dice_loss, hsigmoid_loss, npair_loss  # noqa: F401
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
                       adaptive_avg_pool3d, adaptive_max_pool3d,
                       adaptive_max_pool1d, adaptive_max_pool2d, avg_pool1d,

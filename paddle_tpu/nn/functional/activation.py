@@ -154,3 +154,28 @@ def glu(x, axis=-1, name=None):
         return a * jax.nn.sigmoid(b)
 
     return apply(f, x, name="glu")
+
+
+def relu_(x, name=None):
+    """Inplace relu (reference: paddle.nn.functional.relu_)."""
+    x._value = jax.nn.relu(x._value)
+    return x
+
+
+def elu_(x, alpha=1.0, name=None):
+    """Inplace elu."""
+    x._value = jax.nn.elu(x._value, alpha)
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    """Inplace softmax."""
+    v = x._value if dtype is None else x._value.astype(dtype)
+    x._value = jax.nn.softmax(v, axis=axis)
+    return x
+
+
+def tanh_(x, name=None):
+    """Inplace tanh."""
+    x._value = jnp.tanh(x._value)
+    return x

@@ -1,0 +1,57 @@
+"""Extension functionals: diag_embed, gather_tree (reference:
+python/paddle/nn/functional/extension.py; kernels
+operators/diag_embed_op.cc, operators/gather_tree_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor._helper import apply
+
+__all__ = ["diag_embed", "gather_tree"]
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Embed the last dim of ``input`` as a diagonal of a new matrix
+    spanning (dim1, dim2) (reference: nn/functional/extension.py
+    diag_embed)."""
+    def f(v):
+        n = v.shape[-1]
+        size = n + abs(offset)
+        out_ndim = v.ndim + 1
+        d1 = dim1 % out_ndim
+        d2 = dim2 % out_ndim
+        if d1 == d2:
+            raise ValueError("dim1 and dim2 cannot be the same")
+        base = jnp.zeros(v.shape[:-1] + (size, size), v.dtype)
+        i = jnp.arange(n)
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        base = base.at[..., r, c].set(v)
+        # the new matrix lives at the last two axes (row, col); place
+        # row at dim1 and col at dim2
+        return jnp.moveaxis(base, (-2, -1), (d1, d2))
+
+    return apply(f, input, name="diag_embed")
+
+
+def gather_tree(ids, parents):
+    """Backtrack beam-search step outputs into full sequences
+    (reference: operators/gather_tree_op.cc — walk parent pointers from
+    the last step backwards). ids/parents: [max_time, batch, beam]."""
+    def f(idv, pv):
+        t, b, k = idv.shape
+        beams = jnp.broadcast_to(jnp.arange(k, dtype=pv.dtype), (b, k))
+
+        def step(carry, inp):
+            beam = carry                       # [B, K] beam to follow
+            id_t, par_t = inp
+            tok = jnp.take_along_axis(id_t, beam, axis=1)
+            parent = jnp.take_along_axis(par_t, beam, axis=1)
+            return parent, tok
+
+        _, toks = jax.lax.scan(step, beams, (idv[::-1], pv[::-1]))
+        return toks[::-1]
+
+    return apply(f, ids, parents, differentiable=False, name="gather_tree")

@@ -260,3 +260,87 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
     return apply(f, log_probs, labels, input_lengths, label_lengths,
                  name="ctc_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):  # noqa: A002
+    """Dice coefficient loss over the last (class) axis (reference:
+    fluid/layers/nn.py:7051 — one-hot the label, intersect per sample,
+    1 − 2·inter/total, mean over batch)."""
+    def f(x, lbl):
+        if lbl.shape[-1] == 1:
+            lbl = lbl.squeeze(-1)
+        lv = jax.nn.one_hot(lbl, x.shape[-1], dtype=x.dtype)
+        axes = tuple(range(1, x.ndim))
+        inter = jnp.sum(x * lv, axes)
+        denom = jnp.sum(x, axes) + jnp.sum(lv, axes)
+        return jnp.mean(1.0 - 2.0 * inter / (denom + epsilon))
+
+    return apply(f, input, label, name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (reference: fluid/layers/loss.py:1653 —
+    same-label soft targets over the anchor·positiveᵀ similarity matrix
+    plus Beta·l2_reg embedding regularization)."""
+    def f(a, p, lbl):
+        n = lbl.shape[0]
+        lv = lbl.reshape(n, 1)
+        soft = (lv == lv.T).astype(jnp.float32)
+        soft = soft / jnp.sum(soft, 1, keepdims=True)
+        l2 = (jnp.mean(jnp.sum(jnp.square(a), 1))
+              + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25 * l2_reg
+        sim = (a @ p.T).astype(jnp.float32)
+        # per-position soft CE, batch-summed under the soft labels then
+        # meaned (reference loss.py:1712-1716)
+        ce = -jnp.sum(soft * jax.nn.log_softmax(sim, -1), -1)   # [N]
+        return l2 + jnp.mean(jnp.sum(soft * ce[:, None], 0))
+
+    return apply(f, anchor, positive, labels, name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss [N, 1] (reference: operators/
+    hierarchical_sigmoid_op.h + math/matrix_bit_code.h SimpleCode: leaf
+    id ``label + num_classes`` in an implicit heap, weight row =
+    prefix − 1, binary target = suffix bit; sigmoid-CE summed over the
+    path). ``path_table``/``path_code`` give the custom-tree variant;
+    ``is_sparse`` is a storage hint (dense XLA gathers either way)."""
+    if num_classes < 2 and path_table is None:
+        raise ValueError("num_classes must be >= 2 for the default tree")
+
+    def f(x, lbl, w, *rest):
+        b = rest[0] if bias is not None else None
+        if path_table is None:
+            c = lbl.astype(jnp.int32) + num_classes
+            max_len = int(num_classes).bit_length()
+            js = jnp.arange(max_len)
+            # step j is on the path iff the prefix above it is non-root:
+            # c >> (j+1) > 0  (exact integer arithmetic — float log2
+            # mis-rounds for class counts near 2^24)
+            valid = (c[:, None] >> (js[None, :] + 1)) > 0     # [N, L]
+            idx = jnp.where(valid, (c[:, None] >> (js[None, :] + 1)) - 1,
+                            0)
+            bit = ((c[:, None] >> js[None, :]) & 1).astype(x.dtype)
+        else:
+            pt, pc = rest[-2], rest[-1]
+            idx = jnp.maximum(pt, 0).astype(jnp.int32)
+            valid = pt >= 0
+            bit = pc.astype(x.dtype)
+        wrows = w[idx]                                        # [N, L, F]
+        logits = jnp.einsum("nlf,nf->nl", wrows.astype(jnp.float32),
+                            x.astype(jnp.float32))
+        if b is not None:
+            logits = logits + b.reshape(-1)[idx].astype(jnp.float32)
+        ce = jnp.maximum(logits, 0) - logits * bit.astype(jnp.float32) \
+            + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(jnp.where(valid, ce, 0.0), -1,
+                       keepdims=True).astype(x.dtype)
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    if path_table is not None:
+        args += [path_table, path_code]
+    return apply(f, *args, name="hsigmoid_loss")

@@ -215,3 +215,27 @@ class Unfold(Layer):
     def forward(self, x):
         return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
                         self.dilations)
+
+
+class PairwiseDistance(Layer):
+    """p-norm distance between row pairs (reference:
+    nn/layer/distance.py PairwiseDistance over dist op)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        from ...tensor._helper import apply
+
+        def f(a, b):
+            d = (a - b).astype(jnp.float32) + self.epsilon
+            out = jnp.linalg.norm(jnp.abs(d), ord=self.p, axis=-1,
+                                  keepdims=self.keepdim)
+            return out.astype(a.dtype)
+
+        return apply(f, x, y, name="pairwise_distance")

@@ -1,0 +1,186 @@
+"""Seq2seq decoding API: Decoder / BeamSearchDecoder / dynamic_decode
+(reference: python/paddle/fluid/layers/rnn.py:866,1581 — the Decoder
+protocol the reference wires into a while_loop over LoDTensorArrays;
+here the loop is a plain eager loop over jnp values, and the
+transformer KV-cache path has its own compiled scan in ops/decoding.py).
+
+The beam bookkeeping (scores, parent backtrack via gather_tree) follows
+the reference's beam_search / beam_search_decode op pair
+(operators/beam_search_op.cc, beam_search_decode_op.cc).
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...tensor._helper import unwrap
+from .layers import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _map(fn, tree):
+    return jax.tree_util.tree_map(fn, tree)
+
+
+class Decoder:
+    """Abstract decode protocol: initialize → step* → finalize
+    (reference rnn.py Decoder)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over an RNN cell (reference rnn.py:866).
+
+    ``embedding_fn`` maps token ids → cell inputs; ``output_fn`` maps
+    cell outputs → vocab logits. Finished beams are held in place: all
+    tokens except ``end_token`` score −inf so the beam keeps its score.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished",
+                         "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- beam helpers (reference tile_beam_merge_with_batch et al.) -------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (repeat each batch row beam times)."""
+        v = unwrap(x)
+        out = jnp.repeat(v, beam_size, axis=0)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+    def _merge(self, v):
+        """[B, beam, ...] -> [B*beam, ...]"""
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v):
+        """[B*beam, ...] -> [B, beam, ...]"""
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        cs = _map(unwrap, initial_cell_states)
+        leaf = jax.tree_util.tree_leaves(cs)[0]
+        batch = leaf.shape[0]
+        k = self.beam_size
+        cell_states = _map(
+            lambda v: self._merge(jnp.broadcast_to(
+                v[:, None], (batch, k) + v.shape[1:])), cs)
+        # beam 0 active, others -inf so the first step seeds from beam 0
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (k - 1), jnp.float32), (batch, 1))
+        finished = jnp.zeros((batch, k), bool)
+        lengths = jnp.zeros((batch, k), jnp.int32)
+        tokens = jnp.full((batch * k,), self.start_token, jnp.int32)
+        inputs = self.embedding_fn(Tensor(tokens)) if self.embedding_fn \
+            else Tensor(tokens)
+        return inputs, self.StateWrapper(cell_states, log_probs, finished,
+                                         lengths), finished
+
+    def step(self, time, inputs, states, **kwargs):
+        k = self.beam_size
+        cell_out, next_cs = self.cell(inputs, _map(Tensor,
+                                                   states.cell_states))
+        logits = self.output_fn(cell_out) if self.output_fn else cell_out
+        logits = unwrap(logits).astype(jnp.float32)        # [B*beam, V]
+        v = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, -1)
+        step_lp = self._split(step_lp)                     # [B, beam, V]
+        # finished beams: only end_token continues, at score 0
+        noend = jnp.full((v,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(states.finished[..., None], noend[None, None],
+                            step_lp)
+        scores = states.log_probs[..., None] + step_lp     # [B, beam, V]
+        flat = scores.reshape(scores.shape[0], -1)
+        top, idx = jax.lax.top_k(flat, k)                  # [B, beam]
+        parent = (idx // v).astype(jnp.int32)
+        token = (idx % v).astype(jnp.int32)
+
+        def gather_beam(x):
+            s = self._split(x)
+            g = jnp.take_along_axis(
+                s, parent.reshape(parent.shape + (1,) * (s.ndim - 2)),
+                axis=1)
+            return self._merge(g)
+
+        next_cs = _map(lambda t: gather_beam(unwrap(t)), next_cs)
+        fin = jnp.take_along_axis(states.finished, parent, 1)
+        lengths = jnp.take_along_axis(states.lengths, parent, 1)
+        lengths = jnp.where(fin, lengths, lengths + 1)
+        fin = fin | (token == self.end_token)
+        next_states = self.StateWrapper(next_cs, top, fin, lengths)
+        next_inputs = self.embedding_fn(Tensor(token.reshape(-1))) \
+            if self.embedding_fn else Tensor(token.reshape(-1))
+        out = self.OutputWrapper(top, token, parent)
+        return out, next_states, next_inputs, fin
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack parent pointers into whole sequences
+        (reference beam_search_decode_op.cc → F.gather_tree)."""
+        from ..functional.extension import gather_tree
+
+        ids = gather_tree(Tensor(outputs.predicted_ids),
+                          Tensor(outputs.parent_ids))
+        return self.OutputWrapper(Tensor(outputs.scores), ids,
+                                  Tensor(outputs.parent_ids)), final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every beam finishes or ``max_step_num``
+    (reference rnn.py:1581). Eager loop (dygraph semantics); outputs are
+    stacked over time — [time, ...] when ``output_time_major`` else
+    batch-major."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    t = 0
+    limit = max_step_num if max_step_num is not None else 1 << 30
+    while t < limit:
+        out, states, inputs, finished = decoder.step(t, inputs, states,
+                                                     **kwargs)
+        step_outputs.append(out)
+        t += 1
+        if bool(jnp.all(unwrap(finished))):
+            break
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([unwrap(x) for x in xs], 0), *step_outputs)
+    lengths = getattr(states, "lengths", None)
+    final, final_states = decoder.finalize(stacked, states, lengths)
+
+    def to_batch_major(x):
+        v = unwrap(x)
+        return Tensor(jnp.swapaxes(v, 0, 1)) if not output_time_major \
+            else Tensor(v)
+
+    final = jax.tree_util.tree_map(
+        to_batch_major, final,
+        is_leaf=lambda x: isinstance(x, (Tensor, jnp.ndarray)))
+    if return_length:
+        return final, final_states, Tensor(lengths)
+    return final, final_states

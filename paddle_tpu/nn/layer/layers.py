@@ -102,19 +102,9 @@ class Layer:
     def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
                          default_initializer=None) -> Optional[Parameter]:
         """reference: layers.py create_parameter + LayerHelper."""
-        attr = ParamAttr._to_attr(attr)
-        if attr is None:
-            return None
-        dtype = dtype_mod.convert_dtype(dtype) or self._dtype
-        init = attr.initializer or default_initializer or (
-            I.Constant(0.0) if is_bias else I.XavierNormal())
-        value = init(shape, dtype)
-        p = Parameter(value, name=attr.name or _unique_name("param"),
-                      trainable=attr.trainable)
-        p.optimize_attr["learning_rate"] = attr.learning_rate
-        p.regularizer = attr.regularizer
-        p.need_clip = attr.need_clip
-        return p
+        return build_parameter(shape, attr, dtype, is_bias,
+                               default_initializer,
+                               fallback_dtype=self._dtype)
 
     def create_tensor(self, name=None, persistable=None, dtype=None):
         import jax.numpy as jnp
@@ -304,3 +294,24 @@ class _HookRemoveHelper:
 
     def remove(self):
         self._hooks.pop(self._id, None)
+
+
+def build_parameter(shape, attr=None, dtype=None, is_bias=False,
+                    default_initializer=None, name=None,
+                    fallback_dtype="float32"):
+    """Shared ParamAttr→Parameter resolution (Layer.create_parameter and
+    static.create_parameter both delegate here so attr semantics cannot
+    drift)."""
+    attr = ParamAttr._to_attr(attr)
+    if attr is None:
+        return None
+    dtype = dtype_mod.convert_dtype(dtype) or fallback_dtype
+    init = attr.initializer or default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierNormal())
+    value = init(shape, dtype)
+    p = Parameter(value, name=name or attr.name or _unique_name("param"),
+                  trainable=attr.trainable)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p

@@ -144,3 +144,34 @@ class TripletMarginLoss(Layer):
         return F.triplet_margin_loss(input, positive, negative, self.margin,
                                      self.p, self.epsilon, self.swap,
                                      self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid layer over F.hsigmoid_loss (reference:
+    nn/layer/loss.py HSigmoidLoss / fluid hsigmoid). Owns the
+    [num_classes-1, feature_size] internal-node weights."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        self.is_sparse = is_sparse
+        n_nodes = num_classes if is_custom else num_classes - 1
+        self.weight = self.create_parameter(
+            [n_nodes, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [n_nodes, 1], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        from .. import functional as F
+
+        if self.is_custom and path_table is None:
+            raise ValueError("is_custom=True requires path_table/path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               bias=self.bias, path_table=path_table,
+                               path_code=path_code,
+                               is_sparse=self.is_sparse)

@@ -1,0 +1,4 @@
+"""paddle.nn.utils (reference: python/paddle/nn/utils/weight_norm_hook.py)."""
+from .weight_norm_hook import remove_weight_norm, weight_norm  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm"]

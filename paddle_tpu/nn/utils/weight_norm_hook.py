@@ -1,0 +1,66 @@
+"""Weight normalization (reference: nn/utils/weight_norm_hook.py:155).
+
+The reference installs a forward pre-hook recomputing ``weight`` from
+(g, v) each call; here the same decomposition w = g * v/||v|| is
+recomputed inside a forward wrapper **with tape-tracked tensor ops**, so
+``loss.backward()`` reaches weight_g / weight_v (the recomputed weight
+is a plain Tensor in ``__dict__`` — never re-registered as a
+Parameter, so optimizers and state_dict see only g and v).
+"""
+from __future__ import annotations
+
+from ...framework.tensor import Parameter, Tensor
+from ...tensor import sqrt, square
+from ...tensor import sum as tsum
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except(v: Tensor, dim) -> Tensor:
+    if dim is None:
+        return sqrt(tsum(square(v)))
+    axes = [i for i in range(len(v.shape)) if i != dim]
+    return sqrt(tsum(square(v), axis=axes, keepdim=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Split ``layer.<name>`` into <name>_g (magnitude) and <name>_v
+    (direction); forward recomputes the weight from them."""
+    w = layer._parameters[name]
+    p_v = Parameter(w._value)
+    p_g = Parameter(_norm_except(p_v, dim)._value)
+    del layer._parameters[name]
+    layer.__dict__.pop(name, None)
+    setattr(layer, name + "_g", p_g)
+    setattr(layer, name + "_v", p_v)
+
+    orig_forward = layer.forward
+
+    def wrapped(*args, **kw):
+        # tape-tracked recompute: grads flow to g and v through here
+        w_t = p_v * (p_g / (_norm_except(p_v, dim) + 1e-12))
+        setattr(layer, name, w_t)        # plain Tensor -> __dict__ only
+        return orig_forward(*args, **kw)
+
+    layer._wn_orig_forward = orig_forward
+    layer._wn_name = name
+    layer._wn_dim = dim
+    layer.forward = wrapped
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold (g, v) back into a plain ``weight`` and restore forward."""
+    if not hasattr(layer, "_wn_orig_forward"):
+        return layer
+    dim = layer._wn_dim
+    p_v = layer._parameters.pop(name + "_v")
+    p_g = layer._parameters.pop(name + "_g")
+    layer.__dict__.pop(name + "_v", None)
+    layer.__dict__.pop(name + "_g", None)
+    layer.__dict__.pop(name, None)
+    w = p_v * (p_g / (_norm_except(p_v, dim) + 1e-12))
+    setattr(layer, name, Parameter(w._value))
+    layer.forward = layer._wn_orig_forward
+    del layer._wn_orig_forward
+    return layer

@@ -51,3 +51,16 @@ def default_main_program():
 
 def default_startup_program():
     return Program()
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Standalone trainable parameter (reference:
+    python/paddle/fluid/layers/tensor.py create_parameter; also exported
+    as ``paddle.create_parameter``). Delegates to the same resolution as
+    Layer.create_parameter (nn/layer/layers.py build_parameter)."""
+    from ..framework.param_attr import ParamAttr
+    from ..nn.layer.layers import build_parameter
+
+    return build_parameter(shape, attr if attr is not None else ParamAttr(),
+                           dtype, is_bias, default_initializer, name=name)

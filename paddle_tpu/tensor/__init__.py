@@ -7,6 +7,8 @@ math_op_patch.py and python/paddle/tensor/__init__.py).
 """
 from __future__ import annotations
 
+from .array import array_length, array_read, array_write, create_array  # noqa: F401
+
 from ..framework.tensor import Parameter, Tensor, to_tensor  # noqa: F401
 from . import creation, linalg, logic, manipulation, math, random, search, stat
 from .creation import *  # noqa: F401,F403
@@ -19,14 +21,17 @@ from .logic import (allclose, bitwise_and, bitwise_not, bitwise_or,  # noqa: F40
                     is_empty, is_tensor, isclose, isin, less_equal, less_than,
                     logical_and, logical_not, logical_or, logical_xor,
                     not_equal)
-from .manipulation import (broadcast_tensors, broadcast_to, cast,  # noqa: F401
+from .manipulation import (broadcast_shape, broadcast_tensors,  # noqa: F401
+                           broadcast_to, cast,
                            chunk, concat, crop, expand, expand_as, flatten,
                            flip, gather, gather_nd, index_sample, index_select,
                            masked_fill, masked_select, moveaxis,
                            put_along_axis, repeat_interleave, reshape,
                            reshape_, roll, rot90, scatter, scatter_,
                            scatter_nd, scatter_nd_add, shard_index, slice,
-                           split, squeeze, stack, strided_slice, swapaxes, t,
+                           rank, reverse, shape, split, squeeze, squeeze_,
+                           stack, strided_slice, swapaxes, t, unstack,
+                           unsqueeze_,
                            take_along_axis, tile, transpose, unbind, unique,
                            unique_consecutive, unsqueeze, where)
 from .math import *  # noqa: F401,F403

@@ -1,0 +1,39 @@
+"""Tensor-array ops (reference: python/paddle/tensor/array.py over
+LoDTensorArray + operators/array_read_write ops). Functional state makes
+the array a plain python list in eager mode; inside ``static.nn``
+control flow, use stacked tensors + lax loops instead (SURVEY §7)."""
+from __future__ import annotations
+
+from ..framework.tensor import Tensor
+
+__all__ = ["create_array", "array_length", "array_read", "array_write"]
+
+
+def create_array(dtype="float32", initialized_list=None):
+    arr = list(initialized_list) if initialized_list else []
+    for v in arr:
+        if not isinstance(v, Tensor):
+            raise TypeError("initialized_list must contain Tensors")
+    return arr
+
+
+def array_length(array):
+    return len(array)
+
+
+def array_read(array, i):
+    return array[int(i)]
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    i = int(i)
+    if i < len(array):
+        array[i] = x
+    elif i == len(array):
+        array.append(x)
+    else:
+        raise IndexError(f"array_write index {i} beyond length "
+                         f"{len(array)}")
+    return array

@@ -317,3 +317,54 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1,  # noqa: A
         return jnp.where(owner == shard_id, local, ignore_value)
 
     return apply(f, input, differentiable=False, name="shard_index")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split ``x`` into a python list along ``axis`` (reference:
+    fluid/layers/nn.py unstack → unstack_op.cc). Static shapes make
+    ``num`` redundant; accepted for API parity."""
+    n = x.shape[axis] if num is None else num
+    return [squeeze(s, axis=axis) for s in split(x, n, axis=axis)]
+
+
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference: fluid/layers/nn.py reverse)."""
+    return flip(x, axis)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Result shape of broadcasting two shapes (reference:
+    paddle.broadcast_shape)."""
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(int(v) for v in x_shape),
+                                     tuple(int(v) for v in y_shape)))
+
+
+def rank(input, name=None):  # noqa: A002
+    """0-D int32 tensor holding ndim (reference: fluid/layers/nn.py
+    rank)."""
+    from ..framework.tensor import Tensor
+
+    return Tensor(jnp.asarray(len(unwrap(input).shape), jnp.int32))
+
+
+def shape(input, name=None):  # noqa: A002
+    """1-D int32 tensor of the (static) shape — the reference's shape op
+    (operators/shape_op.cc) reads it at runtime; XLA shapes are static
+    so this is a constant."""
+    from ..framework.tensor import Tensor
+
+    return Tensor(jnp.asarray(unwrap(input).shape, jnp.int32))
+
+
+def squeeze_(x, axis=None, name=None):
+    """Inplace squeeze (reference: paddle.squeeze_)."""
+    x._value = squeeze(x, axis=axis)._value
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    """Inplace unsqueeze (reference: paddle.unsqueeze_)."""
+    x._value = unsqueeze(x, axis)._value
+    return x

@@ -293,3 +293,9 @@ def renorm(x, p, axis, max_norm, name=None):
         return v * factor
 
     return apply(f, x, name="renorm")
+
+
+def tanh_(x, name=None):
+    """Inplace tanh (reference: paddle.tanh_)."""
+    x._value = jnp.tanh(x._value)
+    return x

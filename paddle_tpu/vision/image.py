@@ -1,0 +1,48 @@
+"""Image backend selection (reference: python/paddle/vision/image.py).
+
+The reference multiplexes PIL vs OpenCV loaders; this stack decodes via
+numpy (vision/transforms operate on arrays), so the backend registry
+keeps API parity and validates names.
+"""
+_image_backend = "pil"
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+
+def set_image_backend(backend):
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], but "
+            f"got {backend}")
+    _image_backend = backend
+
+
+def get_image_backend():
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image file as the backend's native type (numpy array
+    here; PIL if installed and selected)."""
+    backend = backend or _image_backend
+    if backend == "pil":
+        try:
+            from PIL import Image
+
+            return Image.open(path)
+        except ImportError:
+            pass
+    import numpy as np
+
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        from PIL import Image
+        import io as _io
+
+        return np.asarray(Image.open(_io.BytesIO(data)))
+    except ImportError as e:
+        raise RuntimeError(
+            "no image decoder available (PIL not installed); pass "
+            "arrays directly to vision.transforms") from e
