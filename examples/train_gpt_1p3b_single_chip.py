@@ -1,0 +1,82 @@
+"""Train GPT-3 1.3B on ONE 16 GB TPU v5e chip.
+
+The memory recipe (distributed/hybrid.py knobs; measured MFU 0.57 =
+12.4k tokens/s on a v5e, BENCH_r03):
+  - bf16 master params + bf16 AdamW moments resident in HBM
+    (param_dtype / moment_dtype),
+  - full per-block rematerialization (strategy.recompute),
+  - fused lm-head + cross entropy — the [B, S, V] logits never
+    materialize (ops/fused_ce.py),
+  - layer-scan schedule (keeps one layer's backward working set live),
+  - free_eager (drops the init-time f32 eager weights, 5.3 GB),
+  - gradient accumulation via n_micro (pipeline machinery with pp=1).
+
+Swap the dtype knobs for ``offload_params=True, offload_optimizer=True``
+to keep an f32 master in pinned_host instead (ZeRO-Offload layout:
+lower MFU, full f32 fidelity; see LOSSCURVE_r03.json for the measured
+bf16-vs-f32 loss parity).
+
+On CPU this runs a tiny config as a smoke test.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+from paddle_tpu.distributed.mesh import create_mesh
+from paddle_tpu.models import GPT, GPTConfig
+
+
+def main(steps=10):
+    import jax
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if on_tpu:
+        cfg = GPTConfig.gpt3_1_3b()
+        micro, n_micro = 2, 6
+    else:                                   # CPU smoke
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_heads=2, max_seq_len=64)
+        micro, n_micro = 2, 2
+
+    paddle.seed(0)
+    model = GPT(cfg)
+    opt = paddle.optimizer.AdamW(2e-4, parameters=model.parameters(),
+                                 weight_decay=0.1)
+    s = DistributedStrategy()
+    s.amp = True
+    s.recompute = True
+    mesh = create_mesh({"dp": 1, "pp": 1, "tp": 1, "sp": 1},
+                       jax.devices()[:1])
+    trainer = HybridPipelineTrainer(
+        model, opt, s, mesh, n_micro=n_micro,
+        param_dtype="bfloat16", moment_dtype="bfloat16",
+        free_eager=on_tpu)
+
+    batch, seq = micro * n_micro, cfg.max_seq_len
+    rng = np.random.RandomState(0)
+    for i in range(steps):
+        tokens = rng.randint(0, cfg.vocab_size,
+                             (batch, seq)).astype(np.int32)
+        t0 = time.perf_counter()
+        loss = trainer.step(tokens)
+        loss_v = float(np.asarray(loss))   # truthful sync
+        dt = time.perf_counter() - t0
+        toks = batch * seq / dt
+        print(f"step {i}: loss {loss_v:.4f}  {toks:,.0f} tokens/s "
+              f"({dt*1e3:.0f} ms)", flush=True)
+
+    if on_tpu and hasattr(trainer, "memory_analysis"):
+        ma = trainer.memory_analysis(tokens)
+        if ma and "peak_bytes_est" in ma:
+            print(f"compiled HBM peak ≈ "
+                  f"{ma['peak_bytes_est'] / 1024**3:.2f} GiB")
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 10)

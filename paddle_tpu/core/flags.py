@@ -80,6 +80,9 @@ define_flag("check_nan_inf", False,
             "scan op outputs for nan/inf in eager mode (flags.cc:33 FLAGS_check_nan_inf)")
 define_flag("benchmark", False,
             "block_until_ready after each eager op (flags.cc FLAGS_benchmark sync)")
+define_flag("enable_unused_var_check", False,
+            "warn for trainable params backward never reached "
+            "(framework/unused_var_check.cc analogue at the tape level)")
 define_flag("seed", 0, "global random seed")
 define_flag("use_bf16_matmul", True,
             "allow bf16 matmul accumulation policy on TPU MXU")

@@ -290,10 +290,11 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
         l2 = (jnp.mean(jnp.sum(jnp.square(a), 1))
               + jnp.mean(jnp.sum(jnp.square(p), 1))) * 0.25 * l2_reg
         sim = (a @ p.T).astype(jnp.float32)
-        # per-position soft CE, batch-summed under the soft labels then
-        # meaned (reference loss.py:1712-1716)
         ce = -jnp.sum(soft * jax.nn.log_softmax(sim, -1), -1)   # [N]
-        return l2 + jnp.mean(jnp.sum(soft * ce[:, None], 0))
+        # the reference's reduce_sum(labels*ce, 0) -> reduce_mean
+        # (loss.py:1714-1716) algebraically reduces to mean(ce) because
+        # soft rows are normalized to sum to 1
+        return l2 + jnp.mean(ce)
 
     return apply(f, anchor, positive, labels, name="npair_loss")
 

@@ -94,6 +94,24 @@ class Optimizer:
     def step(self):
         params = [p for p in self._parameter_list
                   if p.trainable and p.grad is not None]
+        from ..core import flags as _flags
+
+        if _flags.get_flags("enable_unused_var_check") and params:
+            # reference FLAGS_enable_unused_var_check
+            # (framework/unused_var_check.cc) flags declared-but-unused
+            # op inputs; the tape analogue is a trainable parameter that
+            # backward never reached — it will silently not train.
+            # Gated on `params`: a step with NO grads anywhere is an
+            # empty/skipped step, not disconnection.
+            import warnings
+
+            for p in self._parameter_list:
+                if p.trainable and p.grad is None:
+                    warnings.warn(
+                        f"Parameter {getattr(p, 'name', '?')} is "
+                        "trainable but received no gradient this step — "
+                        "it is disconnected from the loss",
+                        RuntimeWarning, stacklevel=2)
         if not params:
             return
         if self._grad_clip is not None:

@@ -195,3 +195,27 @@ class TestTopLevelCompatSurface:
 
     def test_callbacks_namespace(self):
         assert hasattr(paddle.callbacks, "EarlyStopping")
+
+
+class TestUnusedVarCheck:
+    def test_warns_for_grad_disconnected_param(self):
+        """FLAGS_enable_unused_var_check (reference
+        framework/unused_var_check.cc analogue): a trainable parameter
+        backward never reached warns at opt.step()."""
+        import warnings
+
+        paddle.set_flags({"FLAGS_enable_unused_var_check": True})
+        try:
+            a = paddle.nn.Linear(2, 2)
+            b = paddle.nn.Linear(2, 2)        # disconnected
+            opt = paddle.optimizer.SGD(
+                0.1, parameters=list(a.parameters())
+                + list(b.parameters()))
+            x = paddle.to_tensor(np.ones((1, 2), np.float32))
+            a(x).sum().backward()
+            with warnings.catch_warnings(record=True) as w:
+                warnings.simplefilter("always")
+                opt.step()
+            assert any("no gradient" in str(m.message) for m in w)
+        finally:
+            paddle.set_flags({"FLAGS_enable_unused_var_check": False})
