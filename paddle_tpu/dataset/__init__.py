@@ -9,6 +9,8 @@ yield.
 """
 from __future__ import annotations
 
-from . import cifar, imdb, imikolov, mnist, uci_housing  # noqa: F401
+from . import (cifar, conll05, flowers, imdb, imikolov, mnist,  # noqa: F401
+               movielens, uci_housing, voc2012, wmt14, wmt16)
 
-__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing"]
+__all__ = ["mnist", "cifar", "imdb", "imikolov", "uci_housing", "conll05",
+           "flowers", "movielens", "voc2012", "wmt14", "wmt16"]

@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/conll05.py (SRL corpus reader)."""
+from ..text.datasets import Conll05st
+from ._adapt import reader_from
+
+_make = reader_from(Conll05st)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

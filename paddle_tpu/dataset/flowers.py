@@ -1,0 +1,17 @@
+"""reference: python/paddle/dataset/flowers.py (102-flowers reader)."""
+from ..vision.datasets import Flowers
+from ._adapt import reader_from
+
+_make = reader_from(Flowers)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def valid(**kw):
+    return _make(mode="valid", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

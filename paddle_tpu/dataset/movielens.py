@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/movielens.py (rating reader)."""
+from ..text.datasets import Movielens
+from ._adapt import reader_from
+
+_make = reader_from(Movielens)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

@@ -1,0 +1,17 @@
+"""reference: python/paddle/dataset/voc2012.py (segmentation reader)."""
+from ..vision.datasets import VOC2012
+from ._adapt import reader_from
+
+_make = reader_from(VOC2012)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def valid(**kw):
+    return _make(mode="valid", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

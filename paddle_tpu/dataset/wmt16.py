@@ -1,0 +1,13 @@
+"""reference: python/paddle/dataset/wmt16.py (translation pairs)."""
+from ..text.datasets import WMT16
+from ._adapt import reader_from
+
+_make = reader_from(WMT16)
+
+
+def train(**kw):
+    return _make(mode="train", **kw)
+
+
+def test(**kw):
+    return _make(mode="test", **kw)

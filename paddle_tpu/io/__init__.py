@@ -31,6 +31,21 @@ class Dataset:
         raise NotImplementedError
 
 
+def synthetic_optin(cls_name: str, synthetic_size, default: int) -> int:
+    """Synthetic data is OPT-IN across every dataset family (round-3
+    policy: a typo'd path must not silently train on fake data).
+    Without a real data file, callers must pass synthetic_size=N
+    explicitly to acknowledge the corpus is synthetic."""
+    if synthetic_size is None:
+        raise ValueError(
+            f"{cls_name}: no data_file was given and downloading is not "
+            "possible here. Pass data_file=<path to the real dataset "
+            "archive>, or explicitly opt in to a deterministic FAKE "
+            f"corpus with synthetic_size=N (e.g. {default}) for "
+            "tests/smoke runs.")
+    return int(synthetic_size)
+
+
 class IterableDataset(Dataset):
     def __iter__(self):
         raise NotImplementedError

@@ -25,18 +25,8 @@ __all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
 
 _TOK = WhitespaceTokenizer()
 
-def _synthetic_optin(cls_name: str, synthetic_size, default: int) -> int:
-    """Synthetic data is OPT-IN (round-3 fix: a typo'd path must not
-    silently train on fake data). Without a data_file, callers must pass
-    synthetic_size=N explicitly to acknowledge the corpus is synthetic."""
-    if synthetic_size is None:
-        raise ValueError(
-            f"{cls_name}: no data_file was given and downloading is not "
-            "possible here. Pass data_file=<path to the real dataset "
-            "archive>, or explicitly opt in to a deterministic FAKE "
-            f"corpus with synthetic_size=N (e.g. {default}) for "
-            "tests/smoke runs.")
-    return int(synthetic_size)
+from ..io import synthetic_optin as _synthetic_optin  # noqa: E402 — shared
+# opt-in policy lives in io (used by text AND vision dataset families)
 
 
 

@@ -1,3 +1,7 @@
 """Utility surface (reference: python/paddle/utils/)."""
-from . import custom_op  # noqa: F401
+from . import custom_op, download  # noqa: F401
 from .custom_op import get_op, load_op_library, register_op  # noqa: F401
+from .deprecated import deprecated  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
+from .install_check import run_check  # noqa: F401
+from .lazy_import import try_import  # noqa: F401

@@ -1,0 +1,31 @@
+"""@deprecated decorator (reference: python/paddle/utils/deprecated.py)."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to="", since="", reason=""):
+    """Mark an API deprecated: warns once per call site with the
+    suggested replacement, same contract as the reference decorator."""
+    def decorator(func):
+        msg = f"API \"{func.__module__}.{func.__name__}\" is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", and will be removed in future versions. Please "\
+                   f"use \"{update_to}\" instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        wrapper.__deprecated_message__ = msg
+        return wrapper
+
+    return decorator

@@ -1,0 +1,38 @@
+"""Weight/dataset path resolution (reference: utils/download.py).
+
+This environment has no network egress, so URL fetches resolve strictly
+from the local cache (~/.cache/paddle/...). A cache hit returns the
+path; a miss raises with the exact path to place the file at — the
+download machinery's contract without the network dependency.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    fname = os.path.basename(url.split("?")[0])
+    path = os.path.join(root_dir, fname)
+    if os.path.exists(path):
+        if md5sum:
+            import hashlib
+
+            with open(path, "rb") as f:
+                got = hashlib.md5(f.read()).hexdigest()
+            if got != md5sum:
+                raise RuntimeError(
+                    f"cached file {path} is corrupt: md5 {got} != "
+                    f"expected {md5sum}. Delete it and re-place the "
+                    "correct file (no network egress here).")
+        return path
+    raise RuntimeError(
+        f"cannot download {url}: this environment has no network "
+        f"egress. Place the file at {path} and retry.")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -1,0 +1,21 @@
+"""try_import (reference: python/paddle/utils/lazy_import.py)."""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency, raising a helpful ImportError when it
+    is absent (the reference suggests the pip package name)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        if err_msg is None:
+            err_msg = (
+                f"Failed importing {module_name}. This likely means "
+                f"that some modules require additional dependencies "
+                f"that have to be manually installed (usually with "
+                f"`pip install {module_name}`).")
+        raise ImportError(err_msg) from e

@@ -33,8 +33,7 @@ def _find_mnist_files(root, mode):
 class MNIST(Dataset):
     """reference: vision/datasets/mnist.py. Parses the real idx format
     (magic 2051/2049, big-endian headers, gzip) from `image_path`/
-    `label_path` or a directory of standard file names; falls back to a
-    deterministic synthetic set when files are absent."""
+    `label_path` or a directory of standard file names; requires an explicit synthetic_size opt-in when files are absent."""
 
     def __init__(self, image_path=None, label_path=None, mode="train",
                  transform=None, download=True, backend=None,
@@ -64,7 +63,10 @@ class MNIST(Dataset):
                     f"mnist: {len(self.images)} images vs "
                     f"{len(self.labels)} labels")
         else:
-            n = synthetic_size or (6000 if mode == "train" else 1000)
+            from ...io import synthetic_optin as _synthetic_optin
+
+            n = _synthetic_optin("MNIST", synthetic_size,
+                                 6000 if mode == "train" else 1000)
             r = np.random.RandomState(42 if mode == "train" else 43)
             self.labels = r.randint(0, 10, n).astype(np.int64)
             # class-dependent blobs so a real model can actually learn
@@ -122,7 +124,10 @@ class Cifar10(Dataset):
             self.images = np.concatenate(images, 0)
             self.labels = np.asarray(labels, np.int64)
             return
-        n = synthetic_size or (5000 if mode == "train" else 1000)
+        from ...io import synthetic_optin as _synthetic_optin
+
+        n = _synthetic_optin(type(self).__name__, synthetic_size,
+                             5000 if mode == "train" else 1000)
         r = np.random.RandomState(7 if mode == "train" else 8)
         self.labels = r.randint(0, 10, n).astype(np.int64)
         self.images = (r.rand(n, 3, 32, 32) * 255).astype(np.uint8)
@@ -145,3 +150,142 @@ class Cifar100(Cifar10):
     _label_key = b"fine_labels"
     _train_members = ["train"]
     _test_members = ["test"]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: vision/datasets/flowers.py —
+    102flowers.tgz of jpgs + imagelabels.mat + setid.mat). Real-format
+    path: decodes the jpgs via PIL and the .mat files via scipy.io;
+    synthetic opt-in otherwise. Yields (CHW float32 image, int64 label)
+    like the reference's reader."""
+
+    _splits = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None,
+                 synthetic_size=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import io as _io
+
+            import scipy.io as sio
+            from PIL import Image
+
+            for nm, f in (("label_file", label_file),
+                          ("setid_file", setid_file)):
+                if not f or not os.path.exists(f):
+                    raise ValueError(
+                        f"Flowers: {nm} is required alongside data_file "
+                        f"(got {f!r}) — imagelabels.mat / setid.mat from "
+                        "the same release")
+            labels = sio.loadmat(label_file)["labels"].ravel()
+            ids = sio.loadmat(setid_file)[
+                self._splits[mode]].ravel()
+            wanted = {f"image_{int(i):05d}.jpg" for i in ids}
+            by_name = {}
+            with tarfile.open(data_file, "r:*") as tf:
+                for m in tf.getmembers():
+                    base = os.path.basename(m.name)
+                    if base in wanted:          # skip the other ~7k jpgs
+                        by_name[base] = tf.extractfile(m).read()
+            self.images, self.labels = [], []
+            for i in ids:
+                raw = by_name.get(f"image_{int(i):05d}.jpg")
+                if raw is None:
+                    raise ValueError(
+                        f"{data_file}: image_{int(i):05d}.jpg named by "
+                        "setid.mat is missing from the archive")
+                img = np.asarray(Image.open(_io.BytesIO(raw))
+                                 .convert("RGB"), np.uint8)
+                self.images.append(img.transpose(2, 0, 1))
+                self.labels.append(int(labels[int(i) - 1]) - 1)  # 1-based
+            self.labels = np.asarray(self.labels, np.int64)
+            return
+        from ...io import synthetic_optin as _synthetic_optin
+
+        n = _synthetic_optin("Flowers", synthetic_size, 1020)
+        r = np.random.RandomState(11)
+        self.labels = r.randint(0, 102, n).astype(np.int64)
+        self.images = [(r.rand(3, 32, 32) * 255).astype(np.uint8)
+                       for _ in range(n)]
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference:
+    vision/datasets/voc2012.py — the trainval tar's JPEGImages +
+    SegmentationClass pngs, split lists under ImageSets/Segmentation).
+    Yields (CHW float32 image, HW int64 mask)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            import io as _io
+
+            from PIL import Image
+
+            want = {"train": "train.txt", "valid": "val.txt",
+                    "test": "val.txt", "val": "val.txt"}[mode]
+            with tarfile.open(data_file, "r:*") as tf:
+                members = tf.getmembers()
+                split = [m for m in members if m.name.endswith(
+                    f"ImageSets/Segmentation/{want}")]
+                if not split:
+                    raise ValueError(
+                        f"{data_file}: ImageSets/Segmentation/{want} not "
+                        "found — not a VOC trainval archive")
+                names = tf.extractfile(split[0]).read().decode().split()
+                in_split = set(names)
+                # only the split's ~1.4k of the archive's ~17k images are
+                # read — the full trainval tar is multiple GB of jpgs
+                jpgs, pngs = {}, {}
+                for m in members:
+                    base = os.path.basename(m.name)
+                    stem = base[:-4]
+                    if stem not in in_split:
+                        continue
+                    if "/JPEGImages/" in m.name and base.endswith(".jpg"):
+                        jpgs[stem] = tf.extractfile(m).read()
+                    elif "/SegmentationClass/" in m.name and \
+                            base.endswith(".png"):
+                        pngs[stem] = tf.extractfile(m).read()
+            self._pairs = []
+            for n in names:
+                if n not in jpgs or n not in pngs:
+                    raise ValueError(
+                        f"{data_file}: split {want} lists {n!r} but the "
+                        "archive lacks its jpg or segmentation png — "
+                        "truncated/partial archive")
+                img = np.asarray(Image.open(_io.BytesIO(jpgs[n]))
+                                 .convert("RGB"), np.uint8)
+                mask = np.asarray(Image.open(_io.BytesIO(pngs[n])),
+                                  np.uint8)
+                self._pairs.append((img.transpose(2, 0, 1),
+                                    mask.astype(np.int64)))
+            return
+        from ...io import synthetic_optin as _synthetic_optin
+
+        n = _synthetic_optin("VOC2012", synthetic_size, 128)
+        r = np.random.RandomState(13)
+        self._pairs = [((r.rand(3, 32, 32) * 255).astype(np.uint8),
+                        r.randint(0, 21, (32, 32)).astype(np.int64))
+                       for _ in range(n)]
+
+    def __getitem__(self, idx):
+        img, mask = self._pairs[idx]
+        img = img.astype(np.float32) / 127.5 - 1.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._pairs)

@@ -219,3 +219,46 @@ class TestUnusedVarCheck:
             assert any("no gradient" in str(m.message) for m in w)
         finally:
             paddle.set_flags({"FLAGS_enable_unused_var_check": False})
+
+
+class TestUtilsParity:
+    """paddle.utils round-3 additions (reference python/paddle/utils/):
+    run_check, deprecated, try_import, download path resolution."""
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        out = capsys.readouterr().out
+        assert "installed successfully" in out
+
+    def test_deprecated_decorator(self):
+        import warnings
+
+        @paddle.utils.deprecated(update_to="paddle.new", since="2.0")
+        def old_api():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_api() == 42
+        assert any(issubclass(m.category, DeprecationWarning)
+                   and "paddle.new" in str(m.message) for m in w)
+
+    def test_try_import(self):
+        import pytest
+
+        assert paddle.utils.try_import("numpy") is not None
+        with pytest.raises(ImportError, match="pip install"):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    def test_download_cache_contract(self, tmp_path):
+        import pytest
+
+        from paddle_tpu.utils.download import get_path_from_url
+
+        f = tmp_path / "weights.pdparams"
+        f.write_bytes(b"x")
+        url = "https://example.com/weights.pdparams"
+        assert get_path_from_url(url, str(tmp_path)) == str(f)
+        with pytest.raises(RuntimeError, match="no network"):
+            get_path_from_url("https://example.com/missing.bin",
+                              str(tmp_path))
