@@ -2,8 +2,10 @@
 cifar.py, flowers.py…). Zero-egress environment: loaders parse the REAL
 file formats when files are present (MNIST idx-gzip, reference
 vision/datasets/mnist.py:117-143; CIFAR python-pickle tarball, reference
-vision/datasets/cifar.py:112-135) and fall back to a deterministic
-synthetic set when absent (download impossible here).
+vision/datasets/cifar.py:112-135; Flowers jpg-tgz + .mat; VOC2012
+trainval tar). Without files they RAISE unless the caller explicitly
+opts into a deterministic synthetic set with ``synthetic_size=N`` —
+silent fake data is never served (round-3 policy, io.synthetic_optin).
 """
 from __future__ import annotations
 
@@ -15,7 +17,7 @@ import tarfile
 
 import numpy as np
 
-from ...io import Dataset
+from ...io import Dataset, synthetic_optin as _synthetic_optin
 
 _MNIST_DIR_CANDIDATES = ("train-images-idx3-ubyte.gz",
                          "t10k-images-idx3-ubyte.gz")
@@ -63,8 +65,6 @@ class MNIST(Dataset):
                     f"mnist: {len(self.images)} images vs "
                     f"{len(self.labels)} labels")
         else:
-            from ...io import synthetic_optin as _synthetic_optin
-
             n = _synthetic_optin("MNIST", synthetic_size,
                                  6000 if mode == "train" else 1000)
             r = np.random.RandomState(42 if mode == "train" else 43)
@@ -124,8 +124,6 @@ class Cifar10(Dataset):
             self.images = np.concatenate(images, 0)
             self.labels = np.asarray(labels, np.int64)
             return
-        from ...io import synthetic_optin as _synthetic_optin
-
         n = _synthetic_optin(type(self).__name__, synthetic_size,
                              5000 if mode == "train" else 1000)
         r = np.random.RandomState(7 if mode == "train" else 8)
@@ -201,8 +199,6 @@ class Flowers(Dataset):
                 self.labels.append(int(labels[int(i) - 1]) - 1)  # 1-based
             self.labels = np.asarray(self.labels, np.int64)
             return
-        from ...io import synthetic_optin as _synthetic_optin
-
         n = _synthetic_optin("Flowers", synthetic_size, 1020)
         r = np.random.RandomState(11)
         self.labels = r.randint(0, 102, n).astype(np.int64)
@@ -269,15 +265,14 @@ class VOC2012(Dataset):
                                  .convert("RGB"), np.uint8)
                 mask = np.asarray(Image.open(_io.BytesIO(pngs[n])),
                                   np.uint8)
-                self._pairs.append((img.transpose(2, 0, 1),
-                                    mask.astype(np.int64)))
+                # masks stay uint8 until __getitem__ (int64 is 8x the
+                # resident memory over a full VOC split)
+                self._pairs.append((img.transpose(2, 0, 1), mask))
             return
-        from ...io import synthetic_optin as _synthetic_optin
-
         n = _synthetic_optin("VOC2012", synthetic_size, 128)
         r = np.random.RandomState(13)
         self._pairs = [((r.rand(3, 32, 32) * 255).astype(np.uint8),
-                        r.randint(0, 21, (32, 32)).astype(np.int64))
+                        r.randint(0, 21, (32, 32)).astype(np.uint8))
                        for _ in range(n)]
 
     def __getitem__(self, idx):
@@ -285,7 +280,7 @@ class VOC2012(Dataset):
         img = img.astype(np.float32) / 127.5 - 1.0
         if self.transform is not None:
             img = self.transform(img)
-        return img, mask
+        return img, mask.astype(np.int64)
 
     def __len__(self):
         return len(self._pairs)

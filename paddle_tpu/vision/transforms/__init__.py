@@ -109,3 +109,221 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+from . import functional  # noqa: E402,F401
+from .functional import (adjust_brightness, adjust_contrast,  # noqa: E402,F401
+                         adjust_hue, adjust_saturation, center_crop, crop,
+                         hflip, pad, rotate, to_grayscale, vflip)
+
+
+class BaseTransform:
+    """Keys-aware base (reference transforms.py:134); subclasses
+    implement _apply_image (and optionally _apply_* for other keys)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        if self.keys is None:
+            return self._apply_image(inputs)
+        inputs = list(inputs)
+        for i, k in enumerate(self.keys):
+            fn = getattr(self, f"_apply_{k}", None)
+            if fn is not None:
+                inputs[i] = fn(inputs[i])
+        return tuple(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        from ...core import rng
+
+        if rng._numpy_generator.rand() < self.prob:
+            return vflip(img)
+        return img
+
+
+class Transpose(BaseTransform):
+    """HWC -> CHW (reference transforms.py:660)."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        # number v -> [max(0, 1-v), 1+v]; 2-tuple passes through
+        # (reference transforms.py _check_input contract)
+        if isinstance(value, (tuple, list)):
+            self.range = (float(value[0]), float(value[1]))
+        else:
+            v = float(value)
+            self.range = None if v == 0 else (max(0.0, 1 - v), 1 + v)
+
+    def _factor(self):
+        from ...core import rng
+
+        if self.range is None:
+            return 1.0
+        return float(rng._numpy_generator.uniform(*self.range))
+
+    def _apply_image(self, img):
+        return adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def __init__(self, value, keys=None):
+        if not isinstance(value, (tuple, list)) and value < 0:
+            raise ValueError("contrast value should be non-negative")
+        super().__init__(value, keys)
+
+    def _apply_image(self, img):
+        return adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def _apply_image(self, img):
+        return adjust_saturation(img, self._factor())
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if isinstance(value, (tuple, list)):
+            self.range = (float(value[0]), float(value[1]))
+        else:
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value should be in [0, 0.5]")
+            self.range = None if value == 0 else (-float(value),
+                                                  float(value))
+        if self.range and not (-0.5 <= self.range[0]
+                               <= self.range[1] <= 0.5):
+            raise ValueError("hue range must lie in [-0.5, 0.5]")
+
+    def _apply_image(self, img):
+        from ...core import rng
+
+        if self.range is None:
+            return img
+        return adjust_hue(img,
+                          float(rng._numpy_generator.uniform(*self.range)))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms.py:847)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        from ...core import rng
+
+        order = rng._numpy_generator.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            if degrees < 0:
+                raise ValueError("degrees must be positive when scalar")
+            self.degrees = (-degrees, degrees)
+        else:
+            self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from ...core import rng
+
+        angle = float(rng._numpy_generator.uniform(*self.degrees))
+        return rotate(img, angle, self.interpolation, self.expand,
+                      center=self.center, fill=self.fill)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop resized to `size`
+    (reference transforms.py:402)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size if isinstance(size, (list, tuple)) \
+            else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        import math
+
+        from ...core import rng
+
+        arr = np.asarray(img)
+        h, w = (arr.shape[-2:] if arr.ndim == 2
+                or (arr.ndim == 3 and arr.shape[0] in (1, 3, 4))
+                else arr.shape[:2])
+        area = h * w
+        gen = rng._numpy_generator
+        for _ in range(10):
+            target = area * gen.uniform(*self.scale)
+            log_r = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            ar = math.exp(gen.uniform(*log_r))
+            tw = int(round(math.sqrt(target * ar)))
+            th = int(round(math.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                top = gen.randint(0, h - th + 1)
+                left = gen.randint(0, w - tw + 1)
+                out = crop(arr, top, left, th, tw)
+                return resize(out, self.size, self.interpolation)
+        # fallback: center crop to the valid aspect (reference behavior)
+        side = min(h, w)
+        out = CenterCrop((side, side))(arr)
+        return resize(out, self.size, self.interpolation)

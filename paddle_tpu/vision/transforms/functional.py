@@ -1,0 +1,199 @@
+"""Functional image ops (reference: vision/transforms/functional.py,
+dispatching to functional_{pil,cv2,tensor}.py). One numpy backend here:
+images are CHW float arrays (the repo's dataset convention) or HWC/HW
+arrays — channel order is inferred the way ToTensor does.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["to_tensor", "resize", "pad", "crop", "center_crop", "hflip",
+           "vflip", "adjust_brightness", "adjust_contrast",
+           "adjust_saturation", "adjust_hue", "rotate", "to_grayscale",
+           "normalize"]
+
+
+def _is_chw(arr):
+    return arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+
+
+def to_tensor(pic, data_format="CHW"):
+    from . import ToTensor
+
+    return ToTensor(data_format)(pic)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from . import Normalize
+
+    return Normalize(mean, std, data_format, to_rgb)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from . import Resize
+
+    return Resize(size, interpolation)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """reference functional.py:149 — padding int | (pad_lr, pad_tb) |
+    (left, top, right, bottom)."""
+    arr = np.asarray(img)
+    if isinstance(padding, int):
+        l = t = r = b = padding
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    spec = [(0, 0)] * (arr.ndim - 2) + [(t, b), (l, r)] if _is_chw(arr) \
+        or arr.ndim == 2 else [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, spec, mode=mode, **kw)
+
+
+def _hw_slice(arr, top, left, height, width):
+    if _is_chw(arr) or arr.ndim == 2:
+        return arr[..., top:top + height, left:left + width]
+    return arr[top:top + height, left:left + width]
+
+
+def crop(img, top, left, height, width):
+    return _hw_slice(np.asarray(img), top, left, height, width)
+
+
+def center_crop(img, output_size):
+    from . import CenterCrop
+
+    return CenterCrop(output_size)(img)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    ax = -1 if (_is_chw(arr) or arr.ndim == 2) else 1
+    return np.ascontiguousarray(np.flip(arr, axis=ax))
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    ax = -2 if (_is_chw(arr) or arr.ndim == 2) else 0
+    return np.ascontiguousarray(np.flip(arr, axis=ax))
+
+
+def _blend(a, b, factor):
+    out = a.astype(np.float32) * factor + b.astype(np.float32) * (1 - factor)
+    return out.astype(np.float32)
+
+
+def _gray(arr):
+    """Luma (ITU-R 601, the reference's conversion) along the channel
+    axis; arr CHW or HWC float. An HW image is already grayscale."""
+    if arr.ndim == 2:
+        return arr.astype(np.float32)
+    w = np.asarray([0.299, 0.587, 0.114], np.float32)
+    if _is_chw(arr):
+        return np.tensordot(w, arr.astype(np.float32)[:3], 1)
+    return np.tensordot(arr.astype(np.float32)[..., :3], w, 1)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = np.asarray(img, np.float32)
+    return _blend(arr, np.zeros_like(arr), brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img, np.float32)
+    mean = _gray(arr).mean()
+    return _blend(arr, np.full_like(arr, mean), contrast_factor)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img, np.float32)
+    g = _gray(arr)
+    g = g[None] if _is_chw(arr) else g[..., None]
+    return _blend(arr, np.broadcast_to(g, arr.shape), saturation_factor)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor ∈ [-0.5, 0.5] of a full HSV turn
+    (reference functional.py adjust_hue)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = np.asarray(img, np.float32)
+    chw = _is_chw(arr)
+    rgb = arr if not chw else arr.transpose(1, 2, 0)
+    scale = 255.0 if rgb.max() > 2.0 else 1.0
+    rgb = rgb / scale
+    mx, mn = rgb.max(-1), rgb.min(-1)
+    diff = mx - mn
+    safe = np.where(diff == 0, 1.0, diff)
+    r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+    h = np.where(mx == r, (g - b) / safe % 6,
+                 np.where(mx == g, (b - r) / safe + 2, (r - g) / safe + 4))
+    h = np.where(diff == 0, 0.0, h) / 6.0
+    s = np.where(mx == 0, 0.0, diff / np.where(mx == 0, 1.0, mx))
+    h = (h + hue_factor) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = mx * (1 - s)
+    q = mx * (1 - s * f)
+    t = mx * (1 - s * (1 - f))
+    i = i.astype(np.int32) % 6
+    out = np.select(
+        [(i == k)[..., None] for k in range(6)],
+        [np.stack([mx, t, p], -1), np.stack([q, mx, p], -1),
+         np.stack([p, mx, t], -1), np.stack([p, q, mx], -1),
+         np.stack([t, p, mx], -1), np.stack([mx, p, q], -1)])
+    out = (out * scale).astype(np.float32)
+    return out.transpose(2, 0, 1) if chw else out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """reference functional.py rotate (angle in degrees CCW; optional
+    rotation origin ``center`` as (x, y), incompatible with expand —
+    same constraint as the reference, whose expand assumes a center
+    rotation)."""
+    from scipy import ndimage
+
+    arr = np.asarray(img, np.float32)
+    order = {"nearest": 0, "bilinear": 1, "bicubic": 3}[interpolation]
+    if center is not None:
+        if expand:
+            raise ValueError("rotate: center and expand are mutually "
+                             "exclusive (reference semantics)")
+        th = np.deg2rad(angle)
+        # inverse map for affine_transform: out -> in, about (cy, cx)
+        rot = np.array([[np.cos(th), np.sin(th)],
+                        [-np.sin(th), np.cos(th)]], np.float64)
+        cx, cy = center
+        c = np.array([cy, cx], np.float64)
+        off = c - rot @ c
+
+        def one(plane):
+            return ndimage.affine_transform(
+                plane, rot, offset=off, order=order, cval=fill)
+
+        if arr.ndim == 2:
+            return one(arr).astype(np.float32)
+        if _is_chw(arr):
+            return np.stack([one(p) for p in arr]).astype(np.float32)
+        return np.stack([one(arr[..., i]) for i in
+                         range(arr.shape[-1])], -1).astype(np.float32)
+    axes = (-2, -1) if (_is_chw(arr) or arr.ndim == 2) else (0, 1)
+    return ndimage.rotate(arr, angle, axes=(axes[1], axes[0]),
+                          reshape=expand, order=order, cval=fill) \
+        .astype(np.float32)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = np.asarray(img, np.float32)
+    g = _gray(arr)
+    if _is_chw(arr):
+        g = np.repeat(g[None], num_output_channels, 0)
+    else:
+        g = np.repeat(g[..., None], num_output_channels, -1)
+    return g.astype(np.float32)

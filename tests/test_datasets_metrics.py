@@ -316,3 +316,84 @@ class TestFlowersVOC:
         with pytest.raises(RuntimeError, match="corrupt"):
             get_path_from_url("http://x/w.bin", str(tmp_path),
                               md5sum="0" * 32)
+
+
+class TestTransformsParity:
+    """Round-3 vision.transforms completion (reference
+    vision/transforms/{transforms,functional}.py)."""
+
+    def _img(self, seed=0):
+        return (np.random.RandomState(seed)
+                .rand(3, 12, 12) * 255).astype(np.float32)
+
+    def test_functional_geometry(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img()
+        assert T.pad(img, (1, 2)).shape == (3, 16, 14)
+        assert T.pad(img, (1, 2, 3, 4)).shape == (3, 18, 16)
+        np.testing.assert_allclose(T.hflip(T.hflip(img)), img)
+        np.testing.assert_allclose(T.vflip(T.vflip(img)), img)
+        c = T.crop(img, 2, 3, 5, 6)
+        np.testing.assert_allclose(c, img[:, 2:7, 3:9])
+        r = T.rotate(img, 90)
+        np.testing.assert_allclose(T.rotate(r, -90), img, atol=1e-3)
+
+    def test_color_adjustments(self):
+        import paddle_tpu.vision.transforms as T
+
+        img = self._img(1)
+        np.testing.assert_allclose(T.adjust_brightness(img, 0.5),
+                                   img * 0.5)
+        np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img,
+                                   atol=1e-4)
+        np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img,
+                                   atol=1e-4)
+        g = T.to_grayscale(img)
+        w = np.array([0.299, 0.587, 0.114], np.float32)
+        np.testing.assert_allclose(g[0], np.tensordot(w, img, 1),
+                                   rtol=1e-5)
+        # hue is modular: two half-turns return to the start
+        back = T.adjust_hue(T.adjust_hue(img, 0.5), 0.5)
+        np.testing.assert_allclose(back, img, atol=0.1)
+
+    def test_transform_classes(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.vision.transforms as T
+
+        paddle.seed(5)
+        img = self._img(2)
+        assert T.RandomResizedCrop(8)(img).shape == (3, 8, 8)
+        assert T.RandomRotation(30)(img).shape == (3, 12, 12)
+        assert T.RandomVerticalFlip(1.0)(img).shape == (3, 12, 12)
+        np.testing.assert_allclose(T.RandomVerticalFlip(0.0)(img), img)
+        assert T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img).shape == img.shape
+        assert T.Grayscale(3)(img).shape == (3, 12, 12)
+        assert T.Transpose()(np.zeros((8, 9, 3))).shape == (3, 8, 9)
+        out_img, lbl = T.Pad(1, keys=["image", "label"])((img, 3))
+        assert out_img.shape == (3, 14, 14) and lbl == 3
+
+    def test_review_regressions(self):
+        """r3 review fixes: HW grayscale input, tuple color ranges,
+        rotation about an explicit center, uint8 VOC masks."""
+        import pytest
+
+        import paddle_tpu.vision.transforms as T
+        from paddle_tpu.vision.datasets import VOC2012
+
+        hw = np.random.RandomState(0).rand(12, 12).astype(np.float32)
+        np.testing.assert_allclose(T.adjust_contrast(hw, 1.0), hw,
+                                   atol=1e-5)
+        img = (np.random.RandomState(1)
+               .rand(3, 12, 12) * 255).astype(np.float32)
+        out = T.ColorJitter(brightness=(0.8, 1.2), hue=(-0.1, 0.1))(img)
+        assert out.shape == img.shape
+        # center rotate: the origin pixel stays fixed under center=(0,0)
+        r = T.functional.rotate(img, 37.0, interpolation="bilinear",
+                                center=(0, 0))
+        np.testing.assert_allclose(r[:, 0, 0], img[:, 0, 0], atol=1e-3)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            T.functional.rotate(img, 10, expand=True, center=(1, 1))
+        ds = VOC2012(synthetic_size=2)
+        assert ds._pairs[0][1].dtype == np.uint8     # resident uint8
+        assert ds[0][1].dtype == np.int64            # served int64
