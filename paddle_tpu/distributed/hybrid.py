@@ -258,13 +258,24 @@ class HybridPipelineTrainer:
             self.other_opt_specs.append({k: sp for k in s})
 
         if free_eager:
+            # device_put may return a NEW Array sharing the SAME buffer
+            # when dtype+sharding are unchanged, so aliasing cannot be
+            # detected by identity. Delete only buffers that are
+            # provably fresh copies: per-layer block params (jnp.stack
+            # always materializes a new stacked buffer) and other params
+            # whose dtype cast forced a copy. An uncast "other" param
+            # keeps sharing its buffer with the trainer — dropping the
+            # eager reference alone still releases nothing extra, and
+            # deleting would kill the trainer's own state.
             for ts in per_block_tensors:
                 for t in ts:
                     t._value.delete()
                     t._value = None
-            for n in self.other_names:
-                name2t[n]._value.delete()
-                name2t[n]._value = None
+            for n, v in zip(self.other_names, self.other_vals):
+                t = name2t[n]
+                if t._value.dtype != v.dtype:
+                    t._value.delete()
+                t._value = None
 
         self._step = 0
         self._n_batch_args: Optional[int] = None

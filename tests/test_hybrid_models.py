@@ -182,6 +182,14 @@ class TestMemoryKnobs:
         for _, t in model.named_parameters():
             assert t._value is not None
 
+    def test_free_eager_without_dtype_cast(self):
+        """r3 regression: device_put with unchanged dtype+sharding can
+        ALIAS the eager buffer — free_eager must not delete buffers the
+        trainer itself references."""
+        tr, losses = self._train(free_eager=True)
+        assert losses[-1] < losses[0], losses
+        assert all(np.isfinite(v) for v in losses)
+
     def test_free_eager_releases_then_sync_restores(self):
         tr, losses = self._train(param_dtype="bfloat16", free_eager=True)
         assert losses[-1] < losses[0], losses
