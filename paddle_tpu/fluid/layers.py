@@ -31,7 +31,16 @@ elementwise_div = _p.divide
 hard_sigmoid = _F.hardsigmoid
 hard_swish = _F.hardswish
 soft_relu = _F.softplus
-create_tensor = _p.zeros
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """1.x signature create_tensor(dtype, ...) — an uninitialized scalar
+    variable of ``dtype`` (reference fluid/layers/tensor.py create_tensor),
+    not zeros(shape)."""
+    t = _p.zeros([], dtype=dtype)
+    t.name = name or ""
+    t.persistable = persistable
+    return t
 
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,  # noqa: A002

@@ -198,10 +198,16 @@ class Tensor:
     def pin_memory(self):
         return self.cpu()
 
-    # -- in-place mutation (leaf-only, like reference VarBase set_value) ---
+    # -- in-place mutation --------------------------------------------------
+    # Full-overwrite mutations (set_value/zero_/fill_) follow reference
+    # VarBase.set_value semantics: the tensor becomes a fresh leaf — any
+    # previous producer node is detached so backward cannot mix the
+    # overwritten value with the old op's vjp.
     def set_value(self, value):
         v = value._value if isinstance(value, Tensor) else jnp.asarray(value)
         self._value = v.astype(self._value.dtype) if hasattr(v, "astype") else v
+        self._node = None
+        self._out_idx = 0
         return self
 
     def copy_(self, other, blocking: bool = True):
@@ -209,25 +215,33 @@ class Tensor:
 
     def zero_(self):
         self._value = jnp.zeros_like(self._value)
+        self._node = None
+        self._out_idx = 0
         return self
 
     def fill_(self, value):
         self._value = jnp.full_like(self._value, value)
+        self._node = None
+        self._out_idx = 0
         return self
 
+    # Arithmetic inplace ops are differentiable in the reference
+    # (op_function_generator.cc inplace variants); route through the tape
+    # with rebinding so gradients stay correct.
     def scale_(self, scale):
-        self._value = self._value * scale
-        return self
+        from ..tensor._helper import inplace_apply
+
+        return inplace_apply(lambda v: v * scale, self, name="scale_")
 
     def add_(self, other):
-        o = other._value if isinstance(other, Tensor) else other
-        self._value = self._value + o
-        return self
+        from ..tensor._helper import inplace_apply
+
+        return inplace_apply(lambda v, o: v + o, self, other, name="add_")
 
     def subtract_(self, other):
-        o = other._value if isinstance(other, Tensor) else other
-        self._value = self._value - o
-        return self
+        from ..tensor._helper import inplace_apply
+
+        return inplace_apply(lambda v, o: v - o, self, other, name="subtract_")
 
     # -- indexing ----------------------------------------------------------
     def __getitem__(self, idx):

@@ -100,7 +100,8 @@ def to_static(layer=None, input_spec=None, build_strategy=None,
     return wrap(layer)
 
 
-def save(layer, path, input_spec=None, batch_buckets=None, **config):
+def save(layer, path, input_spec=None, batch_buckets=None,
+         batched_inputs=None, **config):
     """paddle.jit.save equivalent (reference: fluid/dygraph/jit.py save).
 
     Persists:
@@ -161,8 +162,38 @@ def save(layer, path, input_spec=None, batch_buckets=None, **config):
             # when no buckets were requested.
             base_b = tuple(input_spec[0].shape)[0] \
                 if len(input_spec[0].shape) else None
-            batched_in = [i for i, s in enumerate(input_spec)
-                          if len(s.shape) and s.shape[0] == base_b]
+            if batched_inputs is not None:
+                # explicit caller truth (like batch_buckets) — an
+                # unbatched aux input whose leading dim happens to equal
+                # the batch (e.g. a [4,K] table at batch 4) cannot be
+                # told apart by shape alone.
+                batched_in = sorted(int(i) for i in batched_inputs)
+            else:
+                batched_in = [i for i, s in enumerate(input_spec)
+                              if len(s.shape) and s.shape[0] == base_b]
+                if base_b is not None and len(batched_in) > 1:
+                    # Sensitivity check: candidate i is truly batched iff
+                    # holding it fixed while the other candidates grow
+                    # breaks shape agreement (eval_shape — abstract, no
+                    # compile). An aux input independent of the batch
+                    # passes and is dropped from the batched set.
+                    confirmed = []
+                    for i in batched_in:
+                        others = [jax.ShapeDtypeStruct(
+                            ((base_b + 1,) + tuple(s.shape[1:]))
+                            if (j in batched_in and j != i)
+                            else tuple(s.shape), np.dtype(s.dtype))
+                            for j, s in enumerate(input_spec)]
+                        try:
+                            jax.eval_shape(pure, p_specs, b_specs, *others)
+                            # fn is insensitive to i staying at base_b
+                            # while the batch grows → i is not batched
+                        except Exception:
+                            confirmed.append(i)
+                    # keep shape-heuristic fallback if the check degenerates
+                    # (e.g. fn broadcasts everything and nothing errors)
+                    if confirmed:
+                        batched_in = confirmed
 
             def specs_at(n):
                 return [jax.ShapeDtypeStruct(

@@ -14,7 +14,7 @@ from .layer.common import (AlphaDropout, Bilinear, CosineSimilarity,  # noqa: F4
                            PairwiseDistance,
                            Dropout, Dropout2D, Dropout3D, Embedding, Flatten,
                            Identity, Linear, Pad1D, Pad2D, Pad3D,
-                           PixelShuffle, Unfold, Upsample,
+                           PixelShuffle, Fold, Unfold, Upsample,
                            UpsamplingBilinear2D, UpsamplingNearest2D,
                            ZeroPad2D)
 from .layer.container import (LayerDict, LayerList, ParameterList,  # noqa: F401

@@ -11,9 +11,9 @@ from .activation import (celu, elu, gelu, gumbel_softmax, hardshrink,  # noqa: F
 from .attention import scaled_dot_product_attention  # noqa: F401
 from ...ops.fused_ce import fused_linear_cross_entropy  # noqa: F401
 from .common import (alpha_dropout, bilinear, cosine_similarity,  # noqa: F401
-                     dropout, dropout2d, dropout3d, embedding, interpolate,
-                     label_smooth, linear, pad, pixel_shuffle, unfold,
-                     upsample, zeropad2d)
+                     dropout, dropout2d, dropout3d, embedding, fold,
+                     interpolate, label_smooth, linear, pad, pixel_shuffle,
+                     unfold, upsample, zeropad2d)
 from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose,  # noqa: F401
                    conv3d, conv3d_transpose)
 from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # noqa: F401
@@ -27,7 +27,14 @@ from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F
 from .vision import (affine_grid, grid_sample, temporal_shift,  # noqa: F401
                      deform_conv2d)
 from . import extension  # noqa: F401
-from .extension import diag_embed, gather_tree  # noqa: F401
+from .extension import diag_embed, edit_distance, gather_tree  # noqa: F401
+from . import sequence_lod  # noqa: F401
+from .sequence_lod import (sequence_mask, sequence_pad, sequence_unpad,  # noqa: F401
+                           sequence_pool, sequence_first_step,
+                           sequence_last_step, sequence_expand,
+                           sequence_expand_as, sequence_concat,
+                           sequence_softmax, sequence_reverse, sequence_conv,
+                           sequence_enumerate, sequence_slice)
 from .loss import dice_loss, hsigmoid_loss, npair_loss  # noqa: F401
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
                       adaptive_avg_pool3d, adaptive_max_pool3d,

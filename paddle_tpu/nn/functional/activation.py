@@ -6,7 +6,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...tensor._helper import apply, make_unary, unwrap
+from ...tensor._helper import apply, inplace_apply, make_unary, unwrap
 
 relu = make_unary(jax.nn.relu, "relu")
 relu6 = make_unary(lambda x: jnp.clip(x, 0.0, 6.0), "relu6")
@@ -157,25 +157,26 @@ def glu(x, axis=-1, name=None):
 
 
 def relu_(x, name=None):
-    """Inplace relu (reference: paddle.nn.functional.relu_)."""
-    x._value = jax.nn.relu(x._value)
-    return x
+    """Inplace relu (reference: paddle.nn.functional.relu_). Differentiable
+    via tape rebinding like every inplace op here."""
+    return inplace_apply(jax.nn.relu, x, name="relu_")
 
 
 def elu_(x, alpha=1.0, name=None):
     """Inplace elu."""
-    x._value = jax.nn.elu(x._value, alpha)
-    return x
+    return inplace_apply(lambda v: jax.nn.elu(v, alpha), x, name="elu_")
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
     """Inplace softmax."""
-    v = x._value if dtype is None else x._value.astype(dtype)
-    x._value = jax.nn.softmax(v, axis=axis)
-    return x
+    def f(v):
+        if dtype is not None:
+            v = v.astype(dtype)
+        return jax.nn.softmax(v, axis=axis)
+
+    return inplace_apply(f, x, name="softmax_")
 
 
 def tanh_(x, name=None):
     """Inplace tanh."""
-    x._value = jnp.tanh(x._value)
-    return x
+    return inplace_apply(jnp.tanh, x, name="tanh_")

@@ -238,3 +238,45 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
         return out.reshape(b, c * ks[0] * ks[1], oh * ow)
 
     return apply(f, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (reference: operators/fold_op would be the inverse of
+    unfold_op.cc; Paddle exposes it as F.fold). Fold is *exactly* the
+    linear transpose of unfold — overlapping patches sum — so rather than
+    hand-writing the scatter-add we transpose the im2col map with
+    jax.linear_transpose; XLA lowers it to the same scatter it would have
+    gotten from autodiff, guaranteed adjoint-consistent with unfold."""
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) else \
+        [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else \
+        [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(cols):
+        b, ckk, length = cols.shape
+        c = ckk // (ks[0] * ks[1])
+        h, w = int(os_[0]), int(os_[1])
+
+        def u(img):
+            v = jnp.pad(img, [(0, 0), (0, 0), (pd[0], pd[0]),
+                              (pd[1], pd[1])])
+            oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+            ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+            patches = []
+            for i in range(ks[0]):
+                for j in range(ks[1]):
+                    di, dj = i * dl[0], j * dl[1]
+                    patches.append(v[:, :, di:di + oh * st[0]:st[0],
+                                     dj:dj + ow * st[1]:st[1]])
+            out = jnp.stack(patches, axis=2)
+            return out.reshape(b, c * ks[0] * ks[1], oh * ow)
+
+        img_spec = jax.ShapeDtypeStruct((b, c, h, w), cols.dtype)
+        (img,) = jax.linear_transpose(u, img_spec)(cols)
+        return img
+
+    return apply(f, x, name="fold")

@@ -1,0 +1,300 @@
+"""Sequence (LoD) op family, dense-ragged form.
+
+Reference: paddle/fluid/operators/sequence_ops/ (~15 ops) with python
+surface python/paddle/fluid/layers/sequence_lod.py. The reference carries
+raggedness in LoDTensor offsets; this framework's stance is "LoD => dense
+ragged at the data layer": every op here takes an explicit ``lengths``
+tensor (the LoD level-0 run lengths) next to either
+
+  * a *packed* tensor ``[sum(lengths), ...]`` (rows of all sequences
+    concatenated — the reference's LoDTensor buffer layout), or
+  * a *padded* tensor ``[batch, max_time, ...]``.
+
+Padded-form ops are jittable (static shapes, masks instead of offsets —
+the TPU-friendly formulation); ops whose *output* row count is
+data-dependent (sequence_unpad, sequence_expand, sequence_erase) execute
+eagerly on host, like the reference's CPU kernels for the same ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...tensor._helper import apply, unwrap
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "sequence_pool",
+    "sequence_first_step", "sequence_last_step", "sequence_expand",
+    "sequence_expand_as", "sequence_concat", "sequence_softmax",
+    "sequence_reverse", "sequence_conv", "sequence_enumerate",
+    "sequence_slice",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """lengths -> [..., maxlen] 0/1 mask (reference:
+    sequence_ops/sequence_mask_op.cc; public paddle.nn.functional API)."""
+    from ...core import dtype as dtype_mod
+
+    d = dtype_mod.convert_dtype(dtype)
+    lengths = unwrap(x)
+    if maxlen is None:
+        maxlen = int(np.asarray(lengths).max())
+    maxlen = int(maxlen)
+
+    def f(lv):
+        t = jnp.arange(maxlen, dtype=lv.dtype)
+        return (t < lv[..., None]).astype(d)
+
+    return apply(f, x, differentiable=False, name="sequence_mask")
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    """Packed [sum(len), ...] + lengths -> (padded [B, maxlen, ...],
+    lengths) (reference: sequence_ops/sequence_pad_op.cc). Jittable: the
+    gather index grid is computed from cumulative offsets; out-of-range
+    positions read row 0 and are overwritten by ``pad_value``."""
+    if length is None:
+        raise ValueError(
+            "sequence_pad: dense-ragged form requires the explicit "
+            "`length` tensor (the LoD run lengths).")
+    lengths_np = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+    ml = int(maxlen) if maxlen is not None else int(lengths_np.max())
+
+    def f(v, lv, pv):
+        lv = lv.reshape(-1)
+        offs = jnp.concatenate([jnp.zeros((1,), lv.dtype),
+                                jnp.cumsum(lv)[:-1]])
+        t = jnp.arange(ml, dtype=lv.dtype)
+        idx = offs[:, None] + t[None, :]               # [B, ml]
+        valid = t[None, :] < lv[:, None]
+        idx = jnp.where(valid, idx, 0)
+        out = v[idx.reshape(-1)].reshape((lv.shape[0], ml) + v.shape[1:])
+        mask = valid.reshape(valid.shape + (1,) * (v.ndim - 1))
+        pad = jnp.asarray(pv, v.dtype)
+        return jnp.where(mask, out, pad)
+
+    out = apply(f, x, length, pad_value, name="sequence_pad")
+    return out, Tensor(jnp.asarray(lengths_np))
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] + lengths -> packed [sum(len), ...] (reference:
+    sequence_ops/sequence_unpad_op.cc). Output row count is data-dependent
+    => eager host op."""
+    v = np.asarray(unwrap(x))
+    lens = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+    rows = [v[b, :int(n)] for b, n in enumerate(lens)]
+    return Tensor(jnp.asarray(np.concatenate(rows, axis=0)))
+
+
+def _masked(v, lv, fill):
+    t = jnp.arange(v.shape[1])
+    mask = t[None, :] < lv.reshape(-1)[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+    return jnp.where(mask, v, jnp.asarray(fill, v.dtype)), mask
+
+
+def sequence_pool(input, pool_type, length=None, pad_value=0.0, name=None):  # noqa: A002
+    """Masked pooling over time of a padded [B, T, ...] tensor (reference:
+    sequence_ops/sequence_pool_op.cc — AVERAGE/SUM/SQRT/MAX/LAST/FIRST).
+    Empty sequences yield ``pad_value`` like the reference."""
+    if length is None:
+        raise ValueError("sequence_pool: `length` is required")
+    pt = pool_type.lower()
+
+    def f(v, lv):
+        lv = lv.reshape(-1)
+        n = jnp.maximum(lv, 1).astype(v.dtype)
+        n = n.reshape((-1,) + (1,) * (v.ndim - 2))
+        if pt == "max":
+            mv, _ = _masked(v, lv, -jnp.inf)
+            out = mv.max(axis=1)
+        elif pt in ("average", "sum", "sqrt"):
+            mv, _ = _masked(v, lv, 0)
+            out = mv.sum(axis=1)
+            if pt == "average":
+                out = out / n
+            elif pt == "sqrt":
+                out = out / jnp.sqrt(n)
+        elif pt == "first":
+            out = v[:, 0]
+        elif pt == "last":
+            idx = jnp.maximum(lv - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), axis=1
+            ).squeeze(1)
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        empty = (lv == 0).reshape((-1,) + (1,) * (v.ndim - 2))
+        return jnp.where(empty, jnp.asarray(pad_value, v.dtype), out)
+
+    return apply(f, input, length, name="sequence_pool")
+
+
+def sequence_first_step(input, length=None, name=None):  # noqa: A002
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None, name=None):  # noqa: A002
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_expand(x, y_length, ref_level=0, name=None):
+    """Repeat row-blocks of ``x`` per ``y_length`` counts (reference:
+    sequence_ops/sequence_expand_op.cc). Dense form: x is [B, ...] (one
+    row per sequence) or packed with its own lengths == 1; output packs
+    x's row b repeated y_length[b] times. Output row count is
+    data-dependent => eager host op."""
+    v = np.asarray(unwrap(x))
+    counts = np.asarray(unwrap(y_length)).astype(np.int64).reshape(-1)
+    out = np.repeat(v, counts, axis=0)
+    return Tensor(jnp.asarray(out))
+
+
+def sequence_expand_as(x, y, y_length=None, name=None):
+    """sequence_expand with counts taken from ``y``'s lengths (reference:
+    sequence_ops/sequence_expand_as_op.cc)."""
+    if y_length is None:
+        raise ValueError("sequence_expand_as: dense-ragged form requires "
+                         "`y_length`")
+    return sequence_expand(x, y_length)
+
+
+def sequence_concat(input, lengths=None, name=None):  # noqa: A002
+    """Concatenate ragged sequences time-wise (reference:
+    sequence_ops/sequence_concat_op.cc): row b of the output is
+    seq_b(x1) ++ seq_b(x2) ++ ... Inputs are padded [B, Ti, ...] with
+    lengths[i] = [B]; output is padded [B, sum(Ti), ...] plus the summed
+    lengths."""
+    if lengths is None:
+        raise ValueError("sequence_concat: `lengths` (one per input) "
+                         "required")
+    vs = [np.asarray(unwrap(t)) for t in input]
+    ls = [np.asarray(unwrap(le)).astype(np.int64).reshape(-1)
+          for le in lengths]
+    b = vs[0].shape[0]
+    total = sum(l_ for l_ in ls)
+    ml = int(total.max())
+    out = np.zeros((b, ml) + vs[0].shape[2:], vs[0].dtype)
+    for row in range(b):
+        pos = 0
+        for v, l_ in zip(vs, ls):
+            n = int(l_[row])
+            out[row, pos:pos + n] = v[row, :n]
+            pos += n
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(total))
+
+
+def sequence_softmax(input, length=None, axis=1, name=None):  # noqa: A002
+    """Per-sequence masked softmax over time (reference:
+    sequence_ops/sequence_softmax_op.cc). Padded [B, T, ...]; positions
+    beyond the length get probability 0."""
+    if length is None:
+        raise ValueError("sequence_softmax: `length` is required")
+
+    def f(v, lv):
+        mv, mask = _masked(v, lv, -jnp.inf)
+        out = jax.nn.softmax(mv, axis=axis)
+        return jnp.where(mask, out, 0.0)
+
+    return apply(f, input, length, name="sequence_softmax")
+
+
+def sequence_reverse(x, length=None, name=None):
+    """Reverse the valid prefix of each row (reference:
+    sequence_ops/sequence_reverse_op.cc). Padding stays in place."""
+    if length is None:
+        raise ValueError("sequence_reverse: `length` is required")
+
+    def f(v, lv):
+        lv = lv.reshape(-1)
+        t = jnp.arange(v.shape[1])
+        rev = lv[:, None] - 1 - t[None, :]
+        idx = jnp.where(t[None, :] < lv[:, None], rev, t[None, :])
+        return jnp.take_along_axis(
+            v, idx.reshape(idx.shape + (1,) * (v.ndim - 2)), axis=1)
+
+    return apply(f, x, length, name="sequence_reverse")
+
+
+def sequence_conv(input, weight, length=None, context_length=3,  # noqa: A002
+                  context_start=None, bias=None, padding=True, name=None):
+    """Context-window projection over time (reference:
+    sequence_ops/sequence_conv_op.cc + math/context_project.h): each
+    timestep concatenates ``context_length`` neighbouring frames (zeros
+    beyond sequence boundaries — boundaries come from ``length``, not the
+    pad buffer) and projects by ``weight`` [context_length*D, M]."""
+    if length is None:
+        raise ValueError("sequence_conv: `length` is required")
+    cl = int(context_length)
+    cs = -((cl - 1) // 2) if context_start is None else int(context_start)
+
+    def f(v, w, lv, *rest):
+        lv = lv.reshape(-1)
+        bsz, tmax, d = v.shape
+        mv, _ = _masked(v, lv, 0)
+        t = jnp.arange(tmax)
+        cols = []
+        for k in range(cl):
+            shift = cs + k
+            src = t + shift
+            ok = (src >= 0) & (src < lv[:, None])
+            src_c = jnp.clip(src, 0, tmax - 1)
+            g = jnp.take_along_axis(
+                mv, jnp.broadcast_to(src_c[None, :], (bsz, tmax))[..., None],
+                axis=1)
+            cols.append(jnp.where(ok[..., None], g, 0))
+        ctx = jnp.concatenate(cols, axis=-1)        # [B, T, cl*D]
+        out = ctx @ w
+        if rest:
+            out = out + rest[0]
+        valid = (t[None, :] < lv[:, None])[..., None]
+        return jnp.where(valid, out, 0)
+
+    args = (input, weight, length) + ((bias,) if bias is not None else ())
+    return apply(f, *args, name="sequence_conv")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+    """Sliding windows of ids (reference:
+    sequence_ops/sequence_enumerate_op.cc): [B, T] int -> [B, T, win]
+    where window positions past each row's length fill ``pad_value``."""
+    def f(v, lv=None):
+        bsz, tmax = v.shape
+        t = jnp.arange(tmax)
+        outs = []
+        for k in range(int(win_size)):
+            src = t + k
+            ok = src < tmax
+            src_c = jnp.clip(src, 0, tmax - 1)
+            g = v[:, src_c]
+            outs.append(jnp.where(ok[None, :], g, pad_value))
+        return jnp.stack(outs, axis=-1)
+
+    return apply(f, input, differentiable=False, name="sequence_enumerate")
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    """Per-row slice [offset[b], offset[b]+length[b]) of the time axis
+    (reference: sequence_ops/sequence_slice_op.cc), returned padded to
+    max(length) with zeros, plus the new lengths."""
+    v = unwrap(input)
+    off = np.asarray(unwrap(offset)).astype(np.int64).reshape(-1)
+    ln = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+    ml = int(ln.max())
+
+    def f(vv):
+        t = jnp.arange(ml)
+        idx = jnp.asarray(off)[:, None] + t[None, :]
+        ok = t[None, :] < jnp.asarray(ln)[:, None]
+        idx = jnp.clip(idx, 0, vv.shape[1] - 1)
+        out = jnp.take_along_axis(
+            vv, idx.reshape(idx.shape + (1,) * (vv.ndim - 2)), axis=1)
+        mask = ok.reshape(ok.shape + (1,) * (vv.ndim - 2))
+        return jnp.where(mask, out, 0)
+
+    out = apply(f, input, name="sequence_slice")
+    return out, Tensor(jnp.asarray(ln))

@@ -217,6 +217,21 @@ class Unfold(Layer):
                         self.dilations)
 
 
+class Fold(Layer):
+    """col2im layer — inverse of Unfold (overlaps sum)."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings = strides, paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)
+
+
 class PairwiseDistance(Layer):
     """p-norm distance between row pairs (reference:
     nn/layer/distance.py PairwiseDistance over dist op)."""

@@ -11,6 +11,7 @@ the reference's beam_search / beam_search_decode op pair
 from __future__ import annotations
 
 import collections
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -157,10 +158,17 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     (reference rnn.py:1581). Eager loop (dygraph semantics); outputs are
     stacked over time — [time, ...] when ``output_time_major`` else
     batch-major."""
+    if impute_finished:
+        raise NotImplementedError(
+            "impute_finished=True is not implemented; finished beams "
+            "already hold their state via the decoder's finished mask.")
     inputs, states, finished = decoder.initialize(inits)
     step_outputs = []
     t = 0
-    limit = max_step_num if max_step_num is not None else 1 << 30
+    # Unbounded eager decode with an untrained cell can emit no end_token
+    # ever; cap the default so it terminates instead of hanging (reference
+    # rnn.py:1581 loops on a while-op with the same practical bound).
+    limit = max_step_num if max_step_num is not None else 1000
     while t < limit:
         out, states, inputs, finished = decoder.step(t, inputs, states,
                                                      **kwargs)
@@ -168,6 +176,11 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         t += 1
         if bool(jnp.all(unwrap(finished))):
             break
+    else:
+        if max_step_num is None:
+            warnings.warn(
+                "dynamic_decode: no beam emitted end_token within the "
+                "default 1000-step cap; pass max_step_num to raise it.")
     stacked = jax.tree_util.tree_map(
         lambda *xs: jnp.stack([unwrap(x) for x in xs], 0), *step_outputs)
     lengths = getattr(states, "lengths", None)

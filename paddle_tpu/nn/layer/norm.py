@@ -203,7 +203,71 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization: W / sigma_max(W) via power iteration
+    (reference: operators/spectral_norm_op.cc; python surface
+    fluid/dygraph/nn.py SpectralNorm). ``dim`` selects the axis treated as
+    the output dim; the weight is viewed as [h, w] = [shape[dim],
+    prod(rest)]. u/v are persistent buffers updated without gradient each
+    forward; gradients flow through the weight only (matching the
+    reference, which marks U/V as stop-gradient inputs)."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
                  name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned (round 2)")
+        import numpy as np
+
+        from ...core import rng
+
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._eps = float(eps)
+        self._weight_shape = [int(s) for s in weight_shape]
+        h = self._weight_shape[self._dim]
+        w = 1
+        for i, s in enumerate(self._weight_shape):
+            if i != self._dim:
+                w *= s
+        import jax
+
+        ku, kv = jax.random.split(rng.op_key())
+        u = jax.random.normal(ku, (h,), jnp.float32)
+        v = jax.random.normal(kv, (w,), jnp.float32)
+        self.register_buffer("weight_u", Tensor(
+            u / jnp.maximum(jnp.linalg.norm(u), self._eps)))
+        self.register_buffer("weight_v", Tensor(
+            v / jnp.maximum(jnp.linalg.norm(v), self._eps)))
+
+    def forward(self, weight):
+        import jax
+
+        from ...autograd.tape import apply
+
+        def f(wt, u, v):
+            perm = [self._dim] + [i for i in range(wt.ndim)
+                                  if i != self._dim]
+            mat = jnp.transpose(wt, perm).reshape(wt.shape[self._dim], -1)
+
+            def normalize(x):
+                return x / jnp.maximum(jnp.linalg.norm(x), self._eps)
+
+            def it(carry, _):
+                u_, v_ = carry
+                m = jax.lax.stop_gradient(mat)
+                v_ = normalize(m.T @ u_)
+                u_ = normalize(m @ v_)
+                return (u_, v_), None
+
+            (u, v), _ = jax.lax.scan(it, (u, v), None,
+                                     length=self._power_iters)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ (mat @ v)
+            return wt / sigma, u, v
+
+        out, u_new, v_new = apply(f, weight, self.weight_u, self.weight_v,
+                                  name="spectral_norm")
+        # power-iteration state persists across calls (buffer update, no
+        # tape node — same as BatchNorm running stats)
+        self.weight_u._value = u_new._value
+        self.weight_v._value = v_new._value
+        return out

@@ -15,7 +15,24 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor
 from ..tensor._helper import apply
 
-__all__ = ["cond", "while_loop", "case", "switch_case"]
+# paddle.static.nn is also the 2.x home of the sequence (LoD) op family
+# (reference: python/paddle/fluid/layers/sequence_lod.py, re-exported as
+# paddle.static.nn.sequence_*)
+from ..nn.functional.sequence_lod import (sequence_mask, sequence_pad,  # noqa: F401,E402
+                                          sequence_unpad, sequence_pool,
+                                          sequence_first_step,
+                                          sequence_last_step,
+                                          sequence_expand, sequence_expand_as,
+                                          sequence_concat, sequence_softmax,
+                                          sequence_reverse, sequence_conv,
+                                          sequence_enumerate, sequence_slice)
+
+__all__ = ["cond", "while_loop", "case", "switch_case",
+           "sequence_mask", "sequence_pad", "sequence_unpad",
+           "sequence_pool", "sequence_first_step", "sequence_last_step",
+           "sequence_expand", "sequence_expand_as", "sequence_concat",
+           "sequence_softmax", "sequence_reverse", "sequence_conv",
+           "sequence_enumerate", "sequence_slice"]
 
 
 def _tensors_in(vals):

@@ -46,6 +46,30 @@ def shape_arg(shape):
     return tuple(out)
 
 
+def inplace_apply(fn, x, *extra, name=""):
+    """Differentiable in-place op (``relu_``, ``squeeze_`` …).
+
+    The reference's generated ``core.ops.<op>_`` fast paths are fully
+    differentiable (pybind/op_function_generator.cc registers grad nodes for
+    inplace variants). Mutating ``x._value`` alone would silently drop the
+    op from the tape, so instead: snapshot ``x``'s pre-mutation state into a
+    detached alias, run the op through the tape against the alias, then
+    rebind ``x`` to the result *object state* in place. Downstream consumers
+    of ``x`` see the new value and the new tape node; backward flows through
+    the alias into ``x``'s original producer.
+    """
+    if not isinstance(x, Tensor):
+        return wrap(fn(x, *(unwrap(e) for e in extra)))
+    prev = Tensor.__new__(Tensor)
+    prev.__dict__.update(x.__dict__)
+    out = _apply(fn, prev, *extra, name=name)
+    x._value = out._value
+    x._node = out._node
+    x._out_idx = out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
 def make_unary(jnp_fn, opname):
     def op(x, name=None):
         return apply(jnp_fn, x, name=opname)

@@ -359,12 +359,18 @@ def shape(input, name=None):  # noqa: A002
 
 
 def squeeze_(x, axis=None, name=None):
-    """Inplace squeeze (reference: paddle.squeeze_)."""
-    x._value = squeeze(x, axis=axis)._value
-    return x
+    """Inplace squeeze (reference: paddle.squeeze_), differentiable via
+    tape rebinding."""
+    from ._helper import inplace_apply
+
+    return inplace_apply(lambda v: jnp.squeeze(v, axis_arg(axis)), x,
+                         name="squeeze_")
 
 
 def unsqueeze_(x, axis, name=None):
-    """Inplace unsqueeze (reference: paddle.unsqueeze_)."""
-    x._value = unsqueeze(x, axis)._value
-    return x
+    """Inplace unsqueeze (reference: paddle.unsqueeze_), differentiable via
+    tape rebinding."""
+    from ._helper import inplace_apply
+
+    return inplace_apply(lambda v: jnp.expand_dims(v, axis_arg(axis)), x,
+                         name="unsqueeze_")

@@ -296,6 +296,8 @@ def renorm(x, p, axis, max_norm, name=None):
 
 
 def tanh_(x, name=None):
-    """Inplace tanh (reference: paddle.tanh_)."""
-    x._value = jnp.tanh(x._value)
-    return x
+    """Inplace tanh (reference: paddle.tanh_), differentiable via tape
+    rebinding."""
+    from ._helper import inplace_apply
+
+    return inplace_apply(jnp.tanh, x, name="tanh_")

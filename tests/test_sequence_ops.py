@@ -1,0 +1,288 @@
+"""Sequence (LoD) family, edit_distance, fold, SpectralNorm — the round-4
+op tail (reference: paddle/fluid/operators/sequence_ops/,
+edit_distance_op.cc, unfold_op.cc, spectral_norm_op.cc). NumPy-golden
+forward + finite-diff grads per the OpTest contract (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+from op_test import check_grad, check_output
+
+
+def test_sequence_mask():
+    lens = np.array([2, 0, 3], np.int64)
+    out = F.sequence_mask(paddle.to_tensor(lens), maxlen=4, dtype="int32")
+    np.testing.assert_array_equal(
+        out.numpy(), [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+    # maxlen inferred
+    out2 = F.sequence_mask(paddle.to_tensor(lens))
+    assert out2.shape == [3, 3]
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lens = np.array([2, 1, 3], np.int64)
+    padded, out_len = F.sequence_pad(paddle.to_tensor(flat), 0.0,
+                                     length=paddle.to_tensor(lens))
+    assert padded.shape == [3, 3, 2]
+    np.testing.assert_array_equal(out_len.numpy(), lens)
+    np.testing.assert_allclose(padded.numpy()[0], [[0, 1], [2, 3], [0, 0]])
+    np.testing.assert_allclose(padded.numpy()[1], [[4, 5], [0, 0], [0, 0]])
+    np.testing.assert_allclose(padded.numpy()[2], [[6, 7], [8, 9], [10, 11]])
+    # pad_value + maxlen
+    p2, _ = F.sequence_pad(paddle.to_tensor(flat), -1.0, maxlen=4,
+                           length=paddle.to_tensor(lens))
+    assert p2.shape == [3, 4, 2] and p2.numpy()[1, 1, 0] == -1.0
+    # unpad inverts
+    back = F.sequence_unpad(padded, paddle.to_tensor(lens))
+    np.testing.assert_allclose(back.numpy(), flat)
+
+
+def test_sequence_pad_grad():
+    lens = np.array([2, 1], np.int64)
+
+    def op(x):
+        return F.sequence_pad(x, 0.0, length=paddle.to_tensor(lens))[0]
+
+    check_grad(op, {"x": np.random.rand(3, 2).astype(np.float32)}, ["x"])
+
+
+@pytest.mark.parametrize("pool", ["sum", "average", "sqrt", "max", "first",
+                                  "last"])
+def test_sequence_pool(pool):
+    x = np.random.rand(3, 4, 2).astype(np.float32)
+    lens = np.array([2, 4, 1], np.int64)
+    got = F.sequence_pool(paddle.to_tensor(x), pool,
+                          length=paddle.to_tensor(lens)).numpy()
+    for b, n in enumerate(lens):
+        seg = x[b, :n]
+        want = {"sum": seg.sum(0), "average": seg.mean(0),
+                "sqrt": seg.sum(0) / np.sqrt(n), "max": seg.max(0),
+                "first": seg[0], "last": seg[-1]}[pool]
+        np.testing.assert_allclose(got[b], want, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_pool_empty_seq_pad_value():
+    x = np.random.rand(2, 3, 2).astype(np.float32)
+    lens = np.array([0, 2], np.int64)
+    got = F.sequence_pool(paddle.to_tensor(x), "max",
+                          length=paddle.to_tensor(lens),
+                          pad_value=7.0).numpy()
+    np.testing.assert_allclose(got[0], 7.0)
+
+
+def test_sequence_pool_grad():
+    lens = np.array([2, 3], np.int64)
+    for pool in ("sum", "average", "max"):
+        def op(x, _pool=pool):
+            return F.sequence_pool(x, _pool, length=paddle.to_tensor(lens))
+
+        check_grad(op, {"x": np.random.rand(2, 3, 2).astype(np.float32)},
+                   ["x"])
+
+
+def test_sequence_expand_and_as():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    counts = np.array([2, 3], np.int64)
+    out = F.sequence_expand(paddle.to_tensor(x), paddle.to_tensor(counts))
+    np.testing.assert_allclose(
+        out.numpy(), [[1, 2], [1, 2], [3, 4], [3, 4], [3, 4]])
+    out2 = F.sequence_expand_as(paddle.to_tensor(x), None,
+                                y_length=paddle.to_tensor(counts))
+    np.testing.assert_allclose(out2.numpy(), out.numpy())
+
+
+def test_sequence_concat():
+    a = np.arange(8, dtype=np.float32).reshape(2, 2, 2)
+    b = np.arange(100, 112, dtype=np.float32).reshape(2, 3, 2)
+    la = np.array([1, 2], np.int64)
+    lb = np.array([3, 1], np.int64)
+    out, lens = F.sequence_concat(
+        [paddle.to_tensor(a), paddle.to_tensor(b)],
+        lengths=[paddle.to_tensor(la), paddle.to_tensor(lb)])
+    np.testing.assert_array_equal(lens.numpy(), [4, 3])
+    np.testing.assert_allclose(out.numpy()[0, :4],
+                               np.concatenate([a[0, :1], b[0, :3]]))
+    np.testing.assert_allclose(out.numpy()[1, :3],
+                               np.concatenate([a[1, :2], b[1, :1]]))
+
+
+def test_sequence_softmax():
+    x = np.random.rand(2, 4).astype(np.float32)
+    lens = np.array([3, 1], np.int64)
+    got = F.sequence_softmax(paddle.to_tensor(x[..., None]),
+                             length=paddle.to_tensor(lens)).numpy()[..., 0]
+    for b, n in enumerate(lens):
+        e = np.exp(x[b, :n] - x[b, :n].max())
+        np.testing.assert_allclose(got[b, :n], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(got[b, n:], 0.0)
+
+
+def test_sequence_reverse():
+    x = np.arange(12, dtype=np.float32).reshape(2, 3, 2)
+    lens = np.array([2, 3], np.int64)
+    got = F.sequence_reverse(paddle.to_tensor(x),
+                             length=paddle.to_tensor(lens)).numpy()
+    np.testing.assert_allclose(got[0], [x[0, 1], x[0, 0], x[0, 2]])
+    np.testing.assert_allclose(got[1], x[1, ::-1])
+
+
+def test_sequence_conv_matches_manual():
+    b_, t_, d_, m_, cl = 2, 4, 3, 5, 3
+    x = np.random.rand(b_, t_, d_).astype(np.float32)
+    w = np.random.rand(cl * d_, m_).astype(np.float32)
+    lens = np.array([4, 2], np.int64)
+    got = F.sequence_conv(paddle.to_tensor(x), paddle.to_tensor(w),
+                          length=paddle.to_tensor(lens),
+                          context_length=cl).numpy()
+    # manual: context_start = -1; zero outside [0, len)
+    for b in range(b_):
+        for t in range(int(lens[b])):
+            ctx = []
+            for k in range(cl):
+                s = t - 1 + k
+                ctx.append(x[b, s] if 0 <= s < lens[b]
+                           else np.zeros(d_, np.float32))
+            want = np.concatenate(ctx) @ w
+            np.testing.assert_allclose(got[b, t], want, rtol=1e-4,
+                                       atol=1e-5)
+        np.testing.assert_allclose(got[b, int(lens[b]):], 0.0)
+
+
+def test_sequence_conv_grad():
+    lens = np.array([3, 2], np.int64)
+
+    def op(x, w):
+        return F.sequence_conv(x, w, length=paddle.to_tensor(lens),
+                               context_length=3)
+
+    check_grad(op, {"x": np.random.rand(2, 3, 2).astype(np.float32),
+                    "w": np.random.rand(6, 4).astype(np.float32)},
+               ["x", "w"])
+
+
+def test_sequence_enumerate():
+    x = np.array([[1, 2, 3], [4, 5, 6]], np.int64)
+    got = F.sequence_enumerate(paddle.to_tensor(x), 2, pad_value=0).numpy()
+    np.testing.assert_array_equal(
+        got, [[[1, 2], [2, 3], [3, 0]], [[4, 5], [5, 6], [6, 0]]])
+
+
+def test_sequence_slice():
+    x = np.arange(24, dtype=np.float32).reshape(2, 6, 2)
+    out, lens = F.sequence_slice(paddle.to_tensor(x),
+                                 paddle.to_tensor(np.array([1, 2])),
+                                 paddle.to_tensor(np.array([2, 3])))
+    np.testing.assert_array_equal(lens.numpy(), [2, 3])
+    np.testing.assert_allclose(out.numpy()[0, :2], x[0, 1:3])
+    np.testing.assert_allclose(out.numpy()[1, :3], x[1, 2:5])
+
+
+def _lev(a, b):
+    m, n = len(a), len(b)
+    d = np.zeros((m + 1, n + 1), np.int64)
+    d[:, 0] = np.arange(m + 1)
+    d[0, :] = np.arange(n + 1)
+    for i in range(1, m + 1):
+        for j in range(1, n + 1):
+            d[i, j] = min(d[i - 1, j] + 1, d[i, j - 1] + 1,
+                          d[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+    return d[m, n]
+
+
+def test_edit_distance():
+    rng = np.random.RandomState(0)
+    a = rng.randint(0, 5, (4, 6)).astype(np.int64)
+    b = rng.randint(0, 5, (4, 5)).astype(np.int64)
+    la = np.array([6, 3, 0, 4], np.int64)
+    lb = np.array([5, 5, 2, 1], np.int64)
+    dist, num = F.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                                normalized=False,
+                                input_length=paddle.to_tensor(la),
+                                label_length=paddle.to_tensor(lb))
+    assert num.numpy()[0] == 4
+    for i in range(4):
+        want = _lev(list(a[i, :la[i]]), list(b[i, :lb[i]]))
+        assert dist.numpy()[i, 0] == want, (i, dist.numpy()[i, 0], want)
+
+
+def test_edit_distance_normalized_and_ignored():
+    a = np.array([[1, 2, 3]], np.int64)
+    b = np.array([[1, 9, 3, 0]], np.int64)
+    d, _ = F.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                           normalized=True,
+                           label_length=paddle.to_tensor(
+                               np.array([3], np.int64)))
+    np.testing.assert_allclose(d.numpy(), [[1.0 / 3.0]])
+    # ignoring token 9 in the label makes it a deletion-only diff
+    d2, _ = F.edit_distance(paddle.to_tensor(a), paddle.to_tensor(b),
+                            normalized=False, ignored_tokens=[9, 0])
+    np.testing.assert_allclose(d2.numpy(), [[1.0]])  # [1,2,3] vs [1,3]
+
+
+def test_fold_inverts_unfold_counts():
+    # fold(unfold(x)) multiplies each pixel by its patch-coverage count
+    x = np.random.rand(2, 3, 6, 6).astype(np.float32)
+    cols = F.unfold(paddle.to_tensor(x), 2, strides=2)
+    back = F.fold(cols, [6, 6], 2, strides=2)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-5)  # disjoint: count=1
+    # overlapping: interior counted k times
+    cols2 = F.unfold(paddle.to_tensor(x), 3, strides=1, paddings=1)
+    back2 = F.fold(cols2, [6, 6], 3, strides=1, paddings=1)
+    ones = np.ones_like(x)
+    cnt = F.fold(F.unfold(paddle.to_tensor(ones), 3, strides=1, paddings=1),
+                 [6, 6], 3, strides=1, paddings=1).numpy()
+    np.testing.assert_allclose(back2.numpy(), x * cnt, rtol=1e-5)
+
+
+def test_fold_grad():
+    def op(x):
+        return F.fold(x, [4, 4], 2, strides=2)
+
+    check_grad(op, {"x": np.random.rand(1, 4, 4).astype(np.float32)}, ["x"])
+
+
+def test_fold_layer():
+    layer = nn.Fold([4, 4], 2, strides=2)
+    x = paddle.to_tensor(np.random.rand(1, 4, 4).astype(np.float32))
+    assert layer(x).shape == [1, 1, 4, 4]
+
+
+def test_spectral_norm_matches_svd():
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 6).astype(np.float32)
+    sn = nn.SpectralNorm(w.shape, dim=0, power_iters=60)
+    out = sn(paddle.to_tensor(w)).numpy()
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(out, w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_spectral_norm_conv_dim1_and_state():
+    rng = np.random.RandomState(2)
+    w = rng.randn(3, 4, 2, 2).astype(np.float32)
+    sn = nn.SpectralNorm(w.shape, dim=1, power_iters=30)
+    u0 = sn.weight_u.numpy().copy()
+    out = sn(paddle.to_tensor(w)).numpy()
+    assert not np.allclose(u0, sn.weight_u.numpy())  # state advanced
+    mat = np.transpose(w, (1, 0, 2, 3)).reshape(4, -1)
+    sigma = np.linalg.svd(mat, compute_uv=False)[0]
+    np.testing.assert_allclose(out, w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def test_spectral_norm_grad_flows():
+    w = paddle.to_tensor(np.random.randn(3, 3).astype(np.float32))
+    w.stop_gradient = False
+    sn = nn.SpectralNorm([3, 3], power_iters=5)
+    sn(w).sum().backward()
+    assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
+
+
+def test_static_nn_namespace():
+    from paddle_tpu.static import nn as snn
+
+    for name in ("sequence_pad", "sequence_pool", "sequence_mask",
+                 "sequence_conv", "sequence_expand"):
+        assert hasattr(snn, name)
