@@ -1,4 +1,4 @@
-"""Train GPT-3 1.3B on ONE 16 GB TPU v5e chip.
+"""Train GPT-3 1.3B on ONE 16 GB TPU v5e chip, from an on-disk corpus.
 
 The memory recipe (distributed/hybrid.py knobs; measured MFU 0.57 =
 12.4k tokens/s on a v5e, BENCH_r03):
@@ -11,6 +11,15 @@ The memory recipe (distributed/hybrid.py knobs; measured MFU 0.57 =
   - free_eager (drops the init-time f32 eager weights, 5.3 GB),
   - gradient accumulation via n_micro (pipeline machinery with pp=1).
 
+The data path is the native C++ engine's strided-window zero-copy mode
+(native/src/data_engine.cc:17-21): the corpus is ONE mmap'd flat int32
+token file; each sample is an overlapping [seq_len+1] window gathered
+straight out of the mapping by C++ worker threads (GIL released) — no
+windows are ever materialized host-side. ``--corpus FILE.bin`` points at
+any flat int32 token dump; without it the example builds one at
+/tmp/paddle_tpu_corpus.bin by byte-level tokenizing real text (Python
+stdlib sources on this machine).
+
 Swap the dtype knobs for ``offload_params=True, offload_optimizer=True``
 to keep an f32 master in pinned_host instead (ZeRO-Offload layout:
 lower MFU, full f32 fidelity; see LOSSCURVE_r03.json for the measured
@@ -18,6 +27,7 @@ bf16-vs-f32 loss parity).
 
 On CPU this runs a tiny config as a smoke test.
 """
+import glob
 import os
 import sys
 import time
@@ -29,10 +39,40 @@ import paddle_tpu as paddle
 from paddle_tpu.distributed.fleet import DistributedStrategy
 from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
 from paddle_tpu.distributed.mesh import create_mesh
+from paddle_tpu.io.native_engine import token_windows
 from paddle_tpu.models import GPT, GPTConfig
 
+CORPUS = "/tmp/paddle_tpu_corpus.bin"
 
-def main(steps=10):
+
+def build_corpus(path=CORPUS, target_mb=8):
+    """Byte-level tokenize real text (stdlib .py sources) into a flat
+    int32 file — the corpus format the strided-window loader mmaps."""
+    if os.path.exists(path):
+        return path
+    import sysconfig
+
+    srcs = sorted(glob.glob(os.path.join(
+        sysconfig.get_paths()["stdlib"], "*.py")))
+    out, total = [], 0
+    for fn in srcs:
+        try:
+            with open(fn, "rb") as f:
+                data = f.read()
+        except OSError:
+            continue
+        out.append(np.frombuffer(data, np.uint8).astype(np.int32))
+        total += len(data)
+        if total >= target_mb * 1024 * 1024:
+            break
+    tokens = np.concatenate(out)
+    tokens.tofile(path)
+    print(f"built corpus: {path} ({len(tokens):,} tokens from "
+          f"{len(out)} files)")
+    return path
+
+
+def main(steps=10, corpus=None):
     import jax
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -59,24 +99,54 @@ def main(steps=10):
         free_eager=on_tpu)
 
     batch, seq = micro * n_micro, cfg.max_seq_len
-    rng = np.random.RandomState(0)
-    for i in range(steps):
-        tokens = rng.randint(0, cfg.vocab_size,
-                             (batch, seq)).astype(np.int32)
-        t0 = time.perf_counter()
-        loss = trainer.step(tokens)
-        loss_v = float(np.asarray(loss))   # truthful sync
-        dt = time.perf_counter() - t0
-        toks = batch * seq / dt
-        print(f"step {i}: loss {loss_v:.4f}  {toks:,.0f} tokens/s "
-              f"({dt*1e3:.0f} ms)", flush=True)
 
-    if on_tpu and hasattr(trainer, "memory_analysis"):
-        ma = trainer.memory_analysis(tokens)
+    # mmap the corpus; windows of seq+1 (input + shifted label in one
+    # row) gathered zero-copy by the native engine
+    path = corpus or build_corpus()
+    tokens = np.memmap(path, dtype=np.int32, mode="r")
+    loader = token_windows(tokens, seq_len=seq, batch_size=batch,
+                           shuffle=True, seed=0, epochs=10**6,
+                           num_workers=2)
+
+    curve = []
+    try:
+        for i in range(steps):
+            (window,) = next(loader)
+            # byte-level corpus: ids already < 256 <= vocab
+            toks = window[:, :seq].astype(np.int32)
+            t0 = time.perf_counter()
+            loss = trainer.step(toks)
+            loss_v = float(np.asarray(loss))   # truthful sync
+            dt = time.perf_counter() - t0
+            tps = batch * seq / dt
+            curve.append(round(loss_v, 4))
+            print(f"step {i}: loss {loss_v:.4f}  {tps:,.0f} tokens/s "
+                  f"({dt*1e3:.0f} ms)", flush=True)
+    finally:
+        loader.close()
+    print("loss curve:", curve)
+    # warmup-free AdamW spikes in the first few steps; judge progress
+    # over a window (measured on TPU: 10.94 → 5.86 by step 11)
+    if len(curve) >= 10:
+        assert np.mean(curve[-3:]) < np.mean(curve[:3]), \
+            f"no learning progress on real corpus: {curve}"
+
+    if on_tpu and steps > 0 and hasattr(trainer, "memory_analysis"):
+        ma = trainer.memory_analysis(toks)
         if ma and "peak_bytes_est" in ma:
             print(f"compiled HBM peak ≈ "
                   f"{ma['peak_bytes_est'] / 1024**3:.2f} GiB")
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 10)
+    corpus, args = None, []
+    argv = sys.argv[1:]
+    while argv:
+        a = argv.pop(0)
+        if a.startswith("--corpus="):
+            corpus = a.split("=", 1)[1]
+        elif a == "--corpus":
+            corpus = argv.pop(0)
+        else:
+            args.append(a)
+    main(int(args[0]) if args else 10, corpus=corpus)

@@ -25,6 +25,7 @@ from .core.rng import get_rng_state as get_cuda_rng_state  # noqa: F401
 from .core.rng import set_rng_state as set_cuda_rng_state  # noqa: F401
 from .device import get_cudnn_version, is_compiled_with_xpu  # noqa: F401
 from .framework import ParamAttr, Parameter, Tensor, to_tensor  # noqa: F401
+from .framework.lazy import LazyGuard  # noqa: F401
 from .framework.printoptions import set_printoptions  # noqa: F401
 
 # dtype names at top level (paddle.float32 style)

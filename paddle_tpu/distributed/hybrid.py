@@ -168,6 +168,15 @@ class HybridPipelineTrainer:
         self._name2tensor = name2t
         self._per_block_tensors = per_block_tensors
 
+        # LazyGuard (framework/lazy.py) models: every param is a
+        # ShapeDtypeStruct. The trainer then *plans* instead of allocating
+        # — stack/cast/shard through jax.eval_shape, optimizer state via
+        # eval_shape of _init_state, and step() is AOT-only
+        # (lower/compile/memory_analysis). This is the 13B path: planning
+        # a 156 GB-state model allocates nothing anywhere.
+        from ..framework.lazy import is_abstract
+        self.abstract = any(is_abstract(t) for t in pt)
+
         dp = self.mesh.shape.get("dp", 1)
 
         # stacked block params: [pp, lps, ...] (GPipe) or
@@ -176,18 +185,26 @@ class HybridPipelineTrainer:
         self.block_vals: Dict[str, jax.Array] = {}
         self.block_specs: Dict[str, P] = {}
         for j, sfx in enumerate(self.block_suffixes):
-            per_layer = [per_block_tensors[i][j]._value for i in range(L)]
-            stacked = jnp.stack(per_layer, 0)
+            base = per_block_tensors[0][j]._value
             if self.v == 1:
-                stacked = stacked.reshape(
-                    (self.pp, self.lps) + per_layer[0].shape)
+                full_shape = (self.pp, self.lps) + tuple(base.shape)
                 extra = (None,)
             else:
                 lps_v = self.lps // self.v
-                stacked = stacked.reshape(
-                    (self.v, self.pp, lps_v) + per_layer[0].shape)
-                stacked = jnp.swapaxes(stacked, 0, 1)   # [pp, v, lps_v,...]
+                full_shape = (self.pp, self.v, lps_v) + tuple(base.shape)
                 extra = (None, None)
+            if self.abstract:
+                stacked = jax.ShapeDtypeStruct(full_shape, base.dtype)
+            else:
+                per_layer = [per_block_tensors[i][j]._value
+                             for i in range(L)]
+                stacked = jnp.stack(per_layer, 0)
+                if self.v == 1:
+                    stacked = stacked.reshape(full_shape)
+                else:
+                    stacked = stacked.reshape(
+                        (self.v, self.pp, lps_v) + per_layer[0].shape)
+                    stacked = jnp.swapaxes(stacked, 0, 1)  # [pp,v,lps_v,...]
             spec0 = base_specs[self._blk0_fullnames[j]]
             pp_ax = "pp" if "pp" in self.mesh.axis_names else None
             spec = P(pp_ax, *extra, *spec0)
@@ -195,11 +212,18 @@ class HybridPipelineTrainer:
                 shape = _local_check_shape(stacked.shape, spec, self.mesh)
                 spec = _add_axis(spec, stacked.ndim, shape, "dp", dp)
             self.block_specs[sfx] = spec
+            dt = stacked.dtype
             if self.param_dtype is not None and \
-                    jnp.issubdtype(stacked.dtype, jnp.floating):
-                stacked = stacked.astype(self.param_dtype)
-            self.block_vals[sfx] = jax.device_put(
-                stacked, self._param_ns(spec))
+                    jnp.issubdtype(dt, jnp.floating):
+                dt = self.param_dtype
+            if self.abstract:
+                self.block_vals[sfx] = jax.ShapeDtypeStruct(
+                    full_shape, dt, sharding=self._param_ns(spec))
+            else:
+                if dt != stacked.dtype:
+                    stacked = stacked.astype(dt)
+                self.block_vals[sfx] = jax.device_put(
+                    stacked, self._param_ns(spec))
 
         self.other_vals: List[jax.Array] = []
         self.other_specs: List[P] = []
@@ -211,11 +235,18 @@ class HybridPipelineTrainer:
                 spec = _add_axis(spec, t._value.ndim, shape, "dp", dp)
             self.other_specs.append(spec)
             v = t._value
+            dt = v.dtype
             if self.param_dtype is not None and \
-                    jnp.issubdtype(v.dtype, jnp.floating):
-                v = v.astype(self.param_dtype)
-            self.other_vals.append(jax.device_put(
-                v, self._param_ns(spec)))
+                    jnp.issubdtype(dt, jnp.floating):
+                dt = self.param_dtype
+            if self.abstract:
+                self.other_vals.append(jax.ShapeDtypeStruct(
+                    tuple(v.shape), dt, sharding=self._param_ns(spec)))
+            else:
+                if dt != v.dtype:
+                    v = v.astype(dt)
+                self.other_vals.append(jax.device_put(
+                    v, self._param_ns(spec)))
 
         # --- optimizer state ----------------------------------------------
         def opt_state_spec(spec, shape, ndim):
@@ -239,25 +270,43 @@ class HybridPipelineTrainer:
             self.mesh, sp, memory_kind="pinned_host") \
             if self.offload_optimizer else NamedSharding(self.mesh, sp)
 
+        def init_opt_state(v, sp):
+            """Optimizer state for one (stacked) param: real arrays
+            normally; shape-only (eval_shape of _init_state) in abstract
+            mode, with the moment-dtype cast applied to the metadata."""
+            if not self.abstract:
+                s = cast_state(optimizer._init_state(_FakeParam(v)))
+                return jax.device_put(s, {k: self._opt_ns(sp) for k in s})
+            s = jax.eval_shape(
+                lambda vv: optimizer._init_state(_FakeParam(vv)),
+                jax.ShapeDtypeStruct(v.shape, v.dtype))
+            out = {}
+            for k, sd in s.items():
+                dt = sd.dtype
+                if self.moment_dtype is not None and \
+                        jnp.issubdtype(dt, jnp.floating):
+                    dt = self.moment_dtype
+                out[k] = jax.ShapeDtypeStruct(
+                    tuple(sd.shape), dt, sharding=self._opt_ns(sp))
+            return out
+
         self.block_opt: Dict[str, dict] = {}
         self.block_opt_specs: Dict[str, dict] = {}
         for sfx, v in self.block_vals.items():
-            s = cast_state(optimizer._init_state(_FakeParam(v)))
             sp = opt_state_spec(self.block_specs[sfx], v.shape, v.ndim)
-            self.block_opt[sfx] = jax.device_put(
-                s, {k: self._opt_ns(sp) for k in s})
+            s = init_opt_state(v, sp)
+            self.block_opt[sfx] = s
             self.block_opt_specs[sfx] = {k: sp for k in s}
         self.other_opt: List[dict] = []
         self.other_opt_specs: List[dict] = []
         for n, v, spec in zip(self.other_names, self.other_vals,
                               self.other_specs):
-            s = cast_state(optimizer._init_state(_FakeParam(v)))
             sp = opt_state_spec(spec, v.shape, v.ndim)
-            self.other_opt.append(jax.device_put(
-                s, {k: self._opt_ns(sp) for k in s}))
+            s = init_opt_state(v, sp)
+            self.other_opt.append(s)
             self.other_opt_specs.append({k: sp for k in s})
 
-        if free_eager:
+        if free_eager and not self.abstract:
             # device_put may return a NEW Array sharing the SAME buffer
             # when dtype+sharding are unchanged, so aliasing cannot be
             # detected by identity. Delete only buffers that are
@@ -543,6 +592,12 @@ class HybridPipelineTrainer:
     def step(self, *batch) -> jax.Array:
         from ..core import rng as rng_mod
 
+        if self.abstract:
+            raise RuntimeError(
+                "This trainer was built from a LazyGuard (abstract) model "
+                "— it can plan (memory_analysis / aot_lower) but not "
+                "execute. Materialize the model (framework.lazy."
+                "materialize) and rebuild the trainer to train.")
         if self._step_fn is None or self._n_batch_args != len(batch):
             self._build(len(batch))
         self._step += 1
@@ -569,20 +624,7 @@ class HybridPipelineTrainer:
         ``peak ≈ arguments − aliased + temps`` (donated state re-uses its
         argument buffers; offloaded state is host-resident and excluded
         from the HBM argument total by XLA's per-space accounting)."""
-        if self._step_fn is None or self._n_batch_args != len(batch):
-            self._build(len(batch))
-        vs = []
-        for b in batch:
-            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
-            vs.append(jax.device_put(v, NamedSharding(
-                self.mesh, self._batch_spec(v.ndim))))
-        # constant key: only avals matter for lowering, and a diagnostic
-        # must not advance the training RNG stream
-        lowered = self._step_fn.lower(
-            self.block_vals, self.other_vals, self.block_opt,
-            self.other_opt, tuple(vs), jnp.asarray(0.0, jnp.float32),
-            jnp.asarray(0, jnp.int32), jax.random.PRNGKey(0))
-        ma = lowered.compile().memory_analysis()
+        ma = self.aot_compile(*batch).memory_analysis()
         if ma is None:
             return None
         out = {k: int(getattr(ma, k)) for k in
@@ -595,6 +637,35 @@ class HybridPipelineTrainer:
                                      - out["alias_size_in_bytes"]
                                      + out["temp_size_in_bytes"])
         return out
+
+    def aot_lower(self, *batch):
+        """AOT-lower the train step without executing anything. ``batch``
+        entries may be Tensors, arrays, or ``jax.ShapeDtypeStruct``s
+        (required in abstract/LazyGuard mode — nothing is materialized
+        anywhere in that path)."""
+        if self._step_fn is None or self._n_batch_args != len(batch):
+            self._build(len(batch))
+        vs = []
+        for b in batch:
+            if isinstance(b, jax.ShapeDtypeStruct):
+                vs.append(jax.ShapeDtypeStruct(
+                    tuple(b.shape), b.dtype, sharding=NamedSharding(
+                        self.mesh, self._batch_spec(len(b.shape)))))
+            else:
+                v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+                vs.append(jax.device_put(v, NamedSharding(
+                    self.mesh, self._batch_spec(v.ndim))))
+        # constant key: only avals matter for lowering, and a diagnostic
+        # must not advance the training RNG stream
+        return self._step_fn.lower(
+            self.block_vals, self.other_vals, self.block_opt,
+            self.other_opt, tuple(vs),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+
+    def aot_compile(self, *batch):
+        return self.aot_lower(*batch).compile()
 
     # -- sharded checkpoint integration (distributed/checkpoint.py) -------
     def device_state(self):

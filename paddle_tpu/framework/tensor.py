@@ -32,6 +32,18 @@ class Tensor:
                  stop_gradient: bool = True, name: Optional[str] = None):
         if isinstance(value, Tensor):
             value = value._value
+        if isinstance(value, jax.ShapeDtypeStruct):
+            # abstract (LazyGuard) tensor: metadata only, no buffer —
+            # the 13B-scale AOT planning path (framework/lazy.py)
+            self._value = value
+            self.stop_gradient = stop_gradient
+            self.grad = None
+            self._node = None
+            self._out_idx = 0
+            self.name = name or ""
+            self.persistable = False
+            self._place = place
+            return
         if not isinstance(value, (jax.Array,)) or dtype is not None:
             d = dtype_mod.convert_dtype(dtype) if dtype is not None else None
             if d is None and isinstance(value, (float,)):

@@ -290,6 +290,29 @@ def default_collate_fn(batch):
     return batch
 
 
+class WorkerInfo:
+    """Per-worker context visible inside an IterableDataset.__iter__
+    (reference: fluid/reader.py worker loop sets a module-global
+    _worker_info; public API paddle.io.get_worker_info). A
+    sharding-aware iterable dataset reads ``id``/``num_workers`` and
+    yields only its split; a naive dataset iterated by N workers yields
+    every sample N times — same contract as the reference."""
+
+    def __init__(self, id, num_workers, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+_worker_tls = threading.local()
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: that worker's WorkerInfo; None in the
+    main thread (reference: paddle.io.get_worker_info)."""
+    return getattr(_worker_tls, "info", None)
+
+
 class _PoolState:
     """Shared state of a DataLoader worker pool. Lives OUTSIDE the
     iterator so worker threads never hold a strong reference to it —
@@ -305,7 +328,18 @@ class _PoolState:
         self.results = {}
         self.dispatched = 0
         self.dispatch_done = False
+        # iterable mode: per-worker produced-batch counts, recorded when
+        # each worker's stream ends. Worker w's k-th batch is published at
+        # seq k*nw + w (round-robin interleave — deterministic delivery
+        # order); the consumer skips seqs that can never arrive.
+        self.worker_counts = {}
         self.inflight = threading.Semaphore(prefetch * nw)
+        # iterable mode: per-worker backpressure. A shared semaphore
+        # would deadlock: a fast worker could hold every permit while the
+        # consumer waits (in round-robin order) on a slow worker that is
+        # itself parked in acquire().
+        self.worker_sems = [threading.Semaphore(prefetch)
+                            for _ in range(nw)]
         self.work_q = queue.Queue()
 
     def publish(self, seq, item):
@@ -321,6 +355,13 @@ class _PoolState:
         for _ in range(self.nw):
             self.work_q.put((None, self.END))
 
+    def finish_worker(self, wid, count):
+        with self.cond:
+            self.worker_counts[wid] = count
+            if len(self.worker_counts) == self.nw:
+                self.dispatch_done = True
+            self.cond.notify_all()
+
     def shutdown(self):
         """Idempotent: unblock the dispatcher (parked in acquire) and the
         workers (parked in get) so every pool thread exits."""
@@ -329,6 +370,8 @@ class _PoolState:
         self.stop.set()
         for _ in range(self.nw + 1):
             self.inflight.release()
+        for sem in self.worker_sems:
+            sem.release()
         for _ in range(self.nw):
             self.work_q.put((None, self.END))
         with self.cond:
@@ -360,24 +403,36 @@ def _pool_map_worker(state, dataset, collate_fn):
 
 
 def _pool_iterable_worker(state, dataset, collate_fn, batch_size,
-                          drop_last):
-    seq = 0
+                          drop_last, wid):
+    """One of nw streams over an IterableDataset. Exposes WorkerInfo so
+    sharding-aware datasets yield their split (reference
+    fluid/reader.py:91 worker semantics); publishes its k-th batch at
+    seq k*nw + wid."""
+    _worker_tls.info = WorkerInfo(wid, state.nw, dataset)
+    k = 0
     try:
         it = iter(dataset)
+        if it is dataset and wid != 0:
+            # __iter__ returned the dataset itself: ONE shared iterator,
+            # which N threads cannot drive safely (a generator would
+            # raise "already executing"; a stateful __next__ would lose
+            # samples). Fall back to the single-stream behavior — only
+            # worker 0 consumes it.
+            return
         while not state.stop.is_set():
             batch = list(itertools.islice(it, batch_size))
             if not batch or (drop_last and len(batch) < batch_size):
                 break
-            state.inflight.acquire()
+            state.worker_sems[wid].acquire()
             if state.stop.is_set():
                 break
-            state.publish(seq, collate_fn(batch))
-            seq += 1
+            state.publish(k * state.nw + wid, collate_fn(batch))
+            k += 1
     except BaseException as e:
-        state.publish(seq, e)
-        seq += 1
+        state.publish(k * state.nw + wid, e)
+        k += 1
     finally:
-        state.finish_dispatch(seq)
+        state.finish_worker(wid, k)
 
 
 class _DataLoaderIter:
@@ -386,9 +441,11 @@ class _DataLoaderIter:
     numpy/host IO releases the GIL, and jax arrays are not fork-safe).
     Batches are delivered IN ORDER via per-batch sequence numbers and a
     reorder buffer, with at most prefetch_factor×workers in flight.
-    Iterable datasets use a single worker (one stream; the reference
-    shards an IterableDataset per worker process, which thread-sharing a
-    Python iterator cannot reproduce safely). Threads reference only the
+    Iterable datasets run num_workers independent streams: each worker
+    iterates its own iter(dataset) with WorkerInfo exposed via
+    get_worker_info() (reference fluid/reader.py:91 per-worker-process
+    semantics) — sharding-aware datasets yield their split, and batches
+    interleave round-robin deterministically. Threads reference only the
     _PoolState; a weakref.finalize shuts the pool down when the iterator
     is dropped (early break / exception) so no thread ever leaks."""
 
@@ -399,7 +456,7 @@ class _DataLoaderIter:
         self._state = None
         self._next_seq = 0
         if loader.num_workers > 0:
-            nw = 1 if loader._iterable_mode else loader.num_workers
+            nw = loader.num_workers
             st = _PoolState(nw, max(2, loader.prefetch_factor))
             self._state = st
             self._finalizer = weakref.finalize(self, _PoolState.shutdown,
@@ -408,8 +465,8 @@ class _DataLoaderIter:
                 threads = [threading.Thread(
                     target=_pool_iterable_worker,
                     args=(st, loader.dataset, loader.collate_fn,
-                          loader.batch_size, loader.drop_last),
-                    daemon=True)]
+                          loader.batch_size, loader.drop_last, w),
+                    daemon=True) for w in range(nw)]
             else:
                 threads = [threading.Thread(
                     target=_pool_map_worker,
@@ -427,6 +484,7 @@ class _DataLoaderIter:
 
     def __next__(self):
         st = self._state
+        iterable = self.loader._iterable_mode
         if st is not None:
             with st.cond:
                 while True:
@@ -434,11 +492,29 @@ class _DataLoaderIter:
                         item = st.results.pop(self._next_seq)
                         self._next_seq += 1
                         break
-                    if st.dispatch_done and \
+                    if iterable:
+                        # worker streams end at different k's: skip seqs a
+                        # finished worker can never publish; stop when
+                        # every worker is done and no published seq is
+                        # left at/after next_seq
+                        w = self._next_seq % st.nw
+                        k = self._next_seq // st.nw
+                        if w in st.worker_counts and \
+                                k >= st.worker_counts[w]:
+                            if len(st.worker_counts) == st.nw and not any(
+                                    s >= self._next_seq
+                                    for s in st.results):
+                                raise StopIteration
+                            self._next_seq += 1
+                            continue
+                    elif st.dispatch_done and \
                             self._next_seq >= st.dispatched:
                         raise StopIteration
                     st.cond.wait()
-            st.inflight.release()
+            if iterable:
+                st.worker_sems[(self._next_seq - 1) % st.nw].release()
+            else:
+                st.inflight.release()
             if isinstance(item, BaseException):
                 st.shutdown()
                 raise item
@@ -569,7 +645,3 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no fixed length")
         return len(self.batch_sampler)
-
-
-def get_worker_info():
-    return None

@@ -308,9 +308,21 @@ def build_parameter(shape, attr=None, dtype=None, is_bias=False,
     dtype = dtype_mod.convert_dtype(dtype) or fallback_dtype
     init = attr.initializer or default_initializer or (
         I.Constant(0.0) if is_bias else I.XavierNormal())
-    value = init(shape, dtype)
-    p = Parameter(value, name=name or attr.name or _unique_name("param"),
-                  trainable=attr.trainable)
+    from ...framework.lazy import in_lazy_mode
+
+    if in_lazy_mode():
+        import jax as _jax
+        import numpy as _np
+
+        value = _jax.ShapeDtypeStruct(
+            tuple(int(s) for s in shape), _np.dtype(dtype))
+        p = Parameter(value, name=name or attr.name or
+                      _unique_name("param"), trainable=attr.trainable)
+        p._lazy_initializer = init
+    else:
+        value = init(shape, dtype)
+        p = Parameter(value, name=name or attr.name or
+                      _unique_name("param"), trainable=attr.trainable)
     p.optimize_attr["learning_rate"] = attr.learning_rate
     p.regularizer = attr.regularizer
     p.need_clip = attr.need_clip
