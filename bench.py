@@ -257,6 +257,108 @@ def bench_moe(paddle, steps, peak):
             "params_m": round(cfg.num_params() / 1e6, 1)}
 
 
+def bench_predictor_int8(paddle, steps=20):
+    """Serving latency: f32 vs bf16 vs int8-COMPUTE predictors on a
+    matmul-bound MLP (VERDICT r3 next #3 — the int8 artifact now embeds
+    int8×int8→int32 MXU dots, quantization.Int8Linear; v5e int8 peak is
+    2× bf16). Inputs stay device-resident and the sync is a tiny-slice
+    fetch: the axon tunnel's ~20 MB/s host link would otherwise measure
+    transfers, not compute — identical overhead across the three
+    variants, so the deltas are the compute."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.quantization import QAT, save_quantized_model
+    from paddle_tpu.static.input_spec import InputSpec
+
+    d, h, batch = 4096, 16384, 1024
+
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(d, h)
+            self.act = nn.ReLU()
+            self.fc2 = nn.Linear(h, d)
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    paddle.seed(7)
+    rng = np.random.RandomState(7)
+    x = (rng.randn(batch, d) * 0.5).astype(np.float32)
+    tmp = tempfile.mkdtemp()
+
+    net = MLP()
+    import paddle_tpu.jit as pjit
+
+    pjit.save(net, f"{tmp}/mlp_f32",
+              input_spec=[InputSpec([batch, d], "float32", "x")])
+
+    # bf16 variant: same weights cast
+    net_bf = MLP()
+    net_bf.set_state_dict(net.state_dict())
+    for p in net_bf.parameters():
+        p._value = p._value.astype(jnp.bfloat16)
+    pjit.save(net_bf, f"{tmp}/mlp_bf16",
+              input_spec=[InputSpec([batch, d], "bfloat16", "x")])
+
+    # int8 deploy: QAT wrap + calibration forward, then the int8 export
+    net_q = MLP()
+    net_q.set_state_dict(net.state_dict())
+    QAT().quantize(net_q)
+    net_q.train()
+    net_q(paddle.to_tensor(x))
+    net_q.eval()
+    want = np.asarray(net_q(paddle.to_tensor(x))._value)  # QAT eval truth
+    save_quantized_model(net_q, f"{tmp}/mlp_int8",
+                         input_spec=[InputSpec([batch, d], "float32",
+                                               "x")])
+
+    def make_once(path, xv):
+        pred = create_predictor(Config(f"{tmp}/{path}"))
+        xd = jax.device_put(jnp.asarray(xv))
+        call = pred._cached_call(pred._exported)
+
+        def once():
+            return jax.tree_util.tree_leaves(
+                call(pred._params, pred._buffers, xd))[0]
+
+        np.asarray(once()[:1, :8])             # warm the executable
+        return once, pred
+
+    runners = {"f32": make_once("mlp_f32", x),
+               "bf16": make_once("mlp_bf16", x.astype(jnp.bfloat16)),
+               "int8": make_once("mlp_int8", x)}
+    # interleaved rounds, min-of-rounds: run order shifts per-variant
+    # numbers ~30% on the shared tunnel — min is the stable estimator
+    best = {k: float("inf") for k in runners}
+    for _ in range(3):
+        for k, (once, _) in runners.items():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = once()                   # dispatches pipeline
+            np.asarray(out[:1, :8])            # truthful sync, amortized
+            best[k] = min(best[k], (time.perf_counter() - t0) / steps)
+    dt_f32, dt_bf16, dt_int8 = best["f32"], best["bf16"], best["int8"]
+    pred8 = runners["int8"][1]
+    out8 = jax.tree_util.tree_leaves(pred8._exported.call(
+        pred8._params, pred8._buffers, jax.device_put(jnp.asarray(x))))[0]
+    rel = float(np.max(np.abs(np.asarray(out8) - want)
+                       / (np.abs(want).max() + 1e-6)))
+    return {"batch": batch, "d_model": d, "d_ffn": h,
+            "latency_ms_f32": round(dt_f32 * 1e3, 2),
+            "latency_ms_bf16": round(dt_bf16 * 1e3, 2),
+            "latency_ms_int8": round(dt_int8 * 1e3, 2),
+            "int8_speedup_vs_bf16": round(dt_bf16 / dt_int8, 2),
+            "int8_max_rel_err_vs_qat": round(rel, 5),
+            "note": "device-resident input, tiny-slice sync (tunnel "
+                    "transfer excluded identically for all variants)"}
+
+
 def _mlm_batch(vocab, batch, seq):
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
@@ -358,6 +460,8 @@ def main():
             remat=True))
         extra("resnet50_dp_amp", lambda: bench_resnet50(
             paddle, steps=10, batch=64))
+        extra("predictor_int8_serving", lambda: bench_predictor_int8(
+            paddle, steps=20))
         extra("moe_gpt_8experts", lambda: bench_moe(
             paddle, steps=10, peak=peak))
         # most expensive + skippable last: the ZeRO-Offload fidelity run

@@ -67,6 +67,22 @@ class CUDAPinnedPlace(CPUPlace):
 _expected_place: Optional[Place] = None
 
 
+def target_platform() -> str:
+    """Platform the current computation is being COMPILED FOR — not the
+    process's default backend. AOT lowering against a TPU topology
+    (jax.experimental.topologies) happens in a CPU-only process; the
+    CPU-backend workarounds (bf16-collective promotion, pallas interpret
+    mode) must key off the target, or the AOT artifact would bake the
+    workarounds into the TPU program. Overridden by
+    PADDLE_TPU_TARGET_PLATFORM; defaults to jax.default_backend()."""
+    import os
+
+    forced = os.environ.get("PADDLE_TPU_TARGET_PLATFORM")
+    if forced:
+        return forced
+    return jax.default_backend()
+
+
 def device_count() -> int:
     """Number of local accelerator devices (reference gpu_info GetCUDADeviceCount)."""
     return len([d for d in jax.devices() if d.platform != "cpu"]) or 0

@@ -50,3 +50,65 @@ def manual_sequence_parallel_scope():
 
 def current_sequence_parallel() -> Optional[Tuple[Mesh, str, bool]]:
     return _SP
+
+
+_PIPE_AUTO: Optional[Tuple[Mesh, Tuple[str, ...]]] = None
+
+
+@contextlib.contextmanager
+def pipeline_auto_axes_scope(mesh: Mesh, axes):
+    """Inside the pipeline's shard_map (manual over 'pp'), the remaining
+    mesh axes are GSPMD-auto. Mosaic (pallas) kernels cannot be
+    auto-partitioned in a *partially* manual region — XLA requires every
+    mesh axis manual around a Mosaic call — so kernels consult this scope
+    and open a nested shard_map over the listed axes (flash_attention.py).
+    CPU meshes never need it (interpret mode is plain HLO)."""
+    global _PIPE_AUTO
+    prev = _PIPE_AUTO
+    _PIPE_AUTO = (mesh, tuple(axes))
+    try:
+        yield
+    finally:
+        _PIPE_AUTO = prev
+
+
+def current_pipeline_auto_axes() -> Optional[Tuple[Mesh, Tuple[str, ...]]]:
+    return _PIPE_AUTO
+
+
+def in_partial_manual_region() -> bool:
+    """True when tracing inside a partially-manual region on a real
+    (non-interpret) target — the condition under which a Mosaic kernel
+    must be nested or avoided. One copy, consulted by both
+    flash_attention and ring_attention."""
+    from ..core.place import target_platform
+
+    return _PIPE_AUTO is not None and target_platform() != "cpu"
+
+
+def nested_kernel_shard(fn, in_specs, out_specs):
+    """Single shared implementation of the "make every axis manual around
+    a Mosaic kernel" rule (used by flash_attention and ring_attention —
+    one copy so the mesh-selection logic cannot drift): wraps ``fn`` in a
+    shard_map over the scope's remaining auto axes. Returns None when no
+    scope is active (fully-auto region — GSPMD handles the kernel
+    directly). Inside the pipeline's shard_map the context mesh is the
+    AbstractMesh with 'pp' already Manual — shard_map must receive that
+    mesh; fall back to the recorded concrete mesh otherwise."""
+    pa = current_pipeline_auto_axes()
+    if pa is None:
+        return None
+    mesh, axes = pa
+    import jax
+
+    try:
+        from jax.sharding import get_abstract_mesh
+
+        am = get_abstract_mesh()
+        use = am if (am is not None and getattr(am, "axis_names", ())) \
+            else mesh
+    except Exception:
+        use = mesh
+    return jax.shard_map(fn, mesh=use, in_specs=in_specs,
+                         out_specs=out_specs, axis_names=frozenset(axes),
+                         check_vma=False)

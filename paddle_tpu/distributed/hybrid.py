@@ -24,10 +24,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.place import target_platform as _target_platform
 from ..framework.tensor import Tensor
 from ..static.functional import _swapped_state, state_tensors
 from .fleet.distributed_strategy import DistributedStrategy
@@ -351,7 +354,7 @@ class HybridPipelineTrainer:
             """Keep activations sequence-sharded between ring attentions.
             Skipped for bf16 on XLA:CPU (tests): resharding constraints on
             bf16 trip a CPU-backend crash; TPU is unaffected."""
-            if sp > 1 and not (jax.default_backend() == "cpu"
+            if sp > 1 and not (_target_platform() == "cpu"
                                and h.dtype == jnp.bfloat16):
                 return jax.lax.with_sharding_constraint(
                     h, NamedSharding(self.mesh, P("dp", "sp", None)))
@@ -368,9 +371,20 @@ class HybridPipelineTrainer:
             """Apply one stage's lps blocks (lax.scan over layers).
             MoE models: returns (out, weighted aux-loss sum of the
             stage's blocks) — the pipeline's stage_aux contract."""
+            # axes that stay GSPMD-auto inside the manual-pp region:
+            # pallas kernels must nest a shard_map over them (Mosaic
+            # cannot be auto-partitioned in a partially-manual region).
+            # pp == 1 runs fully auto — no scope needed.
+            auto_axes = tuple(a for a in self.mesh.axis_names
+                              if a != "pp" and not (manual_sp and a == "sp"))
+            auto_scope = (
+                (lambda: dctx.pipeline_auto_axes_scope(self.mesh,
+                                                       auto_axes))
+                if self.pp > 1 else contextlib.nullcontext)
+
             def one_block(h, layer_params):
                 vals = [layer_params[s] for s in self.block_suffixes]
-                with _swapped_state(blk0_tensors, vals):
+                with _swapped_state(blk0_tensors, vals), auto_scope():
                     if manual_sp:
                         with dctx.manual_sequence_parallel_scope():
                             out = block0(Tensor(h))._value
@@ -397,7 +411,7 @@ class HybridPipelineTrainer:
 
             init = (x, jnp.zeros((), jnp.float32)) if moe else x
             unroll = self.unroll_layers if self.unroll_layers is not None \
-                else (jax.default_backend() != "cpu" and not self.remat)
+                else (_target_platform() != "cpu" and not self.remat)
             out, _ = jax.lax.scan(body, init, stage_local, unroll=unroll)
             if moe:
                 h, a = out
@@ -413,7 +427,7 @@ class HybridPipelineTrainer:
         # the manual-pp region like the blocks' do.
         import os
         head_inside = not manual_sp and self.pp > 1 and not (
-            jax.default_backend() == "cpu" and self.amp) and \
+            _target_platform() == "cpu" and self.amp) and \
             os.environ.get("PADDLE_TPU_HEAD_INSIDE", "1") != "0"
         with _swapped_state(other_tensors, other_cast), \
                 dctx.sequence_parallel_scope(self.mesh):

@@ -109,7 +109,8 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     # shard_map TRANSPOSE of a replicated input inserts exactly that (psum
     # of input cotangents over pp). Promote the boundary dtype on CPU only;
     # TPU keeps native bf16 transfers.
-    boundary_f32 = (jax.default_backend() == "cpu"
+    from ..core.place import target_platform
+    boundary_f32 = (target_platform() == "cpu"
                     and compute_dtype == jnp.bfloat16)
 
     param_specs = jax.tree_util.tree_map(

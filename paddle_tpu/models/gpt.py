@@ -146,9 +146,12 @@ class GPTAttention(nn.Layer):
                     "sequence parallelism (ring attention); set "
                     "GPTConfig.dropout=0 or sp_degree=1")
             if manual:
-                # already inside a shard_map manual over `axis`
+                # already inside a shard_map manual over `axis`; capture
+                # the remaining-auto-axes scope NOW — the custom_vjp
+                # backward traces at transpose time, after the scope exits
+                auto_ctx = _dctx.current_pipeline_auto_axes()
                 fn = lambda q_, k_, v_: _ring_mha(q_, k_, v_, True, None,
-                                                  axis)
+                                                  axis, auto_ctx)
             else:
                 fn = lambda q_, k_, v_: sequence_parallel_attention(
                     q_, k_, v_, mesh, causal=True, axis_name=axis)

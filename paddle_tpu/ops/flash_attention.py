@@ -50,12 +50,22 @@ _BLOCK_K = 1024        # amortize grid/DMA overhead and, at 1024, collapse
                        # causal so the diagonal block covers its own row.
 _SEQ_ALIGN = 128
 _NEG_INF = -1e30
+
+# The kernel's matmul semantics are part of the kernel, not of global
+# config: under jax_default_matmul_precision="highest" (the test suite's
+# golden-value setting) an unpinned dot_general would ask Mosaic for
+# fp32-precision bf16 matmuls, which the bundled libtpu rejects ("Bad lhs
+# type") — and 6-pass emulation is never what a flash kernel wants anyway.
+_dot = functools.partial(jax.lax.dot_general,
+                         precision=jax.lax.Precision.DEFAULT)
 _LOG2E = 1.4426950408889634   # softmax runs in base 2: exp(x) = exp2(x·log2e)
 _LN2 = 0.6931471805599453     # (exp2 is the TPU-native transcendental)
 
 
 def _interpret() -> bool:
-    return jax.default_backend() == "cpu"
+    from ..core.place import target_platform
+
+    return target_platform() == "cpu"
 
 
 def _causal_mask(iq, ik, block_q, block_k):
@@ -119,7 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         k = k_ref[0]                                     # [bk, d]
         v = v_ref[0]
         # base-2 logits: one fused scale, exp2 on the VPU (cheaper than exp)
-        s = jax.lax.dot_general(
+        s = _dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if masked:
@@ -132,7 +142,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         if masked:
             p = jnp.where(mask, p, 0.0)
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] * alpha + _dot(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = m_cur
@@ -222,18 +232,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]                                  # [bq, 1] natural
         delta = delta_ref[0]                              # [bq, 1]
-        s = jax.lax.dot_general(
+        s = _dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if masked:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s,
                           _NEG_INF)
         p = jnp.exp2(s - lse * _LOG2E)                    # [bq, bk]
-        dp = jax.lax.dot_general(
+        dp = _dot(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dq_acc[:] += jax.lax.dot_general(
+        dq_acc[:] += _dot(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -267,21 +277,21 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0]                                  # [bq, 1] natural
         delta = delta_ref[0]                              # [bq, 1]
-        s = jax.lax.dot_general(
+        s = _dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if masked:
             s = jnp.where(_causal_mask(iq, ik, block_q, block_k), s,
                           _NEG_INF)
         p = jnp.exp2(s - lse * _LOG2E)                    # [bq, bk]
-        dv_acc[:] += jax.lax.dot_general(
+        dv_acc[:] += _dot(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # p^T @ do
-        dp = jax.lax.dot_general(
+        dp = _dot(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale                     # [bq, bk]
-        dk_acc[:] += jax.lax.dot_general(
+        dk_acc[:] += _dot(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # ds^T @ q
 
@@ -312,25 +322,25 @@ def _bwd_single_tile_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
     do = do_ref[0]
     lse = lse_ref[0]                                      # [bq, 1] natural
     delta = delta_ref[0]                                  # [bq, 1]
-    s = jax.lax.dot_general(
+    s = _dot(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * (scale * _LOG2E)
     if causal:
         s = jnp.where(_causal_mask(0, 0, q.shape[0], k.shape[0]), s,
                       _NEG_INF)
     p = jnp.exp2(s - lse * _LOG2E)                        # [bq, bk]
-    dv_ref[0] = jax.lax.dot_general(
+    dv_ref[0] = _dot(
         p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-    dp = jax.lax.dot_general(
+    dp = _dot(
         do, v, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     ds = p * (dp - delta) * scale
     dsq = ds.astype(q.dtype)
-    dq_ref[0] = jax.lax.dot_general(
+    dq_ref[0] = _dot(
         dsq, k, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dq_ref.dtype)
-    dk_ref[0] = jax.lax.dot_general(
+    dk_ref[0] = _dot(
         dsq, q, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
@@ -502,6 +512,39 @@ def _flash_mha_bwd(causal, scale, res, do):
 _flash_mha.defvjp(_flash_fwd_res, _flash_mha_bwd)
 
 
+def _maybe_nested_shard(q_shape, causal, scale):
+    """Inside the pipeline's manual-'pp' region the remaining mesh axes
+    are GSPMD-auto, and XLA refuses to auto-partition a Mosaic kernel in
+    a partially-manual region. Returns a callable that nests a shard_map
+    over those axes (dp shards batch, tp shards heads — the framework's
+    axis convention) so every mesh axis is manual around the pallas call,
+    or None when not applicable (full-auto region, CPU interpret, or
+    non-divisible shapes → caller falls back)."""
+    from ..distributed import context as dctx
+
+    pa = dctx.current_pipeline_auto_axes()
+    if pa is None or _interpret():
+        return None
+    mesh, axes = pa
+    from jax.sharding import PartitionSpec as P
+
+    b, s, h, d = q_shape
+    dp = mesh.shape.get("dp", 1) if "dp" in axes else 1
+    tp = mesh.shape.get("tp", 1) if "tp" in axes else 1
+    if b % max(dp, 1) or h % max(tp, 1):
+        return None
+    spec = P("dp" if dp > 1 else None, None, "tp" if tp > 1 else None,
+             None)
+
+    def call(q, k, v):
+        fn = dctx.nested_kernel_shard(
+            lambda q_, k_, v_: _flash_mha(q_, k_, v_, causal, scale),
+            in_specs=(spec, spec, spec), out_specs=spec)
+        return fn(q, k, v)
+
+    return call
+
+
 def flash_attention(query, key, value, causal=False, scale=None, name=None):
     """q,k,v: [batch, seq, heads, head_dim] -> [batch, seq, heads, head_dim].
 
@@ -509,9 +552,23 @@ def flash_attention(query, key, value, causal=False, scale=None, name=None):
     entry used by jitted functional paths (distributed/hybrid_gpt.py).
     """
     def f(q, k, v):
+        nested = _maybe_nested_shard(q.shape, causal, scale)
+        if nested is not None:
+            return nested(q, k, v)
+        if _pipeline_partial_manual():
+            # partially-manual region but shapes not shardable: the
+            # Mosaic kernel would be rejected — use the auto-partitionable
+            # jnp reference instead
+            return mha_reference(q, k, v, causal, scale)
         return _flash_mha(q, k, v, causal, scale)
 
     return apply(f, query, key, value, name="flash_attention")
+
+
+def _pipeline_partial_manual() -> bool:
+    from ..distributed import context as dctx
+
+    return dctx.in_partial_manual_region()
 
 
 def mha_reference(q, k, v, causal=False, scale=None):

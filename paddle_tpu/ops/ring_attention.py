@@ -79,6 +79,35 @@ def _chunk_fwd_jnp(q3, k3, v3, scale, causal):
     return o, (m + jnp.log(l))
 
 
+def _bh_kernel_shard(fn, n_in, n_out, bh):
+    """Mosaic inside the pipeline's partially-manual region: wrap a
+    [BH, S, *]-chunk kernel call in a shard_map over the remaining auto
+    axes (shared rule: distributed/context.nested_kernel_shard). Row
+    attention is independent per BH row, so ANY even partition of dim 0
+    is numerically exact — P((dp, tp)) contiguous chunks are used even
+    though flattened b-major/h-minor order interleaves them. Returns
+    None when no scope is active or BH does not split evenly (caller
+    falls back to the auto-partitionable jnp path)."""
+    from ..distributed import context as dctx
+    from jax.sharding import PartitionSpec as P
+
+    pa = dctx.current_pipeline_auto_axes()
+    if pa is None or fa._interpret():
+        # CPU interpret mode is plain HLO — auto-partitionable, no nest
+        return None
+    mesh, axes = pa
+    dim0 = tuple(a for a in ("dp", "tp")
+                 if a in axes and mesh.shape.get(a, 1) > 1)
+    size = 1
+    for a in dim0:
+        size *= mesh.shape[a]
+    if bh % size:
+        return None
+    spec = P(dim0 if dim0 else None, None, None)
+    return dctx.nested_kernel_shard(fn, in_specs=(spec,) * n_in,
+                                    out_specs=(spec,) * n_out)
+
+
 def _chunk_fwd(q3, k3, v3, scale, causal):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -87,8 +116,21 @@ def _chunk_fwd(q3, k3, v3, scale, causal):
         bk = fa._pick_block(sk, fa._BLOCK_K)
         if causal:
             bq = bk = min(bq, bk)
+        nested = _bh_kernel_shard(
+            lambda a, b, c: fa._fwd(a, b, c, scale, causal, bq, bk),
+            n_in=3, n_out=2, bh=bh)
+        if nested is not None:
+            return nested(q3, k3, v3)
+        if _in_partial_manual():
+            return _chunk_fwd_jnp(q3, k3, v3, scale, causal)
         return fa._fwd(q3, k3, v3, scale, causal, bq, bk)
     return _chunk_fwd_jnp(q3, k3, v3, scale, causal)
+
+
+def _in_partial_manual() -> bool:
+    from ..distributed import context as dctx
+
+    return dctx.in_partial_manual_region()
 
 
 def _chunk_skip(q3, k3, v3, scale):
@@ -133,6 +175,16 @@ def _chunk_bwd(q3, k3, v3, do3, lse, delta, scale, causal):
         # o3 in res is only used for delta, which we precompute (it is a
         # property of the GLOBAL output row); out_dtype f32 so per-chunk
         # partials don't round before the ring accumulation.
+        nested = _bh_kernel_shard(
+            lambda q_, k_, v_, do_, lse_, delta_: fa._bwd(
+                scale, causal, bq, bk, (q_, k_, v_, None, lse_), do_,
+                delta=delta_, out_dtype=jnp.float32),
+            n_in=6, n_out=3, bh=bh)
+        if nested is not None:
+            return nested(q3, k3, v3, do3, lse, delta)
+        if _in_partial_manual():
+            return _chunk_bwd_jnp(q3, k3, v3, do3, lse, delta, scale,
+                                  causal)
         return fa._bwd(scale, causal, bq, bk, (q3, k3, v3, None, lse), do3,
                        delta=delta, out_dtype=jnp.float32)
     return _chunk_bwd_jnp(q3, k3, v3, do3, lse, delta, scale, causal)
@@ -156,9 +208,24 @@ def _branch(t, idx, sp, causal):
     return jnp.where(src > idx, 0, jnp.where(src < idx, 1, 2)), src
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _ring_mha(q, k, v, causal, scale, axis_name):
-    o, _ = _ring_fwd_res(q, k, v, causal, scale, axis_name)
+def _auto_scope(auto_ctx):
+    """Re-enter the pipeline_auto_axes scope captured at call time.
+    custom_vjp backwards are traced at TRANSPOSE time, long after the
+    caller's ``with`` scope exited — so the (mesh, axes) pair rides the
+    nondiff args and both fwd and bwd re-enter it around their chunk
+    kernels."""
+    import contextlib
+
+    from ..distributed import context as dctx
+
+    if auto_ctx is None:
+        return contextlib.nullcontext()
+    return dctx.pipeline_auto_axes_scope(auto_ctx[0], auto_ctx[1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_mha(q, k, v, causal, scale, axis_name, auto_ctx=None):
+    o, _ = _ring_fwd_res(q, k, v, causal, scale, axis_name, auto_ctx)
     return o
 
 
@@ -166,10 +233,12 @@ def _boundary_f32(dtype) -> bool:
     # XLA:CPU crashes on bf16 collectives inside (nested) manual regions
     # (same bug the pipeline works around, distributed/pipeline.py); TPU
     # keeps native bf16 ring transfers.
-    return jax.default_backend() == "cpu" and dtype == jnp.bfloat16
+    from ..core.place import target_platform
+
+    return target_platform() == "cpu" and dtype == jnp.bfloat16
 
 
-def _ring_fwd_res(q, k, v, causal, scale, axis_name):
+def _ring_fwd_res(q, k, v, causal, scale, axis_name, auto_ctx=None):
     b, s_loc, h, d = q.shape
     sp = lax.psum(1, axis_name)     # axis size: static int under shard_map
     raise_if_not_static(sp)
@@ -188,22 +257,23 @@ def _ring_fwd_res(q, k, v, causal, scale, axis_name):
     w = jnp.zeros((bh, s_loc, 1), jnp.float32)
     acc = jnp.zeros((bh, s_loc, d), jnp.float32)
     k_c, v_c = k3, v3
-    for t in range(sp):
-        br, _ = _branch(t, idx, sp, causal)
-        o_t, lse_t = lax.switch(
-            br,
-            [lambda q_, k_, v_: _chunk_skip(q_, k_, v_, s_val),
-             lambda q_, k_, v_: _chunk_fwd(q_, k_, v_, s_val, False),
-             lambda q_, k_, v_: _chunk_fwd(q_, k_, v_, s_val, True)],
-            q3, k_c, v_c)
-        m_new = jnp.maximum(m, lse_t)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(lse_t - m_new)
-        acc = acc * alpha + o_t.astype(jnp.float32) * beta
-        w = w * alpha + beta
-        m = m_new
-        if t < sp - 1:
-            k_c, v_c = _ring_shift((k_c, v_c), axis_name, sp)
+    with _auto_scope(auto_ctx):
+        for t in range(sp):
+            br, _ = _branch(t, idx, sp, causal)
+            o_t, lse_t = lax.switch(
+                br,
+                [lambda q_, k_, v_: _chunk_skip(q_, k_, v_, s_val),
+                 lambda q_, k_, v_: _chunk_fwd(q_, k_, v_, s_val, False),
+                 lambda q_, k_, v_: _chunk_fwd(q_, k_, v_, s_val, True)],
+                q3, k_c, v_c)
+            m_new = jnp.maximum(m, lse_t)
+            alpha = jnp.exp(m - m_new)
+            beta = jnp.exp(lse_t - m_new)
+            acc = acc * alpha + o_t.astype(jnp.float32) * beta
+            w = w * alpha + beta
+            m = m_new
+            if t < sp - 1:
+                k_c, v_c = _ring_shift((k_c, v_c), axis_name, sp)
     w_safe = jnp.where(w == 0.0, 1.0, w)
     o3 = (acc / w_safe).astype(q.dtype)
     lse = m + jnp.log(w_safe)
@@ -211,7 +281,7 @@ def _ring_fwd_res(q, k, v, causal, scale, axis_name):
     return o, (q3, k3, v3, o3, lse, b, h, s_val)
 
 
-def _ring_bwd(causal, scale, axis_name, res, do):
+def _ring_bwd(causal, scale, axis_name, auto_ctx, res, do):
     q3, k3, v3, o3, lse, b, h, s_val = res
     sp = lax.psum(1, axis_name)
     raise_if_not_static(sp)
@@ -231,27 +301,28 @@ def _ring_bwd(causal, scale, axis_name, res, do):
                 jnp.zeros_like(k_, jnp.float32),
                 jnp.zeros_like(v_, jnp.float32))
 
-    for t in range(sp):
-        br, _ = _branch(t, idx, sp, causal)
-        dq_t, dk_t, dv_t = lax.switch(
-            br,
-            [_zero,
-             lambda q_, k_, v_, do_, l_, dl_: _chunk_bwd(
-                 q_, k_, v_, do_, l_, dl_, s_val, False),
-             lambda q_, k_, v_, do_, l_, dl_: _chunk_bwd(
-                 q_, k_, v_, do_, l_, dl_, s_val, True)],
-            q3, k_c, v_c, do3, lse, delta)
-        dq = dq + dq_t
-        dk_c = dk_c + dk_t
-        dv_c = dv_c + dv_t
-        # dK/dV accumulators travel WITH their chunk; after sp hops they
-        # are home. K/V only need sp-1 hops (last compute used the final
-        # position), so the last tick ships just the grads.
-        if t < sp - 1:
-            k_c, v_c, dk_c, dv_c = _ring_shift((k_c, v_c, dk_c, dv_c),
-                                               axis_name, sp)
-        else:
-            dk_c, dv_c = _ring_shift((dk_c, dv_c), axis_name, sp)
+    with _auto_scope(auto_ctx):
+        for t in range(sp):
+            br, _ = _branch(t, idx, sp, causal)
+            dq_t, dk_t, dv_t = lax.switch(
+                br,
+                [_zero,
+                 lambda q_, k_, v_, do_, l_, dl_: _chunk_bwd(
+                     q_, k_, v_, do_, l_, dl_, s_val, False),
+                 lambda q_, k_, v_, do_, l_, dl_: _chunk_bwd(
+                     q_, k_, v_, do_, l_, dl_, s_val, True)],
+                q3, k_c, v_c, do3, lse, delta)
+            dq = dq + dq_t
+            dk_c = dk_c + dk_t
+            dv_c = dv_c + dv_t
+            # dK/dV accumulators travel WITH their chunk; after sp hops
+            # they are home. K/V only need sp-1 hops (last compute used
+            # the final position), so the last tick ships just the grads.
+            if t < sp - 1:
+                k_c, v_c, dk_c, dv_c = _ring_shift(
+                    (k_c, v_c, dk_c, dv_c), axis_name, sp)
+            else:
+                dk_c, dv_c = _ring_shift((dk_c, dv_c), axis_name, sp)
 
     dq_ = fa._reshape_out(dq.astype(out_dtype), b, h)
     dk_ = fa._reshape_out(dk_c.astype(out_dtype), b, h)
@@ -277,7 +348,10 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = True,
     shard. Returns the local shard of the attention output. Differentiable
     (custom VJP runs the backward ring).
     """
-    return _ring_mha(q, k, v, causal, scale, axis_name)
+    from ..distributed import context as dctx
+
+    return _ring_mha(q, k, v, causal, scale, axis_name,
+                     dctx.current_pipeline_auto_axes())
 
 
 def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = True,
@@ -297,8 +371,17 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = True,
             use_mesh = am
     except AttributeError:
         pass
+    # inside this sp-manual region the other mesh axes stay GSPMD-auto;
+    # pass them as the kernels' auto-context so the chunk kernels nest a
+    # shard_map over them on the TPU target (Mosaic cannot live in a
+    # partially-manual region) — threaded through _ring_mha's static args
+    # so the transpose-time backward sees it too
+    remaining = tuple(a for a in mesh.axis_names if a != axis_name)
+    auto_ctx = (mesh, remaining) if remaining else None
+
     mapped = jax.shard_map(
-        lambda a, b_, c: _ring_mha(a, b_, c, causal, scale, axis_name),
+        lambda a, b_, c: _ring_mha(a, b_, c, causal, scale, axis_name,
+                                   auto_ctx),
         mesh=use_mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False, axis_names=frozenset({axis_name}))
