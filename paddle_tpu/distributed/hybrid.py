@@ -60,6 +60,7 @@ class HybridPipelineTrainer:
                  param_dtype=None, moment_dtype=None,
                  offload_optimizer: bool = False,
                  offload_params: bool = False,
+                 offload_depth: int = 2,
                  unroll_layers: Optional[bool] = None,
                  free_eager: bool = False):
         """Memory knobs for billion-param single/few-chip configs
@@ -128,6 +129,10 @@ class HybridPipelineTrainer:
             else None
         self.offload_optimizer = offload_optimizer
         self.offload_params = offload_params
+        # host↔HBM streaming pipeline depth: how many per-group f32
+        # (p, m, v) working sets may be in flight at once. Deeper = more
+        # copy/compute overlap, +1 group of transient HBM per step
+        self.offload_depth = max(1, int(offload_depth))
         if offload_params and not self.amp:
             raise ValueError("offload_params requires strategy.amp (the "
                              "compute copies are bf16)")
@@ -541,12 +546,13 @@ class HybridPipelineTrainer:
             g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
 
             # offload_params: serialize the per-group host↔HBM update
-            # streams (fetch k waits on update k-1) — unconstrained, the
-            # scheduler launches every group's copy-in during backward
-            # and the transient f32 state OOMs; chained, one group's
-            # f32 (p, m, v) is in HBM at a time and copy-in of group k
-            # overlaps copy-out of group k-1 on the full-duplex link.
-            chain = [loss, loss]     # depth-2: two groups in flight
+            # streams (fetch k waits on update k-depth) — unconstrained,
+            # the scheduler launches every group's copy-in during
+            # backward and the transient f32 state OOMs; chained,
+            # offload_depth groups' f32 (p, m, v) are in HBM at a time
+            # and copy-in of group k overlaps update k-1 and copy-out of
+            # group k-depth on the full-duplex link.
+            chain = [loss] * self.offload_depth
 
             def barriered(p, g, s):
                 if not offload_p:

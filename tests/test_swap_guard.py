@@ -1,0 +1,40 @@
+"""_swapped_state thread-safety guard (VERDICT r3 weak #6): same-thread
+nesting is legal (pipeline head re-swaps inside the outer swap, LIFO
+restore); a second thread swapping the same tensor must raise instead of
+corrupting the other trace."""
+import threading
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.static.functional import _swapped_state
+
+
+def test_same_thread_nesting_lifo():
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    with _swapped_state([t], [np.ones(2, np.float32)]):
+        with _swapped_state([t], [np.full(2, 2.0, np.float32)]):
+            assert float(np.asarray(t._value)[0]) == 2.0
+        assert float(np.asarray(t._value)[0]) == 1.0
+    assert float(np.asarray(t._value)[0]) == 0.0
+    assert id(t) not in _swapped_state._owner
+
+
+def test_cross_thread_swap_raises():
+    t = paddle.to_tensor(np.zeros(2, np.float32))
+    err = []
+    with _swapped_state([t], [np.ones(2, np.float32)]):
+        def other():
+            try:
+                with _swapped_state([t], [np.zeros(2, np.float32)]):
+                    pass
+            except RuntimeError as e:
+                err.append(str(e))
+        th = threading.Thread(target=other)
+        th.start()
+        th.join()
+    assert err and "another thread" in err[0]
+    # registry cleaned up; a fresh swap works
+    with _swapped_state([t], [np.ones(2, np.float32)]):
+        pass
+    assert id(t) not in _swapped_state._owner
