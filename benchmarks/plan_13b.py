@@ -53,7 +53,46 @@ def rss_gb():
     return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6
 
 
-def build_trainer(cfg, plan, abstract=True):
+def plan_one_v5p(cfg, plan):
+    """The definitive lowering: AOT-compile against a REAL v5p 2x4x2
+    topology (jax.experimental.topologies — the actual TPU compiler and
+    layouts, bf16 collectives, no CPU promotions). remat_policy='dots'
+    because the pip-bundled libtpu miscompiles full-remat+scan flash
+    ('Bad lhs type', see tests/test_tpu_lowering.py) — selective remat
+    is the production bench config anyway."""
+    import os
+    import time as _t
+
+    import jax
+    from jax.experimental import topologies
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5p:2x4x2")
+    os.environ["PADDLE_TPU_TARGET_PLATFORM"] = "tpu"
+    try:
+        t0 = _t.time()
+        plan = dict(plan, remat_policy="dots")
+        _, _, trainer = build_trainer(cfg, plan, devices=topo.devices)
+        batch = jax.ShapeDtypeStruct((GLOBAL_BATCH, SEQ), np.int32)
+        ma = trainer.aot_compile(batch).memory_analysis()
+        out = dict(plan)
+        out["compile_s"] = round(_t.time() - t0, 1)
+        out["host_peak_rss_gb"] = round(rss_gb(), 2)
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes"):
+            out[k] = int(getattr(ma, k))
+        peak = (out["argument_size_in_bytes"] - out["alias_size_in_bytes"]
+                + out["temp_size_in_bytes"])
+        out["peak_bytes_per_chip"] = int(peak)
+        out["peak_gb_per_chip"] = round(peak / 1e9, 2)
+        out["fits_v5p_95gb"] = bool(peak / 1e9 <= V5P_HBM_GB)
+        out["hbm_headroom_gb"] = round(V5P_HBM_GB - peak / 1e9, 2)
+        return out
+    finally:
+        del os.environ["PADDLE_TPU_TARGET_PLATFORM"]
+
+
+def build_trainer(cfg, plan, abstract=True, devices=None):
     import paddle_tpu as paddle
     from paddle_tpu.distributed.fleet.distributed_strategy import \
         DistributedStrategy
@@ -76,8 +115,15 @@ def build_trainer(cfg, plan, abstract=True):
         model = GPT(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                                  parameters=model.parameters())
+    mesh = None
+    if devices is not None:
+        from paddle_tpu.distributed.strategy_compiler import \
+            build_mesh_from_strategy
+
+        n = plan["dp"] * plan["tp"] * plan["pp"]
+        mesh = build_mesh_from_strategy(strat, np.array(devices)[:n])
     trainer = HybridPipelineTrainer(
-        model, opt, strategy=strat, n_micro=plan["n_micro"],
+        model, opt, strategy=strat, mesh=mesh, n_micro=plan["n_micro"],
         param_dtype="bfloat16", moment_dtype="bfloat16",
         remat_policy=plan.get("remat_policy"))
     return model, opt, trainer
@@ -158,14 +204,32 @@ def main():
         results["plans"].append(r)
         print(json.dumps(r), flush=True)
 
-    ok = [r for r in results["plans"] if r.get("fits_v5p_95gb")]
-    if ok:
-        chosen = min(ok, key=lambda r: r["peak_bytes_per_chip"])
+    # definitive stage: the REAL v5p compiler + layouts (available
+    # offline via jax.experimental.topologies) — the CPU plans above are
+    # kept as the comparison proxy
+    results["plans_v5p_true_lowering"] = []
+    for plan in plans:
+        print(f"--- v5p-true lowering {plan['name']} ...", flush=True)
+        try:
+            r = plan_one_v5p(cfg, plan)
+        except Exception as e:
+            r = dict(plan)
+            r["error"] = f"{type(e).__name__}: {e}"[:500]
+        results["plans_v5p_true_lowering"].append(r)
+        print(json.dumps(r), flush=True)
+
+    pool = [r for r in results["plans_v5p_true_lowering"]
+            if r.get("fits_v5p_95gb")] or \
+        [r for r in results["plans"] if r.get("fits_v5p_95gb")]
+    if pool:
+        chosen = min(pool, key=lambda r: r["peak_bytes_per_chip"])
         results["chosen"] = chosen["name"]
         results["chosen_rationale"] = (
-            "all fitting plans are throughput-equivalent until measured "
-            "on hardware; chosen = lowest per-chip peak (most activation "
-            "headroom to raise n_micro/batch toward the MFU target)")
+            "chosen from the v5p TRUE lowerings when available (real TPU "
+            "layouts); all fitting plans are throughput-equivalent until "
+            "measured on hardware — lowest per-chip peak wins (most "
+            "activation headroom to raise n_micro/batch toward the MFU "
+            "target)")
 
     out_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "BENCH_13B_PLAN.json")

@@ -1,0 +1,62 @@
+"""LazyGuard abstract init (framework/lazy.py, round 4): parameters are
+ShapeDtypeStructs, trainers plan without allocating, materialize() turns
+the model real."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.lazy import is_abstract, materialize
+
+
+def test_lazy_params_are_abstract_and_materialize():
+    from paddle_tpu import nn
+
+    with paddle.LazyGuard():
+        net = nn.Linear(8, 4)
+    assert is_abstract(net.weight)
+    assert tuple(net.weight._value.shape) == (8, 4)
+    # no buffer anywhere: numpy() would fail on a struct
+    materialize(net)
+    assert not is_abstract(net.weight)
+    out = net(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    assert out.shape == [2, 4]
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_lazy_guard_scoped():
+    from paddle_tpu import nn
+
+    with paddle.LazyGuard():
+        a = nn.Linear(4, 4)
+    b = nn.Linear(4, 4)
+    assert is_abstract(a.weight) and not is_abstract(b.weight)
+
+
+def test_abstract_trainer_plans_without_allocating():
+    from paddle_tpu.distributed.fleet.distributed_strategy import \
+        DistributedStrategy
+    from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+    from paddle_tpu.models import GPT, GPTConfig
+    import pytest
+
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=64)
+    s = DistributedStrategy()
+    s.amp = True
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2}
+    with paddle.LazyGuard():
+        model = GPT(cfg)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    tr = HybridPipelineTrainer(model, opt, s, n_micro=2,
+                               param_dtype="bfloat16")
+    assert tr.abstract
+    # all planned state is metadata
+    assert all(isinstance(v, jax.ShapeDtypeStruct)
+               for v in tr.block_vals.values())
+    ma = tr.memory_analysis(jax.ShapeDtypeStruct((4, 64), np.int32))
+    assert ma and ma.get("peak_bytes_est", 0) > 0
+    # an abstract trainer must refuse to execute
+    with pytest.raises(RuntimeError, match="LazyGuard"):
+        tr.step(np.zeros((4, 64), np.int32))

@@ -21,12 +21,24 @@ pytestmark = pytest.mark.filterwarnings("ignore")
 def _tpu_topology_devices():
     from jax.experimental import topologies
 
-    try:
-        topo = topologies.get_topology_desc(platform="tpu",
-                                            topology_name="v5e:2x4")
-        return topo.devices
-    except Exception as e:  # no libtpu compiler in this process
-        pytest.skip(f"TPU topology unavailable: {e}")
+    last = None
+    for attempt in range(2):
+        try:
+            topo = topologies.get_topology_desc(platform="tpu",
+                                                topology_name="v5e:2x4")
+            return topo.devices
+        except Exception as e:
+            last = e
+            # a concurrently-crashed compile leaves a stale lockfile that
+            # aborts libtpu init — clear it once and retry
+            if "libtpu_lockfile" in str(e) and attempt == 0:
+                try:
+                    os.remove("/tmp/libtpu_lockfile")
+                    continue
+                except OSError:
+                    pass
+            break
+    pytest.skip(f"TPU topology unavailable: {last}")
 
 
 def _build_abstract_trainer(devices, dp, tp, pp, sp=1, remat_policy=None):
