@@ -369,7 +369,7 @@ def bench_predictor_int8(paddle, steps=20):
     # interleaved rounds, min-of-rounds: run order shifts per-variant
     # numbers ~30% on the shared tunnel — min is the stable estimator
     best = {k: float("inf") for k in runners}
-    for _ in range(3):
+    for _ in range(2):
         for k, (once, _) in runners.items():
             t0 = time.perf_counter()
             for _ in range(steps):
@@ -444,7 +444,7 @@ def main():
     t_start = time.perf_counter()
     # soft wall budget for the EXTRA configs: the headline must always be
     # measured and printed even if the driver enforces a timeout
-    budget_s = float(os.environ.get("PADDLE_BENCH_BUDGET_S", "1050"))
+    budget_s = float(os.environ.get("PADDLE_BENCH_BUDGET_S", "1450"))
 
     # headline FIRST: the BASELINE metric's own model class (GPT-3 1.3B)
     if on_tpu:
@@ -518,13 +518,14 @@ def main():
                  "docstring roofline"))
         extra("resnet50_dp_amp", lambda: bench_resnet50(
             paddle, steps=10, batch=64))
-        extra("predictor_int8_serving", lambda: bench_predictor_int8(
-            paddle, steps=20))
         extra("moe_gpt_8experts", lambda: bench_moe(
             paddle, steps=10, peak=peak))
-        # most expensive + skippable last: the ZeRO-Offload fidelity run
+        # expensive + skippable last: the ZeRO-Offload fidelity run, then
+        # the serving comparison (cheapest to re-derive offline)
         extra("gpt_1p3b_f32master_offload", lambda: bench_gpt_1p3b(
             paddle, peak, steps=3, micro=2, n_micro=16, offload=True))
+        extra("predictor_int8_serving", lambda: bench_predictor_int8(
+            paddle, steps=15))
 
     print(json.dumps({
         "metric": head_name.replace("_hybrid_amp", "")
