@@ -72,7 +72,7 @@ def build_corpus(path=CORPUS, target_mb=8):
     return path
 
 
-def main(steps=10, corpus=None):
+def main(steps=10, corpus=None, curve_out=None):
     import jax
 
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -130,6 +130,18 @@ def main(steps=10, corpus=None):
     if len(curve) >= 10:
         assert np.mean(curve[-3:]) < np.mean(curve[:3]), \
             f"no learning progress on real corpus: {curve}"
+    if curve_out:
+        import json
+
+        with open(curve_out, "w") as f:
+            json.dump({
+                "model": "gpt3_1.3b" if on_tpu else "gpt_tiny_cpu_smoke",
+                "data": "byte-level stdlib corpus via native "
+                        "strided-window mmap loader (zero-copy)",
+                "batch": batch, "seq": seq, "steps": steps,
+                "loss_curve": curve,
+                "tokens_per_sec_last": round(tps, 1)}, f, indent=1)
+        print("curve written:", curve_out)
 
     if on_tpu and steps > 0 and hasattr(trainer, "memory_analysis"):
         ma = trainer.memory_analysis(toks)
@@ -139,7 +151,7 @@ def main(steps=10, corpus=None):
 
 
 if __name__ == "__main__":
-    corpus, args = None, []
+    corpus, curve_out, args = None, None, []
     argv = sys.argv[1:]
     while argv:
         a = argv.pop(0)
@@ -147,6 +159,10 @@ if __name__ == "__main__":
             corpus = a.split("=", 1)[1]
         elif a == "--corpus":
             corpus = argv.pop(0)
+        elif a.startswith("--curve-out="):
+            curve_out = a.split("=", 1)[1]
+        elif a == "--curve-out":
+            curve_out = argv.pop(0)
         else:
             args.append(a)
-    main(int(args[0]) if args else 10, corpus=corpus)
+    main(int(args[0]) if args else 10, corpus=corpus, curve_out=curve_out)

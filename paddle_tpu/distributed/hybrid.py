@@ -505,21 +505,54 @@ class HybridPipelineTrainer:
 
         offload_p = self.offload_params
 
-        def upd2(p, g, s, spec, lr, step_no, plr, wd, pspec=None):
+        # Offloading: the f32 update math would otherwise materialize
+        # f32 copies of a WHOLE stacked group (p, g, m, v — at 2.7B the
+        # largest group is 0.84 B params ⇒ ~13 GB of f32 transients,
+        # which cannot fit next to the resident bf16 state). Scanning
+        # the update over the stacked layer dim bounds the f32 working
+        # set to ONE layer; the math is elementwise per parameter so the
+        # scan is exact.
+        scan_update = offload_p or offload
+
+        def core_upd(p, g, s_dev, lr, step_no, plr, wd, store_p_dtype,
+                     store_s):
+            np_, ns = upd(p, g, s_dev, lr, step_no, plr=plr, wd=wd)
+            if pdt is not None and jnp.issubdtype(store_p_dtype,
+                                                  jnp.floating):
+                np_ = np_.astype(store_p_dtype)
+            if mdt is not None:
+                ns = {k: v.astype(store_s[k].dtype)
+                      if jnp.issubdtype(v.dtype, jnp.floating) else v
+                      for k, v in ns.items()}
+            return np_, ns
+
+        def upd2(p, g, s, spec, lr, step_no, plr, wd, pspec=None,
+                 stacked=False):
             """Update in f32 math, store back at the configured dtypes
             (+ host placement handled by out_shardings when offloading)."""
             if offload_p:
                 p = jax.device_put(p, NamedSharding(
                     mesh_, pspec, memory_kind="device"))
             s_dev = fetch_state(s, spec)
-            np_, ns = upd(p, g, s_dev, lr, step_no, plr=plr, wd=wd)
-            if pdt is not None and jnp.issubdtype(p.dtype, jnp.floating):
-                np_ = np_.astype(p.dtype)
-            if mdt is not None:
-                ns = {k: v.astype(s[k].dtype)
-                      if jnp.issubdtype(v.dtype, jnp.floating) else v
-                      for k, v in ns.items()}
-            return np_, ns
+            if scan_update and stacked and p.ndim >= 3:
+                lead = p.shape[0] * p.shape[1]
+                pf = p.reshape((lead,) + p.shape[2:])
+                gf = g.reshape((lead,) + g.shape[2:])
+                sf = {k: v.reshape((lead,) + v.shape[2:])
+                      for k, v in s_dev.items()}
+
+                def body(carry, xs):
+                    pi, gi, si = xs
+                    npi, nsi = core_upd(pi, gi, si, lr, step_no, plr, wd,
+                                        p.dtype, {k: s[k] for k in si})
+                    return carry, (npi, nsi)
+
+                _, (npf, nsf) = jax.lax.scan(body, 0, (pf, gf, sf))
+                np_ = npf.reshape(p.shape)
+                ns = {k: v.reshape(s_dev[k].shape)
+                      for k, v in nsf.items()}
+                return np_, ns
+            return core_upd(p, g, s_dev, lr, step_no, plr, wd, p.dtype, s)
 
         def step_fn(block_params, other_params, block_opt, other_opt,
                     batch, lr, step_no, key):
@@ -553,9 +586,15 @@ class HybridPipelineTrainer:
             # and copy-in of group k overlaps update k-1 and copy-out of
             # group k-depth on the full-duplex link.
             chain = [loss] * self.offload_depth
+            any_offload = offload_p or offload
 
             def barriered(p, g, s):
-                if not offload_p:
+                # serialize per-group host fetches whenever ANY state is
+                # host-resident — with only the optimizer offloaded the
+                # unconstrained scheduler would fetch every group's
+                # moments during backward and OOM on the f32 update
+                # transients (hit at 2.7B moment-offload)
+                if not any_offload:
                     return p, g, s
                 (p, g, _), s = jax.lax.optimization_barrier(
                     ((p, g, chain.pop(0)), s))
@@ -567,10 +606,10 @@ class HybridPipelineTrainer:
                                     block_opt[sfx])
                 np_, ns = upd2(p, g, s, self.block_opt_specs[sfx],
                                lr, step_no, lr_block[sfx], wd_block[sfx],
-                               pspec=self.block_specs[sfx])
+                               pspec=self.block_specs[sfx], stacked=True)
                 new_blk[sfx] = np_
                 new_blk_opt[sfx] = ns
-                if offload_p:
+                if any_offload:
                     chain.append(np_)
             new_oth, new_oth_opt = [], []
             for p, g, s, sspec, pspec, plr, wd in zip(
@@ -581,7 +620,7 @@ class HybridPipelineTrainer:
                                pspec=pspec)
                 new_oth.append(np_)
                 new_oth_opt.append(ns)
-                if offload_p:
+                if any_offload:
                     chain.append(np_)
             return loss, new_blk, new_oth, new_blk_opt, new_oth_opt
 
