@@ -61,6 +61,7 @@ class HybridPipelineTrainer:
                  offload_optimizer: bool = False,
                  offload_params: bool = False,
                  offload_depth: int = 2,
+                 update_scan: bool = False,
                  unroll_layers: Optional[bool] = None,
                  free_eager: bool = False):
         """Memory knobs for billion-param single/few-chip configs
@@ -133,6 +134,13 @@ class HybridPipelineTrainer:
         # (p, m, v) working sets may be in flight at once. Deeper = more
         # copy/compute overlap, +1 group of transient HBM per step
         self.offload_depth = max(1, int(offload_depth))
+        # update_scan: run the stacked-group optimizer update as a
+        # lax.scan over layers — bounds f32 update transients to one
+        # layer instead of a whole group. Opt-in: this environment's
+        # remote compile helper SIGABRTs on the scan+offload composition
+        # for some configs, so the default keeps the validated whole-
+        # group update.
+        self.update_scan = bool(update_scan)
         if offload_params and not self.amp:
             raise ValueError("offload_params requires strategy.amp (the "
                              "compute copies are bf16)")
@@ -505,14 +513,14 @@ class HybridPipelineTrainer:
 
         offload_p = self.offload_params
 
-        # Offloading: the f32 update math would otherwise materialize
-        # f32 copies of a WHOLE stacked group (p, g, m, v — at 2.7B the
+        # update_scan (opt-in): the f32 update math materializes f32
+        # copies of a WHOLE stacked group (p, g, m, v — at 2.7B the
         # largest group is 0.84 B params ⇒ ~13 GB of f32 transients,
         # which cannot fit next to the resident bf16 state). Scanning
         # the update over the stacked layer dim bounds the f32 working
         # set to ONE layer; the math is elementwise per parameter so the
         # scan is exact.
-        scan_update = offload_p or offload
+        scan_update = self.update_scan
 
         def core_upd(p, g, s_dev, lr, step_no, plr, wd, store_p_dtype,
                      store_s):
