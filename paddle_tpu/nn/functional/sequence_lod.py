@@ -29,7 +29,8 @@ __all__ = [
     "sequence_first_step", "sequence_last_step", "sequence_expand",
     "sequence_expand_as", "sequence_concat", "sequence_softmax",
     "sequence_reverse", "sequence_conv", "sequence_enumerate",
-    "sequence_slice",
+    "sequence_slice", "sequence_erase", "sequence_reshape",
+    "sequence_scatter", "sequence_topk_avg_pooling",
 ]
 
 
@@ -275,6 +276,98 @@ def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
         return jnp.stack(outs, axis=-1)
 
     return apply(f, input, differentiable=False, name="sequence_enumerate")
+
+
+def sequence_erase(x, tokens, length=None, name=None):
+    """Remove every occurrence of ``tokens`` from each row (reference:
+    sequence_ops/sequence_erase_op.cc). Padded [B, T] int + lengths ->
+    (padded [B, T] zero-padded, new lengths). Output row lengths are
+    data-dependent => eager host op."""
+    v = np.asarray(unwrap(x))
+    lens = (np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+            if length is not None
+            else np.full((v.shape[0],), v.shape[1], np.int64))
+    drop = set(int(t) for t in tokens)
+    out = np.zeros_like(v)
+    new_len = np.zeros_like(lens)
+    for b in range(v.shape[0]):
+        keep = [t for t in v[b, :lens[b]] if int(t) not in drop]
+        out[b, :len(keep)] = keep
+        new_len[b] = len(keep)
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(new_len))
+
+
+def sequence_reshape(x, new_dim, length=None, name=None):
+    """Re-chunk each sequence's feature payload to ``new_dim`` columns
+    (reference: sequence_ops/sequence_reshape_op.cc): packed
+    [total, D] -> [total*D/new_dim, new_dim]; each row length scales by
+    D/new_dim (must divide exactly, like the reference checks)."""
+    v = unwrap(x)
+    d = int(v.shape[-1])
+    if (d * int(np.prod(v.shape[:-1]))) % int(new_dim):
+        raise ValueError(
+            f"sequence_reshape: total elements not divisible by "
+            f"new_dim={new_dim}")
+    out = apply(lambda vv: vv.reshape(-1, int(new_dim)), x,
+                name="sequence_reshape")
+    if length is None:
+        return out
+    lens = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+    if (lens * d) .sum() % int(new_dim) or np.any((lens * d) % new_dim):
+        raise ValueError("sequence_reshape: a row's payload is not "
+                         "divisible by new_dim")
+    return out, Tensor(jnp.asarray(lens * d // int(new_dim)))
+
+
+def sequence_scatter(x, index, updates, updates_length=None, name=None):
+    """Per-row scatter-ADD into the time axis (reference:
+    sequence_ops/sequence_scatter_op.cc: Out[b][ids[b][j]] += upd[b][j]
+    for each row's segment). Dense form: index/updates padded [B, K]
+    with ``updates_length`` valid counts."""
+    if updates_length is None:
+        raise ValueError("sequence_scatter: `updates_length` is required")
+
+    def f(xv, idx, upd, ul):
+        ul = ul.reshape(-1)
+        k = jnp.arange(idx.shape[1])
+        valid = k[None, :] < ul[:, None]
+        idx_c = jnp.clip(idx, 0, xv.shape[1] - 1)
+        upd_m = jnp.where(valid, upd, 0).astype(xv.dtype)
+        b = jnp.arange(xv.shape[0])[:, None]
+        b = jnp.broadcast_to(b, idx.shape)
+        return xv.at[b, idx_c].add(upd_m)
+
+    return apply(f, x, index, updates, updates_length,
+                 name="sequence_scatter")
+
+
+def sequence_topk_avg_pooling(x, length=None, topks=(1,), name=None):
+    """Average of the top-k time positions per feature (reference:
+    sequence_ops/sequence_topk_avg_pooling_op.cc — text-matching
+    pooling). Padded [B, T, C] + lengths -> [B, len(topks), C]; rows
+    shorter than k average their full top-|row| prefix (reference
+    zero-pads the tail of the sort)."""
+    if length is None:
+        raise ValueError("sequence_topk_avg_pooling: `length` required")
+    topks = tuple(int(k) for k in topks)
+
+    def f(v, lv):
+        lv = lv.reshape(-1)
+        mv, _ = _masked(v, lv, -jnp.inf)
+        srt = jnp.sort(mv, axis=1)[:, ::-1]          # [B, T, C] desc
+        outs = []
+        for k in topks:
+            kk = min(k, v.shape[1])
+            top = srt[:, :kk]
+            # positions beyond the row length carry -inf: mask to 0 and
+            # divide by the true count min(k, len)
+            cnt = jnp.minimum(lv, kk).astype(v.dtype)
+            top = jnp.where(jnp.isfinite(top), top, 0.0)
+            outs.append(top.sum(axis=1) /
+                        jnp.maximum(cnt, 1.0)[:, None])
+        return jnp.stack(outs, axis=1)               # [B, n_topk, C]
+
+    return apply(f, x, length, name="sequence_topk_avg_pooling")
 
 
 def sequence_slice(input, offset, length, name=None):  # noqa: A002

@@ -25,14 +25,19 @@ from ..nn.functional.sequence_lod import (sequence_mask, sequence_pad,  # noqa: 
                                           sequence_expand, sequence_expand_as,
                                           sequence_concat, sequence_softmax,
                                           sequence_reverse, sequence_conv,
-                                          sequence_enumerate, sequence_slice)
+                                          sequence_enumerate, sequence_slice,
+                                          sequence_erase, sequence_reshape,
+                                          sequence_scatter,
+                                          sequence_topk_avg_pooling)
 
 __all__ = ["cond", "while_loop", "case", "switch_case",
            "sequence_mask", "sequence_pad", "sequence_unpad",
            "sequence_pool", "sequence_first_step", "sequence_last_step",
            "sequence_expand", "sequence_expand_as", "sequence_concat",
            "sequence_softmax", "sequence_reverse", "sequence_conv",
-           "sequence_enumerate", "sequence_slice"]
+           "sequence_enumerate", "sequence_slice", "sequence_erase",
+           "sequence_reshape", "sequence_scatter",
+           "sequence_topk_avg_pooling"]
 
 
 def _tensors_in(vals):

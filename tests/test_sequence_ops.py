@@ -280,6 +280,66 @@ def test_spectral_norm_grad_flows():
     assert w.grad is not None and np.isfinite(w.grad.numpy()).all()
 
 
+def test_sequence_erase():
+    x = np.array([[1, 2, 3, 2], [2, 2, 5, 0]], np.int64)
+    lens = np.array([4, 3], np.int64)
+    out, nl = F.sequence_erase(paddle.to_tensor(x), [2],
+                               length=paddle.to_tensor(lens))
+    np.testing.assert_array_equal(nl.numpy(), [2, 1])
+    np.testing.assert_array_equal(out.numpy()[0, :2], [1, 3])
+    np.testing.assert_array_equal(out.numpy()[1, :1], [5])
+
+
+def test_sequence_reshape():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    lens = np.array([2, 4], np.int64)
+    out, nl = F.sequence_reshape(paddle.to_tensor(x), 4,
+                                 length=paddle.to_tensor(lens))
+    assert out.shape == [3, 4]
+    np.testing.assert_array_equal(nl.numpy(), [1, 2])
+    np.testing.assert_allclose(out.numpy().reshape(-1), x.reshape(-1))
+
+
+def test_sequence_scatter():
+    x = np.zeros((2, 5), np.float32)
+    idx = np.array([[0, 2, 2], [4, 1, 0]], np.int64)
+    upd = np.array([[1.0, 2.0, 3.0], [7.0, 8.0, 9.0]], np.float32)
+    ul = np.array([3, 2], np.int64)
+    out = F.sequence_scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd),
+                             updates_length=paddle.to_tensor(ul))
+    np.testing.assert_allclose(out.numpy()[0], [1, 0, 5, 0, 0])  # 2+3 add
+    np.testing.assert_allclose(out.numpy()[1], [0, 8, 0, 0, 7])  # 9 masked
+
+
+def test_sequence_scatter_grad():
+    idx = np.array([[0, 2]], np.int64)
+    ul = np.array([2], np.int64)
+
+    def op(x, upd):
+        return F.sequence_scatter(x, paddle.to_tensor(idx), upd,
+                                  updates_length=paddle.to_tensor(ul))
+
+    check_grad(op, {"x": np.random.rand(1, 4).astype(np.float32),
+                    "upd": np.random.rand(1, 2).astype(np.float32)},
+               ["x", "upd"])
+
+
+def test_sequence_topk_avg_pooling():
+    x = np.array([[[1.0], [5.0], [3.0], [9.0]],
+                  [[4.0], [2.0], [0.0], [0.0]]], np.float32)
+    lens = np.array([4, 2], np.int64)
+    out = F.sequence_topk_avg_pooling(paddle.to_tensor(x),
+                                      length=paddle.to_tensor(lens),
+                                      topks=(1, 3)).numpy()
+    # row0: top1 = 9; top3 = (9+5+3)/3
+    np.testing.assert_allclose(out[0, 0, 0], 9.0)
+    np.testing.assert_allclose(out[0, 1, 0], (9 + 5 + 3) / 3.0)
+    # row1 has only 2 valid: top1 = 4; top3 -> avg of its 2 = 3
+    np.testing.assert_allclose(out[1, 0, 0], 4.0)
+    np.testing.assert_allclose(out[1, 1, 0], 3.0)
+
+
 def test_static_nn_namespace():
     from paddle_tpu.static import nn as snn
 
