@@ -283,10 +283,13 @@ class Int8Linear(Layer):
 
 
 class Int8Conv2D(Layer):
-    """Deploy-time conv: int8 weight STORAGE with on-the-fly dequant to
-    the activation dtype (weight-only quantization — integer convolution
-    lowers poorly on the TPU conv units, unlike the MXU dot path, so the
-    compute stays bf16/f32; the 4× weight-size cut is still real)."""
+    """Deploy-time conv, computed as int8 im2col + int8×int8→int32 MXU
+    dot (groups == 1; grouped convs fall back to weight-only int8
+    storage with dequantized compute). The convolution IS a matmul over
+    unfolded patches — exactly the reference's im2col + GEMM kernel
+    shape (math/im2col.cc) — so the same MXU int8 path as Int8Linear
+    applies: patches quantized with the frozen QAT activation scale,
+    per-out-channel weight scales, f32 dequant + bias."""
 
     def __init__(self, inner: Conv2D, act_scale: float, bits: int = 8,
                  act_bits: int = 8, channel_wise: bool = True):
@@ -315,11 +318,62 @@ class Int8Conv2D(Layer):
     def forward(self, x):
         from ..nn import functional as F
 
-        # static activation qdq with the frozen QAT scale: keeps deploy
-        # outputs matching QAT eval (the int8 input the conv WOULD see)
-        amax = self._amax
-        sa = jnp.maximum(self.act_scale._value, 1e-8)
+        amax, wmax = self._amax, self._wmax
         xv = x._value if isinstance(x, Tensor) else x
+        sa = jnp.maximum(self.act_scale._value, 1e-8)
+        simple_pad = isinstance(self._padding, int) or (
+            isinstance(self._padding, (list, tuple))
+            and len(self._padding) == 2
+            and all(isinstance(p, int) for p in self._padding))
+        if self._groups == 1 and simple_pad:
+            wq = self.weight_q._value                # [O, C, kh, kw]
+            o, c, kh, kw = wq.shape
+            st = self._stride if isinstance(self._stride, (list, tuple)) \
+                else (self._stride, self._stride)
+            dl = self._dilation if isinstance(self._dilation,
+                                              (list, tuple)) \
+                else (self._dilation, self._dilation)
+            pad = self._padding
+            if isinstance(pad, int):
+                pad = (pad, pad)
+
+            def f(v, wq_, ws, sa_, *b):
+                sa_ = jnp.maximum(sa_, 1e-8)
+                vq = jnp.clip(jnp.round(v.astype(jnp.float32)
+                                        * (amax / sa_)),
+                              -amax, amax).astype(jnp.int8)
+                # im2col on the int8 activations (pure data movement)
+                vp = jnp.pad(vq, [(0, 0), (0, 0), (pad[0], pad[0]),
+                                  (pad[1], pad[1])])
+                oh = (vp.shape[2] - (dl[0] * (kh - 1) + 1)) // st[0] + 1
+                ow = (vp.shape[3] - (dl[1] * (kw - 1) + 1)) // st[1] + 1
+                cols = []
+                for i in range(kh):
+                    for j in range(kw):
+                        di, dj = i * dl[0], j * dl[1]
+                        cols.append(vp[:, :, di:di + oh * st[0]:st[0],
+                                       dj:dj + ow * st[1]:st[1]])
+                patches = jnp.stack(cols, 2)        # [N, C, k*k, OH, OW]
+                n = patches.shape[0]
+                pm = patches.transpose(0, 3, 4, 1, 2).reshape(
+                    n * oh * ow, c * kh * kw)
+                wm = wq_.reshape(o, c * kh * kw).T   # [C*k*k, O]
+                acc = jax.lax.dot_general(
+                    pm, wm, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                out = acc.astype(jnp.float32) * (sa_ / amax) * \
+                    (jnp.maximum(ws, 1e-8) / wmax)
+                if b:
+                    out = out + b[0].astype(jnp.float32)
+                return out.reshape(n, oh, ow, o).transpose(
+                    0, 3, 1, 2).astype(v.dtype)
+
+            args = (x, self.weight_q, self.w_scale, self.act_scale) + \
+                ((self.bias,) if self.bias is not None else ())
+            return apply(f, *args, differentiable=False,
+                         name="int8_conv2d")
+        # grouped conv fallback: static activation qdq + dequantized
+        # weights (weight-only int8)
         xq = jnp.clip(jnp.round(xv.astype(jnp.float32) * (amax / sa)),
                       -amax, amax) * (sa / amax)
         x = Tensor(xq.astype(xv.dtype))
