@@ -12,7 +12,7 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-from . import autograd, core, framework  # noqa: F401
+from . import autograd, compat, core, framework  # noqa: F401
 from .autograd import enable_grad, grad, no_grad, set_grad_enabled  # noqa: F401
 from .core import (CPUPlace, CUDAPinnedPlace, CUDAPlace, TPUPlace,  # noqa: F401
                    XPUPlace, get_default_dtype, get_flags,

@@ -1,0 +1,72 @@
+"""paddle.compat — py2/py3 text/number helpers kept for API parity
+(reference: python/paddle/compat.py). Python-3-only semantics here; the
+py2 branches of the reference are dead code on every supported runtime.
+"""
+from __future__ import annotations
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def _map(obj, fn, inplace):
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_map(o, fn, inplace) for o in obj]
+            return obj
+        return [_map(o, fn, inplace) for o in obj]
+    if isinstance(obj, set):
+        vals = {_map(o, fn, False) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return vals
+    if isinstance(obj, dict):
+        vals = {_map(k, fn, False): _map(v, fn, False)
+                for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(vals)
+            return obj
+        return vals
+    return fn(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Decode bytes (possibly nested in list/set/dict) to str
+    (reference compat.py:36)."""
+    def one(o):
+        return o.decode(encoding) if isinstance(o, bytes) else o
+
+    return _map(obj, one, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Encode str (possibly nested in list/set/dict) to bytes
+    (reference compat.py:132)."""
+    def one(o):
+        return o.encode(encoding) if isinstance(o, str) else o
+
+    return _map(obj, one, inplace)
+
+
+def round(x, d=0):  # noqa: A001
+    """Py2-style round (away from zero at .5) — reference compat.py:217."""
+    import math
+
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

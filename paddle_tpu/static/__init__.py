@@ -53,6 +53,22 @@ def default_startup_program():
     return Program()
 
 
+def data(name, shape, dtype="float32", lod_level=0):
+    """paddle.static.data — a typed graph input placeholder (reference:
+    python/paddle/fluid/data.py). The TPU translation is an InputSpec:
+    hand it to jit.to_static/save as the traced signature."""
+    return InputSpec(shape, dtype, name)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """paddle.static.gradients (reference fluid/backward.py
+    calc_gradient): grads of ``targets`` w.r.t. ``inputs`` — here the
+    eager tape computes them directly (no program rewriting)."""
+    from ..autograd import grad as _grad
+
+    return _grad(targets, inputs, grad_outputs=target_gradients)
+
+
 def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     """Standalone trainable parameter (reference:
