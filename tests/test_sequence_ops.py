@@ -340,6 +340,28 @@ def test_sequence_topk_avg_pooling():
     np.testing.assert_allclose(out[1, 1, 0], 3.0)
 
 
+def test_padded_sequence_ops_jittable():
+    """The padded-form ops are mask-based and must stage cleanly under
+    jit (TPU-first contract: no data-dependent shapes inside the
+    program)."""
+    import jax
+    import jax.numpy as jnp
+
+    lens = np.array([2, 3], np.int64)
+
+    @jax.jit
+    def f(v, lv):
+        a = F.sequence_softmax(paddle.to_tensor(v),
+                               length=paddle.to_tensor(lv))
+        b = F.sequence_reverse(a, length=paddle.to_tensor(lv))
+        return F.sequence_pool(b, "average",
+                               length=paddle.to_tensor(lv))._value
+
+    out = f(jnp.asarray(np.random.rand(2, 3, 1).astype(np.float32)),
+            jnp.asarray(lens))
+    assert out.shape == (2, 1) and np.isfinite(np.asarray(out)).all()
+
+
 def test_static_nn_namespace():
     from paddle_tpu.static import nn as snn
 
