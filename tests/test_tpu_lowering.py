@@ -89,6 +89,10 @@ def test_tpu_lowering_bf16_collective_permute(monkeypatch):
         f"leaked into the TPU program):\n" + "\n".join(bad[:5]))
     assert any("bf16" in l for l in cps), \
         "no bf16 collective_permute found — stage boundary not bf16"
+    # the attention must be the REAL Mosaic kernel on this target, not
+    # the CPU interpret-mode HLO expansion
+    assert "tpu_custom_call" in hlo or "custom_call" in hlo, \
+        "no Mosaic custom call in the TPU program — flash kernel lost"
 
 
 def test_tpu_topology_compile_and_memory():
