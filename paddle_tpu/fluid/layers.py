@@ -11,6 +11,13 @@ from ..static.nn import case, cond, switch_case, while_loop  # noqa: F401
 from ..tensor.creation import (arange, assign, full, linspace,  # noqa: F401
                                ones, ones_like, zeros, zeros_like)
 from ..tensor import concat, reshape, shape, slice, split, squeeze  # noqa: F401
+from ..vision.detection import (  # noqa: F401
+    anchor_generator, bipartite_match, box_clip, box_coder,
+    collect_fpn_proposals, density_prior_box, distribute_fpn_proposals,
+    generate_proposal_labels, generate_proposals, iou_similarity,
+    locality_aware_nms, matrix_nms, mine_hard_examples, multiclass_nms,
+    polygon_box_transform, retinanet_detection_output, rpn_target_assign,
+    target_assign)
 
 # 1.x names whose modern spelling differs
 

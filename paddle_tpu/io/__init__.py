@@ -341,6 +341,11 @@ class _PoolState:
         self.worker_sems = [threading.Semaphore(prefetch)
                             for _ in range(nw)]
         self.work_q = queue.Queue()
+        # iterable mode: worker 0 probes whether the dataset is its own
+        # iterator (shared cursor) and publishes the verdict here; the
+        # other workers wait on the event before touching the dataset.
+        self.probe_event = threading.Event()
+        self.probe_single_stream = False
 
     def publish(self, seq, item):
         with self.cond:
@@ -374,6 +379,7 @@ class _PoolState:
             sem.release()
         for _ in range(self.nw):
             self.work_q.put((None, self.END))
+        self.probe_event.set()           # unblock workers awaiting probe
         with self.cond:
             self.cond.notify_all()
 
@@ -411,16 +417,38 @@ def _pool_iterable_worker(state, dataset, collate_fn, batch_size,
     _worker_tls.info = WorkerInfo(wid, state.nw, dataset)
     k = 0
     try:
-        it = iter(dataset)
-        if it is dataset and wid != 0:
-            # __iter__ returned the dataset itself: ONE shared iterator,
-            # which N threads cannot drive safely (a generator would
-            # raise "already executing"; a stateful __next__ would lose
-            # samples). Fall back to the single-stream behavior — only
-            # worker 0 consumes it.
-            return
+        # A dataset that is its own iterator (iter(ds) returns ds) holds
+        # ONE shared cursor, which N threads cannot drive safely (a
+        # generator would raise "already executing"; a stateful __next__
+        # would lose samples) — and such datasets often RESET the cursor
+        # in __iter__, so a late worker merely *calling* iter() would
+        # clobber worker 0's in-progress iteration. Probe exactly once:
+        # worker 0 calls iter() and publishes the verdict via an Event;
+        # workers 1..N-1 wait for it and bail out (single-stream
+        # fallback) when the dataset is a self-iterator. Datasets whose
+        # __iter__ returns fresh independent iterators keep the full
+        # N-stream parallelism.
+        if wid == 0:
+            state.probe_single_stream = True   # pessimistic until probed
+            try:
+                it = iter(dataset)
+                state.probe_single_stream = it is dataset
+            finally:
+                state.probe_event.set()
+        else:
+            state.probe_event.wait()
+            if state.probe_single_stream or state.stop.is_set():
+                return
+            it = iter(dataset)
         while not state.stop.is_set():
-            batch = list(itertools.islice(it, batch_size))
+            # draw via next(): islice would call iter(it) per batch,
+            # re-triggering a cursor-resetting __iter__ every batch
+            batch = []
+            try:
+                while len(batch) < batch_size:
+                    batch.append(next(it))
+            except StopIteration:
+                pass
             if not batch or (drop_last and len(batch) < batch_size):
                 break
             state.worker_sems[wid].acquire()
@@ -522,8 +550,15 @@ class _DataLoaderIter:
         if self.loader._iterable_mode:
             if not hasattr(self, "_raw_iter"):
                 self._raw_iter = iter(self.loader.dataset)
-            batch = list(itertools.islice(self._raw_iter,
-                                          self.loader.batch_size))
+            # draw via next(): islice would call iter() on the stream per
+            # batch, restarting datasets whose __iter__ resets a shared
+            # cursor (same hazard as the worker-pool path)
+            batch = []
+            try:
+                while len(batch) < self.loader.batch_size:
+                    batch.append(next(self._raw_iter))
+            except StopIteration:
+                pass
             if not batch or (self.loader.drop_last and
                              len(batch) < self.loader.batch_size):
                 raise StopIteration

@@ -61,8 +61,16 @@ def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
         raise ValueError(
             "sequence_pad: dense-ragged form requires the explicit "
             "`length` tensor (the LoD run lengths).")
-    lengths_np = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
-    ml = int(maxlen) if maxlen is not None else int(lengths_np.max())
+    if maxlen is not None:
+        # Static maxlen: no host materialization of lengths — the op
+        # stages under jit even when `length` is a traced value.
+        ml = int(maxlen)
+        lengths_out = length if isinstance(length, Tensor) else Tensor(
+            jnp.asarray(unwrap(length)).reshape(-1))
+    else:
+        lengths_np = np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+        ml = int(lengths_np.max())
+        lengths_out = Tensor(jnp.asarray(lengths_np))
 
     def f(v, lv, pv):
         lv = lv.reshape(-1)
@@ -78,7 +86,7 @@ def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
         return jnp.where(mask, out, pad)
 
     out = apply(f, x, length, pad_value, name="sequence_pad")
-    return out, Tensor(jnp.asarray(lengths_np))
+    return out, lengths_out
 
 
 def sequence_unpad(x, length, name=None):
@@ -259,23 +267,32 @@ def sequence_conv(input, weight, length=None, context_length=3,  # noqa: A002
     return apply(f, *args, name="sequence_conv")
 
 
-def sequence_enumerate(input, win_size, pad_value=0, name=None):  # noqa: A002
+def sequence_enumerate(input, win_size, pad_value=0, length=None,  # noqa: A002
+                       name=None):
     """Sliding windows of ids (reference:
     sequence_ops/sequence_enumerate_op.cc): [B, T] int -> [B, T, win]
-    where window positions past each row's length fill ``pad_value``."""
+    where window positions past each row's length fill ``pad_value``.
+    Like every sibling op in this dense-ragged module, the per-row valid
+    extent comes from the explicit ``length`` tensor; without it the full
+    padded width is treated as valid."""
     def f(v, lv=None):
         bsz, tmax = v.shape
         t = jnp.arange(tmax)
+        if lv is None:
+            row_len = jnp.full((bsz,), tmax, t.dtype)
+        else:
+            row_len = lv.reshape(-1).astype(t.dtype)
         outs = []
         for k in range(int(win_size)):
             src = t + k
-            ok = src < tmax
+            ok = src[None, :] < row_len[:, None]       # per-row extent
             src_c = jnp.clip(src, 0, tmax - 1)
             g = v[:, src_c]
-            outs.append(jnp.where(ok[None, :], g, pad_value))
+            outs.append(jnp.where(ok, g, pad_value))
         return jnp.stack(outs, axis=-1)
 
-    return apply(f, input, differentiable=False, name="sequence_enumerate")
+    args = (input,) if length is None else (input, length)
+    return apply(f, *args, differentiable=False, name="sequence_enumerate")
 
 
 def sequence_erase(x, tokens, length=None, name=None):

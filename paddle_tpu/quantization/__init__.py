@@ -399,13 +399,22 @@ def convert_to_int8_deploy(model: Layer, _undo=None) -> int:
                     f"int8 deploy supports <=8-bit quantization, got "
                     f"weight_bits={child.bits} "
                     f"activation_bits={child.act_quant.bits}")
+            act_scale = float(np.asarray(child.act_quant.scale._value))
+            if act_scale == 0.0:
+                raise ValueError(
+                    f"layer '{name}' has an uncalibrated activation "
+                    "observer (act scale == 0): no training or "
+                    "calibration forward pass has run, so the deployed "
+                    "int8 graph would saturate every activation. Run at "
+                    "least one forward pass (QAT training step or PTQ "
+                    "calibration batch) before converting to int8 deploy.")
             cls = Int8Linear if isinstance(child, QuantedLinear) \
                 else Int8Conv2D
             if _undo is not None:
                 _undo.append((model, name, child))
             setattr(model, name, cls(
                 child.inner,
-                float(np.asarray(child.act_quant.scale._value)),
+                act_scale,
                 bits=child.bits, act_bits=child.act_quant.bits,
                 channel_wise=child.channel_wise))
             n += 1

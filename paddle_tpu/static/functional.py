@@ -9,6 +9,7 @@ program_translator.py:756): because Layers execute jnp ops on their
 """
 from __future__ import annotations
 
+import threading
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -38,26 +39,35 @@ class _swapped_state:
     corrupting the trace (VERDICT r3 weak #6)."""
 
     _owner: dict = {}                # id(tensor) -> (thread_id, depth)
+    _owner_lock = threading.Lock()
 
     def __init__(self, tensors: List[Tensor], values):
         self.tensors = tensors
         self.values = values
 
     def __enter__(self):
-        import threading
-
         tid = threading.get_ident()
-        for t in self.tensors:
-            owner = _swapped_state._owner.get(id(t))
-            if owner is not None and owner[0] != tid:
-                raise RuntimeError(
-                    "_swapped_state: tensor is already swapped by another "
-                    "thread — two trainers/traces are functionalizing the "
-                    "same module concurrently. Build separate module "
-                    "instances per trainer (shared Layer objects cannot "
-                    "be traced from two threads at once).")
-            _swapped_state._owner[id(t)] = (
-                tid, 1 if owner is None else owner[1] + 1)
+        # The registry bookkeeping must be atomic: without the lock two
+        # threads can both pass the owner check (get-then-set race) and
+        # both swap — the exact corruption this registry detects. And
+        # validation must complete BEFORE any registration: a raise
+        # mid-registration would leak permanent stale entries (no __exit__
+        # runs when __enter__ raises).
+        with _swapped_state._owner_lock:
+            for t in self.tensors:
+                owner = _swapped_state._owner.get(id(t))
+                if owner is not None and owner[0] != tid:
+                    raise RuntimeError(
+                        "_swapped_state: tensor is already swapped by "
+                        "another thread — two trainers/traces are "
+                        "functionalizing the same module concurrently. "
+                        "Build separate module instances per trainer "
+                        "(shared Layer objects cannot be traced from two "
+                        "threads at once).")
+            for t in self.tensors:
+                owner = _swapped_state._owner.get(id(t))
+                _swapped_state._owner[id(t)] = (
+                    tid, 1 if owner is None else owner[1] + 1)
         self.saved = [t._value for t in self.tensors]
         for t, v in zip(self.tensors, self.values):
             t._value = v
@@ -66,13 +76,15 @@ class _swapped_state:
     def __exit__(self, *exc):
         for t, v in zip(self.tensors, self.saved):
             t._value = v
-        for t in self.tensors:
-            owner = _swapped_state._owner.get(id(t))
-            if owner is not None:
-                if owner[1] <= 1:
-                    del _swapped_state._owner[id(t)]
-                else:
-                    _swapped_state._owner[id(t)] = (owner[0], owner[1] - 1)
+        with _swapped_state._owner_lock:
+            for t in self.tensors:
+                owner = _swapped_state._owner.get(id(t))
+                if owner is not None:
+                    if owner[1] <= 1:
+                        del _swapped_state._owner[id(t)]
+                    else:
+                        _swapped_state._owner[id(t)] = (owner[0],
+                                                        owner[1] - 1)
         return False
 
 

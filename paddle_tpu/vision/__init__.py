@@ -1,4 +1,4 @@
 """paddle.vision equivalent."""
-from . import datasets, image, models, ops, transforms  # noqa: F401
+from . import datasets, detection, image, models, ops, transforms  # noqa: F401
 from .image import get_image_backend, image_load, set_image_backend  # noqa: F401
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,355 @@
+"""Detection pipeline op family (round-5 tail): numpy-golden forwards per
+the reference OpTest contract (reference:
+unittests/test_multiclass_nms_op.py, test_box_coder_op.py,
+test_iou_similarity_op.py, test_bipartite_match_op.py,
+test_generate_proposals_op.py style).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import detection as D
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+# --------------------------- numpy goldens ------------------------------
+def np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    out = np.zeros((len(a), len(b)), np.float64)
+    for i, p in enumerate(a):
+        for j, q in enumerate(b):
+            aa = (p[2] - p[0] + off) * (p[3] - p[1] + off)
+            ab = (q[2] - q[0] + off) * (q[3] - q[1] + off)
+            iw = min(p[2], q[2]) - max(p[0], q[0]) + off
+            ih = min(p[3], q[3]) - max(p[1], q[1]) + off
+            inter = max(iw, 0) * max(ih, 0)
+            out[i, j] = inter / (aa + ab - inter + 1e-10)
+    return out
+
+
+def test_iou_similarity():
+    x = np.array([[0.5, 0.5, 2.0, 2.0], [0., 0., 1.0, 1.0]], np.float32)
+    y = np.array([[1.0, 1.0, 2.5, 2.5]], np.float32)
+    got = D.iou_similarity(_t(x), _t(y)).numpy()
+    # reference docstring example (fluid/layers/detection.py:764)
+    np.testing.assert_allclose(got, [[0.2857143], [0.0]], rtol=1e-5)
+    got2 = D.iou_similarity(_t(x), _t(y), box_normalized=False).numpy()
+    np.testing.assert_allclose(got2, np_iou(x, y, False), rtol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.abs(rng.rand(5, 4).astype(np.float32)) + \
+        np.array([0, 0, 1, 1], np.float32)
+    var = [0.1, 0.1, 0.2, 0.2]
+    target = np.abs(rng.rand(3, 4).astype(np.float32)) + \
+        np.array([0, 0, 1, 1], np.float32)
+    enc = D.box_coder(_t(prior), var, _t(target),
+                      code_type="encode_center_size").numpy()
+    assert enc.shape == (3, 5, 4)
+    # decode(enc) must reproduce the target boxes against each prior
+    dec = D.box_coder(_t(prior), var, _t(enc),
+                      code_type="decode_center_size", axis=0).numpy()
+    for j in range(5):
+        np.testing.assert_allclose(dec[:, j], target, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_box_coder_var_tensor_and_axis1():
+    rng = np.random.RandomState(1)
+    prior = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)   # [N=2,4]
+    pvar = np.full((2, 4), 0.5, np.float32)
+    deltas = rng.randn(2, 3, 4).astype(np.float32) * 0.1
+    dec = D.box_coder(_t(prior), _t(pvar), _t(deltas),
+                      code_type="decode_center_size", axis=1).numpy()
+    # manual formula for element [0, 0]
+    pw, ph = 2.0, 2.0
+    pcx, pcy = 1.0, 1.0
+    d = deltas[0, 0]
+    cx = 0.5 * d[0] * pw + pcx
+    cy = 0.5 * d[1] * ph + pcy
+    w = np.exp(0.5 * d[2]) * pw
+    h = np.exp(0.5 * d[3]) * ph
+    np.testing.assert_allclose(
+        dec[0, 0], [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+        rtol=1e-5)
+
+
+def test_box_clip():
+    boxes = np.array([[[-5., -5., 150., 80.], [10., 10., 20., 20.]]],
+                     np.float32)
+    info = np.array([[100., 120., 1.0]], np.float32)   # h=100, w=120
+    got = D.box_clip(_t(boxes), _t(info)).numpy()
+    np.testing.assert_allclose(got[0, 0], [0., 0., 119., 80.])
+    np.testing.assert_allclose(got[0, 1], [10., 10., 20., 20.])
+
+
+def test_polygon_box_transform():
+    v = np.zeros((1, 2, 2, 3), np.float32)
+    got = D.polygon_box_transform(_t(v)).numpy()
+    # even channel: 4*x_index; odd channel: 4*y_index
+    np.testing.assert_allclose(got[0, 0], [[0, 4, 8], [0, 4, 8]])
+    np.testing.assert_allclose(got[0, 1], [[0, 0, 0], [4, 4, 4]])
+
+
+def test_anchor_generator():
+    x = paddle.zeros([1, 8, 2, 2])
+    anchors, variances = D.anchor_generator(
+        x, anchor_sizes=[64.], aspect_ratios=[1.0],
+        variance=[0.1, 0.1, 0.2, 0.2], stride=[16., 16.], offset=0.5)
+    a = anchors.numpy()
+    assert a.shape == (2, 2, 1, 4)
+    # reference kernel formula at (0, 0): ctr = 0.5*15 = 7.5,
+    # base 16x16 anchor scaled by 64/16 -> 64x64
+    np.testing.assert_allclose(a[0, 0, 0],
+                               [7.5 - 31.5, 7.5 - 31.5,
+                                7.5 + 31.5, 7.5 + 31.5])
+    assert variances.numpy().shape == (2, 2, 1, 4)
+    np.testing.assert_allclose(variances.numpy()[1, 1, 0],
+                               [0.1, 0.1, 0.2, 0.2])
+
+
+def test_density_prior_box():
+    inp = paddle.zeros([1, 3, 2, 2])
+    img = paddle.zeros([1, 3, 16, 16])
+    boxes, vars_ = D.density_prior_box(
+        inp, img, densities=[2], fixed_sizes=[4.0], fixed_ratios=[1.0],
+        steps=[8.0, 8.0], offset=0.5, clip=True)
+    b = boxes.numpy()
+    assert b.shape == (2, 2, 4, 4)          # density^2 = 4 priors
+    assert (b >= 0).all() and (b <= 1).all()
+    assert vars_.numpy().shape == b.shape
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[0.9, 0.2, 0.1],
+                     [0.8, 0.7, 0.3]], np.float32)
+    mi, md = D.bipartite_match(_t(dist))
+    # greedy: (0,0)=0.9 first, then row 1 best remaining col -> (1,1)=0.7
+    np.testing.assert_array_equal(mi.numpy(), [[0, 1, -1]])
+    np.testing.assert_allclose(md.numpy(), [[0.9, 0.7, 0.0]], rtol=1e-6)
+    # per_prediction argmax fills col 2 from best row above threshold
+    mi2, md2 = D.bipartite_match(_t(dist), match_type="per_prediction",
+                                 dist_threshold=0.25)
+    np.testing.assert_array_equal(mi2.numpy(), [[0, 1, 1]])
+    np.testing.assert_allclose(md2.numpy(), [[0.9, 0.7, 0.3]], rtol=1e-6)
+
+
+def test_target_assign():
+    # 2 images, 2 + 1 gt rows, P=1, K=4
+    x = np.arange(12, dtype=np.float32).reshape(3, 1, 4)
+    lens = np.array([2, 1])
+    mi = np.array([[1, -1], [0, 0]], np.int32)
+    out, wt = D.target_assign(_t(x), _t(mi), mismatch_value=-1,
+                              input_lengths=_t(lens))
+    o = out.numpy()
+    np.testing.assert_allclose(o[0, 0], x[1, 0])       # img0 row offset 0
+    np.testing.assert_allclose(o[0, 1], [-1] * 4)      # mismatch
+    np.testing.assert_allclose(o[1, 0], x[2, 0])       # img1 offset 2
+    np.testing.assert_allclose(wt.numpy()[:, :, 0], [[1, 0], [1, 1]])
+
+
+def test_multiclass_nms_basic():
+    # two well-separated boxes + one duplicate that must be suppressed
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.array([[[0.9, 0.8, 0.7],         # class 1
+                        [0.1, 0.2, 0.3]]], np.float32)  # class 2
+    scores = np.concatenate([np.zeros((1, 1, 3), np.float32), scores],
+                            axis=1)              # class 0 = background
+    out, nums = D.multiclass_nms(_t(boxes), _t(scores),
+                                 score_threshold=0.15, nms_threshold=0.5,
+                                 background_label=0)
+    o = out.numpy()
+    assert nums.numpy().tolist() == [4]
+    labels = o[:, 0].tolist()
+    assert labels == [1.0, 1.0, 2.0, 2.0]
+    # the duplicate (score 0.8, IoU ~0.9 with the 0.9 box) is gone
+    cls1 = o[o[:, 0] == 1.0]
+    np.testing.assert_allclose(sorted(cls1[:, 1].tolist()), [0.7, 0.9])
+
+
+def test_multiclass_nms_keep_top_k_and_index():
+    boxes = np.tile(np.array([[0, 0, 10, 10]], np.float32), (5, 1))
+    boxes = boxes + np.arange(5, dtype=np.float32)[:, None] * 20
+    scores = np.zeros((1, 2, 5), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7, 0.6, 0.5]
+    out, idx, nums = D.multiclass_nms(
+        _t(boxes[None]), _t(scores), score_threshold=0.1,
+        nms_threshold=0.5, keep_top_k=3, background_label=0,
+        return_index=True)
+    assert nums.numpy().tolist() == [3]
+    np.testing.assert_array_equal(idx.numpy().reshape(-1), [0, 1, 2])
+
+
+def test_matrix_nms_decays_overlaps():
+    boxes = np.array([[[0, 0, 10, 10], [0.1, 0.1, 10.1, 10.1],
+                       [50, 50, 60, 60]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.8]
+    out, nums = D.matrix_nms(_t(boxes), _t(scores), score_threshold=0.1,
+                             post_threshold=0.4, nms_top_k=-1,
+                             keep_top_k=-1, background_label=0)
+    o = out.numpy()
+    # the near-duplicate's score decays by (1-iou)/(1-0) << 1 and falls
+    # under post_threshold; the far box survives undecayed
+    assert nums.numpy().tolist() == [2]
+    np.testing.assert_allclose(sorted(o[:, 1].tolist()), [0.8, 0.9],
+                               rtol=1e-5)
+
+
+def test_locality_aware_nms_merges():
+    boxes = np.array([[[0, 0, 10, 10], [0.2, 0.2, 10.2, 10.2],
+                       [40, 40, 50, 50]]], np.float32)
+    scores = np.zeros((1, 1, 3), np.float32)
+    scores[0, 0] = [0.6, 0.4, 0.9]
+    o, nums = D.locality_aware_nms(
+        _t(boxes), _t(scores), score_threshold=0.1, nms_top_k=-1,
+        keep_top_k=-1, nms_threshold=0.5, background_label=-1)
+    o = o.numpy()
+    assert nums.numpy().tolist() == [2]
+    # adjacent pair is merged: combined score 1.0, box is the
+    # score-weighted average
+    row = o[np.isclose(o[:, 1], 1.0)]
+    assert len(row) == 1
+    np.testing.assert_allclose(
+        row[0, 2:], (boxes[0, 0] * 0.6 + boxes[0, 1] * 0.4), rtol=1e-5)
+
+
+def test_generate_proposals_shapes_and_order():
+    rng = np.random.RandomState(3)
+    h = w = 4
+    a = 3
+    scores = rng.rand(1, a, h, w).astype(np.float32)
+    deltas = (rng.randn(1, 4 * a, h, w) * 0.05).astype(np.float32)
+    anchors, variances = D.anchor_generator(
+        paddle.zeros([1, 8, h, w]), anchor_sizes=[16., 32.],
+        aspect_ratios=[0.5, 1.0, 2.0][:1] + [1.5],   # A=... make A=3?
+        variance=[1., 1., 1., 1.], stride=[8., 8.])
+    # anchor_generator gives A = sizes*ratios = 4; regenerate with A=3
+    anchors, variances = D.anchor_generator(
+        paddle.zeros([1, 8, h, w]), anchor_sizes=[16., 24., 32.],
+        aspect_ratios=[1.0], variance=[1., 1., 1., 1.], stride=[8., 8.])
+    info = np.array([[32., 32., 1.]], np.float32)
+    rois, probs, num = D.generate_proposals(
+        _t(scores), _t(deltas), _t(info), anchors, variances,
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7,
+        min_size=1.0, return_rois_num=True)
+    r = rois.numpy()
+    p = probs.numpy().reshape(-1)
+    assert r.shape[1] == 4 and p.shape[0] == r.shape[0]
+    assert num.numpy().sum() == r.shape[0] <= 5
+    assert (p[:-1] >= p[1:] - 1e-6).all()        # score-descending
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 31).all()
+
+
+def test_rpn_target_assign_labels():
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [100, 100, 110, 110], [0, 0, 11, 11]], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    info = np.array([[200., 200., 1.]], np.float32)
+    bbox_pred = np.zeros((1, 4, 4), np.float32)
+    cls_logits = np.zeros((1, 4, 1), np.float32)
+    scores, loc, labels, tgt, inw = D.rpn_target_assign(
+        _t(bbox_pred), _t(cls_logits), _t(anchors), _t(anchors),
+        _t(gt), _t(np.zeros(1, np.int32)), _t(info),
+        gt_lengths=_t(np.array([1])), use_random=False,
+        rpn_positive_overlap=0.7, rpn_negative_overlap=0.3)
+    lab = labels.numpy().reshape(-1)
+    # anchor 0 overlaps gt exactly -> fg; anchors 1,2 -> bg
+    assert (lab == 1).sum() >= 1
+    assert (lab == 0).sum() >= 2
+    assert loc.numpy().shape[1] == 4
+    assert tgt.numpy().shape == loc.numpy().shape
+    # exact-overlap anchor: zero regression target
+    assert np.abs(tgt.numpy()).min() < 1e-5
+
+
+def test_mine_hard_examples_max_negative():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.8]], np.float32)
+    mi = np.array([[0, -1, -1, -1]], np.int32)
+    md = np.array([[0.9, 0.1, 0.2, 0.6]], np.float32)
+    neg, neg_lens, upd = D.mine_hard_examples(
+        _t(cls_loss), _t(mi), _t(md), neg_pos_ratio=2.0,
+        neg_dist_threshold=0.5)
+    # eligible negatives: cols 1, 2 (dist < 0.5); 1 pos * ratio 2 -> 2
+    # hardest by cls_loss: col 1 (0.9), col 2 (0.5)
+    assert neg_lens.numpy().tolist() == [2]
+    assert sorted(neg.numpy().reshape(-1).tolist()) == [1, 2]
+    np.testing.assert_array_equal(upd.numpy(), mi)
+
+
+def test_distribute_and_collect_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],        # small -> low level
+                     [0, 0, 160, 160],      # large -> high level
+                     [0, 0, 14, 14]], np.float32)
+    multi, restore = D.distribute_fpn_proposals(
+        _t(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    assert len(multi) == 4
+    sizes = [m.numpy().shape[0] for m in multi]
+    assert sum(sizes) == 3
+    # restore index maps concatenated level-major rows back to input
+    r = restore.numpy().reshape(-1)
+    cat = np.concatenate([m.numpy() for m in multi], axis=0)
+    np.testing.assert_allclose(cat[r], rois)
+
+    scores = [paddle.to_tensor(np.full((m.numpy().shape[0], 1), 0.5,
+                                       np.float32)) for m in multi]
+    out = D.collect_fpn_proposals(multi, scores, 2, 5, post_nms_top_n=2)
+    assert out.numpy().shape == (2, 4)
+
+
+def test_retinanet_detection_output():
+    anchors = np.array([[0, 0, 10, 10], [30, 30, 40, 40]], np.float32)
+    bboxes = np.zeros((1, 2, 4), np.float32)
+    scores = np.zeros((1, 2, 2), np.float32)
+    scores[0, 0, 0] = 0.9          # anchor 0, class 0
+    scores[0, 1, 1] = 0.8          # anchor 1, class 1
+    info = np.array([[100., 100., 1.]], np.float32)
+    out, nums = D.retinanet_detection_output(
+        [_t(bboxes)], [_t(scores)], [_t(anchors)], _t(info),
+        score_threshold=0.05, nms_top_k=10, keep_top_k=5,
+        nms_threshold=0.3)
+    o = out.numpy()
+    assert nums.numpy().tolist() == [2]
+    # labels are 1-based (background=0 reserved), zero deltas decode to
+    # the anchors themselves
+    assert sorted(o[:, 0].tolist()) == [1.0, 2.0]
+    top = o[np.argmax(o[:, 1])]
+    np.testing.assert_allclose(top[2:], anchors[0], atol=1e-4)
+
+
+def test_generate_proposal_labels_sampling():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 9.5, 9.5],
+                     [50, 50, 60, 60], [80, 80, 90, 90]], np.float32)
+    gt = np.array([[0, 0, 10, 10]], np.float32)
+    outs = D.generate_proposal_labels(
+        _t(rois), _t(np.array([3])), _t(np.zeros(1, np.int32)), _t(gt),
+        _t(np.array([[100., 100., 1.]], np.float32)),
+        rois_lengths=_t(np.array([4])), gt_lengths=_t(np.array([1])),
+        batch_size_per_im=4, fg_fraction=0.5, fg_thresh=0.5,
+        bg_thresh_hi=0.5, bg_thresh_lo=0.0, class_nums=4,
+        use_random=False)
+    srois, labels, tgt, inw, outw, nums = outs
+    lab = labels.numpy().reshape(-1)
+    assert (lab == 3).sum() >= 1               # fg gets gt class
+    assert (lab == 0).sum() >= 1               # bg sampled
+    assert tgt.numpy().shape[1] == 16          # 4 classes * 4
+    # fg rows have inside weights on their class block only
+    fg_rows = np.nonzero(lab == 3)[0]
+    assert inw.numpy()[fg_rows[0], 12:16].sum() == 4.0
+    np.testing.assert_array_equal(inw.numpy() > 0, outw.numpy() > 0)
+    assert nums.numpy().sum() == len(lab)
+
+
+def test_fluid_layers_exports_detection():
+    import paddle_tpu.fluid as fluid
+
+    for name in ("multiclass_nms", "box_coder", "iou_similarity",
+                 "generate_proposals", "bipartite_match",
+                 "anchor_generator", "distribute_fpn_proposals"):
+        assert hasattr(fluid.layers, name), name

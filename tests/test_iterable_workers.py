@@ -123,3 +123,26 @@ def test_self_iterator_dataset_falls_back_to_single_stream():
     got = _collect(DataLoader(SelfIterDataset(12), batch_size=3,
                               num_workers=4))
     assert got == list(range(12))          # exactly once, in order
+
+
+class ResettingSelfIterDataset(SelfIterDataset):
+    """__iter__ returns self AND resets the cursor — the ADVICE r4 case:
+    a late worker calling iter() would clobber worker 0's in-progress
+    iteration. Workers 1..N-1 must not call iter() on it at all."""
+
+    def __iter__(self):
+        self.i = 0
+        return self
+
+
+def test_resetting_self_iterator_not_clobbered_by_late_workers():
+    for _ in range(5):                     # racy bug => flaky; repeat
+        got = _collect(DataLoader(ResettingSelfIterDataset(12),
+                                  batch_size=3, num_workers=4))
+        assert got == list(range(12))
+
+
+def test_resetting_self_iterator_zero_workers():
+    got = _collect(DataLoader(ResettingSelfIterDataset(12), batch_size=3,
+                              num_workers=0))
+    assert got == list(range(12))

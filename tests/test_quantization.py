@@ -103,6 +103,18 @@ class TestPTQ:
         out = np.asarray(holder(x)._value)
         assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 0.05
 
+    def test_uncalibrated_deploy_raises(self):
+        # ADVICE r4: an uncalibrated act observer (scale==0) must fail
+        # loudly, not export a saturating graph.
+        import pytest
+
+        from paddle_tpu.quantization import convert_to_int8_deploy
+
+        net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+        QAT().quantize(net)          # no forward pass ran
+        with pytest.raises(ValueError, match="uncalibrated"):
+            convert_to_int8_deploy(net)
+
     def test_export_int8(self):
         paddle.seed(7)
         net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))

@@ -171,6 +171,38 @@ def test_sequence_enumerate():
         got, [[[1, 2], [2, 3], [3, 0]], [[4, 5], [5, 6], [6, 0]]])
 
 
+def test_sequence_enumerate_ragged_lengths():
+    # ADVICE r4 (medium): windows past each ROW's length must fill
+    # pad_value, not values from the pad region of the buffer.
+    x = np.array([[1, 2, 3], [4, 5, 6]], np.int64)
+    got = F.sequence_enumerate(
+        paddle.to_tensor(x), 2, pad_value=-1,
+        length=paddle.to_tensor(np.array([2, 3]))).numpy()
+    np.testing.assert_array_equal(
+        got, [[[1, 2], [2, -1], [-1, -1]],
+              [[4, 5], [5, 6], [6, -1]]])
+
+
+def test_sequence_pad_jittable_with_traced_length():
+    # ADVICE r4 (low): with a static maxlen, sequence_pad must stage
+    # under jit even when `length` is traced.
+    import jax
+
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+
+    @jax.jit
+    def f(v, lens):
+        out, out_len = F.sequence_pad(paddle.to_tensor(v), -1.0, maxlen=4,
+                                      length=paddle.to_tensor(lens))
+        return out._value, out_len._value
+
+    out, out_len = f(flat, np.array([2, 3], np.int32))
+    np.testing.assert_array_equal(out_len, [2, 3])
+    np.testing.assert_allclose(np.asarray(out)[0, :2], flat[:2])
+    np.testing.assert_allclose(np.asarray(out)[0, 2:], -1.0)
+    np.testing.assert_allclose(np.asarray(out)[1, :3], flat[2:5])
+
+
 def test_sequence_slice():
     x = np.arange(24, dtype=np.float32).reshape(2, 6, 2)
     out, lens = F.sequence_slice(paddle.to_tensor(x),
