@@ -37,6 +37,8 @@ from .sequence_lod import (sequence_mask, sequence_pad, sequence_unpad,  # noqa:
                            sequence_enumerate, sequence_slice,
                            sequence_erase, sequence_reshape,
                            sequence_scatter, sequence_topk_avg_pooling)
+from . import crf  # noqa: F401
+from .crf import chunk_eval, crf_decoding, linear_chain_crf  # noqa: F401
 from .loss import dice_loss, hsigmoid_loss, npair_loss  # noqa: F401
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
                       adaptive_avg_pool3d, adaptive_max_pool3d,

@@ -1,0 +1,256 @@
+"""Linear-chain CRF family: sequence labeling (NER/tagging).
+
+Reference: paddle/fluid/operators/linear_chain_crf_op.{cc,h} (forward
+algorithm with hand-written backward), crf_decoding_op.h (Viterbi),
+chunk_eval_op.h (chunk P/R/F1); python surface fluid/layers/linear_chain_crf
+/ crf_decoding / chunk_eval.
+
+TPU-native design: the reference computes alpha recursions in normalized
+probability space with a hand-written gradient kernel; here both the
+forward algorithm and Viterbi are ``lax.scan`` over the time axis in LOG
+space (numerically equivalent to the reference's per-step L1
+normalization), jittable with static [B, T, D] shapes and masked by the
+per-row ``length`` — and the backward pass is plain jax AD through the
+scan, no custom gradient needed. chunk_eval is a host metric (the
+reference's kernel is CPU-only too).
+
+Transition layout matches the reference exactly (linear_chain_crf_op.h
+ForwardOneSequence): ``transition`` is [D+2, D]; row 0 = start weights,
+row 1 = end weights, rows 2.. = W[j, i] score of tag j -> tag i.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...tensor._helper import apply, unwrap
+
+__all__ = ["linear_chain_crf", "crf_decoding", "chunk_eval"]
+
+
+def linear_chain_crf(input, label, transition, length=None, name=None):  # noqa: A002
+    """Negative log-likelihood of tag sequences under a linear-chain CRF
+    (reference: linear_chain_crf_op.h ForwardOneSequence returns -ll).
+
+    input: emissions [B, T, D] (padded); label: [B, T] int; transition:
+    [D+2, D]; length: [B]. Returns nll [B, 1]. Differentiable w.r.t.
+    input and transition (the reference ships a hand-written grad kernel;
+    jax AD through the scan is the TPU equivalent).
+    """
+    if length is None:
+        raise ValueError("linear_chain_crf: dense-ragged form requires "
+                         "`length`")
+
+    def f(x, lbl, w, lv):
+        b, t, d = x.shape
+        lv = lv.reshape(-1)
+        w_start, w_end, trans = w[0], w[1], w[2:]     # [D],[D],[D,D]
+        lbl = lbl.reshape(b, t).astype(jnp.int32)
+
+        # --- log partition via forward algorithm (log space) ---
+        alpha0 = w_start[None, :] + x[:, 0]           # [B, D]
+
+        def step(alpha, k):
+            nxt = jax.nn.logsumexp(
+                alpha[:, :, None] + trans[None, :, :], axis=1) + x[:, k]
+            alive = (k < lv)[:, None]
+            return jnp.where(alive, nxt, alpha), None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t)) \
+            if t > 1 else (alpha0, None)
+        logz = jax.nn.logsumexp(alpha + w_end[None, :], axis=1)
+
+        # --- gold path score ---
+        l0 = lbl[:, 0]
+        score = w_start[l0] + jnp.take_along_axis(
+            x[:, 0], l0[:, None], axis=1)[:, 0]
+        if t > 1:
+            prev = lbl[:, :-1]
+            cur = lbl[:, 1:]
+            emit = jnp.take_along_axis(x[:, 1:], cur[..., None],
+                                       axis=2)[..., 0]       # [B, T-1]
+            tr = trans[prev, cur]                             # [B, T-1]
+            k = jnp.arange(1, t)[None, :]
+            alive = k < lv[:, None]
+            score = score + jnp.sum(jnp.where(alive, emit + tr, 0.0),
+                                    axis=1)
+        last = jnp.clip(lv - 1, 0, t - 1)
+        last_lbl = jnp.take_along_axis(lbl, last[:, None], axis=1)[:, 0]
+        score = score + w_end[last_lbl]
+        return (logz - score)[:, None]                # nll [B, 1]
+
+    return apply(f, input, label, transition, length,
+                 name="linear_chain_crf")
+
+
+def crf_decoding(input, transition, length=None, label=None, name=None):  # noqa: A002
+    """Viterbi decode (reference: crf_decoding_op.h Decode): returns the
+    best tag path [B, T] int64 (zeros past each row's length). With
+    ``label`` given, returns per-position 0/1 correctness instead (the
+    reference's evaluation mode)."""
+    if length is None:
+        raise ValueError("crf_decoding: dense-ragged form requires "
+                         "`length`")
+
+    def f(x, w, lv, *rest):
+        b, t, d = x.shape
+        lv = lv.reshape(-1)
+        w_start, w_end, trans = w[0], w[1], w[2:]
+
+        alpha0 = w_start[None, :] + x[:, 0]
+
+        def fwd(alpha, k):
+            scores = alpha[:, :, None] + trans[None, :, :]   # [B, D, D]
+            best = jnp.max(scores, axis=1) + x[:, k]
+            track = jnp.argmax(scores, axis=1)               # [B, D]
+            alive = (k < lv)[:, None]
+            return (jnp.where(alive, best, alpha),
+                    jnp.where(alive, track, -1))
+
+        if t > 1:
+            alpha, tracks = jax.lax.scan(fwd, alpha0,
+                                         jnp.arange(1, t))
+            tracks = jnp.moveaxis(tracks, 0, 1)              # [B, T-1, D]
+        else:
+            alpha = alpha0
+            tracks = jnp.zeros((b, 0, d), jnp.int32)
+        last_tag = jnp.argmax(alpha + w_end[None, :], axis=1)  # [B]
+
+        # backtrace from each row's last valid position: walking the
+        # track table backwards, holding the tag until k < len-1
+        def bwd(tag, k):
+            trk = tracks[:, k]                               # [B, D]
+            prev = jnp.take_along_axis(trk, tag[:, None], axis=1)[:, 0]
+            inside = k < (lv - 1)
+            new_tag = jnp.where(inside, prev, tag)
+            # emit the tag AT position k (tag of step k is new_tag when
+            # k+1 is inside the sequence, else still the last tag)
+            return new_tag, new_tag
+
+        if t > 1:
+            _, rev = jax.lax.scan(bwd, last_tag,
+                                  jnp.arange(t - 2, -1, -1))
+            path = jnp.concatenate(
+                [jnp.flip(jnp.moveaxis(rev, 0, 1), axis=1),
+                 last_tag[:, None]], axis=1)                 # [B, T]
+        else:
+            path = last_tag[:, None]
+        # positions past the length emit 0; the "last tag" must sit at
+        # index len-1, not t-1: roll each row's tail into place
+        kidx = jnp.arange(t)[None, :]
+        # path currently has last_tag at column t-1 and the inside walk
+        # before it. For rows with lv < t the backtrace above held
+        # last_tag through the padded region, so the tag at len-1 is
+        # already correct; just mask the pad tail.
+        path = jnp.where(kidx < lv[:, None], path, 0)
+        if rest:
+            lbl = rest[0].reshape(b, t).astype(path.dtype)
+            ok = (lbl == path).astype(jnp.int32)
+            return jnp.where(kidx < lv[:, None], ok, 0)
+        return path.astype(jnp.int32)
+
+    args = (input, transition, length) + \
+        ((label,) if label is not None else ())
+    return apply(f, *args, differentiable=False, name="crf_decoding")
+
+
+def _get_segments(tags, num_chunk_types, num_tag_types, tag_begin,
+                  tag_inside, tag_end, tag_single):
+    """Chunk segmentation (reference: chunk_eval_op.h GetSegments with
+    ChunkBegin/ChunkEnd predicates)."""
+    other = num_chunk_types
+    segments = []
+    in_chunk = False
+    chunk_start = 0
+    tag, typ = -1, other
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt == tag_begin or pt == tag_inside:
+            return t == tag_begin or t == tag_single
+        return pt == tag_end or pt == tag_single
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty:
+            return True
+        if t == tag_begin or t == tag_single:
+            return True
+        if t == tag_inside or t == tag_end:
+            return pt == tag_end or pt == tag_single
+        return False
+
+    for i, lab in enumerate(tags):
+        pt, pty = tag, typ
+        tag = int(lab) % num_tag_types
+        typ = int(lab) // num_tag_types
+        if in_chunk and chunk_end(pt, pty, tag, typ):
+            segments.append((chunk_start, i - 1, pty))
+            in_chunk = False
+        if chunk_begin(pt, pty, tag, typ):
+            chunk_start = i
+            in_chunk = True
+    if in_chunk:
+        segments.append((chunk_start, len(tags) - 1, typ))
+    return segments
+
+
+_SCHEMES = {
+    # scheme: (num_tag_types, begin, inside, end, single)
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, length=None, name=None):
+    """Chunk-level precision/recall/F1 (reference: chunk_eval_op.h;
+    python fluid/layers/nn.py chunk_eval). Host metric op.
+
+    input/label: [B, T] int (padded) with ``length`` [B], or 1-D packed.
+    Returns (precision, recall, f1, num_infer_chunks, num_label_chunks,
+    num_correct_chunks) — scalars, like the reference.
+    """
+    if chunk_scheme not in _SCHEMES:
+        raise ValueError(f"chunk_eval: unknown chunk_scheme "
+                         f"{chunk_scheme!r}")
+    ntag, tb, ti, te, ts = _SCHEMES[chunk_scheme]
+    excluded = set(excluded_chunk_types or ())
+    inf = np.asarray(unwrap(input)).astype(np.int64)
+    lab = np.asarray(unwrap(label)).astype(np.int64)
+    if inf.ndim == 1:
+        inf, lab = inf[None], lab[None]
+    lens = (np.asarray(unwrap(length)).astype(np.int64).reshape(-1)
+            if length is not None
+            else np.full((inf.shape[0],), inf.shape[1], np.int64))
+
+    n_inf = n_lab = n_cor = 0
+    for b in range(inf.shape[0]):
+        li = int(lens[b])
+        seg_i = [s for s in _get_segments(inf[b, :li], num_chunk_types,
+                                          ntag, tb, ti, te, ts)
+                 if s[2] not in excluded]
+        seg_l = [s for s in _get_segments(lab[b, :li], num_chunk_types,
+                                          ntag, tb, ti, te, ts)
+                 if s[2] not in excluded]
+        n_inf += len(seg_i)
+        n_lab += len(seg_l)
+        n_cor += len(set(seg_i) & set(seg_l))
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    mk = lambda v, dt: Tensor(jnp.asarray(np.asarray(v, dt)))  # noqa: E731
+    return (mk(prec, np.float32), mk(rec, np.float32),
+            mk(f1, np.float32), mk(n_inf, np.int64),
+            mk(n_lab, np.int64), mk(n_cor, np.int64))

@@ -361,6 +361,11 @@ def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
         # get out=mismatch_value, weight=1
         neg = np.asarray(unwrap(negative_indices)).astype(np.int64) \
             .reshape(-1)
+        if negative_lengths is None and len(lens) > 1:
+            raise ValueError(
+                "target_assign: `negative_lengths` is required when the "
+                "batch has more than one image — without it every "
+                "negative index would be assigned to image 0")
         nlens = (np.asarray(unwrap(negative_lengths)).astype(np.int64)
                  .reshape(-1) if negative_lengths is not None
                  else np.asarray([len(neg)], np.int64))
@@ -374,6 +379,13 @@ def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
                 wv[b, j, 0] = 1.0
         out, wt = Tensor(jnp.asarray(ov)), Tensor(jnp.asarray(wv))
     return out, wt
+
+
+# Persistent sampling stream for the target-sampling ops: a fresh
+# RandomState per call would redraw the SAME fg/bg subset every training
+# step (the reference's engine RNG persists across invocations).
+# paddle.seed() reseeds it via core.rng.
+_sample_rng = np.random.RandomState(0)
 
 
 # ---------------------------------------------------------------------------
@@ -426,6 +438,18 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
     return Tensor(jnp.asarray(mi)), Tensor(jnp.asarray(md))
 
 
+def _iou_np(p, q, normalized=True):
+    """Scalar IoU in plain numpy for the per-pair host loops (one device
+    dispatch per 10-flop pair would dominate wall clock)."""
+    off = 0.0 if normalized else 1.0
+    aa = (p[2] - p[0] + off) * (p[3] - p[1] + off)
+    ab = (q[2] - q[0] + off) * (q[3] - q[1] + off)
+    iw = min(p[2], q[2]) - max(p[0], q[0]) + off
+    ih = min(p[3], q[3]) - max(p[1], q[1]) + off
+    inter = max(iw, 0.0) * max(ih, 0.0)
+    return inter / (aa + ab - inter + 1e-10)
+
+
 def _nms_select(boxes, scores, score_threshold, nms_threshold, top_k,
                 eta=1.0, normalized=True):
     """Indices kept by hard NMS (host tail over the jittable core).
@@ -454,11 +478,8 @@ def _nms_select(boxes, scores, score_threshold, nms_threshold, top_k,
     for i in range(len(cand)):
         ok = True
         for kj in kept:
-            iou = float(np.asarray(_iou_matrix(
-                jnp.asarray(bsel[i:i + 1]),
-                jnp.asarray(boxes[kj:kj + 1]),
-                normalized=normalized)).item())
-            if iou > adaptive:
+            if _iou_np(bsel[i], boxes[kj],
+                       normalized=normalized) > adaptive:
                 ok = False
                 break
         if ok:
@@ -597,10 +618,8 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
             idx = -1
             for i in range(m):
                 if idx > -1:
-                    iou = float(np.asarray(_iou_matrix(
-                        jnp.asarray(b[im, i:i + 1]),
-                        jnp.asarray(b[im, idx:idx + 1]),
-                        normalized=normalized)).item())
+                    iou = _iou_np(b[im, i], b[im, idx],
+                                  normalized=normalized)
                     if iou > nms_threshold:
                         s1, s2 = s[im, cls, i], s[im, cls, idx]
                         b[im, idx] = (b[im, i] * s1 + b[im, idx] * s2) / \
@@ -725,7 +744,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     offs = np.concatenate([[0], np.cumsum(lens)])
     num_im = len(lens)
     anum = anc.shape[0]
-    rng = np.random.RandomState(0)
+    rng = _sample_rng
 
     loc_idx, score_idx, labels, tgt_bbox, inside_w = [], [], [], [], []
     for im in range(num_im):
@@ -1029,7 +1048,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
              if gt_lengths is not None else np.asarray([gbox.shape[0]]))
     roffs = np.concatenate([[0], np.cumsum(rlens)])
     goffs = np.concatenate([[0], np.cumsum(glens)])
-    rng = np.random.RandomState(0)
+    rng = _sample_rng
     wts = np.asarray(bbox_reg_weights, np.float32)
 
     o_rois, o_lab, o_tgt, o_in, o_out, o_num = [], [], [], [], [], []

@@ -1,0 +1,197 @@
+"""Linear-chain CRF family: brute-force golden over all tag paths
+(reference OpTest style: unittests/test_linear_chain_crf_op.py computes
+the same quantities with a python reference implementation).
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _score(x, path, w):
+    """Gold-path score per linear_chain_crf_op.h: start + emissions +
+    transitions + end. w is [D+2, D]: row0 start, row1 end, rest W."""
+    s = w[0, path[0]] + x[0, path[0]]
+    for k in range(1, len(path)):
+        s += x[k, path[k]] + w[2 + path[k - 1], path[k]]
+    s += w[1, path[-1]]
+    return s
+
+
+def _brute(x, w):
+    """(logZ, best_path) by enumerating all |D|^T paths."""
+    t, d = x.shape
+    scores = []
+    best, best_s = None, -np.inf
+    for path in itertools.product(range(d), repeat=t):
+        s = _score(x, path, w)
+        scores.append(s)
+        if s > best_s:
+            best_s, best = s, path
+    m = max(scores)
+    logz = m + np.log(sum(np.exp(s - m) for s in scores))
+    return logz, list(best)
+
+
+@pytest.fixture
+def crf_problem():
+    rng = np.random.RandomState(0)
+    b, t, d = 3, 4, 3
+    x = rng.randn(b, t, d).astype(np.float32)
+    w = rng.randn(d + 2, d).astype(np.float32)
+    lens = np.array([4, 2, 3], np.int64)
+    lbl = rng.randint(0, d, (b, t)).astype(np.int64)
+    return x, w, lens, lbl
+
+
+def test_linear_chain_crf_matches_brute_force(crf_problem):
+    x, w, lens, lbl = crf_problem
+    nll = F.linear_chain_crf(paddle.to_tensor(x), paddle.to_tensor(lbl),
+                             paddle.to_tensor(w),
+                             length=paddle.to_tensor(lens)).numpy()
+    assert nll.shape == (3, 1)
+    for b in range(3):
+        li = int(lens[b])
+        logz, _ = _brute(x[b, :li].astype(np.float64),
+                         w.astype(np.float64))
+        gold = _score(x[b, :li].astype(np.float64),
+                      lbl[b, :li].tolist(), w.astype(np.float64))
+        np.testing.assert_allclose(nll[b, 0], logz - gold, rtol=1e-4)
+
+
+def test_linear_chain_crf_gradients(crf_problem):
+    x, w, lens, lbl = crf_problem
+    xt = paddle.to_tensor(x)
+    wt = paddle.to_tensor(w)
+    xt.stop_gradient = False
+    wt.stop_gradient = False
+    nll = F.linear_chain_crf(xt, paddle.to_tensor(lbl), wt,
+                             length=paddle.to_tensor(lens))
+    nll.sum().backward()
+    gx = np.asarray(xt.grad._value)
+    gw = np.asarray(wt.grad._value)
+    assert np.isfinite(gx).all() and np.isfinite(gw).all()
+    # finite-difference check on a few coordinates
+    def loss_at(xv, wv):
+        out = F.linear_chain_crf(paddle.to_tensor(xv),
+                                 paddle.to_tensor(lbl),
+                                 paddle.to_tensor(wv),
+                                 length=paddle.to_tensor(lens))
+        return float(out.numpy().sum())
+
+    eps = 1e-3
+    for idx in [(0, 0, 0), (1, 1, 2), (2, 2, 1)]:
+        xp = x.copy(); xp[idx] += eps
+        xm = x.copy(); xm[idx] -= eps
+        num = (loss_at(xp, w) - loss_at(xm, w)) / (2 * eps)
+        np.testing.assert_allclose(gx[idx], num, rtol=2e-2, atol=2e-3)
+    for idx in [(0, 0), (1, 2), (3, 1)]:
+        wp = w.copy(); wp[idx] += eps
+        wm = w.copy(); wm[idx] -= eps
+        num = (loss_at(x, wp) - loss_at(x, wm)) / (2 * eps)
+        np.testing.assert_allclose(gw[idx], num, rtol=2e-2, atol=2e-3)
+    # padded emissions must receive zero gradient
+    assert np.abs(gx[1, 2:]).max() == 0.0
+
+
+def test_crf_decoding_matches_brute_force(crf_problem):
+    x, w, lens, _ = crf_problem
+    path = F.crf_decoding(paddle.to_tensor(x), paddle.to_tensor(w),
+                          length=paddle.to_tensor(lens)).numpy()
+    assert path.shape == (3, 4)
+    for b in range(3):
+        li = int(lens[b])
+        _, best = _brute(x[b, :li].astype(np.float64),
+                         w.astype(np.float64))
+        np.testing.assert_array_equal(path[b, :li], best)
+        np.testing.assert_array_equal(path[b, li:], 0)
+
+
+def test_crf_decoding_label_mode(crf_problem):
+    x, w, lens, _ = crf_problem
+    path = F.crf_decoding(paddle.to_tensor(x), paddle.to_tensor(w),
+                          length=paddle.to_tensor(lens)).numpy()
+    ok = F.crf_decoding(paddle.to_tensor(x), paddle.to_tensor(w),
+                        length=paddle.to_tensor(lens),
+                        label=paddle.to_tensor(path)).numpy()
+    # comparing against its own decode: all valid positions correct
+    for b in range(3):
+        li = int(lens[b])
+        np.testing.assert_array_equal(ok[b, :li], 1)
+        np.testing.assert_array_equal(ok[b, li:], 0)
+
+
+def test_crf_decoding_jittable(crf_problem):
+    import jax
+
+    x, w, lens, _ = crf_problem
+
+    @jax.jit
+    def f(xv, wv, lv):
+        return F.crf_decoding(paddle.to_tensor(xv), paddle.to_tensor(wv),
+                              length=paddle.to_tensor(lv))._value
+
+    got = np.asarray(f(x, w, lens))
+    want = F.crf_decoding(paddle.to_tensor(x), paddle.to_tensor(w),
+                          length=paddle.to_tensor(lens)).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types: tag = type*2 + {0:B, 1:I}; O = 4
+    # infer:  B0 I0 O  B1    -> chunks (0,1,t0), (3,3,t1)
+    # label:  B0 I0 O  B0    -> chunks (0,1,t0), (3,3,t0)
+    inf = np.array([[0, 1, 4, 2]], np.int64)
+    lab = np.array([[0, 1, 4, 0]], np.int64)
+    p, r, f1, ni, nl, nc = F.chunk_eval(
+        paddle.to_tensor(inf), paddle.to_tensor(lab), "IOB",
+        num_chunk_types=2)
+    assert int(ni.numpy()) == 2 and int(nl.numpy()) == 2
+    assert int(nc.numpy()) == 1
+    np.testing.assert_allclose(float(p.numpy()), 0.5)
+    np.testing.assert_allclose(float(r.numpy()), 0.5)
+    np.testing.assert_allclose(float(f1.numpy()), 0.5)
+
+
+def test_chunk_eval_respects_lengths_and_exclusions():
+    inf = np.array([[0, 1, 0, 1]], np.int64)       # B0 I0 B0 I0
+    lab = np.array([[0, 1, 0, 1]], np.int64)
+    # length 2: only the first chunk counts
+    p, r, f1, ni, nl, nc = F.chunk_eval(
+        paddle.to_tensor(inf), paddle.to_tensor(lab), "IOB",
+        num_chunk_types=1, length=paddle.to_tensor(np.array([2])))
+    assert int(ni.numpy()) == 1 and int(nc.numpy()) == 1
+    # excluding chunk type 0 removes everything
+    p, r, f1, ni, nl, nc = F.chunk_eval(
+        paddle.to_tensor(inf), paddle.to_tensor(lab), "IOB",
+        num_chunk_types=1, excluded_chunk_types=[0])
+    assert int(ni.numpy()) == 0 and float(f1.numpy()) == 0.0
+
+
+def test_chunk_eval_iobes_and_plain():
+    # IOBES, 1 type: B=0 I=1 E=2 S=3, O=4
+    inf = np.array([[0, 1, 2, 3, 4]], np.int64)    # chunk(0-2), chunk(3)
+    lab = np.array([[0, 1, 2, 4, 3]], np.int64)    # chunk(0-2), chunk(4)
+    p, r, f1, ni, nl, nc = F.chunk_eval(
+        paddle.to_tensor(inf), paddle.to_tensor(lab), "IOBES",
+        num_chunk_types=1)
+    assert int(ni.numpy()) == 2 and int(nl.numpy()) == 2
+    assert int(nc.numpy()) == 1
+    # plain: every maximal same-type run is a chunk
+    inf = np.array([[0, 0, 1, 1]], np.int64)
+    lab = np.array([[0, 0, 1, 1]], np.int64)
+    _, _, f1, ni, nl, nc = F.chunk_eval(
+        paddle.to_tensor(inf), paddle.to_tensor(lab), "plain",
+        num_chunk_types=2)
+    assert int(nc.numpy()) == int(ni.numpy()) == int(nl.numpy()) == 2
+    assert float(f1.numpy()) == 1.0
+
+
+def test_fluid_exports_crf():
+    import paddle_tpu.fluid as fluid
+
+    for name in ("linear_chain_crf", "crf_decoding", "chunk_eval"):
+        assert hasattr(fluid.layers, name), name
