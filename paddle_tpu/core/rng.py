@@ -94,10 +94,23 @@ def op_key():
     return next_key()
 
 
+# host-side sampling streams (detection target sampling, NCE/sampled
+# softmax) that must follow the global seed, like the reference engine
+# RNG. Modules register their RandomState at import time.
+_registered_sample_rngs: list = []
+
+
+def register_sample_rng(rng) -> None:
+    """Register a host numpy RandomState to be reseeded by paddle.seed."""
+    _registered_sample_rngs.append(rng)
+
+
 def seed(value: int) -> Generator:
     """paddle.seed equivalent: reseed the global generator (and numpy helper)."""
     _default_generator.manual_seed(value)
     _numpy_generator.seed(value % (2**32))
+    for rng in _registered_sample_rngs:
+        rng.seed(value % (2**32))
     return _default_generator
 
 

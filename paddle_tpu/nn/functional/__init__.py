@@ -39,6 +39,12 @@ from .sequence_lod import (sequence_mask, sequence_pad, sequence_unpad,  # noqa:
                            sequence_scatter, sequence_topk_avg_pooling)
 from . import crf  # noqa: F401
 from .crf import chunk_eval, crf_decoding, linear_chain_crf  # noqa: F401
+from . import misc_ops  # noqa: F401
+from .misc_ops import (nce, sample_logits, row_conv, data_norm,  # noqa: F401
+                       shuffle_channel, rank_loss, center_loss,
+                       im2sequence, lod_reset, pad_constant_like,
+                       unique_with_counts, partial_concat, partial_sum,
+                       match_matrix_tensor, var_conv_2d)
 from .loss import dice_loss, hsigmoid_loss, npair_loss  # noqa: F401
 from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d,  # noqa: F401
                       adaptive_avg_pool3d, adaptive_max_pool3d,

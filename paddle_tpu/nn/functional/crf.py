@@ -88,9 +88,11 @@ def linear_chain_crf(input, label, transition, length=None, name=None):  # noqa:
 
 def crf_decoding(input, transition, length=None, label=None, name=None):  # noqa: A002
     """Viterbi decode (reference: crf_decoding_op.h Decode): returns the
-    best tag path [B, T] int64 (zeros past each row's length). With
-    ``label`` given, returns per-position 0/1 correctness instead (the
-    reference's evaluation mode)."""
+    best tag path [B, T] (zeros past each row's length). With ``label``
+    given, returns per-position 0/1 correctness instead (the reference's
+    evaluation mode). Dtype deviation: int32, not the reference's int64 —
+    jax's default x64-disabled config makes int32 the native TPU index
+    dtype."""
     if length is None:
         raise ValueError("crf_decoding: dense-ragged form requires "
                          "`length`")

@@ -1,0 +1,451 @@
+"""General-op tail: CTR / ranking / text-matching / speech ops.
+
+Reference kernels (paddle/fluid/operators/):
+  nce_op.h, sample_logits_op.h, row_conv_op.cc, data_norm_op.cc,
+  shuffle_channel_op.h, rank_loss_op.h, center_loss_op.h,
+  im2sequence_op.h, lod_reset_op.h, pad_constant_like_op.h,
+  unique_with_counts_op.h, partial_concat_op.h, partial_sum_op.h,
+  match_matrix_tensor_op.cc, var_conv_2d_op.cc.
+
+All dense compute is jittable jnp (class sampling for NCE/sample_logits
+happens on host like the reference's CPU-pinned samplers, then the
+gathered-logit math runs on device); unique_with_counts has a
+data-dependent output size and executes on host (the reference kernel is
+CPU-only for the same reason). LoD-carried ops follow the repo's
+dense-ragged convention (explicit ``length`` tensors).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor
+from ...tensor._helper import apply, unwrap
+
+__all__ = [
+    "nce", "sample_logits", "row_conv", "data_norm", "shuffle_channel",
+    "rank_loss", "center_loss", "im2sequence", "lod_reset",
+    "pad_constant_like", "unique_with_counts", "partial_concat",
+    "partial_sum", "match_matrix_tensor", "var_conv_2d",
+]
+
+from ...core import rng as _core_rng
+
+# host-side class-sampling stream; follows paddle.seed via the core.rng
+# registry (persistent across calls — a fresh RandomState per call would
+# redraw identical samples every training step)
+_sample_rng = np.random.RandomState(0)
+_core_rng.register_sample_rng(_sample_rng)
+
+
+def shuffle_channel(x, group, name=None):
+    """ShuffleNet channel shuffle (reference: shuffle_channel_op.h):
+    [N, C, H, W] -> reshape C into (group, C/group), transpose, flatten."""
+    g = int(group)
+
+    def f(v):
+        n, c, h, w = v.shape
+        if c % g:
+            raise ValueError(f"shuffle_channel: C={c} not divisible by "
+                             f"group={g}")
+        return v.reshape(n, g, c // g, h, w).swapaxes(1, 2) \
+                .reshape(n, c, h, w)
+
+    return apply(f, x, name="shuffle_channel")
+
+
+def rank_loss(label, left, right, name=None):
+    """Pairwise RankNet loss (reference: rank_loss_op.h):
+    log(1 + exp(left-right)) - label*(left-right), elementwise."""
+    def f(lbl, lo, ro):
+        d = lo - ro
+        # log(1+exp(d)) via softplus for stability
+        return jax.nn.softplus(d) - lbl * d
+
+    return apply(f, label, left, right, name="rank_loss")
+
+
+def row_conv(input, filter, length=None, name=None):  # noqa: A002
+    """Lookahead row convolution (DeepSpeech2; reference: row_conv_op.cc):
+    out[t] = sum_{k<fc} x[t+k] * w[k] per channel, zero past each row's
+    end. input [B, T, D] padded (+ ``length`` [B]), filter [fc, D]."""
+    def f(v, w, lv=None):
+        b, t, d = v.shape
+        fc = w.shape[0]
+        lens = (jnp.full((b,), t) if lv is None
+                else lv.reshape(-1))
+        tt = jnp.arange(t)
+        mask = (tt[None, :] < lens[:, None])[..., None]
+        vm = jnp.where(mask, v, 0.0)
+        out = jnp.zeros_like(v)
+        for k in range(fc):
+            shifted = jnp.roll(vm, -k, axis=1)
+            valid = (tt + k < t)[None, :, None]
+            out = out + jnp.where(valid, shifted, 0.0) * w[k][None, None]
+        return jnp.where(mask, out, 0.0)
+
+    args = (input, filter) + (() if length is None else (length,))
+    return apply(f, *args, name="row_conv")
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, name=None):
+    """CTR global-stats normalization (reference: data_norm_op.cc):
+    means = sum/size, scales = sqrt(size/square_sum),
+    y = (x - means) * scales. Returns (y, means, scales)."""
+    def f(v, bn, bs, bss):
+        means = bs / bn
+        scales = jnp.sqrt(bn / bss)
+        return (v - means[None, :]) * scales[None, :], means, scales
+
+    return apply(f, x, batch_size, batch_sum, batch_square_sum,
+                 name="data_norm")
+
+
+def center_loss(x, label, centers, update_rate=0.5, need_update=True,
+                name=None):
+    """Center loss (face recognition; reference: center_loss_op.h):
+    loss_i = ||x_i - c_{y_i}||^2 / 2, and (when need_update) the centers
+    move toward their class means:
+    c_k -= alpha * sum_i(diff_i [y_i=k]) / (1 + count_k).
+    Returns (loss [B, 1], centers_out)."""
+    def f(xv, lbl, cv):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        diff = xv - cv[lbl]                        # [B, D]
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        if not need_update:
+            return loss, cv
+        acc = jnp.zeros_like(cv).at[lbl].add(diff)
+        cnt = jnp.ones(cv.shape[0], xv.dtype).at[lbl].add(1.0)
+        new_c = cv - update_rate * acc / cnt[:, None]
+        return loss, new_c
+
+    return apply(f, x, label, centers, name="center_loss")
+
+
+def im2sequence(input, kernels, strides=(1, 1), paddings=(0, 0, 0, 0),  # noqa: A002
+                name=None):
+    """Image -> patch sequence (reference: im2sequence_op.h, fixed-size
+    path): [N, C, H, W] -> [N*OH*OW, C*kh*kw], each row one kh x kw patch
+    (channel-major like the reference's im2col). Returns (out,
+    per-image sequence lengths [N])."""
+    kh, kw = int(kernels[0]), int(kernels[1])
+    sh, sw = int(strides[0]), int(strides[1])
+    pu, pl, pd, pr = (int(p) for p in paddings)
+
+    def f(v):
+        n, c, h, w = v.shape
+        vp = jnp.pad(v, ((0, 0), (0, 0), (pu, pd), (pl, pr)))
+        oh = (h + pu + pd - kh) // sh + 1
+        ow = (w + pl + pr - kw) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                patches.append(jax.lax.slice(
+                    vp, (0, 0, i, j),
+                    (n, c, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1),
+                    (1, 1, sh, sw)))               # [N, C, OH, OW]
+        # layout rows as (n, oh, ow) x cols (c, kh, kw)
+        st = jnp.stack(patches, axis=2)            # [N, C, kh*kw, OH, OW]
+        st = st.reshape(n, c, kh, kw, oh, ow)
+        st = st.transpose(0, 4, 5, 1, 2, 3)        # [N, OH, OW, C, kh, kw]
+        return st.reshape(n * oh * ow, c * kh * kw)
+
+    out = apply(f, input, name="im2sequence")
+    n, _, h, w = (int(s) for s in unwrap(input).shape)
+    oh = (h + pu + pd - kh) // sh + 1
+    ow = (w + pl + pr - kw) // sw + 1
+    lens = Tensor(jnp.full((n,), oh * ow, jnp.int32))
+    return out, lens
+
+
+def lod_reset(x, y=None, target_lod=None, name=None):
+    """Reassign sequence lengths (reference: lod_reset_op.h). In the
+    dense-ragged convention LoD is carried as an explicit lengths
+    tensor, so this op just validates and returns (x, new_lengths)."""
+    if y is not None:
+        new_lens = np.asarray(unwrap(y)).astype(np.int64).reshape(-1)
+    elif target_lod is not None:
+        offsets = np.asarray(target_lod, np.int64).reshape(-1)
+        new_lens = np.diff(offsets)
+    else:
+        raise ValueError("lod_reset: either `y` (lengths) or `target_lod` "
+                         "(offsets) is required")
+    total = int(np.asarray(unwrap(x)).shape[0])
+    if int(new_lens.sum()) != total:
+        raise ValueError(
+            f"lod_reset: lengths sum {int(new_lens.sum())} != rows "
+            f"{total}")
+    return x, Tensor(jnp.asarray(new_lens))
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad ``y`` up to ``x``'s shape with a constant (reference:
+    pad_constant_like_op.h; the grad of pad with batch-varying shapes)."""
+    xs = tuple(int(s) for s in unwrap(x).shape)
+
+    def f(yv):
+        pads = [(0, xs[i] - yv.shape[i]) for i in range(yv.ndim)]
+        if any(p[1] < 0 for p in pads):
+            raise ValueError("pad_constant_like: y is larger than x")
+        return jnp.pad(yv, pads, constant_values=pad_value)
+
+    return apply(f, y, name="pad_constant_like")
+
+
+def unique_with_counts(x, dtype="int32", name=None):
+    """Unique values + index map + counts (reference:
+    unique_with_counts_op.h; output size is data-dependent => host op,
+    like the reference's CPU-only kernel). Returns (out, index, count):
+    out = uniques in first-appearance order, index[i] = position of x[i]
+    in out."""
+    v = np.asarray(unwrap(x)).reshape(-1)
+    uniq, first, inv, cnt = np.unique(v, return_index=True,
+                                      return_inverse=True,
+                                      return_counts=True)
+    # np.unique sorts; reference keeps first-appearance order
+    order = np.argsort(first, kind="stable")
+    remap = np.empty_like(order)
+    remap[order] = np.arange(len(order))
+    idt = np.int32 if dtype in ("int32", np.int32) else np.int64
+    return (Tensor(jnp.asarray(uniq[order])),
+            Tensor(jnp.asarray(remap[inv].astype(idt))),
+            Tensor(jnp.asarray(cnt[order].astype(idt))))
+
+
+def partial_concat(x, start_index=0, length=-1, name=None):
+    """Concat the same column slice of several [B, D] tensors
+    (reference: partial_concat_op.h): out = concat([t[:, s:s+L] for t in
+    x], axis=1)."""
+    s = int(start_index)
+    ln = int(length)
+
+    def f(*vs):
+        outs = []
+        for v in vs:
+            st = s if s >= 0 else v.shape[1] + s
+            en = v.shape[1] if ln < 0 else st + ln
+            outs.append(v[:, st:en])
+        return jnp.concatenate(outs, axis=1)
+
+    return apply(f, *x, name="partial_concat")
+
+
+def partial_sum(x, start_index=0, length=-1, name=None):
+    """Sum the same column slice of several [B, D] tensors (reference:
+    partial_sum_op.h)."""
+    s = int(start_index)
+    ln = int(length)
+
+    def f(*vs):
+        out = None
+        for v in vs:
+            st = s if s >= 0 else v.shape[1] + s
+            en = v.shape[1] if ln < 0 else st + ln
+            sl = v[:, st:en]
+            out = sl if out is None else out + sl
+        return out
+
+    return apply(f, *x, name="partial_sum")
+
+
+def match_matrix_tensor(x, y, w, x_length=None, y_length=None, dim_t=None,
+                        name=None):
+    """Pyramid text-matching similarity cube (reference:
+    match_matrix_tensor_op.cc): for each channel t,
+    out[b, t, i, j] = x_bi . W_t . y_bj. Dense-ragged: x [B, LX, D],
+    y [B, LY, D] padded with lengths; w [D, T, D]. Returns
+    (out [B, T, LX, LY] masked to the valid extents, tmp = x.W)."""
+    def f(xv, yv, wv, xl=None, yl=None):
+        b, lx, d = xv.shape
+        t = wv.shape[1]
+        ly = yv.shape[1]
+        # tmp[b, i, t, d2] = sum_d x[b,i,d] w[d,t,d2]
+        tmp = jnp.einsum("bid,dte->bite", xv, wv)
+        out = jnp.einsum("bite,bje->btij", tmp, yv)
+        if xl is not None:
+            mi = jnp.arange(lx)[None, :] < xl.reshape(-1)[:, None]
+            out = jnp.where(mi[:, None, :, None], out, 0.0)
+        if yl is not None:
+            mj = jnp.arange(ly)[None, :] < yl.reshape(-1)[:, None]
+            out = jnp.where(mj[:, None, None, :], out, 0.0)
+        return out, tmp
+
+    args = [x, y, w]
+    if x_length is not None:
+        args.append(x_length)
+    if y_length is not None:
+        if x_length is None:
+            raise ValueError("match_matrix_tensor: y_length requires "
+                             "x_length")
+        args.append(y_length)
+    return apply(f, *args, name="match_matrix_tensor")
+
+
+def var_conv_2d(x, w, input_channel, output_channel, filter_size,
+                stride=(1, 1), row_length=None, col_length=None,
+                name=None):
+    """Per-sample variable-extent 2D conv from the text-matching suite
+    (reference: var_conv_2d_op.cc — each LoD row is an image of its own
+    height/width). Dense-ragged: x [B, Cin, H, W] padded to the max
+    extents with ``row_length``/``col_length`` [B]; valid region is
+    convolved, output masked to each sample's own output extent."""
+    kh, kw = (int(filter_size), int(filter_size)) \
+        if np.isscalar(filter_size) else (int(filter_size[0]),
+                                          int(filter_size[1]))
+    sh, sw = (int(stride), int(stride)) if np.isscalar(stride) \
+        else (int(stride[0]), int(stride[1]))
+
+    def f(xv, wv, rl=None, cl=None):
+        b, cin, h, wd = xv.shape
+        # zero the pad region so it cannot leak into valid outputs
+        if rl is not None:
+            mr = jnp.arange(h)[None, :] < rl.reshape(-1)[:, None]
+            xv = jnp.where(mr[:, None, :, None], xv, 0.0)
+        if cl is not None:
+            mc = jnp.arange(wd)[None, :] < cl.reshape(-1)[:, None]
+            xv = jnp.where(mc[:, None, None, :], xv, 0.0)
+        kernel = wv.reshape(output_channel, cin, kh, kw)
+        out = jax.lax.conv_general_dilated(
+            xv, kernel, (sh, sw), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        oh, ow = out.shape[2], out.shape[3]
+        if rl is not None:
+            orl = jnp.maximum((rl.reshape(-1) - kh) // sh + 1, 0)
+            mr = jnp.arange(oh)[None, :] < orl[:, None]
+            out = jnp.where(mr[:, None, :, None], out, 0.0)
+        if cl is not None:
+            ocl = jnp.maximum((cl.reshape(-1) - kw) // sw + 1, 0)
+            mc = jnp.arange(ow)[None, :] < ocl[:, None]
+            out = jnp.where(mc[:, None, None, :], out, 0.0)
+        return out
+
+    args = [x, w]
+    if row_length is not None:
+        args.append(row_length)
+    if col_length is not None:
+        if row_length is None:
+            raise ValueError("var_conv_2d: col_length requires row_length")
+        args.append(col_length)
+    return apply(f, *args, name="var_conv_2d")
+
+
+# ---------------------------------------------------------------------------
+# sampled-softmax family (host sampling + device math, like the
+# reference's CPU-pinned samplers feeding device matmuls)
+# ---------------------------------------------------------------------------
+def _log_uniform_sample(n_classes, shape, rng):
+    """TF/reference LogUniformSampler: P(c) = log((c+2)/(c+1))/log(n+1)."""
+    u = rng.rand(*shape)
+    s = (np.exp(u * np.log(n_classes + 1.0)) - 1.0).astype(np.int64)
+    return np.clip(s, 0, n_classes - 1)
+
+
+def _sampler_prob(samples, n_classes, kind):
+    if kind == "uniform":
+        return np.full(samples.shape, 1.0 / n_classes, np.float32)
+    return (np.log((samples + 2.0) / (samples + 1.0)) /
+            np.log(n_classes + 1.0)).astype(np.float32)
+
+
+def nce(input, label, weight, bias=None, num_total_classes=None,  # noqa: A002
+        num_neg_samples=10, sampler="uniform", custom_dist=None,
+        sample_weight=None, seed=0, name=None):
+    """Noise-contrastive estimation loss (reference: nce_op.h).
+
+    input [B, D], label [B, NT] int, weight [C, D], bias [C]. Per row:
+    o_c = sigmoid(x.w_c + b_c); cost = sum over true classes of
+    -log(o/(o+k*P(c))) plus sum over k sampled noise classes of
+    -log(k*P(c)/(o+k*P(c))). Sampling happens on host (uniform /
+    log_uniform / custom_dist, reference sampler types 0/1/2); the
+    gathered-logit math is one jittable device expression. Returns
+    cost [B, 1].
+    """
+    if num_total_classes is None:
+        num_total_classes = int(unwrap(weight).shape[0])
+    lbl = np.asarray(unwrap(label)).astype(np.int64).reshape(
+        int(unwrap(input).shape[0]), -1)
+    b, nt = lbl.shape
+    k = int(num_neg_samples)
+    rng = _sample_rng if seed == 0 else np.random.RandomState(seed)
+    if sampler == "uniform":
+        neg = rng.randint(0, num_total_classes, (b, k))
+        pneg = _sampler_prob(neg, num_total_classes, "uniform")
+        ptrue = _sampler_prob(lbl, num_total_classes, "uniform")
+    elif sampler == "log_uniform":
+        neg = _log_uniform_sample(num_total_classes, (b, k), rng)
+        pneg = _sampler_prob(neg, num_total_classes, "log_uniform")
+        ptrue = _sampler_prob(lbl, num_total_classes, "log_uniform")
+    elif sampler == "custom_dist":
+        dist = np.asarray(custom_dist, np.float64).reshape(-1)
+        dist = dist / dist.sum()
+        neg = rng.choice(num_total_classes, size=(b, k), p=dist)
+        pneg = dist[neg].astype(np.float32)
+        ptrue = dist[lbl].astype(np.float32)
+    else:
+        raise ValueError(f"nce: unknown sampler {sampler!r}")
+    classes = np.concatenate([lbl, neg], axis=1)           # [B, NT+K]
+    probs = np.concatenate([ptrue, pneg], axis=1)
+
+    def f(xv, wv, *rest):
+        bv = rest[0] if rest else None
+        cw = wv[jnp.asarray(classes)]                      # [B, NT+K, D]
+        logits = jnp.einsum("bd,bkd->bk", xv, cw)
+        if bv is not None:
+            logits = logits + bv[jnp.asarray(classes)]
+        o = jax.nn.sigmoid(logits)
+        bq = jnp.asarray(probs) * k
+        cost_true = -jnp.log(o[:, :nt] / (o[:, :nt] + bq[:, :nt]) + 1e-20)
+        cost_neg = -jnp.log(bq[:, nt:] / (o[:, nt:] + bq[:, nt:]) + 1e-20)
+        out = cost_true.sum(axis=1) + cost_neg.sum(axis=1)
+        if sample_weight is not None:
+            out = out * jnp.asarray(unwrap(sample_weight)).reshape(-1)
+        return out[:, None]
+
+    args = (input, weight) + (() if bias is None else (bias,))
+    return apply(f, *args, name="nce")
+
+
+def sample_logits(logits, label, num_samples, remove_accidental_hits=True,
+                  use_customized_samples=False, customized_samples=None,
+                  customized_probabilities=None, seed=0, name=None):
+    """Sampled-softmax helper (reference: sample_logits_op.h): gather
+    logits at [true classes ++ sampled classes], subtract log Q(c)
+    (the sampled-softmax correction), and mask "accidental hits"
+    (sampled class == a true class) to -1e20. Returns (samples [B,NT+S],
+    probabilities, sampled_logits, sampled_label [B,NT])."""
+    lg = unwrap(logits)
+    lbl = np.asarray(unwrap(label)).astype(np.int64)
+    if lbl.ndim == 1:
+        lbl = lbl[:, None]
+    b, nt = lbl.shape
+    n_classes = int(lg.shape[1])
+    s = int(num_samples)
+    if use_customized_samples:
+        samples = np.asarray(unwrap(customized_samples)).astype(np.int64)
+        probs = np.asarray(unwrap(customized_probabilities), np.float32)
+    else:
+        rng = _sample_rng if seed == 0 else np.random.RandomState(seed)
+        neg = _log_uniform_sample(n_classes, (b, s), rng)
+        samples = np.concatenate([lbl, neg], axis=1)
+        probs = _sampler_prob(samples, n_classes, "log_uniform")
+    hits = np.zeros(samples.shape, bool)
+    if remove_accidental_hits:
+        for i in range(b):
+            true_set = set(lbl[i].tolist())
+            for j in range(nt, samples.shape[1]):
+                if int(samples[i, j]) in true_set:
+                    hits[i, j] = True
+
+    def f(lv):
+        g = jnp.take_along_axis(lv, jnp.asarray(samples), axis=1)
+        g = g - jnp.log(jnp.asarray(probs))
+        return jnp.where(jnp.asarray(hits), -1e20, g)
+
+    sampled = apply(f, logits, name="sample_logits")
+    return (Tensor(jnp.asarray(samples)),
+            Tensor(jnp.asarray(probs)),
+            sampled,
+            Tensor(jnp.broadcast_to(jnp.arange(nt, dtype=jnp.int32),
+                                    (b, nt)).copy()))

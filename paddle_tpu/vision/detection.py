@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from functools import partial
 
+from ..core import rng as _core_rng
 from ..framework.tensor import Tensor
 from ..tensor._helper import apply, unwrap
 
@@ -384,8 +385,9 @@ def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
 # Persistent sampling stream for the target-sampling ops: a fresh
 # RandomState per call would redraw the SAME fg/bg subset every training
 # step (the reference's engine RNG persists across invocations).
-# paddle.seed() reseeds it via core.rng.
+# paddle.seed() reseeds it via the core.rng registry.
 _sample_rng = np.random.RandomState(0)
+_core_rng.register_sample_rng(_sample_rng)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,261 @@
+"""General-op tail (round 5): numpy-golden forwards + grads where
+differentiable (reference OpTest style: unittests/test_rank_loss_op.py,
+test_row_conv_op.py, test_nce.py, test_shuffle_channel_op.py, ...).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def test_shuffle_channel():
+    x = np.arange(2 * 6 * 2 * 2, dtype=np.float32).reshape(2, 6, 2, 2)
+    got = F.shuffle_channel(_t(x), group=3).numpy()
+    want = x.reshape(2, 3, 2, 2, 2).transpose(0, 2, 1, 3, 4) \
+        .reshape(2, 6, 2, 2)
+    np.testing.assert_allclose(got, want)
+
+
+def test_rank_loss_and_grad():
+    rng = np.random.RandomState(0)
+    lbl = rng.randint(0, 2, (8, 1)).astype(np.float32)
+    left = rng.randn(8, 1).astype(np.float32)
+    right = rng.randn(8, 1).astype(np.float32)
+    got = F.rank_loss(_t(lbl), _t(left), _t(right)).numpy()
+    want = np.log(1 + np.exp(left - right)) - lbl * (left - right)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    lt = _t(left)
+    lt.stop_gradient = False
+    out = F.rank_loss(_t(lbl), lt, _t(right))
+    out.sum().backward()
+    sig = 1 / (1 + np.exp(-(left - right)))
+    np.testing.assert_allclose(np.asarray(lt.grad._value), sig - lbl,
+                               rtol=1e-4)
+
+
+def test_row_conv():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 3).astype(np.float32)
+    w = rng.randn(2, 3).astype(np.float32)      # future context 2
+    lens = np.array([5, 3])
+    got = F.row_conv(_t(x), _t(w), length=_t(lens)).numpy()
+    want = np.zeros_like(x)
+    for b in range(2):
+        for t in range(lens[b]):
+            for k in range(2):
+                if t + k < lens[b]:
+                    want[b, t] += x[b, t + k] * w[k]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    np.testing.assert_allclose(got[1, 3:], 0.0)
+
+
+def test_data_norm():
+    rng = np.random.RandomState(2)
+    x = rng.rand(4, 3).astype(np.float32) * 5
+    bn = np.full(3, 10.0, np.float32)
+    bs = rng.rand(3).astype(np.float32) * 10
+    bss = np.full(3, 20.0, np.float32)
+    y, means, scales = F.data_norm(_t(x), _t(bn), _t(bs), _t(bss))
+    np.testing.assert_allclose(means.numpy(), bs / bn, rtol=1e-6)
+    np.testing.assert_allclose(scales.numpy(), np.sqrt(bn / bss),
+                               rtol=1e-6)
+    np.testing.assert_allclose(
+        y.numpy(), (x - (bs / bn)) * np.sqrt(bn / bss), rtol=1e-5)
+
+
+def test_center_loss_and_update():
+    x = np.array([[1.0, 0.0], [0.0, 1.0], [2.0, 0.0]], np.float32)
+    lbl = np.array([0, 1, 0], np.int64)
+    centers = np.zeros((3, 2), np.float32)
+    loss, new_c = F.center_loss(_t(x), _t(lbl), _t(centers),
+                                update_rate=1.0)
+    np.testing.assert_allclose(
+        loss.numpy().reshape(-1), [0.5, 0.5, 2.0])
+    # class 0: diff sum = (1,0)+(2,0)=(3,0), count=1+2 -> c -= (1,0)
+    np.testing.assert_allclose(new_c.numpy()[0], [-1.0, 0.0], rtol=1e-5)
+    np.testing.assert_allclose(new_c.numpy()[1], [0.0, -0.5], rtol=1e-5)
+    np.testing.assert_allclose(new_c.numpy()[2], [0.0, 0.0])
+
+
+def test_center_loss_gradient():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 3).astype(np.float32)
+    lbl = np.array([0, 1, 0, 1], np.int64)
+    centers = rng.randn(2, 3).astype(np.float32)
+    xt = _t(x)
+    xt.stop_gradient = False
+    loss, _ = F.center_loss(xt, _t(lbl), _t(centers), need_update=False)
+    loss.sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad._value),
+                               x - centers[lbl], rtol=1e-5)
+
+
+def test_im2sequence():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out, lens = F.im2sequence(_t(x), kernels=(2, 2), strides=(2, 2))
+    o = out.numpy()
+    assert o.shape == (4, 4)
+    np.testing.assert_allclose(o[0], [0, 1, 4, 5])
+    np.testing.assert_allclose(o[1], [2, 3, 6, 7])
+    np.testing.assert_allclose(o[3], [10, 11, 14, 15])
+    np.testing.assert_array_equal(lens.numpy(), [4])
+
+
+def test_lod_reset():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out, lens = F.lod_reset(_t(x), y=_t(np.array([2, 4])))
+    np.testing.assert_allclose(out.numpy(), x)
+    np.testing.assert_array_equal(lens.numpy(), [2, 4])
+    out, lens = F.lod_reset(_t(x), target_lod=[0, 3, 6])
+    np.testing.assert_array_equal(lens.numpy(), [3, 3])
+    with pytest.raises(ValueError, match="lengths sum"):
+        F.lod_reset(_t(x), y=_t(np.array([2, 2])))
+
+
+def test_pad_constant_like():
+    x = np.zeros((3, 4), np.float32)
+    y = np.ones((2, 2), np.float32)
+    got = F.pad_constant_like(_t(x), _t(y), pad_value=5.0).numpy()
+    assert got.shape == (3, 4)
+    np.testing.assert_allclose(got[:2, :2], 1.0)
+    np.testing.assert_allclose(got[2:], 5.0)
+    np.testing.assert_allclose(got[:2, 2:], 5.0)
+
+
+def test_unique_with_counts():
+    x = np.array([2, 3, 3, 1, 5, 3], np.int64)
+    out, index, count = F.unique_with_counts(_t(x))
+    np.testing.assert_array_equal(out.numpy(), [2, 3, 1, 5])
+    np.testing.assert_array_equal(index.numpy(), [0, 1, 1, 2, 3, 1])
+    np.testing.assert_array_equal(count.numpy(), [1, 3, 1, 1])
+
+
+def test_partial_concat_and_sum():
+    a = np.arange(8, dtype=np.float32).reshape(2, 4)
+    b = a + 10
+    got = F.partial_concat([_t(a), _t(b)], start_index=1, length=2).numpy()
+    np.testing.assert_allclose(
+        got, np.concatenate([a[:, 1:3], b[:, 1:3]], axis=1))
+    got = F.partial_sum([_t(a), _t(b)], start_index=1, length=2).numpy()
+    np.testing.assert_allclose(got, a[:, 1:3] + b[:, 1:3])
+    # negative start + full length
+    got = F.partial_concat([_t(a), _t(b)], start_index=-2).numpy()
+    np.testing.assert_allclose(
+        got, np.concatenate([a[:, 2:], b[:, 2:]], axis=1))
+
+
+def test_match_matrix_tensor():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 4).astype(np.float32)
+    y = rng.randn(2, 5, 4).astype(np.float32)
+    w = rng.randn(4, 2, 4).astype(np.float32)
+    xl = np.array([3, 2])
+    yl = np.array([5, 4])
+    out, tmp = F.match_matrix_tensor(_t(x), _t(y), _t(w),
+                                     x_length=_t(xl), y_length=_t(yl))
+    o = out.numpy()
+    assert o.shape == (2, 2, 3, 5)
+    # golden at (b=0, t=1, i=2, j=3)
+    want = x[0, 2] @ w[:, 1, :] @ y[0, 3]
+    np.testing.assert_allclose(o[0, 1, 2, 3], want, rtol=1e-4)
+    # masked region: batch 1 has x len 2, y len 4
+    np.testing.assert_allclose(o[1, :, 2, :], 0.0)
+    np.testing.assert_allclose(o[1, :, :, 4], 0.0)
+
+
+def test_var_conv_2d():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 1, 6, 6).astype(np.float32)
+    w = rng.randn(2, 1 * 3 * 3).astype(np.float32)
+    rl = np.array([6, 4])
+    cl = np.array([6, 5])
+    out = F.var_conv_2d(_t(x), _t(w), input_channel=1, output_channel=2,
+                        filter_size=3, stride=1, row_length=_t(rl),
+                        col_length=_t(cl)).numpy()
+    assert out.shape == (2, 2, 4, 4)
+    # sample 1's valid output extent: (4-3)+1 = 2 rows, (5-3)+1 = 3 cols
+    np.testing.assert_allclose(out[1, :, 2:, :], 0.0)
+    np.testing.assert_allclose(out[1, :, :, 3:], 0.0)
+    # golden for sample 0 top-left
+    k = w.reshape(2, 1, 3, 3)
+    want = (x[0, 0, :3, :3] * k[0, 0]).sum()
+    np.testing.assert_allclose(out[0, 0, 0, 0], want, rtol=1e-4)
+
+
+def test_nce_loss():
+    rng = np.random.RandomState(6)
+    b, d, c = 4, 8, 20
+    x = rng.randn(b, d).astype(np.float32)
+    lbl = rng.randint(0, c, (b, 1)).astype(np.int64)
+    w = rng.randn(c, d).astype(np.float32)
+    bias = rng.randn(c).astype(np.float32)
+    cost = F.nce(_t(x), _t(lbl), _t(w), _t(bias), num_total_classes=c,
+                 num_neg_samples=5, sampler="uniform", seed=7)
+    assert cost.numpy().shape == (b, 1)
+    assert (cost.numpy() > 0).all()
+    # a model scoring the true class higher gets lower loss
+    w2 = w.copy()
+    for i in range(b):
+        w2[lbl[i, 0]] = x[i] * 3          # align true-class weight
+    cost2 = F.nce(_t(x), _t(lbl), _t(w2), _t(bias),
+                  num_total_classes=c, num_neg_samples=5,
+                  sampler="uniform", seed=7)
+    assert cost2.numpy().sum() < cost.numpy().sum()
+
+
+def test_nce_gradient_flows():
+    rng = np.random.RandomState(7)
+    x = rng.randn(3, 4).astype(np.float32)
+    lbl = rng.randint(0, 10, (3, 1)).astype(np.int64)
+    w = rng.randn(10, 4).astype(np.float32)
+    xt, wt = _t(x), _t(w)
+    xt.stop_gradient = False
+    wt.stop_gradient = False
+    cost = F.nce(xt, _t(lbl), wt, num_total_classes=10,
+                 num_neg_samples=4, seed=3)
+    cost.sum().backward()
+    assert np.isfinite(np.asarray(xt.grad._value)).all()
+    assert np.abs(np.asarray(wt.grad._value)).sum() > 0
+
+
+def test_sample_logits():
+    rng = np.random.RandomState(8)
+    b, c = 3, 50
+    logits = rng.randn(b, c).astype(np.float32)
+    lbl = rng.randint(0, c, (b, 1)).astype(np.int64)
+    samples, probs, slog, slabel = F.sample_logits(
+        _t(logits), _t(lbl), num_samples=10, seed=9)
+    s = samples.numpy()
+    assert s.shape == (3, 11)
+    np.testing.assert_array_equal(s[:, 0], lbl[:, 0])
+    np.testing.assert_array_equal(slabel.numpy(), [[0], [0], [0]])
+    sl = slog.numpy()
+    p = probs.numpy()
+    # non-hit entries equal logits - log q
+    for i in range(b):
+        true = int(lbl[i, 0])
+        np.testing.assert_allclose(
+            sl[i, 0], logits[i, true] - np.log(p[i, 0]), rtol=1e-4)
+        for j in range(1, 11):
+            if int(s[i, j]) == true:
+                assert sl[i, j] == -1e20       # accidental hit masked
+            else:
+                np.testing.assert_allclose(
+                    sl[i, j], logits[i, s[i, j]] - np.log(p[i, j]),
+                    rtol=1e-4)
+
+
+def test_fluid_layers_exports_misc_tail():
+    import paddle_tpu.fluid as fluid
+
+    for name in ("nce", "sample_logits", "row_conv", "data_norm",
+                 "shuffle_channel", "rank_loss", "center_loss",
+                 "im2sequence", "lod_reset", "pad_constant_like",
+                 "unique_with_counts", "partial_concat", "partial_sum",
+                 "match_matrix_tensor", "var_conv_2d"):
+        assert hasattr(fluid.layers, name), name
