@@ -256,9 +256,17 @@ def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False):
 
 
 def bench_moe(paddle, steps, peak):
-    """MoE-GPT via the sparse sort-based dispatch (distributed/moe.py):
-    tokens/sec + the dense-equivalent MFU (active params only — top-1
-    routing activates 1/E of expert FLOPs; VERDICT r2 item 5)."""
+    """MoE-GPT (distributed/moe.py): tokens/sec + dense-equivalent MFU
+    (active params only — top-1 routing activates 1/E of expert FLOPs;
+    VERDICT r2 item 5).
+
+    Round-5 dispatch redesign (r4 MFU 0.29 -> see BENCH_r05): cumsum
+    slot assignment (no argsort), injective-gather dispatch/combine with
+    gather-form custom VJPs (no row scatter-adds in backward), Switch-
+    paper capacity factor 1.0, and gradient merge over 4 micro-batches
+    (one AdamW update per 4 — the f32 moments on 508M params cost ~12%
+    of an unmerged step; gradient_merge is the reference's own
+    meta-optimizer for exactly this)."""
     import jax
     from paddle_tpu.distributed.fleet import DistributedStrategy
     from paddle_tpu.distributed.mesh import create_mesh
@@ -266,14 +274,15 @@ def bench_moe(paddle, steps, peak):
     from paddle_tpu.models import GPT, GPTConfig
 
     cfg = GPTConfig(vocab_size=32768, hidden_size=768, num_layers=12,
-                    num_heads=12, max_seq_len=1024, moe_num_experts=8)
+                    num_heads=12, max_seq_len=1024, moe_num_experts=8,
+                    moe_capacity_factor=1.0)
     net = GPT(cfg)
     opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
     s = DistributedStrategy()
     s.amp = True
     mesh = create_mesh({"dp": 1, "ep": 1}, jax.devices()[:1])
-    tr = compile_train_step(net, opt, s, mesh)
-    batch, seq = 8, 1024
+    tr = compile_train_step(net, opt, s, mesh, accumulate_steps=4)
+    batch, seq = 32, 1024                    # 4 micro-batches of 8
     tokens = np.random.RandomState(0).randint(
         0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     dt = _time_steps(lambda: tr.step(tokens), steps)
@@ -403,7 +412,8 @@ def _mlm_batch(vocab, batch, seq):
 
 
 def bench_mlm(paddle, model_cls, cfg, batch, seq, steps, peak,
-              zero3=False, remat=False, note=None, **kw):
+              zero3=False, remat=False, note=None, accumulate_steps=1,
+              **kw):
     """Shared BERT/ERNIE-style pretraining measurement.
 
     MFU accounting note (round-4 roofline analysis, VERDICT r3 next #2):
@@ -417,8 +427,41 @@ def bench_mlm(paddle, model_cls, cfg, batch, seq, steps, peak,
     the 0.45 bar is the h≤1024 operating point of the family curve
     (identical trainer: h768→0.46, h1024→0.51, h2048→0.57 — matmul
     arithmetic intensity scales with hidden), plus, for ERNIE,
-    rematerialization flops that MFU conventionally does not credit."""
-    tr = _hybrid(paddle, model_cls(cfg), zero3=zero3, remat=remat, **kw)
+    rematerialization flops that MFU conventionally does not credit.
+
+    Round-5 (VERDICT r4 next #1 — "kernels, not notes"): the MLM head
+    now gathers the masked positions BEFORE the vocab projection
+    (cfg.max_predictions, mirroring the reference's masked_lm_positions
+    data pipeline), .loss routes through the fused tied-decoder CE (no
+    [B,S,V] logits), and ``accumulate_steps`` gradient-merges k
+    micro-batches per AdamW update (amortizes moment traffic). The r4
+    roofline note above still holds and stays recorded alongside — the
+    numbers clear the bar without leaning on it."""
+    if accumulate_steps > 1:
+        import jax
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.mesh import create_mesh
+        from paddle_tpu.distributed.strategy_compiler import \
+            compile_train_step
+
+        # pipeline-trainer-only knobs (remat_policy/unroll_layers/
+        # n_micro) have no meaning here — refuse rather than silently
+        # measure a different configuration than the caller named
+        assert not kw, f"bench_mlm(accumulate_steps>1): unsupported {kw}"
+        net = model_cls(cfg)
+        opt = paddle.optimizer.AdamW(1e-4, parameters=net.parameters())
+        s = DistributedStrategy()
+        s.amp = True
+        if zero3:
+            s.sharding = True
+            s.sharding_configs = {"sharding_stage": 3}
+        s.recompute = remat
+        mesh = create_mesh({"dp": 1}, jax.devices()[:1])
+        tr = compile_train_step(net, opt, s, mesh,
+                                accumulate_steps=accumulate_steps)
+    else:
+        tr = _hybrid(paddle, model_cls(cfg), zero3=zero3, remat=remat,
+                     **kw)
     batch_arrays = _mlm_batch(cfg.vocab_size, batch, seq)
     dt = _time_steps(lambda: tr.step(*batch_arrays), steps)
     toks = batch * seq / dt
@@ -499,23 +542,29 @@ def main():
             batch=8, seq=1024, steps=15, peak=peak))
         extra("bert_base_dp_amp", lambda: bench_mlm(
             paddle, BertForPretraining,
-            BertConfig(vocab_size=32768, max_seq_len=512),
-            batch=16, seq=512, steps=10, peak=peak,
-            note="MFU formula under-credits the MLM objective by ~18% "
-                 "(XLA-counted: +10% real flops vs same-width GPT, -8% "
-                 "credited); hardware-normalized efficiency matches "
-                 "GPT-125M (h=768 family point ~0.43) — see bench_mlm "
-                 "docstring roofline"))
-        extra("ernie_zero3_recompute", lambda: bench_mlm(
+            BertConfig(vocab_size=32768, max_seq_len=512,
+                       max_predictions=80),
+            batch=64, seq=512, steps=6, peak=peak, accumulate_steps=4,
+            note="r5 kernels: masked-position MLM head (only the 80 "
+                 "gathered masked positions run the vocab projection, "
+                 "like the reference's masked_lm_positions pipeline; "
+                 "objective == full-seq ignore-index CE, tested) + "
+                 "fused tied-decoder CE in .loss + gradient merge over "
+                 "4 micro-batches of 16 (one AdamW update per 4)"))
+        extra("ernie_zero3_gradmerge", lambda: bench_mlm(
             paddle, ErnieForPretraining,
             ErnieConfig(vocab_size=32768, hidden_size=1024,
-                        num_layers=24, num_heads=16, max_seq_len=512),
-            batch=16, seq=512, steps=10, peak=peak, zero3=True,
-            remat=True, remat_policy="dots", unroll_layers=True,
-            note="selective-dots recompute (r4: +11% vs full remat); "
-                 "remat flops uncredited by MFU convention + MLM-head "
-                 "under-crediting as bert_base — see bench_mlm "
-                 "docstring roofline"))
+                        num_layers=24, num_heads=16, max_seq_len=512,
+                        max_predictions=80),
+            batch=64, seq=512, steps=6, peak=peak, zero3=True,
+            remat=False, accumulate_steps=4,
+            note="replaces r4's ernie_zero3_recompute (0.3851): the "
+                 "scan-accumulate gradient merge keeps ONE micro-batch's "
+                 "activations live, so rematerialization is no longer "
+                 "needed for memory and its ~30% flop tax is gone; "
+                 "masked-position MLM head as bert_base. Recompute "
+                 "itself stays default-on in the gpt_1p3b headline and "
+                 "covered by tests"))
         extra("resnet50_dp_amp", lambda: bench_resnet50(
             paddle, steps=10, batch=64))
         extra("moe_gpt_8experts", lambda: bench_moe(
@@ -540,6 +589,19 @@ def main():
                   "bench_wall_s": round(time.perf_counter() - t_start, 1),
                   "configs": configs},
     }))
+    # Compact summary LAST (VERDICT r4 weak #4): the driver's tail-bytes
+    # capture truncated the r4 sidecar mid-string and lost the headline;
+    # this short line always survives any tail window.
+    summary = {"metric": head_name, "value": head["tokens_per_sec"],
+               "unit": "tokens/s", "mfu": head["mfu"],
+               "vs_baseline": round(head["mfu"] / 0.45, 4)}
+    for name, c in configs.items():
+        if isinstance(c, dict):
+            m = c.get("mfu", c.get("mfu_active_params",
+                                   c.get("int8_speedup_vs_bf16")))
+            if m is not None:
+                summary[f"mfu:{name}"] = m
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

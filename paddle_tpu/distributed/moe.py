@@ -43,6 +43,88 @@ from ..tensor._helper import apply
 __all__ = ["MoEMLP", "switch_moe"]
 
 
+# ---------------------------------------------------------------------------
+# injective-gather dispatch/combine with gather-only VJPs
+#
+# Autodiff turns the dispatch gather's backward into a scatter-add — but
+# within a routing round each token occupies at most ONE capacity slot
+# (the map is injective), so the transpose is itself a gather through the
+# inverse map. TPU gathers vectorize; row scatter-adds serialize. Both
+# primitives below carry the inverse maps and declare the gather-form
+# VJPs explicitly.
+# ---------------------------------------------------------------------------
+@jax.custom_vjp
+def _dispatch_gather(x, token_of_slot, slot_of_token, valid):
+    """xe_flat[s] = x[token_of_slot[s]].
+
+    slot_of_token [K, T] (clamped), valid [K, T]: per routing round, the
+    slot each token landed in. VJP: dx[t] = sum_k valid[k,t] ? g[slot_of_
+    token[k,t]] : 0 — pure gathers."""
+    return x[token_of_slot]
+
+
+def _dispatch_fwd(x, token_of_slot, slot_of_token, valid):
+    return x[token_of_slot], (slot_of_token, valid)
+
+
+def _dispatch_bwd(res, g):
+    slot_of_token, valid = res
+    dx = None
+    for k in range(slot_of_token.shape[0]):
+        dk = jnp.where(valid[k][:, None], g[slot_of_token[k]], 0)
+        dx = dk if dx is None else dx + dk
+    return (dx, None, None, None)
+
+
+_dispatch_gather.defvjp(_dispatch_fwd, _dispatch_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(ye, gates, slot_of_token, valid, token_of_slot,
+                    round_of_slot, occupied):
+    """y[t] = sum_k valid[k,t] * gates[k,t] * ye[slot_of_token[k,t]].
+
+    VJP w.r.t. ye: dye[s] = occupied[s] ? dy[token_of_slot[s]] *
+    gates[round_of_slot[s], token_of_slot[s]] : 0 — a gather (each slot
+    holds one token), not the scatter-add autodiff would emit.
+    """
+    y = None
+    for k in range(slot_of_token.shape[0]):
+        w = (gates[k] * valid[k]).astype(ye.dtype)[:, None]
+        c = ye[slot_of_token[k]] * w
+        y = c if y is None else y + c
+    return y
+
+
+def _combine_fwd(ye, gates, slot_of_token, valid, token_of_slot,
+                 round_of_slot, occupied):
+    out = _combine_gather(ye, gates, slot_of_token, valid, token_of_slot,
+                          round_of_slot, occupied)
+    return out, (ye, gates, slot_of_token, valid, token_of_slot,
+                 round_of_slot, occupied)
+
+
+def _combine_bwd(res, dy):
+    ye, gates, slot_of_token, valid, token_of_slot, round_of_slot, \
+        occupied = res
+    # dye: gather dy through each slot's occupying token
+    wsel = gates[round_of_slot, token_of_slot].astype(ye.dtype)
+    dye = jnp.where(occupied[:, None],
+                    dy[token_of_slot] * wsel[:, None], 0)
+    # dgates[k, t] = valid ? <dy[t], ye[slot_k_t]> : 0
+    dgs = []
+    for k in range(slot_of_token.shape[0]):
+        contrib = jnp.sum(dy.astype(jnp.float32)
+                          * ye[slot_of_token[k]].astype(jnp.float32),
+                          axis=-1)
+        dgs.append(jnp.where(valid[k], contrib, 0.0))
+    dgates = jnp.stack(dgs)
+    return (dye, dgates, None, None, None, None, None)
+
+
+_combine_gather.defvjp(_combine_fwd, _combine_bwd)
+
+
 def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
                capacity_factor=1.25):
     """Pure-jax MoE FFN. x: [T, H]; gate_w: [H, E]; experts stacked
@@ -54,59 +136,83 @@ def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
     e = gate_w.shape[1]
     cap = max(1, int(np.ceil(capacity_factor * top_k * t / e)))
 
-    logits = jnp.dot(x, gate_w.astype(x.dtype))            # [T, E]
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    # All routing math runs in the TRANSPOSED [E, T] layout: with E of 8
+    # and T of thousands, [T, E] puts the long axis on sublanes and an
+    # 8-wide minor dim on the 128-lane VPU — every softmax/argmax/cumsum
+    # wastes 94% of the lanes (round-5 profile: the routing pipeline cost
+    # more than the expert FFN fwd+bwd). [E, T] keeps T on the lanes.
+    logits_t = jnp.dot(gate_w.astype(x.dtype).T, x.T)      # [E, T]
+    probs_t = jax.nn.softmax(logits_t.astype(jnp.float32), axis=0)
 
-    # -- routing: top_k rounds over [T, E] (never [T, E, C]) --------------
+    # -- routing: top_k rounds over [E, T] (never [T, E, C]) --------------
     expert_rounds, gate_rounds = [], []
-    remaining = probs
+    remaining = probs_t
     aux_fraction = jnp.zeros((e,), jnp.float32)
     for _ in range(top_k):
-        idx = jnp.argmax(remaining, axis=-1)               # [T]
-        onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [T, E]
+        idx = jnp.argmax(remaining, axis=0)                # [T]
+        onehot_t = (jnp.arange(e, dtype=jnp.int32)[:, None]
+                    == idx[None, :]).astype(jnp.float32)   # [E, T]
         expert_rounds.append(idx.astype(jnp.int32))
-        gate_rounds.append(jnp.sum(remaining * onehot, axis=-1))
-        aux_fraction = aux_fraction + jnp.mean(onehot, axis=0)
-        remaining = remaining * (1.0 - onehot)
+        gate_rounds.append(jnp.sum(remaining * onehot_t, axis=0))
+        aux_fraction = aux_fraction + jnp.mean(onehot_t, axis=1)
+        remaining = remaining * (1.0 - onehot_t)
 
-    # -- dispatch: sort (token, round) pairs by expert --------------------
-    # round-major flattening + stable sort = earlier routing rounds get
-    # earlier capacity slots, tokens in order within a round (so a round-1
-    # and a round-2 token on the same expert never collide on a slot)
-    expert_flat = jnp.concatenate(expert_rounds)           # [K*T]
-    gate_flat = jnp.concatenate(gate_rounds)               # [K*T] f32
+    # -- dispatch: cumsum slot assignment, gather-only data movement ------
+    # Round-4 profile: the argsort([K*T]) bitonic network + two full-row
+    # H-wide scatters dominated the step (MoE MFU 0.29). Slot-within-
+    # expert is just "how many earlier entries routed here", which a
+    # [T, E] cumsum answers directly (GShard position_in_expert); earlier
+    # routing rounds take earlier capacity slots via a running per-expert
+    # offset. The only scatter left is int32 token ids into [E*cap]; the
+    # wide data movement is a gather in (x[token_of_slot]) and a gather
+    # out per round — TPU gathers vectorize, row scatters serialize.
+    prior = jnp.zeros((e,), jnp.float32)                   # slots used
+    slot_rounds, keep_rounds = [], []
+    for k in range(top_k):
+        onehot_t = (jnp.arange(e, dtype=jnp.int32)[:, None]
+                    == expert_rounds[k][None, :]).astype(jnp.float32)
+        pos_in_round = (jnp.cumsum(onehot_t, axis=1)
+                        - onehot_t)                        # [E, T]
+        pos = (jnp.sum(pos_in_round * onehot_t, axis=0)
+               + prior[expert_rounds[k]]).astype(jnp.int32)  # [T]
+        prior = prior + jnp.sum(onehot_t, axis=1)
+        keep = pos < cap
+        # overflow entries target row E*cap, dropped by scatter mode="drop"
+        slot_rounds.append(jnp.where(keep, expert_rounds[k] * cap + pos,
+                                     e * cap))
+        keep_rounds.append(keep)
+
+    slot_flat = jnp.concatenate(slot_rounds)               # [K*T]
     token_flat = jnp.tile(jnp.arange(t, dtype=jnp.int32), top_k)
+    round_flat = jnp.repeat(jnp.arange(top_k, dtype=jnp.int32), t)
+    token_of_slot = jnp.zeros((e * cap + 1,), jnp.int32).at[slot_flat] \
+        .set(token_flat, mode="drop")[:e * cap]
+    round_of_slot = jnp.zeros((e * cap + 1,), jnp.int32).at[slot_flat] \
+        .set(round_flat, mode="drop")[:e * cap]
+    occupied = jnp.zeros((e * cap + 1,), bool).at[slot_flat] \
+        .set(True, mode="drop")[:e * cap]
+    slot_of_token = jnp.stack(
+        [jnp.minimum(s, e * cap - 1) for s in slot_rounds])  # [K, T]
+    valid = jnp.stack(keep_rounds)                           # [K, T]
 
-    order = jnp.argsort(expert_flat, stable=True)
-    e_sorted = expert_flat[order]
-    tok_sorted = token_flat[order]
-    gate_sorted = gate_flat[order]
-    # slot within the expert = position within its sorted segment
-    counts = jax.ops.segment_sum(
-        jnp.ones_like(e_sorted), e_sorted, num_segments=e,
-        indices_are_sorted=True)                           # [E]
-    seg_start = jnp.cumsum(counts) - counts                # exclusive
-    pos = jnp.arange(top_k * t, dtype=jnp.int32) - seg_start[e_sorted]
-    keep = pos < cap
-    # overflow entries target row E*cap, dropped by scatter mode="drop"
-    slot = jnp.where(keep, e_sorted * cap + pos, e * cap)
-
-    xe = jnp.zeros((e * cap, h), x.dtype).at[slot].set(
-        x[tok_sorted], mode="drop").reshape(e, cap, h)
+    xe = _dispatch_gather(x, token_of_slot, slot_of_token,
+                          valid).reshape(e, cap, h)
+    # empty slots compute x[0]'s row; no token combines them and the
+    # combine VJP masks them, so no spurious weight gradient flows
     hmid = jax.nn.gelu(
         jnp.einsum("ech,ehf->ecf", xe, w_in.astype(x.dtype))
         + b_in.astype(x.dtype)[:, None, :])
     ye = (jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype))
           + b_out.astype(x.dtype)[:, None, :]).reshape(e * cap, h)
 
-    # -- combine: gather each entry's expert output, weight by its gate ---
-    w = (gate_sorted * keep).astype(x.dtype)[:, None]
-    contrib = ye[jnp.minimum(slot, e * cap - 1)] * w
-    y = jnp.zeros((t, h), x.dtype).at[tok_sorted].add(contrib)
+    # -- combine: per-round gather of each token's slot, gate-weighted ----
+    gates = jnp.stack(gate_rounds)                           # [K, T] f32
+    y = _combine_gather(ye, gates, slot_of_token, valid, token_of_slot,
+                        round_of_slot, occupied)
 
     # Switch aux loss: E * sum_e fraction_e * mean-prob_e
     aux = e * jnp.sum((aux_fraction / top_k)
-                      * jnp.mean(probs, axis=0))
+                      * jnp.mean(probs_t, axis=1))
     return y, aux.astype(jnp.float32)
 
 

@@ -189,9 +189,18 @@ class HybridParallelTrainer:
     def __init__(self, layer, optimizer, strategy: Optional[
             DistributedStrategy] = None, mesh: Optional[Mesh] = None,
             loss_fn=None, data_spec: Optional[Tuple] = None,
-            donate: bool = True):
+            donate: bool = True, accumulate_steps: int = 1):
         self.layer = layer
         self.optimizer = optimizer
+        # gradient merge (reference: fleet gradient_merge meta-optimizer /
+        # GradMergeOptimizer): the compiled step lax.scans over
+        # ``accumulate_steps`` micro-batches — each micro's backward
+        # completes before the next forward (one micro's activations
+        # live at a time) — and applies ONE optimizer update on the
+        # mean gradient. Amortizes the optimizer-state memory traffic,
+        # which dominates for expert-heavy models (round-5 MoE profile:
+        # AdamW moments on 508M params cost ~12% of the step).
+        self.accumulate_steps = int(accumulate_steps)
         self.strategy = strategy or DistributedStrategy()
         self.mesh = mesh if mesh is not None else \
             build_mesh_from_strategy(self.strategy)
@@ -290,13 +299,46 @@ class HybridParallelTrainer:
         wds = tuple(opt._decoupled_wd(p) for p in self._param_tensors)
         upd = make_param_update(opt)
 
-        def step_fn(params, opt_states, buffers, batch, lr, step_no, key):
-            def loss_of(ps):
-                loss, new_buf = self._forward_loss(ps, buffers, batch, key)
-                return loss, new_buf
+        k_acc = self.accumulate_steps
 
-            (loss, new_buf), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+        def step_fn(params, opt_states, buffers, batch, lr, step_no, key):
+            if k_acc > 1:
+                for b in jax.tree_util.tree_leaves(batch):
+                    if b.shape[0] % k_acc:
+                        raise ValueError(
+                            f"gradient merge: batch size {b.shape[0]} is "
+                            f"not divisible by accumulate_steps={k_acc}")
+                micros = jax.tree_util.tree_map(
+                    lambda b: b.reshape((k_acc, b.shape[0] // k_acc)
+                                        + b.shape[1:]), batch)
+                keys = jax.random.split(key, k_acc)
+
+                def micro(carry, xs):
+                    bufs, acc = carry
+                    mb, mkey = xs
+
+                    def loss_of(ps):
+                        return self._forward_loss(ps, bufs, mb, mkey)
+
+                    (mloss, nbuf), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(params)
+                    acc = [a + gi.astype(a.dtype)
+                           for a, gi in zip(acc, g)]
+                    return (nbuf, acc), mloss
+
+                acc0 = [jnp.zeros(p.shape, jnp.float32) for p in params]
+                (new_buf, acc), mlosses = jax.lax.scan(
+                    micro, (buffers, acc0), (micros, keys))
+                loss = jnp.mean(mlosses)
+                grads = [a / k_acc for a in acc]
+            else:
+                def loss_of(ps):
+                    loss, new_buf = self._forward_loss(ps, buffers, batch,
+                                                       key)
+                    return loss, new_buf
+
+                (loss, new_buf), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params)
             grads = functional_clip(clip, grads)
             new_params, new_states = [], []
             for p, g, s, plr, wd in zip(params, grads, opt_states, lrs, wds):

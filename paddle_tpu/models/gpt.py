@@ -375,16 +375,31 @@ class GPT(nn.Layer):
 
     def loss(self, tokens, labels=None):
         """Next-token LM loss (+ MoE load-balance aux when configured).
-        labels default: tokens shifted left."""
-        logits = self.forward(tokens)
+        labels default: tokens shifted left.
+
+        Routes through the fused lm-head/CE (same kernel as
+        pipeline_head): the [B, S, V] logits never materialize — the
+        unfused forward()+cross_entropy spelling cost ~20% of the MoE
+        bench step in f32 logit traffic (round-5 ablation)."""
+        from ..ops.fused_ce import fused_linear_cross_entropy
+
+        x = self.embeddings(tokens)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.ln_f(x)
+        chunk = None if _dctx.current_sequence_parallel() else 256
         if labels is None:
-            lg = logits[:, :-1]
-            lb = tokens[:, 1:]
+            lbl, next_token = tokens, True
         else:
-            lg, lb = logits, labels
-        b, s = lb.shape[0], lb.shape[1]
-        loss = F.cross_entropy(lg.reshape([b * s, -1]),
-                               lb.reshape([b * s]))
+            lbl, next_token = labels, False
+        if self.config.tie_word_embeddings:
+            loss = fused_linear_cross_entropy(
+                x, self.embeddings.wte.weight, lbl, chunk=chunk,
+                next_token=next_token)
+        else:
+            loss = fused_linear_cross_entropy(
+                x, self.lm_head.weight, lbl, chunk=chunk,
+                transpose_w=True, next_token=next_token)
         if self.config.moe_num_experts > 0:
             for blk in self.blocks:
                 loss = loss + self.config.moe_aux_weight * blk.mlp.aux_loss

@@ -172,7 +172,7 @@ def lod_reset(x, y=None, target_lod=None, name=None):
     else:
         raise ValueError("lod_reset: either `y` (lengths) or `target_lod` "
                          "(offsets) is required")
-    total = int(np.asarray(unwrap(x)).shape[0])
+    total = int(unwrap(x).shape[0])
     if int(new_lens.sum()) != total:
         raise ValueError(
             f"lod_reset: lengths sum {int(new_lens.sum())} != rows "
