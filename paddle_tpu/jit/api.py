@@ -31,6 +31,31 @@ class StaticLayer:
         self._input_spec = input_spec
         self._is_layer = isinstance(target, Layer)
         self._compiled = {}
+        # dy2static AST pre-pass (reference: dygraph_to_static
+        # program_translator.py convert_to_static): tensor-dependent
+        # if/while in the target's forward become cond/while_loop so
+        # they stage under tracing. No-op when the source has no
+        # control flow or is unavailable. The user's layer is NOT
+        # mutated: the converted forward is swapped in only for the
+        # duration of each traced call (_swap_forward).
+        import inspect as _inspect
+        import types as _types
+
+        from .dy2static import convert_to_static
+
+        self._converted_forward = None
+        if self._is_layer:
+            conv = convert_to_static(type(target).forward)
+            if conv is not None:
+                self._converted_forward = _types.MethodType(conv, target)
+        elif _inspect.ismethod(target):
+            conv = convert_to_static(target.__func__)
+            if conv is not None:
+                self._target = _types.MethodType(conv, target.__self__)
+        else:
+            conv = convert_to_static(target)
+            if conv is not None:
+                self._target = conv
         if self._is_layer:
             self._jit_fn = jax.jit(self._pure_forward,
                                    static_argnames=("training",))
@@ -38,9 +63,29 @@ class StaticLayer:
     # pure function traced by XLA
     def _pure_forward(self, param_vals, buffer_vals, key, arg_vals,
                       training=False):
-        out, new_buf = functional_call(self._target, param_vals, buffer_vals,
-                                       arg_vals, training=training,
-                                       rng_key=key)
+        from contextlib import contextmanager
+
+        @contextmanager
+        def _swap_forward():
+            if self._converted_forward is None:
+                yield
+                return
+            layer = self._target
+            had = "forward" in layer.__dict__
+            prev = layer.__dict__.get("forward")
+            layer.__dict__["forward"] = self._converted_forward
+            try:
+                yield
+            finally:
+                if had:
+                    layer.__dict__["forward"] = prev
+                else:
+                    layer.__dict__.pop("forward", None)
+
+        with _swap_forward():
+            out, new_buf = functional_call(self._target, param_vals,
+                                           buffer_vals, arg_vals,
+                                           training=training, rng_key=key)
         return out, new_buf
 
     def __call__(self, *args):

@@ -266,21 +266,24 @@ class GPT(nn.Layer):
     def pipeline_blocks(self):
         return self.blocks
 
-    def pipeline_head(self, x, tokens):
+    def pipeline_head(self, x, tokens, labels=None):
         """Final norm + fused lm-head/CE (ops/fused_ce.py): the [B,S,V]
-        logits never materialize in HBM."""
+        logits never materialize in HBM. ``labels`` (eager .loss path):
+        explicit targets instead of the shifted-token LM objective."""
         from ..ops.fused_ce import fused_linear_cross_entropy
 
         x = self.ln_f(x)
         # chunking over seq would fight an sp sharding; sp>1 runs one chunk
         chunk = None if _dctx.current_sequence_parallel() else 256
+        lbl, next_token = (tokens, True) if labels is None \
+            else (labels, False)
         if self.config.tie_word_embeddings:
             return fused_linear_cross_entropy(
-                x, self.embeddings.wte.weight, tokens, chunk=chunk,
-                next_token=True)
+                x, self.embeddings.wte.weight, lbl, chunk=chunk,
+                next_token=next_token)
         return fused_linear_cross_entropy(
-            x, self.lm_head.weight, tokens, chunk=chunk, transpose_w=True,
-            next_token=True)
+            x, self.lm_head.weight, lbl, chunk=chunk, transpose_w=True,
+            next_token=next_token)
 
     # --- decoding (ops/decoding.py loops over the KV-cached forward) -----
     def generate(self, input_ids, max_new_tokens: int = 32,
@@ -381,25 +384,10 @@ class GPT(nn.Layer):
         pipeline_head): the [B, S, V] logits never materialize — the
         unfused forward()+cross_entropy spelling cost ~20% of the MoE
         bench step in f32 logit traffic (round-5 ablation)."""
-        from ..ops.fused_ce import fused_linear_cross_entropy
-
         x = self.embeddings(tokens)
         for blk in self.blocks:
             x = blk(x)
-        x = self.ln_f(x)
-        chunk = None if _dctx.current_sequence_parallel() else 256
-        if labels is None:
-            lbl, next_token = tokens, True
-        else:
-            lbl, next_token = labels, False
-        if self.config.tie_word_embeddings:
-            loss = fused_linear_cross_entropy(
-                x, self.embeddings.wte.weight, lbl, chunk=chunk,
-                next_token=next_token)
-        else:
-            loss = fused_linear_cross_entropy(
-                x, self.lm_head.weight, lbl, chunk=chunk,
-                transpose_w=True, next_token=next_token)
+        loss = self.pipeline_head(x, tokens, labels=labels)
         if self.config.moe_num_experts > 0:
             for blk in self.blocks:
                 loss = loss + self.config.moe_aux_weight * blk.mlp.aux_loss
