@@ -178,7 +178,7 @@ def _hybrid(paddle, model, amp=True, zero3=False, remat=False, **kw):
 
 
 def bench_gpt_1p3b(paddle, peak, steps=6, micro=2, n_micro=6,
-                   offload=False):
+                   offload=False, cfg=None):
     """The BASELINE metric's own model class on ONE 16 GB v5e chip.
 
     Default (headline): bf16 master+moments resident in HBM, full remat,
@@ -187,10 +187,13 @@ def bench_gpt_1p3b(paddle, peak, steps=6, micro=2, n_micro=6,
     bf16 moments in pinned_host, streamed through HBM around the
     per-group update (bandwidth-bound at ~12 GB/s: lower MFU, full f32
     master fidelity; the config for models that cannot fit otherwise).
+    ``cfg`` overrides the model for offline scaling probes (used by the
+    r5 2.7B attempts recorded in MEMO_SCALING_r05.md — all six configs
+    exceed this chip's HBM, so no in-bench config passes it today).
     """
     from paddle_tpu.models import GPT, GPTConfig
 
-    cfg = GPTConfig.gpt3_1_3b()
+    cfg = cfg or GPTConfig.gpt3_1_3b()
     seq = cfg.max_seq_len
     kw = dict(remat=True, n_micro=n_micro, free_eager=True)
     if offload:
@@ -591,6 +594,16 @@ def main():
         # the serving comparison (cheapest to re-derive offline)
         extra("gpt_1p3b_f32master_offload", lambda: bench_gpt_1p3b(
             paddle, peak, steps=3, micro=2, n_micro=16, offload=True))
+        # 2.7B on ONE 15.75 GB v5e: six measured attempts this round
+        # land 0.4-4 GB over HBM (best 16.11 GB, moments-offload +
+        # update_scan). The structural floor is bf16 params+grads =
+        # 10.6 GB plus the offload update's whole-group moment fetch —
+        # the per-layer host-stream rework (MEMO_SCALING_r05.md) is the
+        # enabler; recorded as a documented wall, not silently skipped.
+        configs["gpt_2p7b_offload"] = {
+            "status": "exceeds single-v5e HBM",
+            "best_attempt_hbm_gb": 16.11, "hbm_gb": 15.75,
+            "attempts": 6, "memo": "MEMO_SCALING_r05.md"}
         extra("predictor_int8_serving", lambda: bench_predictor_int8(
             paddle, steps=15))
         extra("predictor_int8_serving_computebound",

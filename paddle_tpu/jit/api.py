@@ -179,9 +179,36 @@ def save(layer, path, input_spec=None, batch_buckets=None,
         specs = [jax.ShapeDtypeStruct(tuple(s.shape), np.dtype(s.dtype))
                  for s in input_spec]
 
+        # dy2static: export must trace the CONVERTED forward too — a
+        # control-flow model that runs via to_static would otherwise
+        # fail export with a swallowed TracerBoolConversionError
+        import types as _types
+        from contextlib import contextmanager
+
+        from .dy2static import convert_to_static
+
+        _conv = convert_to_static(type(target).forward)
+
+        @contextmanager
+        def _swapped():
+            if _conv is None:
+                yield
+                return
+            had = "forward" in target.__dict__
+            prev = target.__dict__.get("forward")
+            target.__dict__["forward"] = _types.MethodType(_conv, target)
+            try:
+                yield
+            finally:
+                if had:
+                    target.__dict__["forward"] = prev
+                else:
+                    target.__dict__.pop("forward", None)
+
         def pure(p_vals, b_vals, *a_vals):
-            out, _ = functional_call(target, p_vals, b_vals, a_vals,
-                                     training=False)
+            with _swapped():
+                out, _ = functional_call(target, p_vals, b_vals, a_vals,
+                                         training=False)
             return out
 
         p_specs = [jax.ShapeDtypeStruct(p._value.shape, p._value.dtype)

@@ -63,10 +63,26 @@ def _wrap(v):
 
 class _Undef:
     """Placeholder for a carried local not yet bound before the
-    statement (legal when both branches assign it)."""
+    statement (legal when both branches assign it). Any USE fails
+    loudly with the original unbound-local semantics instead of letting
+    the sentinel propagate."""
 
     def __repr__(self):
         return "<dy2static undefined>"
+
+    def _raise(self, *a, **k):
+        raise UnboundLocalError(
+            "dy2static: a local variable carried through converted "
+            "control flow was used before assignment (the taken branch "
+            "never assigned it)")
+
+    __getattr__ = _raise
+    __call__ = _raise
+    __bool__ = _raise
+    __add__ = __radd__ = __sub__ = __rsub__ = _raise
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
+    __lt__ = __le__ = __gt__ = __ge__ = _raise
+    __iter__ = __len__ = __getitem__ = _raise
 
 
 _UNDEF = _Undef()
@@ -156,8 +172,12 @@ def _jst_while(cond_fn, body_fn, init, names):
 
 
 def _as_tuple(out, names):
+    if isinstance(out, list):
+        out = tuple(out)
     if len(names) == 1:
-        return (out,) if not isinstance(out, tuple) else out
+        if isinstance(out, tuple) and len(out) == 1:
+            return out
+        return (out,)
     return tuple(out)
 
 
@@ -186,6 +206,24 @@ def _assigned_names(stmts):
         def visit_For(self, node):
             self._target(node.target)
             self.generic_visit(node)
+
+        def visit_NamedExpr(self, node):   # walrus binds a local too
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_With(self, node):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    self._target(item.optional_vars)
+            self.generic_visit(node)
+
+        def visit_Import(self, node):      # noqa: N802
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+
+        def visit_ImportFrom(self, node):  # noqa: N802
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
 
         def _target(self, t):
             if isinstance(t, ast.Name):
@@ -374,6 +412,13 @@ def convert_to_static(fn: Callable) -> Optional[Callable]:
     has_cf = any(isinstance(n, (ast.If, ast.While))
                  for n in ast.walk(tree))
     if not has_cf:
+        return None
+    # zero-arg super() relies on the class-body-compiled __class__ cell;
+    # a factory recompile cannot reproduce that linkage faithfully —
+    # leave such forwards unconverted (bool conditions keep working;
+    # tensor conditions get jax's tracer error)
+    if any(isinstance(n, ast.Name) and n.id == "super"
+           for n in ast.walk(tree)):
         return None
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -207,3 +207,80 @@ def test_to_static_does_not_mutate_layer():
     _ = paddle.jit.to_static(net)
     assert net.forward == before
     assert "forward" not in net.__dict__
+
+
+def test_single_carried_while_var_returns_tensor():
+    class OneVarWhile(paddle.nn.Layer):
+        def forward(self, x):
+            s = x.sum()
+            while s < 100.0:
+                s = s * 2.0
+            return s
+
+    st = paddle.jit.to_static(OneVarWhile())
+    out = st(paddle.to_tensor(np.full((4,), 1.5, np.float32)))
+    assert not isinstance(out, (list, tuple)), type(out)
+    np.testing.assert_allclose(float(out.numpy()), 192.0, rtol=1e-5)
+
+
+def test_walrus_and_with_bindings_carried():
+    def f(x, flag=True):
+        if flag:
+            y = (t := x * 2.0)
+        else:
+            y = x
+            t = x
+        return y + t
+
+    conv = convert_to_static(f)
+    assert conv is not None
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    np.testing.assert_allclose(conv(x).numpy(), [4.0, 4.0])
+
+
+def test_undef_use_raises_unboundlocal():
+    def f(x, flag=False):
+        if flag:
+            y = x * 2.0
+        return y  # noqa: F821 — unbound when flag is False
+
+    conv = convert_to_static(f)
+    assert conv is not None
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    out = conv(x)
+    with pytest.raises(UnboundLocalError, match="dy2static"):
+        out.sum()
+
+
+def test_super_forward_left_unconverted():
+    class Base(paddle.nn.Layer):
+        def forward(self, x):
+            return x * 2.0
+
+    class Child(Base):
+        def forward(self, x):
+            if x.shape[0] > 0:          # bool condition: python path
+                y = super().forward(x)
+            else:
+                y = x
+            return y
+
+    assert convert_to_static(Child.forward) is None
+    # unconverted forward still works via to_static (bool condition)
+    st = paddle.jit.to_static(Child())
+    out = st(paddle.to_tensor(np.ones((2,), np.float32)))
+    np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+
+def test_jit_save_exports_converted_control_flow(tmp_path):
+    import paddle_tpu.jit as pjit
+    from paddle_tpu.static.input_spec import InputSpec
+
+    paddle.seed(9)
+    net = IfNet()
+    pjit.save(net, str(tmp_path / "ifnet"),
+              input_spec=[InputSpec([2, 4], "float32", "x")])
+    import pickle
+
+    meta = pickle.load(open(str(tmp_path / "ifnet") + ".pdmeta", "rb"))
+    assert meta.get("exported"), meta.get("export_error")
