@@ -78,7 +78,10 @@ def build(cfg, plan, sched_steps=2000):
         warmup_steps=100, start_lr=1e-7, end_lr=1e-4)
     opt = paddle.optimizer.AdamW(sched, weight_decay=0.01,
                                  parameters=model.parameters())
-    mesh = build_mesh_from_strategy(strat)
+    import jax
+
+    need = plan["dp"] * plan["tp"] * plan["pp"]
+    mesh = build_mesh_from_strategy(strat, jax.devices()[:need])
     trainer = HybridPipelineTrainer(
         model, opt, strategy=strat, mesh=mesh, n_micro=plan["n_micro"],
         param_dtype="bfloat16", moment_dtype="bfloat16",
@@ -131,6 +134,7 @@ def main(argv):
         global_batch, seq = doc["global_batch"], doc["seq"]
     trainer, sched = build(cfg, plan)
 
+    loader = None
     if corpus:
         from paddle_tpu.io.native_engine import token_windows
 
@@ -153,17 +157,21 @@ def main(argv):
         gen = batches()
 
     losses = []
-    for i in range(steps):
-        toks = next(gen)
-        t0 = time.perf_counter()
-        loss = trainer.step(toks)
-        loss_v = float(np.asarray(loss))
-        sched.step()
-        dt = time.perf_counter() - t0
-        losses.append(loss_v)
-        print(f"step {i}: loss {loss_v:.4f}  "
-              f"{global_batch * seq / dt:,.0f} tokens/s "
-              f"({dt*1e3:.0f} ms)", flush=True)
+    try:
+        for i in range(steps):
+            toks = next(gen)
+            t0 = time.perf_counter()
+            loss = trainer.step(toks)
+            loss_v = float(np.asarray(loss))
+            sched.step()
+            dt = time.perf_counter() - t0
+            losses.append(loss_v)
+            print(f"step {i}: loss {loss_v:.4f}  "
+                  f"{global_batch * seq / dt:,.0f} tokens/s "
+                  f"({dt*1e3:.0f} ms)", flush=True)
+    finally:
+        if loader is not None:
+            loader.close()
     assert np.isfinite(losses).all()
     if len(losses) >= 3:
         assert losses[-1] < losses[0], losses

@@ -63,26 +63,9 @@ class StaticLayer:
     # pure function traced by XLA
     def _pure_forward(self, param_vals, buffer_vals, key, arg_vals,
                       training=False):
-        from contextlib import contextmanager
+        from .dy2static import swapped_forward
 
-        @contextmanager
-        def _swap_forward():
-            if self._converted_forward is None:
-                yield
-                return
-            layer = self._target
-            had = "forward" in layer.__dict__
-            prev = layer.__dict__.get("forward")
-            layer.__dict__["forward"] = self._converted_forward
-            try:
-                yield
-            finally:
-                if had:
-                    layer.__dict__["forward"] = prev
-                else:
-                    layer.__dict__.pop("forward", None)
-
-        with _swap_forward():
+        with swapped_forward(self._target, self._converted_forward):
             out, new_buf = functional_call(self._target, param_vals,
                                            buffer_vals, arg_vals,
                                            training=training, rng_key=key)
@@ -183,30 +166,19 @@ def save(layer, path, input_spec=None, batch_buckets=None,
         # control-flow model that runs via to_static would otherwise
         # fail export with a swallowed TracerBoolConversionError
         import types as _types
-        from contextlib import contextmanager
 
-        from .dy2static import convert_to_static
+        from .dy2static import convert_to_static, swapped_forward
 
-        _conv = convert_to_static(type(target).forward)
-
-        @contextmanager
-        def _swapped():
-            if _conv is None:
-                yield
-                return
-            had = "forward" in target.__dict__
-            prev = target.__dict__.get("forward")
-            target.__dict__["forward"] = _types.MethodType(_conv, target)
-            try:
-                yield
-            finally:
-                if had:
-                    target.__dict__["forward"] = prev
-                else:
-                    target.__dict__.pop("forward", None)
+        if isinstance(layer, StaticLayer) and \
+                layer._converted_forward is not None:
+            _conv_bound = layer._converted_forward
+        else:
+            _conv = convert_to_static(type(target).forward)
+            _conv_bound = _types.MethodType(_conv, target) \
+                if _conv is not None else None
 
         def pure(p_vals, b_vals, *a_vals):
-            with _swapped():
+            with swapped_forward(target, _conv_bound):
                 out, _ = functional_call(target, p_vals, b_vals, a_vals,
                                          training=False)
             return out

@@ -35,8 +35,34 @@ import textwrap
 import types
 from typing import Callable, Optional
 
-__all__ = ["convert_to_static", "_jst_ifelse", "_jst_while",
-           "control_flow_error_hint"]
+__all__ = ["convert_to_static", "swapped_forward", "_jst_ifelse",
+           "_jst_while", "control_flow_error_hint"]
+
+
+def swapped_forward(target, converted_bound):
+    """Context manager: temporarily install a converted bound forward on
+    ``target`` (instance __dict__ only; the user's layer is untouched
+    outside the scope). Shared by StaticLayer.__call__ tracing and
+    jit.save's export trace."""
+    from contextlib import contextmanager
+
+    @contextmanager
+    def cm():
+        if converted_bound is None:
+            yield
+            return
+        had = "forward" in target.__dict__
+        prev = target.__dict__.get("forward")
+        target.__dict__["forward"] = converted_bound
+        try:
+            yield
+        finally:
+            if had:
+                target.__dict__["forward"] = prev
+            else:
+                target.__dict__.pop("forward", None)
+
+    return cm()
 
 _HELPERS = "__pt_jst_ifelse", "__pt_jst_while"
 
@@ -83,6 +109,8 @@ class _Undef:
     __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _raise
     __lt__ = __le__ = __gt__ = __ge__ = _raise
     __iter__ = __len__ = __getitem__ = _raise
+    __eq__ = __ne__ = __hash__ = _raise
+    __str__ = __format__ = _raise
 
 
 _UNDEF = _Undef()
