@@ -25,6 +25,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 import contextlib
+import os
 
 import jax
 import jax.numpy as jnp
@@ -61,6 +62,9 @@ class HybridPipelineTrainer:
                  offload_optimizer: bool = False,
                  offload_params: bool = False,
                  offload_depth: int = 2,
+                 stream_layers: bool = False,
+                 comp_resident: bool = True,
+                 conservative_fetch: bool = False,
                  update_scan: bool = False,
                  unroll_layers: Optional[bool] = None,
                  free_eager: bool = False):
@@ -144,10 +148,57 @@ class HybridPipelineTrainer:
         if offload_params and not self.amp:
             raise ValueError("offload_params requires strategy.amp (the "
                              "compute copies are bf16)")
+        # stream_layers (MEMO_SCALING_r05 enabler, VERDICT r4 next #7):
+        # host-offloaded state is stored PER-LAYER (lists, not one
+        # stacked array) and the update python-unrolls over layers
+        # behind a depth-``offload_depth`` optimization_barrier chain —
+        # layer k+1's host→HBM fetch overlaps layer k's f32 update while
+        # layer k−1's new master streams back. With offload_params the
+        # forward also runs on PERSISTENT bf16 compute copies carried as
+        # trainer state, eliminating the whole-model master fetch+cast
+        # the whole-group path pays at the top of every step. Bounded
+        # HBM: offload_depth layers' f32 working sets instead of a whole
+        # stacked group (the 2.7B wall in MEMO_SCALING_r05.md).
+        self.stream_layers = bool(stream_layers)
+        # comp_resident (stream_layers + offload_params only): keep the
+        # bf16 compute copies as persistent trainer state (fast path —
+        # no forward-side master traffic). False streams the forward
+        # copies per-layer from the host masters inside the program
+        # instead: per-step host traffic grows by one master read, but
+        # the program has ~zero HBM *arguments* — needed at 2.7B where
+        # this toolchain's compile-time accounting charges resident
+        # argument state on top of the (aliased) program requirement.
+        self.comp_resident = bool(comp_resident)
+        # conservative_fetch (stream_layers): additionally gate every
+        # host fetch on the layer's GRADIENT, serializing fetches
+        # behind backward. Lower peak HBM (no fetch overlaps fwd/bwd)
+        # at the cost of the overlap — the knob that fits 1.9B on one
+        # v5e, where the free schedule's ~1 GB of early-fetch
+        # working set pushes past the 15.75 GB budget (measured:
+        # 1.3B free 0.4295 @ 15.0 GB vs conservative 0.414 @ 4.9 GB).
+        self.conservative_fetch = bool(conservative_fetch)
+        if self.stream_layers:
+            if not (offload_params or offload_optimizer):
+                raise ValueError(
+                    "stream_layers requires offload_params and/or "
+                    "offload_optimizer (it schedules host streams)")
+            if self.v != 1:
+                raise ValueError(
+                    "stream_layers supports v_virtual == 1 (per-layer "
+                    "groups assume the [pp, lps, ...] stacking)")
+        # PADDLE_TPU_FAKE_PINNED_HOST=1 (tests only): XLA:CPU has no
+        # pinned_host memory space, so the virtual-mesh tests exercise
+        # the full streaming program structure with both "spaces"
+        # mapped to default device memory — placement differs, math and
+        # schedule constraints are identical.
+        if os.environ.get("PADDLE_TPU_FAKE_PINNED_HOST") == "1":
+            self._host_kind, self._dev_kind = None, None
+        else:
+            self._host_kind, self._dev_kind = "pinned_host", "device"
         self.unroll_layers = unroll_layers
 
         self._param_ns = lambda sp: NamedSharding(
-            self.mesh, sp, memory_kind="pinned_host") \
+            self.mesh, sp, memory_kind=self._host_kind) \
             if self.offload_params else NamedSharding(self.mesh, sp)
 
         blocks = list(model.pipeline_blocks())
@@ -201,6 +252,11 @@ class HybridPipelineTrainer:
         # (c·pp + s)·lps_v .. +lps_v — the circular assignment)
         self.block_vals: Dict[str, jax.Array] = {}
         self.block_specs: Dict[str, P] = {}
+        # stream_layers: per-layer piece specs [pp, ...] and, with
+        # offload_params, persistent bf16 compute copies (trainer state)
+        self.block_layer_specs: Dict[str, P] = {}
+        self.block_comp: Dict[str, jax.Array] = {}
+        self.other_comp: List[jax.Array] = []
         for j, sfx in enumerate(self.block_suffixes):
             base = per_block_tensors[0][j]._value
             if self.v == 1:
@@ -210,6 +266,65 @@ class HybridPipelineTrainer:
                 lps_v = self.lps // self.v
                 full_shape = (self.pp, self.v, lps_v) + tuple(base.shape)
                 extra = (None, None)
+            spec0 = base_specs[self._blk0_fullnames[j]]
+            pp_ax = "pp" if "pp" in self.mesh.axis_names else None
+            spec = P(pp_ax, *extra, *spec0)
+            if self.zero >= 3:
+                shape = _local_check_shape(full_shape, spec, self.mesh)
+                spec = _add_axis(spec, len(full_shape), shape, "dp", dp)
+            self.block_specs[sfx] = spec
+            dt = base.dtype
+            if self.param_dtype is not None and \
+                    jnp.issubdtype(dt, jnp.floating):
+                dt = self.param_dtype
+            if self.stream_layers:
+                lspec = P(pp_ax, *spec0)
+                pshape = (self.pp,) + tuple(base.shape)
+                if self.zero >= 3:
+                    lshape = _local_check_shape(pshape, lspec, self.mesh)
+                    lspec = _add_axis(lspec, len(pshape), lshape, "dp", dp)
+                self.block_layer_specs[sfx] = lspec
+            if self.stream_layers and self.offload_params:
+                # per-layer host masters + one resident bf16 compute
+                # stack. The full f32 stack is never materialized on
+                # device (at 2.7B it would not fit next to the eager
+                # params), and eager buffers are freed suffix-by-suffix
+                # so the init peak declines as the comp copies grow.
+                fl = jnp.issubdtype(dt, jnp.floating)
+                cdt = jnp.bfloat16 if fl else dt
+                lns = self._param_ns(self.block_layer_specs[sfx])
+                pshape = (self.pp,) + tuple(base.shape)
+                if self.abstract:
+                    self.block_vals[sfx] = [
+                        jax.ShapeDtypeStruct(pshape, dt, sharding=lns)
+                        for _ in range(self.lps)]
+                    if self.comp_resident:
+                        self.block_comp[sfx] = jax.ShapeDtypeStruct(
+                            full_shape, cdt,
+                            sharding=NamedSharding(self.mesh, spec))
+                else:
+                    pieces, comp_pieces = [], []
+                    for i in range(self.lps):
+                        vals = [per_block_tensors[s * self.lps + i][j]
+                                ._value for s in range(self.pp)]
+                        piece = jnp.stack(vals, 0)
+                        if dt != piece.dtype:
+                            piece = piece.astype(dt)
+                        pieces.append(jax.device_put(piece, lns))
+                        if self.comp_resident:
+                            comp_pieces.append(piece.astype(cdt))
+                    self.block_vals[sfx] = pieces
+                    if self.comp_resident:
+                        self.block_comp[sfx] = jax.device_put(
+                            jnp.stack(comp_pieces, 1),
+                            NamedSharding(self.mesh, spec))
+                    if free_eager:
+                        for i in range(L):
+                            t = per_block_tensors[i][j]
+                            if t._value is not None:
+                                t._value.delete()
+                                t._value = None
+                continue
             if self.abstract:
                 stacked = jax.ShapeDtypeStruct(full_shape, base.dtype)
             else:
@@ -222,17 +337,6 @@ class HybridPipelineTrainer:
                     stacked = stacked.reshape(
                         (self.v, self.pp, lps_v) + per_layer[0].shape)
                     stacked = jnp.swapaxes(stacked, 0, 1)  # [pp,v,lps_v,...]
-            spec0 = base_specs[self._blk0_fullnames[j]]
-            pp_ax = "pp" if "pp" in self.mesh.axis_names else None
-            spec = P(pp_ax, *extra, *spec0)
-            if self.zero >= 3:
-                shape = _local_check_shape(stacked.shape, spec, self.mesh)
-                spec = _add_axis(spec, stacked.ndim, shape, "dp", dp)
-            self.block_specs[sfx] = spec
-            dt = stacked.dtype
-            if self.param_dtype is not None and \
-                    jnp.issubdtype(dt, jnp.floating):
-                dt = self.param_dtype
             if self.abstract:
                 self.block_vals[sfx] = jax.ShapeDtypeStruct(
                     full_shape, dt, sharding=self._param_ns(spec))
@@ -256,12 +360,24 @@ class HybridPipelineTrainer:
             if self.param_dtype is not None and \
                     jnp.issubdtype(dt, jnp.floating):
                 dt = self.param_dtype
+            stream_comp = self.stream_layers and self.offload_params \
+                and self.comp_resident
+            if stream_comp:
+                cdt = jnp.bfloat16 if jnp.issubdtype(dt, jnp.floating) \
+                    else dt
             if self.abstract:
                 self.other_vals.append(jax.ShapeDtypeStruct(
                     tuple(v.shape), dt, sharding=self._param_ns(spec)))
+                if stream_comp:
+                    self.other_comp.append(jax.ShapeDtypeStruct(
+                        tuple(v.shape), cdt,
+                        sharding=NamedSharding(self.mesh, spec)))
             else:
                 if dt != v.dtype:
                     v = v.astype(dt)
+                if stream_comp:
+                    self.other_comp.append(jax.device_put(
+                        v.astype(cdt), NamedSharding(self.mesh, spec)))
                 self.other_vals.append(jax.device_put(
                     v, self._param_ns(spec)))
 
@@ -284,7 +400,7 @@ class HybridPipelineTrainer:
                     for k, v in s.items()}
 
         self._opt_ns = lambda sp: NamedSharding(
-            self.mesh, sp, memory_kind="pinned_host") \
+            self.mesh, sp, memory_kind=self._host_kind) \
             if self.offload_optimizer else NamedSharding(self.mesh, sp)
 
         def init_opt_state(v, sp):
@@ -310,6 +426,28 @@ class HybridPipelineTrainer:
         self.block_opt: Dict[str, dict] = {}
         self.block_opt_specs: Dict[str, dict] = {}
         for sfx, v in self.block_vals.items():
+            if self.stream_layers and self.offload_optimizer:
+                # per-layer host-resident optimizer state (lists of
+                # dicts, parallel to the per-layer masters)
+                if isinstance(v, list):
+                    pav = jax.ShapeDtypeStruct(tuple(v[0].shape),
+                                               v[0].dtype)
+                else:
+                    pav = jax.ShapeDtypeStruct(
+                        (v.shape[0],) + tuple(v.shape[2:]), v.dtype)
+                sp = opt_state_spec(self.block_layer_specs[sfx],
+                                    pav.shape, len(pav.shape))
+                lst = [init_opt_state(pav, sp) for _ in range(self.lps)]
+                self.block_opt[sfx] = lst
+                self.block_opt_specs[sfx] = {k: sp for k in lst[0]}
+                continue
+            if isinstance(v, list):
+                # stream_layers+offload_params with RESIDENT moments:
+                # stacked state from the stacked master aval
+                # (_init_state is shape-only; no f32 stack materializes)
+                v = jax.ShapeDtypeStruct(
+                    (self.pp, self.lps) + tuple(v[0].shape[1:]),
+                    v[0].dtype)
             sp = opt_state_spec(self.block_specs[sfx], v.shape, v.ndim)
             s = init_opt_state(v, sp)
             self.block_opt[sfx] = s
@@ -335,8 +473,9 @@ class HybridPipelineTrainer:
             # deleting would kill the trainer's own state.
             for ts in per_block_tensors:
                 for t in ts:
-                    t._value.delete()
-                    t._value = None
+                    if t._value is not None:   # stream path freed it
+                        t._value.delete()
+                        t._value = None
             for n, v in zip(self.other_names, self.other_vals):
                 t = name2t[n]
                 if t._value.dtype != v.dtype:
@@ -479,12 +618,38 @@ class HybridPipelineTrainer:
                     loss = loss + Tensor(aux)
         return loss._value.astype(jnp.float32)
 
-    def _build(self, n_batch_args: int):
-        from .strategy_compiler import functional_clip, make_param_update
+    def _cast_back(self, np_, ns, store_p_dtype, store_s):
+        """Shared storage-dtype rule for both update builders: the f32
+        update result is stored back at the configured param/moment
+        dtypes (param_dtype/moment_dtype knobs)."""
+        if self.param_dtype is not None and \
+                jnp.issubdtype(store_p_dtype, jnp.floating):
+            np_ = np_.astype(store_p_dtype)
+        if self.moment_dtype is not None:
+            ns = {k: v.astype(store_s[k].dtype)
+                  if jnp.issubdtype(v.dtype, jnp.floating) else v
+                  for k, v in ns.items()}
+        return np_, ns
+
+    def _make_batch_spec(self):
+        """Batch-arg sharding: dim 0 over dp, dim 1 over sp when present
+        (shared by both step builders)."""
+        sp = self.mesh.shape.get("sp", 1)
+
+        def batch_spec(ndim):
+            if ndim >= 2 and sp > 1:
+                return P("dp", "sp")
+            return P("dp") if ndim >= 1 else P()
+
+        return batch_spec
+
+    def _update_ctx(self):
+        """Shared update-builder prologue: the per-parameter update fn,
+        clip, and the per-suffix/per-other lr & decoupled-wd tables
+        (used identically by _build and _build_stream)."""
+        from .strategy_compiler import make_param_update
 
         opt = self.optimizer
-        clip = opt._grad_clip
-        mesh = self.mesh
         wd_other = tuple(opt._decoupled_wd(self._name2tensor[n])
                          for n in self.other_names)
         lr_other = tuple(
@@ -495,9 +660,18 @@ class HybridPipelineTrainer:
         lr_block = {s: t.optimize_attr.get("learning_rate", 1.0)
                     for s, t in zip(self.block_suffixes,
                                     self._blk0_tensors)}
-        upd = make_param_update(opt)
+        return (make_param_update(opt), opt._grad_clip, wd_other,
+                lr_other, wd_block, lr_block)
 
-        pdt, mdt = self.param_dtype, self.moment_dtype
+    def _build(self, n_batch_args: int):
+        if self.stream_layers:
+            return self._build_stream(n_batch_args)
+        from .strategy_compiler import functional_clip
+
+        upd, clip, wd_other, lr_other, wd_block, lr_block = \
+            self._update_ctx()
+        mesh = self.mesh
+
         offload = self.offload_optimizer
         mesh_ = self.mesh
 
@@ -508,7 +682,8 @@ class HybridPipelineTrainer:
             if not offload:
                 return s
             return {k: jax.device_put(
-                v, NamedSharding(mesh_, spec[k], memory_kind="device"))
+                v, NamedSharding(mesh_, spec[k],
+                                 memory_kind=self._dev_kind))
                 for k, v in s.items()}
 
         offload_p = self.offload_params
@@ -525,14 +700,7 @@ class HybridPipelineTrainer:
         def core_upd(p, g, s_dev, lr, step_no, plr, wd, store_p_dtype,
                      store_s):
             np_, ns = upd(p, g, s_dev, lr, step_no, plr=plr, wd=wd)
-            if pdt is not None and jnp.issubdtype(store_p_dtype,
-                                                  jnp.floating):
-                np_ = np_.astype(store_p_dtype)
-            if mdt is not None:
-                ns = {k: v.astype(store_s[k].dtype)
-                      if jnp.issubdtype(v.dtype, jnp.floating) else v
-                      for k, v in ns.items()}
-            return np_, ns
+            return self._cast_back(np_, ns, store_p_dtype, store_s)
 
         def upd2(p, g, s, spec, lr, step_no, plr, wd, pspec=None,
                  stacked=False):
@@ -540,7 +708,7 @@ class HybridPipelineTrainer:
             (+ host placement handled by out_shardings when offloading)."""
             if offload_p:
                 p = jax.device_put(p, NamedSharding(
-                    mesh_, pspec, memory_kind="device"))
+                    mesh_, pspec, memory_kind=self._dev_kind))
             s_dev = fetch_state(s, spec)
             if scan_update and stacked and p.ndim >= 3:
                 lead = p.shape[0] * p.shape[1]
@@ -569,7 +737,7 @@ class HybridPipelineTrainer:
                 # compute copies (half the grad HBM of the f32 path)
                 def dev_cast(v, spec):
                     v = jax.device_put(v, NamedSharding(
-                        mesh_, spec, memory_kind="device"))
+                        mesh_, spec, memory_kind=self._dev_kind))
                     return v.astype(jnp.bfloat16) \
                         if jnp.issubdtype(v.dtype, jnp.floating) else v
                 bp_c = {k: dev_cast(v, self.block_specs[k])
@@ -641,14 +809,7 @@ class HybridPipelineTrainer:
                       for k, v in self.block_opt_specs.items()}
         oth_opt_sh = [{kk: ons(vv) for kk, vv in d.items()}
                       for d in self.other_opt_specs]
-        sp = mesh.shape.get("sp", 1)
-
-        def batch_spec(ndim):
-            if ndim >= 2 and sp > 1:
-                return P("dp", "sp")
-            return P("dp") if ndim >= 1 else P()
-
-        self._batch_spec = batch_spec
+        self._batch_spec = self._make_batch_spec()
         self._step_fn = jax.jit(
             step_fn,
             in_shardings=(blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
@@ -656,6 +817,218 @@ class HybridPipelineTrainer:
             out_shardings=(ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh),
             donate_argnums=(0, 1, 2, 3))
         self._n_batch_args = n_batch_args
+
+    def _build_stream(self, n_batch_args: int):
+        """stream_layers step: per-layer host↔HBM streaming update.
+
+        One pjit program; ordering comes from a depth-``offload_depth``
+        optimization_barrier chain seeded on the step counter, so the
+        first ``depth`` layer fetches launch at program start and hide
+        under forward/backward, after which fetch k waits on update
+        k−depth (not on its writeback):
+
+            fetch layer k+1 (host→HBM) ∥ f32 update layer k ∥
+            writeback layer k−1 (HBM→host)
+
+        With offload_params the forward/backward run on PERSISTENT bf16
+        compute copies carried as trainer state and rebuilt by each
+        update, so per-step host traffic is exactly one master read +
+        one master write — the whole-group path's additional whole-
+        model master fetch+cast at the top of every step is gone.
+        Reference analogue: the staged ZeRO-Offload update
+        (reference: python/paddle/incubate/optimizer/distributed_fused_lamb.py,
+        paddle/fluid/operators/optimizers/distributed_fused_lamb_op.cc),
+        scheduled here by XLA instead of CUDA streams."""
+        from .strategy_compiler import functional_clip
+
+        upd, clip, wd_other, lr_other, wd_block, lr_block = \
+            self._update_ctx()
+        mesh = self.mesh
+        offload_p = self.offload_params
+        offload_o = self.offload_optimizer
+        depth = self.offload_depth
+        devk = self._dev_kind
+        lps = self.lps
+        sfx_list = list(self.block_suffixes)
+
+        def to_dev(v, spec):
+            return jax.device_put(
+                v, NamedSharding(mesh, spec, memory_kind=devk))
+
+        def bf16_of(v):
+            return v.astype(jnp.bfloat16) \
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+
+        def one_group(pm, g, s, gate, p_spec, s_specs, plr, wd, lr,
+                      step_no):
+            """Barrier-gated fetch → f32 update → storage-dtype cast for
+            one parameter group (one layer's suffix, or one 'other').
+
+            By default only the HOST-RESIDENT operands (pm, s) are tied
+            to the gate: including g would chain the fetch to the
+            gradient, which the layer-scan backward produces only at
+            its end — serializing every fetch behind backward (the r4
+            behavior this rework removes). g is device-resident and
+            needs no gating; the update itself waits on it naturally.
+            conservative_fetch opts back into the grad gate where the
+            free schedule's early-fetch working set exceeds HBM."""
+            if self.conservative_fetch:
+                (pm, g, _), s = jax.lax.optimization_barrier(
+                    ((pm, g, gate), s))
+            else:
+                (pm, _), s = jax.lax.optimization_barrier(
+                    ((pm, gate), s))
+            pm_d = to_dev(pm, p_spec) if offload_p and p_spec is not None \
+                else pm
+            s_d = {k: to_dev(v, s_specs[k]) for k, v in s.items()} \
+                if offload_o and s_specs is not None else s
+            np_, ns = upd(pm_d, g, s_d, lr, step_no, plr=plr, wd=wd)
+            return self._cast_back(np_, ns, pm.dtype, s)
+
+        comp_res = self.comp_resident
+
+        def step_fn(blk_m, oth_m, blk_c, oth_c, blk_o, oth_o,
+                    batch, lr, step_no, key):
+            if offload_p and not comp_res:
+                # no persistent compute copies: stream the forward's
+                # bf16 copies per-layer from the host masters, chained
+                # so ≤depth f32 pieces are in flight (the zero-argument
+                # layout — see comp_resident in __init__)
+                fchain = [step_no] * depth
+                bl = {s: [None] * lps for s in sfx_list}
+                for i in range(lps):
+                    gate = fchain.pop(0)
+                    last = gate
+                    for sfx in sfx_list:
+                        (pm, _) = jax.lax.optimization_barrier(
+                            (blk_m[sfx][i], gate))
+                        c = bf16_of(to_dev(
+                            pm, self.block_layer_specs[sfx]))
+                        bl[sfx][i] = c
+                        last = c
+                    fchain.append(last)
+                bp = {s: jax.lax.with_sharding_constraint(
+                    jnp.stack(bl[s], 1),
+                    NamedSharding(mesh, self.block_specs[s]))
+                    for s in sfx_list}
+                op = [bf16_of(to_dev(oth_m[idx], self.other_specs[idx]))
+                      for idx in range(len(oth_m))]
+            elif offload_p:
+                bp, op = blk_c, oth_c
+            else:
+                bp, op = blk_m, oth_m
+
+            def loss_of(b, o):
+                return self._forward_loss(b, o, batch, key)
+
+            loss, (g_blk, g_oth) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(bp, op)
+            g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
+
+            chain = [step_no] * depth
+            new_m = {s: [None] * lps for s in sfx_list}
+            new_c = {s: [None] * lps for s in sfx_list}
+            new_o = {s: [None] * lps for s in sfx_list}
+            for i in range(lps):
+                gate = chain.pop(0)
+                last = gate
+                for sfx in sfx_list:
+                    if offload_p:
+                        pm = blk_m[sfx][i]
+                    else:
+                        pm = jax.lax.index_in_dim(blk_m[sfx], i, 1,
+                                                  keepdims=False)
+                    g = jax.lax.index_in_dim(g_blk[sfx], i, 1,
+                                             keepdims=False)
+                    if offload_o:
+                        s = blk_o[sfx][i]
+                    else:
+                        s = {k: jax.lax.index_in_dim(v, i, 1,
+                                                     keepdims=False)
+                             for k, v in blk_o[sfx].items()}
+                    np_, ns = one_group(
+                        pm, g, s, gate, self.block_layer_specs[sfx],
+                        self.block_opt_specs[sfx] if offload_o else None,
+                        lr_block[sfx], wd_block[sfx], lr, step_no)
+                    new_m[sfx][i] = np_
+                    if offload_p and comp_res:
+                        new_c[sfx][i] = bf16_of(np_)
+                    new_o[sfx][i] = ns
+                    last = np_
+                chain.append(last)
+
+            new_oth_m, new_oth_c, new_oth_o = [], [], []
+            for idx in range(len(oth_m)):
+                gate = chain.pop(0)
+                np_, ns = one_group(
+                    oth_m[idx], g_oth[idx], oth_o[idx], gate,
+                    self.other_specs[idx],
+                    self.other_opt_specs[idx] if offload_o else None,
+                    lr_other[idx], wd_other[idx], lr, step_no)
+                new_oth_m.append(np_)
+                if offload_p and comp_res:
+                    new_oth_c.append(bf16_of(np_))
+                new_oth_o.append(ns)
+                chain.append(np_)
+
+            if offload_p:
+                out_blk_m = new_m
+                out_blk_c = {s: jnp.stack(new_c[s], 1)
+                             for s in sfx_list} if comp_res else {}
+            else:
+                out_blk_m = {s: jnp.stack(new_m[s], 1) for s in sfx_list}
+                out_blk_c = {}
+            if offload_o:
+                out_blk_o = new_o
+            else:
+                out_blk_o = {s: {k: jnp.stack(
+                    [new_o[s][i][k] for i in range(lps)], 1)
+                    for k in blk_o[s]} for s in sfx_list}
+            return (loss, out_blk_m, new_oth_m, out_blk_c, new_oth_c,
+                    out_blk_o, new_oth_o)
+
+        ns = lambda spec: NamedSharding(mesh, spec)  # noqa: E731
+        pns = self._param_ns
+        ons = self._opt_ns
+        if offload_p:
+            blk_m_sh = {s: [pns(self.block_layer_specs[s])] * lps
+                        for s in sfx_list}
+            if comp_res:
+                blk_c_sh = {s: ns(self.block_specs[s])
+                            for s in sfx_list}
+                oth_c_sh = [ns(sp) for sp in self.other_specs]
+            else:
+                blk_c_sh, oth_c_sh = {}, []
+        else:
+            blk_m_sh = {s: pns(self.block_specs[s]) for s in sfx_list}
+            blk_c_sh, oth_c_sh = {}, []
+        oth_m_sh = [pns(sp) for sp in self.other_specs]
+        if offload_o:
+            blk_o_sh = {s: [{k: ons(v) for k, v in
+                             self.block_opt_specs[s].items()}] * lps
+                        for s in sfx_list}
+        else:
+            blk_o_sh = {s: {k: ons(v) for k, v in
+                            self.block_opt_specs[s].items()}
+                        for s in sfx_list}
+        oth_o_sh = [{k: ons(v) for k, v in d.items()}
+                    for d in self.other_opt_specs]
+        self._batch_spec = self._make_batch_spec()
+        self._step_fn = jax.jit(
+            step_fn,
+            in_shardings=(blk_m_sh, oth_m_sh, blk_c_sh, oth_c_sh,
+                          blk_o_sh, oth_o_sh, None, None, None, None),
+            out_shardings=(ns(P()), blk_m_sh, oth_m_sh, blk_c_sh,
+                           oth_c_sh, blk_o_sh, oth_o_sh),
+            donate_argnums=(0, 1, 2, 3, 4, 5))
+        self._n_batch_args = n_batch_args
+
+    def _state_args(self):
+        if self.stream_layers:
+            return (self.block_vals, self.other_vals, self.block_comp,
+                    self.other_comp, self.block_opt, self.other_opt)
+        return (self.block_vals, self.other_vals, self.block_opt,
+                self.other_opt)
 
     def step(self, *batch) -> jax.Array:
         from ..core import rng as rng_mod
@@ -675,11 +1048,15 @@ class HybridPipelineTrainer:
             vs.append(jax.device_put(v, NamedSharding(
                 self.mesh, self._batch_spec(v.ndim))))
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        loss, self.block_vals, self.other_vals, self.block_opt, \
-            self.other_opt = self._step_fn(
-                self.block_vals, self.other_vals, self.block_opt,
-                self.other_opt, tuple(vs), lr,
-                jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+        out = self._step_fn(
+            *self._state_args(), tuple(vs), lr,
+            jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+        if self.stream_layers:
+            (loss, self.block_vals, self.other_vals, self.block_comp,
+             self.other_comp, self.block_opt, self.other_opt) = out
+        else:
+            (loss, self.block_vals, self.other_vals, self.block_opt,
+             self.other_opt) = out
         self.optimizer._global_step = self._step
         return loss
 
@@ -714,20 +1091,27 @@ class HybridPipelineTrainer:
             def nbytes(v):
                 return int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
 
+            leaves = jax.tree_util.tree_leaves
             if self.offload_params:
-                host += sum(nbytes(v) for v in self.block_vals.values())
-                host += sum(nbytes(v) for v in self.other_vals)
+                host += sum(nbytes(v) for v in leaves(self.block_vals))
+                host += sum(nbytes(v) for v in leaves(self.other_vals))
             if self.offload_optimizer:
-                host += sum(nbytes(v) for s in self.block_opt.values()
-                            for v in s.values())
-                host += sum(nbytes(v) for s in self.other_opt
-                            for v in s.values())
+                host += sum(nbytes(v) for v in leaves(self.block_opt))
+                host += sum(nbytes(v) for v in leaves(self.other_opt))
             out["host_resident_argument_bytes"] = host
-            out["hbm_argument_bytes"] = max(
-                out.get("argument_size_in_bytes", 0) - host, 0)
-            if "peak_bytes_est" in out:
-                out["hbm_peak_bytes_est"] = max(
-                    out["peak_bytes_est"] - host, 0)
+            args = out.get("argument_size_in_bytes", 0)
+            if args >= host:
+                out["hbm_argument_bytes"] = args - host
+                if "peak_bytes_est" in out:
+                    out["hbm_peak_bytes_est"] = max(
+                        out["peak_bytes_est"] - host, 0)
+            else:
+                # this toolchain build already excluded host-space args
+                # from its per-space totals — subtracting again would
+                # double-count (seen at 1.9B: args < host bytes)
+                out["hbm_argument_bytes"] = args
+                if "peak_bytes_est" in out:
+                    out["hbm_peak_bytes_est"] = out["peak_bytes_est"]
         return out
 
     def aot_lower(self, *batch):
@@ -750,8 +1134,7 @@ class HybridPipelineTrainer:
         # constant key: only avals matter for lowering, and a diagnostic
         # must not advance the training RNG stream
         return self._step_fn.lower(
-            self.block_vals, self.other_vals, self.block_opt,
-            self.other_opt, tuple(vs),
+            *self._state_args(), tuple(vs),
             jax.ShapeDtypeStruct((), jnp.float32),
             jax.ShapeDtypeStruct((), jnp.int32),
             jax.ShapeDtypeStruct((2,), jnp.uint32))
@@ -765,15 +1148,37 @@ class HybridPipelineTrainer:
         (params + optimizer state), for distributed.checkpoint.save."""
         return {"block": dict(self.block_vals),
                 "other": list(self.other_vals),
-                "block_opt": {k: dict(v) for k, v in self.block_opt.items()},
+                "block_opt": {k: list(v) if isinstance(v, list)
+                              else dict(v)
+                              for k, v in self.block_opt.items()},
                 "other_opt": [dict(d) for d in self.other_opt]}
 
     def load_device_state(self, st, step: Optional[int] = None):
         """Inverse of device_state (resume-exact: same values, shardings)."""
         self.block_vals = dict(st["block"])
         self.other_vals = list(st["other"])
-        self.block_opt = {k: dict(v) for k, v in st["block_opt"].items()}
+        self.block_opt = {k: list(v) if isinstance(v, list) else dict(v)
+                          for k, v in st["block_opt"].items()}
         self.other_opt = [dict(d) for d in st["other_opt"]]
+        if self.stream_layers and self.offload_params \
+                and self.comp_resident:
+            # the bf16 compute copies are derived state (comp ≡
+            # bf16(master) after every update) — rebuild, don't persist
+            def dev_bf16(p, spec):
+                d = jax.device_put(p, NamedSharding(self.mesh, spec))
+                return d.astype(jnp.bfloat16) \
+                    if jnp.issubdtype(d.dtype, jnp.floating) else d
+
+            self.block_comp = {
+                sfx: jax.device_put(
+                    jnp.stack([dev_bf16(p, self.block_layer_specs[sfx])
+                               for p in pieces], 1),
+                    NamedSharding(self.mesh, self.block_specs[sfx]))
+                for sfx, pieces in self.block_vals.items()}
+            self.other_comp = [
+                jax.device_put(dev_bf16(v, spec),
+                               NamedSharding(self.mesh, spec))
+                for v, spec in zip(self.other_vals, self.other_specs)]
         if step is not None:
             self._step = int(step)
             self.optimizer._global_step = int(step)
@@ -785,6 +1190,13 @@ class HybridPipelineTrainer:
         L = self.n_layers
 
         def unstack(a):
+            if isinstance(a, list):
+                # stream_layers per-layer pieces [pp, ...] → [pp, lps, ..]
+                a = jnp.stack(
+                    [jax.device_put(
+                        p, NamedSharding(self.mesh, p.sharding.spec))
+                     if getattr(p.sharding, "memory_kind", None)
+                     == "pinned_host" else p for p in a], 1)
             if getattr(a.sharding, "memory_kind", None) == "pinned_host":
                 a = jax.device_put(
                     a, NamedSharding(self.mesh, a.sharding.spec))
@@ -796,8 +1208,11 @@ class HybridPipelineTrainer:
         for sfx_i, sfx in enumerate(self.block_suffixes):
             stacked = self.block_vals[sfx]
             flat = unstack(stacked)
-            opt_flat = {k: unstack(v)
-                        for k, v in self.block_opt[sfx].items()}
+            opt_src = self.block_opt[sfx]
+            if isinstance(opt_src, list):   # stream per-layer dicts
+                opt_src = {k: [d[k] for d in opt_src]
+                           for k in opt_src[0]}
+            opt_flat = {k: unstack(v) for k, v in opt_src.items()}
             for i in range(L):
                 t = self._per_block_tensors[i][sfx_i]
                 t._value = flat[i]

@@ -227,6 +227,25 @@ class PTQ:
         return model
 
 
+def _int8_pallas_enabled() -> bool:
+    """Fused Pallas int8 kernel gate (ops/int8_matmul.py) —
+    OPT-IN via PADDLE_TPU_INT8_PALLAS=1, default off.
+
+    Measured on v5e (r5, batch 4096 × d4096 × ffn16384): XLA's own
+    int8×int8→int32 matmul runs at ~181 Tops (~46% of int8 peak) and
+    already beats bf16 by 1.75×; the Mosaic kernel reaches only
+    ~103 Tops on this libtpu (the int8 dot does not hit the native MXU
+    int8 path, and larger tilings crash the remote compile helper), so
+    fusing the epilogue costs more than the saved HBM traffic. The
+    kernel + chain-fusion machinery stay (tested in interpret mode,
+    bit-identical math) for when Mosaic's int8 lowering matures; the
+    default deploy path is the unfused-XLA expression below. Decided at
+    TRACE time: the artifact bakes whichever path exported it."""
+    import os
+
+    return os.environ.get("PADDLE_TPU_INT8_PALLAS") == "1"
+
+
 class Int8Linear(Layer):
     """Deploy-time int8 linear — the compute is ACTUALLY int8, not
     dequantize-then-f32 (reference handoff: slim's quantized program runs
@@ -260,9 +279,53 @@ class Int8Linear(Layer):
         self.register_buffer("act_scale", Tensor(
             jnp.asarray(float(act_scale), jnp.float32)))
         self.bias = inner.bias
+        # set by _fuse_sequential_int8 (Sequential-only pattern pass):
+        # apply ReLU + re-quantize to the NEXT int8 layer's scale inside
+        # the fused kernel epilogue, emitting int8 directly. _int8_src
+        # points a consumer back at its producer so the chain's final
+        # output keeps the ORIGINAL float dtype (int8 carries none).
+        self._fuse_relu = False
+        self._next_scale: Optional[Tensor] = None
+        self._int8_src: Optional["Int8Linear"] = None
+        self._last_float_dtype = None
 
     def forward(self, x):
         wmax, amax = self._wmax, self._amax
+        xv = x._value if isinstance(x, Tensor) else x
+        if _int8_pallas_enabled() and xv.ndim >= 2 and (
+                xv.dtype == jnp.int8
+                or jnp.issubdtype(xv.dtype, jnp.floating)):
+            # fused Pallas path (ops/int8_matmul.py): quantize + MXU
+            # int8 dot + dequant/bias[/ReLU/requant] in one kernel
+            from ..ops.int8_matmul import int8_linear_fused
+
+            has_bias = self.bias is not None
+            fuse_relu, nscale = self._fuse_relu, self._next_scale
+            if jnp.issubdtype(xv.dtype, jnp.floating):
+                odt = self._last_float_dtype = xv.dtype
+            else:
+                # int8 input from a chain-fused producer: restore the
+                # float dtype the producer saw at trace time, so the
+                # fused artifact's output dtype matches the unfused one
+                # (stored forward so 3+-layer chains propagate it too)
+                odt = getattr(self._int8_src, "_last_float_dtype",
+                              None) or jnp.float32
+                self._last_float_dtype = odt
+
+            def f(xv_, wq, ws, sa, *rest):
+                b = rest[0] if has_bias else None
+                ns = rest[-1] if nscale is not None else None
+                return int8_linear_fused(
+                    xv_, wq, ws, sa, b, wmax=wmax, amax=amax,
+                    relu=fuse_relu, next_act_scale=ns, out_dtype=odt)
+
+            args = (x, self.weight_q, self.w_scale, self.act_scale)
+            if has_bias:
+                args += (self.bias,)
+            if nscale is not None:
+                args += (nscale,)
+            return apply(f, *args, differentiable=False,
+                         name="int8_linear_fused")
 
         def f(xv, wq, ws, sa, *b):
             sa = jnp.maximum(sa, 1e-8)
@@ -385,6 +448,32 @@ class Int8Conv2D(Layer):
                         groups=self._groups)
 
 
+def _fuse_sequential_int8(seq) -> int:
+    """Inside an ``nn.Sequential`` (forward order == child order by
+    construction — the only container where the pattern is provably
+    sequential), chain Int8Linear→ReLU→Int8Linear triples: the first
+    linear applies the ReLU and re-quantizes straight to the second's
+    int8 input inside the fused kernel epilogue, so the f32
+    intermediate never reaches HBM. The interposed ReLU child stays in
+    place (identity on the non-negative int8 values), and on the
+    unfused fallback path the flags are ignored — semantics are
+    preserved either way. Reference analogue: TensorRT's
+    quant-fused GEMM+activation in the slim int8 handoff."""
+    from ..nn.layer.activation import ReLU
+
+    kids = list(seq.named_children())
+    n = 0
+    for (_, c1), (_, c2), (_, c3) in zip(kids, kids[1:], kids[2:]):
+        if isinstance(c1, Int8Linear) and isinstance(c2, ReLU) \
+                and isinstance(c3, Int8Linear) \
+                and c1._next_scale is None and c1._amax == c3._amax:
+            c1._fuse_relu = True
+            c1._next_scale = c3.act_scale
+            c3._int8_src = c1
+            n += 1
+    return n
+
+
 def convert_to_int8_deploy(model: Layer, _undo=None) -> int:
     """Swap every QuantedLinear/QuantedConv2D for its deploy-time int8
     layer IN PLACE (destructive, like the reference's
@@ -420,6 +509,9 @@ def convert_to_int8_deploy(model: Layer, _undo=None) -> int:
             n += 1
         else:
             n += convert_to_int8_deploy(child, _undo)
+    from ..nn.layer.container import Sequential
+    if isinstance(model, Sequential):
+        _fuse_sequential_int8(model)
     return n
 
 

@@ -1,0 +1,163 @@
+"""Fused int8 matmul kernel (ops/int8_matmul.py) vs the unfused
+Int8Linear expression — same math to f32 rounding (same round-half-even, same
+clip bounds), so the fused serving path inherits QAT-eval parity.
+
+Runs in Pallas interpret mode on CPU; the hardware path is the same
+kernel compiled by Mosaic (bench.py predictor_int8 configs).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.int8_matmul import int8_linear_fused, int8_matmul
+
+
+def _unfused(x, wq, ws, sa, bias=None, wmax=127.0, amax=127.0):
+    """Int8Linear.forward's expression (quantization/__init__.py)."""
+    sa = jnp.maximum(sa, 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) * (amax / sa)),
+                  -amax, amax).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, wq, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (sa / amax) * \
+        (jnp.maximum(ws, 1e-8) / wmax)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out
+
+
+def _quantize_weights(w, wmax=127.0):
+    ws = np.max(np.abs(w), axis=0)
+    q = np.clip(np.round(w / np.maximum(ws, 1e-8) * wmax),
+                -wmax, wmax).astype(np.int8)
+    return jnp.asarray(q), jnp.asarray(ws, jnp.float32)
+
+
+class TestFusedMatchesUnfused:
+    def _setup(self, m=96, k=200, n=72, seed=0):
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray((rng.randn(m, k) * 0.5).astype(np.float32))
+        wq, ws = _quantize_weights(rng.randn(k, n).astype(np.float32))
+        b = jnp.asarray(rng.randn(n).astype(np.float32))
+        sa = jnp.asarray(float(np.abs(np.asarray(x)).max()), jnp.float32)
+        return x, wq, ws, b, sa
+
+    def test_basic_parity(self):
+        x, wq, ws, b, sa = self._setup()
+        want = _unfused(x, wq, ws, sa, b)
+        got = int8_linear_fused(x, wq, ws, sa, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_unaligned_shapes_pad_correctly(self):
+        x, wq, ws, b, sa = self._setup(m=67, k=130, n=45, seed=1)
+        want = _unfused(x, wq, ws, sa, b)
+        got = int8_linear_fused(x, wq, ws, sa, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_no_bias_and_3d_input(self):
+        rng = np.random.RandomState(2)
+        x3 = jnp.asarray((rng.randn(4, 24, 100) * 0.3)
+                         .astype(np.float32))
+        wq, ws = _quantize_weights(rng.randn(100, 56).astype(np.float32))
+        sa = jnp.asarray(0.9, jnp.float32)
+        want = _unfused(x3.reshape(-1, 100), wq, ws, sa) \
+            .reshape(4, 24, 56)
+        got = int8_linear_fused(x3, wq, ws, sa)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_fused_two_layer_chain_matches_unfused_chain(self):
+        """fc1(+ReLU, requant to int8) → fc2: the f32 intermediate never
+        exists; the chain equals the unfused Int8Linear→ReLU→Int8Linear
+        composition (fc2 quantizing the f32 ReLU output itself)."""
+        rng = np.random.RandomState(3)
+        m, d, h = 48, 64, 160
+        x = jnp.asarray((rng.randn(m, d) * 0.5).astype(np.float32))
+        w1q, w1s = _quantize_weights(rng.randn(d, h).astype(np.float32))
+        w2q, w2s = _quantize_weights(rng.randn(h, d).astype(np.float32))
+        b1 = jnp.asarray(rng.randn(h).astype(np.float32))
+        b2 = jnp.asarray(rng.randn(d).astype(np.float32))
+        sa1 = jnp.asarray(1.7, jnp.float32)
+        # unfused chain
+        y1 = jnp.maximum(_unfused(x, w1q, w1s, sa1, b1), 0.0)
+        sa2 = jnp.asarray(float(np.abs(np.asarray(y1)).max()),
+                          jnp.float32)
+        want = _unfused(y1, w2q, w2s, sa2, b2)
+        # fused chain: fc1 emits int8 directly at fc2's act scale
+        y1q = int8_linear_fused(x, w1q, w1s, sa1, b1, relu=True,
+                                next_act_scale=sa2)
+        assert y1q.dtype == jnp.int8
+        got = int8_linear_fused(y1q, w2q, w2s, sa2, b2)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_prequantized_int8_input(self):
+        """int8 x skips the in-kernel quantize but still dequants with
+        the caller's act scale."""
+        rng = np.random.RandomState(4)
+        xq = jnp.asarray(rng.randint(-127, 128, (32, 80), dtype=np.int8))
+        wq, ws = _quantize_weights(rng.randn(80, 40).astype(np.float32))
+        sa = jnp.asarray(2.5, jnp.float32)
+        acc = jax.lax.dot_general(xq, wq, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+        want = acc.astype(jnp.float32) * (sa / 127.0) * \
+            (jnp.maximum(ws, 1e-8) / 127.0)
+        got = int8_linear_fused(xq, wq, ws, sa)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-5)
+
+    def test_bf16_input(self):
+        x, wq, ws, b, sa = self._setup(seed=5)
+        xb = x.astype(jnp.bfloat16)
+        want = _unfused(xb, wq, ws, sa, b)
+        got = int8_linear_fused(xb, wq, ws, sa, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-5)
+
+
+class TestDeployIntegration:
+    """QAT → convert_to_int8_deploy on an nn.Sequential: the pallas
+    path (forced via PADDLE_TPU_INT8_PALLAS=1, interpret mode on CPU)
+    matches the unfused XLA path, and the Linear→ReLU→Linear triple is
+    chain-fused (fc1 emits int8 at fc2's activation scale)."""
+
+    def _deploy(self, seed=9):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import QAT, convert_to_int8_deploy
+
+        paddle.seed(seed)
+        net = nn.Sequential(nn.Linear(32, 64), nn.ReLU(),
+                            nn.Linear(64, 16))
+        QAT().quantize(net)
+        net.train()
+        x = np.random.RandomState(seed).randn(8, 32).astype(np.float32)
+        net(paddle.to_tensor(x))       # calibration forward
+        net.eval()
+        convert_to_int8_deploy(net)
+        return net, x
+
+    def test_fused_matches_unfused_deploy(self):
+        import os
+
+        import paddle_tpu as paddle
+        from paddle_tpu.quantization import Int8Linear
+
+        net, x = self._deploy()
+        # fusion pass wired fc1 → fc2
+        fc1 = next(c for _, c in net.named_children()
+                   if isinstance(c, Int8Linear))
+        assert fc1._fuse_relu and fc1._next_scale is not None
+        outs = {}
+        for flag in ("0", "1"):
+            os.environ["PADDLE_TPU_INT8_PALLAS"] = flag
+            try:
+                outs[flag] = np.asarray(
+                    net(paddle.to_tensor(x))._value)
+            finally:
+                os.environ.pop("PADDLE_TPU_INT8_PALLAS", None)
+        np.testing.assert_allclose(outs["1"], outs["0"],
+                                   rtol=1e-5, atol=1e-4)
