@@ -161,3 +161,36 @@ class TestDeployIntegration:
                 os.environ.pop("PADDLE_TPU_INT8_PALLAS", None)
         np.testing.assert_allclose(outs["1"], outs["0"],
                                    rtol=1e-5, atol=1e-4)
+
+    def test_three_layer_chain_preserves_float_dtype(self):
+        """3+ fused layers: the middle layer is int8-in/int8-out, and
+        _last_float_dtype must propagate through it so the chain's
+        final output keeps the original float dtype (bf16 here)."""
+        import os
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.quantization import (QAT, Int8Linear,
+                                             convert_to_int8_deploy)
+
+        paddle.seed(10)
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 32), nn.ReLU(),
+                            nn.Linear(32, 8))
+        QAT().quantize(net)
+        net.train()
+        x = np.random.RandomState(10).randn(4, 16).astype(np.float32)
+        net(paddle.to_tensor(x))
+        net.eval()
+        convert_to_int8_deploy(net)
+        linears = [c for _, c in net.named_children()
+                   if isinstance(c, Int8Linear)]
+        assert linears[0]._next_scale is not None    # fc1 -> fc2 fused
+        assert linears[1]._next_scale is not None    # fc2 -> fc3 fused
+        os.environ["PADDLE_TPU_INT8_PALLAS"] = "1"
+        try:
+            out = net(paddle.to_tensor(
+                jnp.asarray(x, jnp.bfloat16)))._value
+        finally:
+            os.environ.pop("PADDLE_TPU_INT8_PALLAS", None)
+        assert out.dtype == jnp.bfloat16, out.dtype
