@@ -89,6 +89,26 @@ class HybridPipelineTrainer:
             per parameter group before writing back to host. Requires
             amp. This is the full-fidelity path for models whose f32
             master + f32 grads cannot fit HBM (1.3B+ on one 16 GB v5e).
+        stream_layers: store host-offloaded state PER-LAYER and stream
+            it through HBM behind a depth-``offload_depth``
+            optimization_barrier chain (fetch layer k+1 ∥ f32 update
+            layer k ∥ writeback layer k−1; the first fetches hide
+            under forward/backward). With offload_params the forward
+            runs on persistent bf16 compute copies, so per-step host
+            traffic is one master read + one write. Bounds the HBM
+            working set to ``offload_depth`` layers instead of a whole
+            stacked group — the knob that fits 1.9B on one v5e
+            (measured: 1.3B offload MFU 0.3955 → 0.4295;
+            MEMO_SCALING_r05.md).
+        comp_resident: (stream_layers) keep the bf16 compute copies as
+            persistent trainer state (default). False re-streams the
+            forward copies per-layer from host each step — a near-zero-
+            HBM-argument program for toolchains that double-charge
+            resident argument state at compile time.
+        conservative_fetch: (stream_layers) additionally gate host
+            fetches on the layer's gradient: no fetch overlaps
+            forward/backward, trading the overlap for a smaller peak
+            (the 1.9B fit knob).
         unroll_layers: unroll the per-stage layer loop. Default: unroll
             on TPU without remat (removes the scan's dynamic-slice
             bookkeeping), scan under remat — unrolling a rematerialized
