@@ -52,6 +52,59 @@ def _sync(x):
     return float(np.asarray(x).ravel()[0])
 
 
+def profiler_block(tr, args, phases=True):
+    """Run the trainer briefly under paddle_tpu.profiler and return the
+    summary subset each config attaches as its ``profiler`` key: per-phase
+    ms, the profiler's own tokens/sec + steps/sec (measured over a window
+    of two warm instrumented steps — includes sync overhead, so it reads
+    slightly below the timed-loop number), collective bytes/step,
+    device-memory peak, and the retrace count (anything nonzero here is a
+    silent recompile during the measured window — a red flag on the
+    config).
+
+    phases=True additionally runs profile_step_phases (fwd/bwd/optim/comm
+    split — costs two extra compiles, so only the small configs ask for
+    it); phases=False runs the collective-bytes lowering only, falling
+    back to the compiled program when StableHLO shows zero collectives
+    (pure-GSPMD case). CAVEAT: a mixed shard_map+GSPMD step whose
+    StableHLO already shows SOME collectives skips that fallback, so its
+    byte count omits the GSPMD-implicit ones — the price of not paying
+    an extra XLA compile on the big configs. Either way the rates are
+    snapshotted BEFORE that pass, so compile time never pollutes the
+    tokens/sec denominator."""
+    import paddle_tpu.profiler as profiler
+
+    profiler.enable()
+    try:
+        # the caller's timed loop already compiled+warmed the step
+        _sync(tr.step(*args))
+        _sync(tr.step(*args))
+        rates = profiler.summary()["rates"]
+        if phases and hasattr(tr, "profile_step_phases"):
+            tr.profile_step_phases(*args)
+        elif hasattr(tr, "aot_lower"):
+            profiler.record_collectives_from(
+                tr.aot_lower(*args), getattr(tr, "mesh", None))
+        s = profiler.summary()
+
+        def gauge(name):
+            g = s["metrics"].get(name) or {}
+            return g.get("value")
+
+        return {"phases_ms": s["phases_ms"],
+                "tokens_per_sec": rates.get("tokens_per_sec"),
+                "steps_per_sec": rates.get("steps_per_sec"),
+                "collective_bytes_per_step":
+                    gauge("comm/collective_bytes_per_step"),
+                "peak_bytes_in_use": gauge("memory/peak_bytes_in_use"),
+                "retraces": len(s["retraces"])}
+    except Exception as e:      # telemetry must never kill a bench line
+        return {"error": f"{type(e).__name__}: {e}"[:160]}
+    finally:
+        profiler.disable()
+        profiler.reset()
+
+
 def _time_steps(fn, n):
     _sync(fn())
     _sync(fn())
@@ -223,7 +276,11 @@ def bench_gpt_1p3b(paddle, peak, steps=6, micro=2, n_micro=6,
     mfu = toks * cfg.flops_per_token(seq) / peak
     out = {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
            "tokens_per_sec": round(toks, 1), "mfu": round(mfu, 4),
-           "params_m": round(cfg.num_params() / 1e6, 1)}
+           "params_m": round(cfg.num_params() / 1e6, 1),
+           # per-phase/step telemetry replaces bare wall-clock-only
+           # reporting; phases=False here — the fwd/bwd split would cost
+           # two extra 1.3B compiles against the bench wall budget
+           "profiler": profiler_block(tr, (tokens,), phases=False)}
     if offload:
         # r4: memory_analysis now splits HBM vs host arguments (the
         # trainer knows exactly which state it placed in pinned_host)
@@ -261,7 +318,8 @@ def bench_gpt_1p3b(paddle, peak, steps=6, micro=2, n_micro=6,
     return out
 
 
-def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False):
+def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False,
+              profile_phases=False):
     from paddle_tpu.models import GPT
 
     tr = _hybrid(paddle, GPT(cfg), remat=remat)
@@ -272,7 +330,9 @@ def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False):
     mfu = toks * cfg.flops_per_token(seq) / peak
     return {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
             "tokens_per_sec": round(toks, 1), "mfu": round(mfu, 4),
-            "params_m": round(cfg.num_params() / 1e6, 1)}
+            "params_m": round(cfg.num_params() / 1e6, 1),
+            "profiler": profiler_block(tr, (tokens,),
+                                       phases=profile_phases)}
 
 
 def bench_moe(paddle, steps, peak):
@@ -316,7 +376,8 @@ def bench_moe(paddle, steps, peak):
     return {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
             "num_experts": 8, "tokens_per_sec": round(toks, 1),
             "mfu_active_params": round(mfu_active, 4),
-            "params_m": round(cfg.num_params() / 1e6, 1)}
+            "params_m": round(cfg.num_params() / 1e6, 1),
+            "profiler": profiler_block(tr, (tokens,), phases=False)}
 
 
 def bench_predictor_int8(paddle, steps=20, batch=1024,
@@ -539,7 +600,8 @@ def bench_mlm(paddle, model_cls, cfg, batch, seq, steps, peak,
     mfu = toks * cfg.flops_per_token(seq) / peak
     out = {"step_ms": round(dt * 1e3, 2), "batch": batch, "seq": seq,
            "tokens_per_sec": round(toks, 1), "mfu": round(mfu, 4),
-           "params_m": round(cfg.num_params() / 1e6, 1)}
+           "params_m": round(cfg.num_params() / 1e6, 1),
+           "profiler": profiler_block(tr, batch_arrays, phases=False)}
     if note:
         out["mfu_note"] = note
     return out
@@ -571,7 +633,7 @@ def main():
         head_cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                              num_heads=4, max_seq_len=128)
         head = bench_gpt(paddle, head_cfg, batch=2, seq=64, steps=2,
-                         peak=peak)
+                         peak=peak, profile_phases=True)
         head_name = "gpt_350m_hybrid_amp"
     configs[head_name] = head
 
@@ -613,7 +675,10 @@ def main():
             paddle, GPTConfig(vocab_size=32768, hidden_size=768,
                               num_layers=12, num_heads=12,
                               max_seq_len=1024),
-            batch=8, seq=1024, steps=15, peak=peak))
+            batch=8, seq=1024, steps=15, peak=peak,
+            # the full fwd/bwd/optim split on the cheapest GPT config:
+            # two extra ~125M compiles, well inside the wall budget
+            profile_phases=True))
         extra("bert_base_dp_amp", lambda: bench_mlm(
             paddle, BertForPretraining,
             BertConfig(vocab_size=32768, max_seq_len=512,

@@ -1,7 +1,23 @@
-"""Scratch: in-trainer ablations to find the missing step time."""
+"""In-trainer ablations to find the missing step time.
+
+Emits ONE JSON line (plus the human-readable prints): per-ablation step
+ms AND the profiler's per-phase decomposition of the full config —
+fwd/bwd/optim/comm ms and tokens/sec from paddle_tpu.profiler — instead
+of bare wall-clock totals. The ablation timing loops themselves run with
+the profiler DISABLED (its disabled cost is one bool read per step, so
+the numbers stay comparable with earlier rounds).
+"""
+import json
+import os
+import sys
 import time
+
 import numpy as np
 import jax, jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from bench import profiler_block  # noqa: E402 - the ONE telemetry harness
 
 
 def step_time(tr, tokens, n=10):
@@ -40,10 +56,12 @@ def make(cfg_kw=None, strat_kw=None, n_micro=1):
 def main():
     rng = np.random.RandomState(0)
     tokens = rng.randint(0, 32768, (8, 1024)).astype(np.int32)
+    results = {}
 
     tr, cfg = make()
     t_full = step_time(tr, tokens)
     print(f"full step: {t_full:.2f} ms")
+    results["full"] = {"step_ms": round(t_full, 2)}
 
     # ablate attention (unfused==flash swap shows reshape overhead instead)
     import paddle_tpu.models.gpt as gptmod
@@ -57,6 +75,8 @@ def main():
     tr2, _ = make()
     t = step_time(tr2, tokens)
     print(f"no-attention step: {t:.2f} ms (attention total = {t_full - t:.2f})")
+    results["no_attention"] = {"step_ms": round(t, 2),
+                               "attention_ms": round(t_full - t, 2)}
     gptmod.GPTAttention.forward = orig_fwd
 
     # ablate loss head: mean instead of fused CE
@@ -70,15 +90,29 @@ def main():
     tr3, _ = make()
     t = step_time(tr3, tokens)
     print(f"no-CE step: {t:.2f} ms (loss head total = {t_full - t:.2f})")
+    results["no_ce"] = {"step_ms": round(t, 2),
+                        "loss_head_ms": round(t_full - t, 2)}
     fce.fused_linear_cross_entropy_fn = orig_ce
 
     # unfused attention for comparison
     tr4, _ = make(cfg_kw={"use_flash_attention": False})
-    print(f"unfused-attention step: {step_time(tr4, tokens):.2f} ms")
+    t = step_time(tr4, tokens)
+    print(f"unfused-attention step: {t:.2f} ms")
+    results["unfused_attention"] = {"step_ms": round(t, 2)}
 
     # remat on (cheaper bwd memory, more flops)
     tr5, _ = make(strat_kw={"recompute": True})
-    print(f"remat step: {step_time(tr5, tokens):.2f} ms")
+    t = step_time(tr5, tokens)
+    print(f"remat step: {t:.2f} ms")
+    results["remat"] = {"step_ms": round(t, 2)}
+
+    # last: profiler_block's enabled steps and extra phase compiles must
+    # not perturb the ablation timing loops above (it also caps its own
+    # errors, so telemetry never kills the JSON line)
+    results["full"]["profiler"] = profiler_block(tr, (tokens,))
+
+    print(json.dumps({"bench": "ablation_step", "batch": 8, "seq": 1024,
+                      "configs": results}))
 
 
 if __name__ == "__main__":

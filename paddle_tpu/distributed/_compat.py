@@ -1,0 +1,35 @@
+"""jax version compatibility for the distributed stack.
+
+The package targets the modern ``jax.shard_map`` entry point (manual
+axes listed via ``axis_names``, replication checking via ``check_vma``).
+Older jax (< 0.5, e.g. 0.4.x) only ships
+``jax.experimental.shard_map.shard_map``, whose dialect is inverted:
+the body is manual over every mesh axis EXCEPT the ``auto`` complement
+set, and the check flag is ``check_rep``. One shim, imported by every
+shard_map call site, so the translation cannot drift per-site.
+"""
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: bool = False):
+    """``jax.shard_map`` when available, else the experimental one with
+    ``axis_names``/``check_vma`` translated to ``auto``/``check_rep``.
+    ``axis_names=None`` means manual over all mesh axes (both dialects'
+    default)."""
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = frozenset(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma,
+                             **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    auto = frozenset()
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=bool(check_vma), auto=auto)

@@ -99,7 +99,6 @@ def nested_kernel_shard(fn, in_specs, out_specs):
     if pa is None:
         return None
     mesh, axes = pa
-    import jax
 
     try:
         from jax.sharding import get_abstract_mesh
@@ -109,6 +108,8 @@ def nested_kernel_shard(fn, in_specs, out_specs):
             else mesh
     except Exception:
         use = mesh
-    return jax.shard_map(fn, mesh=use, in_specs=in_specs,
-                         out_specs=out_specs, axis_names=frozenset(axes),
-                         check_vma=False)
+    from ._compat import shard_map
+
+    return shard_map(fn, mesh=use, in_specs=in_specs,
+                     out_specs=out_specs, axis_names=frozenset(axes),
+                     check_vma=False)

@@ -29,25 +29,34 @@ def _allreduce_np(arr: np.ndarray, op) -> np.ndarray:
     return np.asarray(t._value)
 
 
+def _as_array(input) -> np.ndarray:
+    """Accept a Tensor, numpy/jax array, plain Python scalar, or (nested)
+    list — everything np.asarray digests (the reference took raw Gloo
+    buffers; callers here hand in whatever their loop accumulated)."""
+    if isinstance(input, Tensor):
+        input = input._value
+    return np.asarray(input, np.float64)
+
+
+def _scalar_or_array(out: np.ndarray):
+    """0-d reductions come back as Python floats (``fm.sum(loss)`` is
+    directly printable/comparable); array inputs keep their shape."""
+    return float(out) if out.ndim == 0 else out
+
+
 def sum(input, scope=None, util=None):  # noqa: A001
     """reference: fleet/metrics/metric.py sum(:22)."""
-    arr = np.asarray(input._value if isinstance(input, Tensor) else input,
-                     np.float64)
-    return _allreduce_np(arr, ReduceOp.SUM)
+    return _scalar_or_array(_allreduce_np(_as_array(input), ReduceOp.SUM))
 
 
 def max(input, scope=None, util=None):  # noqa: A001
     """reference: fleet/metrics/metric.py max(:57)."""
-    arr = np.asarray(input._value if isinstance(input, Tensor) else input,
-                     np.float64)
-    return _allreduce_np(arr, ReduceOp.MAX)
+    return _scalar_or_array(_allreduce_np(_as_array(input), ReduceOp.MAX))
 
 
 def min(input, scope=None, util=None):  # noqa: A001
     """reference: fleet/metrics/metric.py min(:92)."""
-    arr = np.asarray(input._value if isinstance(input, Tensor) else input,
-                     np.float64)
-    return _allreduce_np(arr, ReduceOp.MIN)
+    return _scalar_or_array(_allreduce_np(_as_array(input), ReduceOp.MIN))
 
 
 def acc(correct, total, scope=None, util=None):
@@ -61,8 +70,8 @@ def auc(stat_pos, stat_neg, scope=None, util=None):
     """reference: fleet/metrics/metric.py auc(:162) — allreduce the
     positive/negative histograms then integrate (same math as
     paddle_tpu.metric.Auc.accumulate)."""
-    pos = _allreduce_np(np.asarray(stat_pos, np.int64), ReduceOp.SUM)
-    neg = _allreduce_np(np.asarray(stat_neg, np.int64), ReduceOp.SUM)
+    pos = _allreduce_np(_as_array(stat_pos), ReduceOp.SUM)
+    neg = _allreduce_np(_as_array(stat_neg), ReduceOp.SUM)
     tot_pos = tot_neg = 0.0
     area = 0.0
     for i in range(len(pos) - 1, -1, -1):

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 
 import contextlib
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +35,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.place import target_platform as _target_platform
 from ..framework.tensor import Tensor
+from ..profiler import instrument as _pinstr
+from ..profiler import recompile as _precomp
+from ..profiler import trace as _ptrace
+from ..profiler.metrics import registry as _preg
 from ..static.functional import _swapped_state, state_tensors
 from .fleet.distributed_strategy import DistributedStrategy
 from .pipeline import pipeline_apply
@@ -120,7 +125,31 @@ class HybridPipelineTrainer:
         free_eager: delete the eager model's device buffers after the
             trainer stacks/casts its own copies — at 1.3B the eager f32
             params are 5.3 GB of HBM that would sit dead next to the
-            trainer's bf16 state. ``sync_to_layer`` restores them."""
+            trainer's bf16 state. ``sync_to_layer`` restores them.
+
+        Observability knobs (paddle_tpu.profiler; all zero-cost until
+        ``profiler.enable()`` — the step reads one bool when disabled):
+
+        profiler.enable(trace_dir=...): every ``step()`` then records an
+            ``hybrid/h2d`` + ``hybrid/step`` host span (synced on the
+            loss, so it measures execution, not dispatch), moves the
+            ``train/steps`` / ``train/tokens`` counters and the
+            ``hybrid/step_ms`` histogram, and tracks the device-memory
+            high-water mark; ``trace_dir`` additionally captures a
+            TensorBoard-loadable XLA device trace. ``fwd/stem``,
+            ``fwd/blocks``, ``fwd/head`` named scopes are baked into the
+            compiled program, so XLA traces attribute device time per
+            phase regardless of when profiling was switched on.
+        profile_step_phases(*batch): fwd/bwd/optim/comm phase split as
+            ``phase/*_ms`` gauges (two extra compiles; comm is modeled
+            from collective bytes — see the method docstring).
+        retrace telemetry: every (re)trace of the step program is logged
+            to ``profiler.retraces()`` with the triggering batch shapes;
+            diagnostic lowerings (``aot_lower``/``memory_analysis``) are
+            suppressed, so anything in the log is a silent recompile.
+        profiler.summary()/export_chrome_trace(path): the collected
+            picture — per-scope spans, counters, tokens/sec + steps/sec
+            over the enabled window, phases, retraces."""
         _check_protocol(model)
         # MoE composes with pp: blocks return (h, aux) and pipeline_apply
         # carries the load-balance scalar across the schedule (stage_aux)
@@ -505,6 +534,9 @@ class HybridPipelineTrainer:
         self._step = 0
         self._n_batch_args: Optional[int] = None
         self._step_fn = None
+        # recompilation telemetry: every (re)trace of this trainer's step
+        # program is reported to profiler.recompile under this site
+        self._prof_site = _precomp.unique_site("hybrid.step")
 
     # ---------------------------------------------------------------------
     def _forward_loss(self, block_params, other_params, batch, key):
@@ -605,37 +637,46 @@ class HybridPipelineTrainer:
         with _swapped_state(other_tensors, other_cast), \
                 dctx.sequence_parallel_scope(self.mesh):
             with rng_mod.key_scope(key):
-                x = model.pipeline_stem(*batch_tensors)._value
-                x = seq_constraint(x)
+                # fwd/* named scopes: pure op-name metadata traced into
+                # the program, so XLA traces/HLO dumps attribute device
+                # time to the phase (profiler/trace.py annotate)
+                with _ptrace.annotate("fwd/stem"):
+                    x = model.pipeline_stem(*batch_tensors)._value
+                    x = seq_constraint(x)
                 if head_inside:
                     # head params + batch enter the manual region as
                     # explicit inputs; blocks' swapped values are local
                     def head_fn(full, other_vals, batch_vals):
                         with _swapped_state(other_tensors,
-                                            list(other_vals)):
+                                            list(other_vals)), \
+                                _ptrace.annotate("fwd/head"):
                             return model.pipeline_head(
                                 Tensor(full),
                                 *[Tensor(b) for b in batch_vals])._value
-                    loss_v = pipeline_apply(
-                        self.mesh, block_apply, block_cast, x,
-                        self.n_micro, v_virtual=self.v, head_fn=head_fn,
-                        head_args=(tuple(other_cast), tuple(batch)),
-                        stage_aux=moe)
+                    with _ptrace.annotate("fwd/blocks"):
+                        loss_v = pipeline_apply(
+                            self.mesh, block_apply, block_cast, x,
+                            self.n_micro, v_virtual=self.v,
+                            head_fn=head_fn,
+                            head_args=(tuple(other_cast), tuple(batch)),
+                            stage_aux=moe)
                     if moe:
                         loss_v, aux = loss_v
                         return (loss_v + aux).astype(jnp.float32)
                     return loss_v.astype(jnp.float32)
-                x = pipeline_apply(self.mesh, block_apply, block_cast, x,
-                                   self.n_micro, v_virtual=self.v,
-                                   sp_axis="sp" if manual_sp else None,
-                                   stage_aux=moe)
+                with _ptrace.annotate("fwd/blocks"):
+                    x = pipeline_apply(self.mesh, block_apply, block_cast,
+                                       x, self.n_micro, v_virtual=self.v,
+                                       sp_axis="sp" if manual_sp else None,
+                                       stage_aux=moe)
                 aux = None
                 if moe:
                     x, aux = x
-                x = Tensor(seq_constraint(x))
-                loss = model.pipeline_head(x, *batch_tensors)
-                if aux is not None:
-                    loss = loss + Tensor(aux)
+                with _ptrace.annotate("fwd/head"):
+                    x = Tensor(seq_constraint(x))
+                    loss = model.pipeline_head(x, *batch_tensors)
+                    if aux is not None:
+                        loss = loss + Tensor(aux)
         return loss._value.astype(jnp.float32)
 
     def _cast_back(self, np_, ns, store_p_dtype, store_s):
@@ -752,6 +793,10 @@ class HybridPipelineTrainer:
 
         def step_fn(block_params, other_params, block_opt, other_opt,
                     batch, lr, step_no, key):
+            # python side effect at the top of the traced body: runs once
+            # per trace, so every cache miss (silent recompile) is logged
+            # with the batch shapes that triggered it
+            _precomp.mark_trace(self._prof_site, batch)
             if offload_p:
                 # stream masters to HBM and cast; grads flow to the bf16
                 # compute copies (half the grad HBM of the f32 path)
@@ -909,6 +954,7 @@ class HybridPipelineTrainer:
 
         def step_fn(blk_m, oth_m, blk_c, oth_c, blk_o, oth_o,
                     batch, lr, step_no, key):
+            _precomp.mark_trace(self._prof_site, batch)
             if offload_p and not comp_res:
                 # no persistent compute copies: stream the forward's
                 # bf16 copies per-layer from the host masters, chained
@@ -1062,15 +1108,32 @@ class HybridPipelineTrainer:
         if self._step_fn is None or self._n_batch_args != len(batch):
             self._build(len(batch))
         self._step += 1
-        vs = []
-        for b in batch:
-            v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
-            vs.append(jax.device_put(v, NamedSharding(
-                self.mesh, self._batch_spec(v.ndim))))
+        # zero-overhead-when-disabled guard: one bool read per step; the
+        # instrumented branch additionally SYNCS on the loss (a host value
+        # fetch — the only truthful step boundary, bench.py NOTE), so the
+        # enabled step_ms histogram measures execution, not dispatch.
+        prof = _ptrace.is_enabled()
+        t0 = time.perf_counter_ns() if prof else 0
+        h2d = _ptrace.scope("hybrid/h2d") if prof else contextlib.nullcontext()
+        with h2d:
+            vs = self._stage_batch(batch)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
-        out = self._step_fn(
-            *self._state_args(), tuple(vs), lr,
-            jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+        if prof:
+            with _ptrace.scope("hybrid/step"):
+                out = self._step_fn(
+                    *self._state_args(), vs, lr,
+                    jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+                float(np.asarray(out[0]))          # truthful sync
+            dt_ms = (time.perf_counter_ns() - t0) / 1e6
+            reg = _preg()
+            reg.counter("train/steps").add(1)
+            reg.counter("train/tokens").add(_pinstr.tokens_in_batch(vs))
+            reg.histogram("hybrid/step_ms").observe(dt_ms)
+            _pinstr.record_memory_high_water()
+        else:
+            out = self._step_fn(
+                *self._state_args(), vs, lr,
+                jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
         if self.stream_layers:
             (loss, self.block_vals, self.other_vals, self.block_comp,
              self.other_comp, self.block_opt, self.other_opt) = out
@@ -1081,6 +1144,61 @@ class HybridPipelineTrainer:
         return loss
 
     __call__ = step
+
+    def _stage_arg(self, b):
+        v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
+        return jax.device_put(v, NamedSharding(
+            self.mesh, self._batch_spec(v.ndim)))
+
+    def _stage_batch(self, batch) -> tuple:
+        """Device-put each batch element with the trainer's batch
+        sharding — the ONE staging definition; step(),
+        profile_step_phases() and aot_lower() must place batches
+        identically or their programs would not cache-share."""
+        return tuple(self._stage_arg(b) for b in batch)
+
+    def profile_step_phases(self, *batch, iters: int = 2):
+        """Per-phase (fwd/bwd/optim/comm) decomposition of the train
+        step, recorded as ``phase/*_ms`` gauges — what
+        ``profiler.summary()["phases_ms"]`` reports.
+
+        The step is ONE fused pjit program, so phases cannot be
+        host-timed inside it; nested prefixes are compiled and timed
+        instead — fwd (loss only), fwd+bwd (value_and_grad), full step —
+        and bwd = fwdbwd − fwd, optim = step − fwdbwd. ``comm`` is a
+        model, not a measurement: collective bytes parsed from the
+        lowered program over the nominal link bandwidth
+        (profiler.instrument.estimate_comm_ms); 0 on one chip. Costs two
+        extra compiles and runs ``iters`` REAL optimizer steps (training
+        state advances). Offload/stream configs skip the fwd/bwd split
+        (their step streams host-resident state the sub-programs would
+        misattribute) and report step + comm only.
+        """
+        from ..core import rng as rng_mod
+
+        if self._step_fn is None or self._n_batch_args != len(batch):
+            self._build(len(batch))
+        vs = self._stage_batch(batch)
+        key = rng_mod.next_key()
+
+        t_fwd = t_fb = None
+        if not (self.stream_layers or self.offload_params):
+            fwd = jax.jit(lambda bp, op: self._forward_loss(
+                bp, op, vs, key))
+            t_fwd = _pinstr.time_compiled(
+                lambda: fwd(self.block_vals, self.other_vals), iters)
+            fb = jax.jit(lambda bp, op: jax.value_and_grad(
+                lambda b_, o_: self._forward_loss(b_, o_, vs, key),
+                argnums=(0, 1))(bp, op))
+            t_fb = _pinstr.time_compiled(
+                lambda: fb(self.block_vals, self.other_vals), iters)
+        t_step = _pinstr.time_compiled(lambda: self.step(*batch), iters)
+
+        st = _pinstr.record_collectives_from(
+            self.aot_lower(*batch), self.mesh)
+        return _pinstr.record_phases(
+            fwd_s=t_fwd, fwdbwd_s=t_fb, step_s=t_step,
+            comm_bytes=st["total_bytes"], platform=_target_platform())
 
     def memory_analysis(self, *batch):
         """Compiled-memory report of the train step (bytes), from XLA's
@@ -1148,16 +1266,17 @@ class HybridPipelineTrainer:
                     tuple(b.shape), b.dtype, sharding=NamedSharding(
                         self.mesh, self._batch_spec(len(b.shape)))))
             else:
-                v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
-                vs.append(jax.device_put(v, NamedSharding(
-                    self.mesh, self._batch_spec(v.ndim))))
+                vs.append(self._stage_arg(b))
         # constant key: only avals matter for lowering, and a diagnostic
-        # must not advance the training RNG stream
-        return self._step_fn.lower(
-            *self._state_args(), tuple(vs),
-            jax.ShapeDtypeStruct((), jnp.float32),
-            jax.ShapeDtypeStruct((), jnp.int32),
-            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        # must not advance the training RNG stream. suppressed(): this
+        # re-trace is by design, not a silent recompile — keep it out of
+        # the profiler's retrace counter/log.
+        with _precomp.suppressed():
+            return self._step_fn.lower(
+                *self._state_args(), tuple(vs),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((2,), jnp.uint32))
 
     def aot_compile(self, *batch):
         return self.aot_lower(*batch).compile()

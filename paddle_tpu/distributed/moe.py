@@ -38,6 +38,7 @@ from .. import nn
 from ..framework.tensor import Tensor
 from ..nn import functional as F
 from ..nn import initializer as I
+from ..profiler.trace import annotate as _annotate
 from ..tensor._helper import apply
 
 __all__ = ["MoEMLP", "switch_moe"]
@@ -141,21 +142,24 @@ def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
     # 8-wide minor dim on the 128-lane VPU — every softmax/argmax/cumsum
     # wastes 94% of the lanes (round-5 profile: the routing pipeline cost
     # more than the expert FFN fwd+bwd). [E, T] keeps T on the lanes.
-    logits_t = jnp.dot(gate_w.astype(x.dtype).T, x.T)      # [E, T]
-    probs_t = jax.nn.softmax(logits_t.astype(jnp.float32), axis=0)
+    # moe/* named scopes: routing/dispatch/experts/combine phase names
+    # traced into the program for device-time attribution (profiler)
+    with _annotate("moe/route"):
+        logits_t = jnp.dot(gate_w.astype(x.dtype).T, x.T)  # [E, T]
+        probs_t = jax.nn.softmax(logits_t.astype(jnp.float32), axis=0)
 
-    # -- routing: top_k rounds over [E, T] (never [T, E, C]) --------------
-    expert_rounds, gate_rounds = [], []
-    remaining = probs_t
-    aux_fraction = jnp.zeros((e,), jnp.float32)
-    for _ in range(top_k):
-        idx = jnp.argmax(remaining, axis=0)                # [T]
-        onehot_t = (jnp.arange(e, dtype=jnp.int32)[:, None]
-                    == idx[None, :]).astype(jnp.float32)   # [E, T]
-        expert_rounds.append(idx.astype(jnp.int32))
-        gate_rounds.append(jnp.sum(remaining * onehot_t, axis=0))
-        aux_fraction = aux_fraction + jnp.mean(onehot_t, axis=1)
-        remaining = remaining * (1.0 - onehot_t)
+        # -- routing: top_k rounds over [E, T] (never [T, E, C]) ----------
+        expert_rounds, gate_rounds = [], []
+        remaining = probs_t
+        aux_fraction = jnp.zeros((e,), jnp.float32)
+        for _ in range(top_k):
+            idx = jnp.argmax(remaining, axis=0)            # [T]
+            onehot_t = (jnp.arange(e, dtype=jnp.int32)[:, None]
+                        == idx[None, :]).astype(jnp.float32)   # [E, T]
+            expert_rounds.append(idx.astype(jnp.int32))
+            gate_rounds.append(jnp.sum(remaining * onehot_t, axis=0))
+            aux_fraction = aux_fraction + jnp.mean(onehot_t, axis=1)
+            remaining = remaining * (1.0 - onehot_t)
 
     # -- dispatch: cumsum slot assignment, gather-only data movement ------
     # Round-4 profile: the argsort([K*T]) bitonic network + two full-row
@@ -195,20 +199,23 @@ def switch_moe(x, gate_w, w_in, b_in, w_out, b_out, *, top_k=1,
         [jnp.minimum(s, e * cap - 1) for s in slot_rounds])  # [K, T]
     valid = jnp.stack(keep_rounds)                           # [K, T]
 
-    xe = _dispatch_gather(x, token_of_slot, slot_of_token,
-                          valid).reshape(e, cap, h)
+    with _annotate("moe/dispatch"):
+        xe = _dispatch_gather(x, token_of_slot, slot_of_token,
+                              valid).reshape(e, cap, h)
     # empty slots compute x[0]'s row; no token combines them and the
     # combine VJP masks them, so no spurious weight gradient flows
-    hmid = jax.nn.gelu(
-        jnp.einsum("ech,ehf->ecf", xe, w_in.astype(x.dtype))
-        + b_in.astype(x.dtype)[:, None, :])
-    ye = (jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype))
-          + b_out.astype(x.dtype)[:, None, :]).reshape(e * cap, h)
+    with _annotate("moe/experts"):
+        hmid = jax.nn.gelu(
+            jnp.einsum("ech,ehf->ecf", xe, w_in.astype(x.dtype))
+            + b_in.astype(x.dtype)[:, None, :])
+        ye = (jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype))
+              + b_out.astype(x.dtype)[:, None, :]).reshape(e * cap, h)
 
     # -- combine: per-round gather of each token's slot, gate-weighted ----
-    gates = jnp.stack(gate_rounds)                           # [K, T] f32
-    y = _combine_gather(ye, gates, slot_of_token, valid, token_of_slot,
-                        round_of_slot, occupied)
+    with _annotate("moe/combine"):
+        gates = jnp.stack(gate_rounds)                       # [K, T] f32
+        y = _combine_gather(ye, gates, slot_of_token, valid, token_of_slot,
+                            round_of_slot, occupied)
 
     # Switch aux loss: E * sum_e fraction_e * mean-prob_e
     aux = e * jnp.sum((aux_fraction / top_k)

@@ -43,6 +43,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..profiler.trace import annotate as _annotate
+from ._compat import shard_map as _shard_map
+
 
 def stack_block_params(block_param_lists):
     """[{name: val} per layer] → {name: [L, ...] stacked}."""
@@ -96,7 +99,12 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
             lambda a: a[0] if v == 1 else a[0].reshape(
                 (-1,) + tuple(a.shape[3:])), stacked_params)
         mbs = _to_microbatches(x, n_micro)
-        out = jax.lax.map(lambda mb: stage_fn(sliced, mb), mbs)
+
+        def one_mb(mb):
+            with _annotate("pp/stage"):
+                return stage_fn(sliced, mb)
+
+        out = jax.lax.map(one_mb, mbs)
         if stage_aux:
             out, auxs = out
             aux = jnp.sum(auxs.astype(jnp.float32)) / n_micro
@@ -134,7 +142,7 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
     # over outer-traced sharded values are rejected inside shard_map
     head_specs = jax.tree_util.tree_map(lambda _: P(), head_args)
 
-    @partial(jax.shard_map, mesh=mesh,
+    @partial(_shard_map, mesh=mesh,
              in_specs=(param_specs, x_spec, head_specs), out_specs=out_spec,
              check_vma=False, axis_names=manual)
     def pipelined(params, xs, head_args):
@@ -159,9 +167,13 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
             prev_out, ret, outputs, aux_acc = carry
             # stage i receives stage i-1's last output (ring; stage 0's
             # recv feeds the circuit-return buffer)
-            recv = jax.lax.ppermute(
-                prev_out, pp_axis,
-                [(i, (i + 1) % pp) for i in range(pp)])
+            # pp/* named scopes: schedule-phase names baked into the
+            # compiled program so device traces attribute time to the
+            # inter-stage permute vs the stage compute (profiler/trace.py)
+            with _annotate("pp/ppermute"):
+                recv = jax.lax.ppermute(
+                    prev_out, pp_axis,
+                    [(i, (i + 1) % pp) for i in range(pp)])
             if v > 1:
                 # a completed circuit item arrives back at stage 0 at tick
                 # t with microbatch id (t - pp) mod n_micro
@@ -192,7 +204,8 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
                         a, c_s, 0, keepdims=False), local)
             else:
                 chunk = local
-            out = stage_fn(chunk, inp.astype(compute_dtype))
+            with _annotate("pp/stage"):
+                out = stage_fn(chunk, inp.astype(compute_dtype))
             if stage_aux:
                 out, aux = out
                 # fill/drain ticks run on garbage zeros — mask their aux.
@@ -226,7 +239,8 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
             # value is real — egress is ONE scalar, not the activations
             full = outputs.reshape((outputs.shape[0] * outputs.shape[1],)
                                    + tuple(outputs.shape[2:]))
-            loss = head_fn(full.astype(compute_dtype), *head_args)
+            with _annotate("pp/head"):
+                loss = head_fn(full.astype(compute_dtype), *head_args)
             loss = jnp.where(stage == pp - 1, loss, 0.0)
             loss = jax.lax.psum(loss.astype(jnp.float32), pp_axis)
             return (loss, aux_total) if stage_aux else loss

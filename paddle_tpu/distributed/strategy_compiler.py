@@ -21,6 +21,7 @@ inserted by hand (SURVEY.md §7):
 from __future__ import annotations
 
 import math
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -31,6 +32,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
 from ..nn import ClipGradByGlobalNorm
+from ..profiler import instrument as _pinstr
+from ..profiler import recompile as _precomp
+from ..profiler import trace as _ptrace
+from ..profiler.metrics import registry as _preg
 from ..static.functional import functional_call, state_tensors
 from .fleet.distributed_strategy import DistributedStrategy
 from .mesh import create_mesh
@@ -245,6 +250,7 @@ class HybridParallelTrainer:
 
         self.data_spec = data_spec
         self._step = 0
+        self._prof_site = _precomp.unique_site("compile_train_step")
         self._build()
 
     # -- functional pieces -------------------------------------------------
@@ -269,10 +275,13 @@ class HybridParallelTrainer:
                     jnp.asarray(b).dtype, jnp.floating)
                 else b for i, b in enumerate(batch))
         if self.loss_fn is not None:
-            out, new_buf = functional_call(layer, cast, buffers, batch[:-1],
-                                           training=True, rng_key=key)
-            loss = self.loss_fn(Tensor(out) if not isinstance(out, Tensor)
-                                else out, Tensor(batch[-1]))
+            with _ptrace.annotate("fwd"):
+                out, new_buf = functional_call(layer, cast, buffers,
+                                               batch[:-1], training=True,
+                                               rng_key=key)
+                loss = self.loss_fn(
+                    Tensor(out) if not isinstance(out, Tensor) else out,
+                    Tensor(batch[-1]))
             loss = loss._value if isinstance(loss, Tensor) else loss
         else:
             # model exposes .loss(*batch) (e.g. GPT)
@@ -283,7 +292,7 @@ class HybridParallelTrainer:
             from ..static.functional import _swapped_state
 
             with _swapped_state(pt + bt, list(cast) + list(buffers)):
-                with rng_mod.key_scope(key):
+                with rng_mod.key_scope(key), _ptrace.annotate("fwd"):
                     loss_t = layer.loss(*[Tensor(b) for b in batch])
                 new_buf = [t._value for t in bt]
             loss = loss_t._value
@@ -302,6 +311,9 @@ class HybridParallelTrainer:
         k_acc = self.accumulate_steps
 
         def step_fn(params, opt_states, buffers, batch, lr, step_no, key):
+            # trace-time side effect: reports every (re)trace of this
+            # program with the triggering batch shapes (profiler.recompile)
+            _precomp.mark_trace(self._prof_site, batch)
             if k_acc > 1:
                 for b in jax.tree_util.tree_leaves(batch):
                     if b.shape[0] % k_acc:
@@ -340,11 +352,13 @@ class HybridParallelTrainer:
                 (loss, new_buf), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params)
             grads = functional_clip(clip, grads)
-            new_params, new_states = [], []
-            for p, g, s, plr, wd in zip(params, grads, opt_states, lrs, wds):
-                np_, ns = upd(p, g, s, lr, step_no, plr=plr, wd=wd)
-                new_params.append(np_)
-                new_states.append(ns)
+            with _ptrace.annotate("optim"):
+                new_params, new_states = [], []
+                for p, g, s, plr, wd in zip(params, grads, opt_states,
+                                            lrs, wds):
+                    np_, ns = upd(p, g, s, lr, step_no, plr=plr, wd=wd)
+                    new_params.append(np_)
+                    new_states.append(ns)
             return loss, new_params, new_states, new_buf
 
         param_sh = [NamedSharding(mesh, self.param_specs[n])
@@ -382,14 +396,67 @@ class HybridParallelTrainer:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self._step, jnp.int32)
         key = rng_mod.next_key()
-        batch = self._shard_batch(batch)
-        loss, self.params, self.opt_states, self.buffers = self._step_fn(
-            self.params, self.opt_states, self.buffers, batch, lr, step_no,
-            key)
+        # disabled cost: one bool read. Enabled, the step is host-timed
+        # against a loss value fetch (the only truthful sync, bench.py
+        # NOTE) and the train counters/memory high-water are recorded.
+        if _ptrace.is_enabled():
+            t0 = time.perf_counter_ns()
+            with _ptrace.scope("compiled/h2d"):
+                batch = self._shard_batch(batch)
+            with _ptrace.scope("compiled/step"):
+                loss, self.params, self.opt_states, self.buffers = \
+                    self._step_fn(self.params, self.opt_states,
+                                  self.buffers, batch, lr, step_no, key)
+                float(np.asarray(loss))
+            reg = _preg()
+            reg.counter("train/steps").add(1)
+            reg.counter("train/tokens").add(_pinstr.tokens_in_batch(batch))
+            reg.histogram("compiled/step_ms").observe(
+                (time.perf_counter_ns() - t0) / 1e6)
+            _pinstr.record_memory_high_water()
+        else:
+            batch = self._shard_batch(batch)
+            loss, self.params, self.opt_states, self.buffers = \
+                self._step_fn(self.params, self.opt_states, self.buffers,
+                              batch, lr, step_no, key)
         self.optimizer._global_step = self._step
         return loss
 
     __call__ = step
+
+    def profile_step_phases(self, *batch, iters: int = 2):
+        """Per-phase (fwd/bwd/optim/comm) decomposition — the
+        compile_train_step counterpart of
+        ``HybridPipelineTrainer.profile_step_phases`` (see its docstring
+        for semantics): nested prefixes fwd / fwd+bwd / full step are
+        compiled and timed, comm is modeled from collective bytes, and
+        the results land in the ``phase/*_ms`` gauges."""
+        from ..core import rng as rng_mod
+
+        vs = self._shard_batch(batch)
+        key = rng_mod.next_key()
+
+        fwd = jax.jit(lambda ps, bufs: self._forward_loss(
+            ps, bufs, vs, key)[0])
+        t_fwd = _pinstr.time_compiled(
+            lambda: fwd(self.params, self.buffers), iters)
+        fb = jax.jit(lambda ps, bufs: jax.value_and_grad(
+            lambda p_: self._forward_loss(p_, bufs, vs, key),
+            has_aux=True)(ps))
+        t_fb = _pinstr.time_compiled(
+            lambda: fb(self.params, self.buffers), iters)
+        t_step = _pinstr.time_compiled(lambda: self.step(*batch), iters)
+
+        with _precomp.suppressed():
+            lowered = self._step_fn.lower(
+                self.params, self.opt_states, self.buffers, vs,
+                jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32),
+                key)
+        st = _pinstr.record_collectives_from(lowered, self.mesh)
+        return _pinstr.record_phases(
+            fwd_s=t_fwd, fwdbwd_s=t_fb, step_s=t_step,
+            comm_bytes=st["total_bytes"],
+            platform=self.mesh.devices.flat[0].platform)
 
     def sync_to_layer(self):
         """Write device state back into the eager Layer (for save/eval)."""

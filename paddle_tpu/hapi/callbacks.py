@@ -187,6 +187,60 @@ class LRScheduler(Callback):
             s.step()
 
 
+class ProfilerCallback(Callback):
+    """Profile a ``Model.fit`` run with paddle_tpu.profiler.
+
+    Enables the profiler once ``skip_steps`` train batches have run (the
+    default 1 keeps the first batch's compile out of the statistics),
+    lets Model.train_batch's own instrumentation record per-batch spans
+    and train/steps + train/tokens counters, and on train end writes
+    ``summary.json`` (profiler.summary(): scopes, metrics, rates,
+    phases, retraces) plus ``trace.json`` (chrome://tracing) into
+    ``log_dir``, then disables the profiler.
+
+    ``trace_dir``: additionally start a jax/XLA device trace into that
+    directory while profiling (TensorBoard-loadable; TPU timelines).
+    """
+
+    def __init__(self, log_dir="./profile", skip_steps=1,
+                 export_chrome=True, trace_dir=None):
+        super().__init__()
+        self.log_dir = log_dir
+        self.skip_steps = max(0, int(skip_steps))
+        self.export_chrome = export_chrome
+        self.trace_dir = trace_dir
+        self._seen = 0
+
+    def _profiler(self):
+        from .. import profiler
+
+        return profiler
+
+    def on_train_begin(self, logs=None):
+        self._seen = 0
+        if self.skip_steps == 0:
+            self._profiler().enable(trace_dir=self.trace_dir)
+
+    def on_train_batch_end(self, step, logs=None):
+        self._seen += 1
+        p = self._profiler()
+        if not p.is_enabled() and self._seen >= self.skip_steps:
+            p.enable(trace_dir=self.trace_dir)
+
+    def on_train_end(self, logs=None):
+        import json
+
+        p = self._profiler()
+        if not p.is_enabled():
+            return
+        os.makedirs(self.log_dir, exist_ok=True)
+        if self.export_chrome:
+            p.export_chrome_trace(os.path.join(self.log_dir, "trace.json"))
+        summary = p.disable()
+        with open(os.path.join(self.log_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2, default=float)
+
+
 class VisualDL(Callback):
     """Metrics writer (reference: hapi/callbacks.py VisualDL); writes a
     jsonl metrics log instead of the visualdl binary format."""

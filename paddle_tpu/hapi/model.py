@@ -14,6 +14,9 @@ import numpy as np
 from ..autograd import no_grad
 from ..framework.tensor import Tensor
 from ..metric import Metric
+from ..profiler import instrument as _pinstr
+from ..profiler import trace as _ptrace
+from ..profiler.metrics import registry as _preg
 from .callbacks import config_callbacks
 
 
@@ -45,6 +48,26 @@ class Model:
         raise ValueError("loss is not set; call prepare(loss=...)")
 
     def train_batch(self, inputs, labels=None, update=True):
+        # profiler hook: one bool read when disabled; enabled, the batch
+        # is a host span and the train counters move (ProfilerCallback
+        # or a manual profiler.enable() both land here)
+        if _ptrace.is_enabled():
+            with _ptrace.scope("hapi/train_batch"):
+                res = self._train_batch_impl(inputs, labels, update)
+            ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            # shape-only accounting: never np.asarray a device array here
+            # (a d2h copy of the batch would perturb the step timings)
+            vals = [x._value if isinstance(x, Tensor) else x for x in ins]
+            vals = [v if hasattr(v, "shape") else np.asarray(v)
+                    for v in vals]
+            reg = _preg()
+            reg.counter("train/steps").add(1)
+            reg.counter("train/tokens").add(_pinstr.tokens_in_batch(vals))
+            _pinstr.record_memory_high_water()
+            return res
+        return self._train_batch_impl(inputs, labels, update)
+
+    def _train_batch_impl(self, inputs, labels=None, update=True):
         self.network.train()
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))
@@ -65,6 +88,14 @@ class Model:
 
     @no_grad()
     def eval_batch(self, inputs, labels=None):
+        if _ptrace.is_enabled():
+            with _ptrace.scope("hapi/eval_batch"):
+                res = self._eval_batch_impl(inputs, labels)
+            _preg().counter("eval/steps").add(1)
+            return res
+        return self._eval_batch_impl(inputs, labels)
+
+    def _eval_batch_impl(self, inputs, labels=None):
         self.network.eval()
         ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         ins = [x if isinstance(x, Tensor) else Tensor(np.asarray(x))

@@ -39,6 +39,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 names it TPUCompilerParams; the kwargs are identical
+
+
+def _no_compiler_params(*_a, **_k):
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams on this jax version — update the alias here")
+
+
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams",
+                                  _no_compiler_params))
+
 from ..tensor._helper import apply
 
 _BLOCK_Q = 1024        # default tile edges (capped by seq len). Large tiles
@@ -200,7 +213,7 @@ def _fwd(q3, k3, v3, scale, causal, block_q, block_k):
             pltpu.VMEM((block_q, 1), jnp.float32),       # running denom
             pltpu.VMEM((block_q, d), jnp.float32),       # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * sq * sk * d // (2 if causal else 1),
@@ -373,7 +386,7 @@ def _bwd_single_tile(scale, causal, res, do3, delta, dtypes):
             jax.ShapeDtypeStruct((bh, sk, d), dk_dtype),
             jax.ShapeDtypeStruct((bh, sk, d), dv_dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)
@@ -425,7 +438,7 @@ def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
         out_specs=[pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((bh, sq, d), dq_dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)[0]
@@ -455,7 +468,7 @@ def _bwd(scale, causal, block_q, block_k, res, do3, delta=None,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q3, k3, v3, do3, lse, delta)

@@ -38,6 +38,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax < 0.5 names it TPUCompilerParams; the kwargs are identical
+
+
+def _no_compiler_params(*_a, **_k):
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams on this jax version — update the alias here")
+
+
+_CompilerParams = getattr(pltpu, "CompilerParams",
+                          getattr(pltpu, "TPUCompilerParams",
+                                  _no_compiler_params))
+
 
 def _interpret() -> bool:
     from ..core.place import target_platform
@@ -140,7 +153,7 @@ def int8_matmul(x, wq, scale, bias=None, qscale=None, *,
         out_shape=jax.ShapeDtypeStruct(
             (mp, np_), jnp.int8 if quant_out else out_dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(xp, wp, qs, sp, bp)

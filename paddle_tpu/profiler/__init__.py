@@ -1,0 +1,119 @@
+"""paddle_tpu.profiler — unified runtime observability.
+
+Three pillars, one switch (``profiler.enable()``):
+
+1. **Tracing** (``trace.py``): ``profiler.scope("name")`` /
+   ``RecordEvent`` context managers. Inside a jit trace they lower to
+   ``jax.named_scope`` (op-name metadata — device time attributable in
+   XLA traces); outside they are host ``perf_counter`` spans that double
+   as ``jax.profiler.TraceAnnotation``. Export: ``export_chrome_trace``
+   (chrome://tracing JSON) and ``scope_summary`` per-scope stats.
+
+2. **Metrics** (``metrics.py``): counters / gauges / histograms in a
+   process-global registry — steps, tokens, per-phase ms, collective
+   bytes, device-memory high-water marks. ``registry().aggregate()``
+   reduces across ranks via distributed/fleet/metrics.py.
+
+3. **Recompilation telemetry** (``recompile.py``): instrumented step
+   functions report every jit (re)trace with the triggering abstract
+   shapes; the ``profiler/retraces`` counter and ``retraces()`` log make
+   silent recompiles in hybrid.py/pipeline.py visible.
+
+Instrumented out of the box: ``HybridPipelineTrainer`` /
+``HybridParallelTrainer`` steps (distributed/hybrid.py,
+strategy_compiler.py), the pipeline schedule (named phases in the
+compiled program), MoE dispatch/combine, ``hapi.Model`` train/eval
+batches, and ``hapi.callbacks.ProfilerCallback`` for fit() loops. All
+hooks are behind a single enabled check — disabled cost is one bool
+read per step.
+
+Quick use::
+
+    import paddle_tpu.profiler as profiler
+    profiler.enable()
+    ... train ...
+    print(profiler.summary())          # phases, rates, counters, retraces
+    profiler.export_chrome_trace("trace.json")
+    profiler.disable()
+"""
+from __future__ import annotations
+
+from . import instrument, metrics, recompile, trace  # noqa: F401
+from .instrument import (collective_stats, device_memory_stats,  # noqa: F401
+                         estimate_comm_ms, record_collective_stats,
+                         record_collectives_from, record_memory_high_water,
+                         record_phases, tokens_in_batch)
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      registry)
+from .recompile import (mark_trace, retraces, suppressed,  # noqa: F401
+                        trace_counts, unique_site, watch)
+from .trace import (RecordEvent, annotate, chrome_trace,  # noqa: F401
+                    export_chrome_trace, is_enabled, scope,
+                    scope_summary)
+
+__all__ = [
+    "enable", "disable", "is_enabled", "reset",
+    "scope", "RecordEvent", "annotate",
+    "scope_summary", "chrome_trace", "export_chrome_trace",
+    "registry", "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "mark_trace", "watch", "retraces", "trace_counts", "suppressed",
+    "unique_site",
+    "collective_stats", "record_collective_stats",
+    "record_collectives_from", "estimate_comm_ms",
+    "record_phases", "device_memory_stats", "record_memory_high_water",
+    "tokens_in_batch",
+    "summary",
+]
+
+
+def enable(trace_dir=None, reset: bool = True) -> None:
+    """Turn profiling on. ``reset`` (default) clears prior host spans,
+    the metrics registry, and the public retrace log, so the window's
+    counters and rates cover only this session; retrace signature
+    HISTORY is kept (a step function first traced before enable must
+    still read as a retrace on its next re-trace). ``trace_dir``
+    additionally starts a jax/XLA device trace into that directory."""
+    if reset:
+        trace.reset_events()
+        metrics.registry().reset()
+        recompile.clear_log()
+    trace.enable(trace_dir=trace_dir, reset=False)
+
+
+def disable() -> dict:
+    """Stop profiling; returns the full summary()."""
+    s = summary()
+    trace.disable()
+    return s
+
+
+def reset() -> None:
+    """Clear spans, metrics, and retrace history (enabled flag kept)."""
+    trace.reset_events()
+    metrics.registry().reset()
+    recompile.reset()
+
+
+def summary(aggregate: bool = False) -> dict:
+    """One JSON-ready dict with everything this subsystem observed:
+    per-scope host spans, metric snapshot (rank-aggregated when
+    ``aggregate``), derived rates (tokens/sec, steps/sec over the enabled
+    window), per-phase ms gauges, and the retrace log."""
+    reg = metrics.registry()
+    snap = reg.aggregate() if aggregate else reg.snapshot()
+    window_s = trace.enabled_window_s()
+    rates = {}
+    phases = {}
+    for name, s in snap.items():
+        if s["type"] == "counter" and window_s > 0 and \
+                name.startswith("train/"):
+            rates[name.split("/", 1)[1] + "_per_sec"] = round(
+                s["value"] / window_s, 3)
+        if name.startswith("phase/") and s.get("value") is not None:
+            phases[name.split("/", 1)[1]] = round(s["value"], 4)
+    return {"enabled_window_s": round(window_s, 6),
+            "scopes": trace.scope_summary(),
+            "metrics": snap,
+            "rates": rates,
+            "phases_ms": phases,
+            "retraces": recompile.retraces()}

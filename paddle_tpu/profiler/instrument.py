@@ -1,0 +1,254 @@
+"""Instrumentation helpers shared by the trainer/pipeline hooks.
+
+- collective accounting: parse a lowered (StableHLO) program for
+  cross-device collectives and sum the bytes they move — the number that
+  makes an allreduce-compression experiment (EQuARX-style) attributable
+  instead of inferred from wall-clock deltas;
+- device memory high-water marks via ``Device.memory_stats()`` (absent on
+  CPU and behind some remote-device tunnels — callers get None, never an
+  exception);
+- batch token counting for throughput metrics.
+"""
+from __future__ import annotations
+
+import re
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .metrics import registry
+
+# StableHLO collective ops (jax lowers psum/all_gather/ppermute/... to
+# these). The text form is `%x = "stablehlo.all_reduce"(...)` or
+# `stablehlo.all_reduce(...)` depending on printer version.
+_COLLECTIVE_RE = re.compile(
+    r"stablehlo\.(all_reduce|all_gather|all_to_all|collective_permute|"
+    r"reduce_scatter|collective_broadcast)")
+_TENSOR_RE = re.compile(r"tensor<([0-9x]*)x?([a-z][a-z0-9]+)>")
+# everything after the function-type arrow: the op's result type(s)
+_ARROW_RE = re.compile(r"->\s*(.*)$")
+# post-partitioning HLO spelling (`compiled.as_text()`): the op name is
+# dash-separated and the RESULT type(s) sit between `=` and the op name,
+# e.g. `%ar = f32[8,4]{1,0} all-reduce(...)` or a `(f32[..], ...)` tuple.
+# Async pairs: count the `-done` op (its result is the payload) and skip
+# `-start` (its result tuple aliases operand+result — double the bytes).
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s*(\(?[a-z][a-z0-9]+\[[^=]*?)\s"
+    r"(all-reduce|all-gather|all-to-all|collective-permute|"
+    r"reduce-scatter|collective-broadcast)(?:-done)?\(")
+_HLO_TYPE_RE = re.compile(r"([a-z][a-z0-9]+)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1, "pred": 1,
+}
+
+
+def _tensor_bytes(dims: str, dtype: str) -> int:
+    n = 1
+    if dims:
+        for d in dims.split("x"):
+            if d:
+                n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4)
+
+
+def collective_stats(lowered_text: str) -> dict:
+    """Count collectives and the bytes they move in a lowered program.
+
+    ``lowered_text``: ``jitted.lower(...).as_text()`` (StableHLO) or
+    ``.lower(...).compile().as_text()`` (optimized HLO). Bytes are the
+    per-invocation result-buffer sizes — i.e. what one execution of the
+    program moves across the collective, not link-level wire bytes
+    (which depend on the algorithm XLA picks). NOTE: a GSPMD program
+    (jit + shardings, no shard_map) keeps its collectives implicit until
+    XLA's SPMD partitioner runs, so its StableHLO reports 0 — pass the
+    COMPILED text to count those. Returns
+    {"ops": {op_name: count}, "bytes": {op_name: bytes}, "total_bytes"}.
+    """
+    ops: dict = {}
+    byts: dict = {}
+    lines = lowered_text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            hm = _HLO_COLLECTIVE_RE.search(line)
+            if hm:
+                op = hm.group(2).replace("-", "_")
+                ops[op] = ops.get(op, 0) + 1
+                byts[op] = byts.get(op, 0) + sum(
+                    _tensor_bytes(dims.replace(",", "x"), dt)
+                    for dt, dims in _HLO_TYPE_RE.findall(hm.group(1)))
+            i += 1
+            continue
+        op = m.group(1)
+        ops[op] = ops.get(op, 0) + 1
+        # Region-bearing collectives (all_reduce / reduce_scatter carry
+        # their reduction computation as a region) print the function
+        # type on the region's CLOSING `}) : (...) -> ...` line; reading
+        # the op line instead would pick up the replica_groups attribute
+        # type (tensor<NxMxi64>).
+        type_line = line
+        if line.rstrip().endswith("({"):
+            j = i + 1
+            while j < len(lines):
+                if lines[j].lstrip().startswith("})"):
+                    type_line = lines[j]
+                    i = j
+                    break
+                j += 1
+        am = _ARROW_RE.search(type_line)
+        tensors = _TENSOR_RE.findall(am.group(1)) if am else []
+        if tensors:
+            # after `->`: the result type(s); variadic collectives print
+            # a tuple `(tensor<..>, tensor<..>)` — sum every buffer
+            byts[op] = byts.get(op, 0) + sum(
+                _tensor_bytes(d, t) for d, t in tensors)
+        else:
+            # compact printer form has no arrow (`... applies stablehlo.add
+            # : tensor<..>`): last tensor type on the line is the result
+            tensors = _TENSOR_RE.findall(type_line)
+            if tensors:
+                dims, dt = tensors[-1]
+                byts[op] = byts.get(op, 0) + _tensor_bytes(dims, dt)
+        i += 1
+    return {"ops": ops, "bytes": byts,
+            "total_bytes": sum(byts.values())}
+
+
+def record_collective_stats(lowered_text: str, prefix: str = "comm") -> dict:
+    """collective_stats + fold the totals into the metrics registry."""
+    st = collective_stats(lowered_text)
+    reg = registry()
+    reg.gauge(f"{prefix}/collective_bytes_per_step").set(st["total_bytes"])
+    reg.gauge(f"{prefix}/collective_ops_per_step").set(
+        sum(st["ops"].values()))
+    return st
+
+
+def record_collectives_from(lowered, mesh=None, prefix: str = "comm") -> dict:
+    """record_collective_stats over a ``jax.stages.Lowered``, with the
+    GSPMD fallback: when the StableHLO shows ZERO collectives on a
+    multi-device mesh, parse the partitioned (compiled) program instead
+    — GSPMD keeps its collectives implicit until XLA's SPMD partitioner,
+    and only paying the extra compile in that case keeps shard_map
+    programs cheap. (A mixed shard_map+GSPMD program whose StableHLO
+    already shows some collectives skips the fallback and undercounts
+    the implicit ones — callers wanting exact mixed accounting must pass
+    compiled text to record_collective_stats themselves.)"""
+    text = lowered.as_text()
+    if not collective_stats(text)["ops"] and mesh is not None \
+            and mesh.devices.size > 1:
+        text = lowered.compile().as_text()
+    return record_collective_stats(text, prefix)
+
+
+def device_memory_stats(device=None) -> Optional[dict]:
+    """``Device.memory_stats()`` of the first (or given) local device;
+    None where the backend does not report (CPU, some remote tunnels)."""
+    try:
+        d = device or jax.local_devices()[0]
+        stats = d.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    return dict(stats)
+
+
+def record_memory_high_water(prefix: str = "memory") -> Optional[int]:
+    """Record the device-memory high-water mark (bytes) as a max-gauge.
+    Returns the current peak or None when the backend has no stats."""
+    st = device_memory_stats()
+    if st is None:
+        return None
+    peak = st.get("peak_bytes_in_use", st.get("bytes_in_use"))
+    if peak is None:
+        return None
+    reg = registry()
+    reg.gauge(f"{prefix}/peak_bytes_in_use").set_max(int(peak))
+    if "bytes_in_use" in st:
+        reg.gauge(f"{prefix}/bytes_in_use").set(int(st["bytes_in_use"]))
+    return int(peak)
+
+
+# Nominal interconnect bandwidth (bytes/s, per direction) used by the
+# comm-phase MODEL below. v5e ICI is ~45 GB/s/link; the CPU figure is a
+# loopback placeholder so the model degrades to ~0 on test platforms.
+_LINK_BW = {"tpu": 45e9, "cpu": 10e9}
+
+
+def estimate_comm_ms(total_bytes: int, platform: str = "tpu") -> float:
+    """Lower-bound comm-phase time from collective bytes over the nominal
+    interconnect bandwidth. A MODEL, not a measurement: XLA overlaps
+    collectives with compute and picks algorithms that change wire bytes;
+    this answers "how long would the bytes alone take at link rate" —
+    0 for a program with no collectives (single chip)."""
+    return total_bytes / _LINK_BW.get(platform, _LINK_BW["tpu"]) * 1e3
+
+
+def _first_leaf(o) -> float:
+    return float(np.asarray(jax.tree_util.tree_leaves(o)[0]).ravel()[0])
+
+
+def time_compiled(fn, iters: int = 2) -> float:
+    """Mean seconds per call of ``fn`` (a thunk running a jitted
+    program): one call to compile + warm, then ``iters`` timed calls
+    ended by a host fetch of the first output leaf — the only truthful
+    sync point under async dispatch. Shared by every
+    ``profile_step_phases`` so the phase numbers trainers report stay
+    comparable."""
+    _first_leaf(fn())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    _first_leaf(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def record_phases(fwd_s=None, fwdbwd_s=None, step_s=None,
+                  comm_bytes=None, platform: str = "tpu") -> dict:
+    """Fold a phase decomposition (seconds; any may be None) into the
+    ``phase/*_ms`` gauges the profiler summary reports.
+
+    The step is ONE fused XLA program, so trainers time nested prefixes
+    (fwd-only, fwd+bwd, full step) and this derives
+    bwd = fwdbwd − fwd, optim = step − fwdbwd. comm is modeled from
+    collective bytes (estimate_comm_ms). Returns the phases dict (ms).
+    """
+    reg = registry()
+    out = {}
+    if fwd_s is not None:
+        out["fwd_ms"] = fwd_s * 1e3
+    if fwdbwd_s is not None and fwd_s is not None:
+        out["bwd_ms"] = max(fwdbwd_s - fwd_s, 0.0) * 1e3
+    if step_s is not None:
+        out["step_ms"] = step_s * 1e3
+        if fwdbwd_s is not None:
+            out["optim_ms"] = max(step_s - fwdbwd_s, 0.0) * 1e3
+    if comm_bytes is not None:
+        out["comm_ms"] = estimate_comm_ms(comm_bytes, platform)
+    for k, v in out.items():
+        reg.gauge(f"phase/{k[:-3]}_ms").set(round(v, 4))
+    return {k: round(v, 4) for k, v in out.items()}
+
+
+def tokens_in_batch(batch) -> int:
+    """Throughput accounting for a step's batch: ``batch*seq`` when the
+    first array-like argument is a 2-d INTEGER array (a token grid),
+    else its ``batch`` dim (sample count — a [N,C,H,W] image batch must
+    not scale with channels). Labels/aux inputs ride dim-0-aligned with
+    the first, so the first is the truthful count."""
+    for b in batch:
+        shape = getattr(b, "shape", None)
+        if shape is None or len(shape) == 0:
+            continue
+        if len(shape) == 2 and "int" in str(getattr(b, "dtype", "")):
+            return int(shape[0]) * int(shape[1])
+        return int(shape[0])
+    return 0

@@ -1,0 +1,249 @@
+"""Runtime metrics registry: counters, gauges, histograms with rank-aware
+aggregation.
+
+Reference analogue: distributed/fleet/metrics/metric.py aggregates ad-hoc
+numpy values over the RoleMaker's Gloo ring; here the registry is the
+first-class store (steps, tokens, per-phase ms, collective bytes, memory
+high-water marks) and cross-rank reduction rides the same eager collective
+helpers (distributed/fleet/metrics.py -> distributed/collective.py over
+the jax coordination service). world_size == 1 degenerates to identity, so
+every aggregation path is exercisable in single-process tests.
+
+Instrumentation sites guard their .add()/.set() calls on
+``profiler.is_enabled()`` — the registry itself is always usable directly
+(a user metric does not need the tracer to be on).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_RESERVOIR = 1024
+
+
+class Counter:
+    """Monotonic accumulator (tokens seen, steps run, retraces, bytes)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, v: float = 1.0) -> None:
+        with self._lock:
+            self._v += float(v)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "value": self._v}
+
+
+class Gauge:
+    """Last-value metric (memory high-water, phase ms, learning rate)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    def set_max(self, v: float) -> None:
+        """High-water-mark update: keep the max of current and v."""
+        with self._lock:
+            v = float(v)
+            if self._v is None or v > self._v:
+                self._v = v
+
+    @property
+    def value(self) -> Optional[float]:
+        return self._v
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self._v}
+
+
+class Histogram:
+    """Distribution metric (per-step ms). Keeps exact count/sum/min/max
+    plus a bounded reservoir of the most recent observations for
+    percentiles — step-time telemetry must not grow without bound over a
+    million-step run."""
+
+    __slots__ = ("name", "_n", "_sum", "_min", "_max", "_recent", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._n = 0
+        self._sum = 0.0
+        self._min = float("inf")
+        self._max = float("-inf")
+        self._recent: List[float] = []
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        with self._lock:
+            self._n += 1
+            self._sum += v
+            self._min = min(self._min, v)
+            self._max = max(self._max, v)
+            self._recent.append(v)
+            if len(self._recent) > _RESERVOIR:
+                del self._recent[: len(self._recent) - _RESERVOIR]
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def percentile(self, q: float) -> Optional[float]:
+        with self._lock:
+            if not self._recent:
+                return None
+            s = sorted(self._recent)
+            i = min(int(q / 100.0 * len(s)), len(s) - 1)
+            return s[i]
+
+    def snapshot(self) -> dict:
+        if self._n == 0:
+            return {"type": "histogram", "count": 0}
+        return {"type": "histogram", "count": self._n,
+                "sum": self._sum, "mean": self._sum / self._n,
+                "min": self._min, "max": self._max,
+                "p50": self.percentile(50), "p99": self.percentile(99)}
+
+
+class MetricsRegistry:
+    """Named metric store. ``counter/gauge/histogram(name)`` create on
+    first use (prometheus-client idiom); ``aggregate()`` reduces across
+    ranks; ``snapshot()`` is the JSON-ready export."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in items}
+
+    @staticmethod
+    def _schema_union(snap: Dict[str, dict]) -> List[Tuple[str, str]]:
+        """All ranks' (name, type) pairs, unioned and sorted — the ONE
+        deterministic reduction order every rank walks in aggregate().
+        Each rank's schema rides an allgather of its JSON encoding,
+        padded to the allreduced max length (collectives move fixed-size
+        buffers, not strings)."""
+        from ..distributed.collective import all_gather
+        from ..distributed.env import get_world_size
+        from ..distributed.fleet import metrics as fm
+        from ..framework.tensor import Tensor
+
+        local = sorted((n, s["type"]) for n, s in snap.items())
+        if get_world_size() <= 1:
+            return local
+        payload = np.frombuffer(
+            json.dumps(local).encode(), np.uint8).copy()
+        buf = np.zeros(int(fm.max(payload.size)), np.uint8)
+        buf[: payload.size] = payload
+        gathered: list = []
+        all_gather(gathered, Tensor(buf))
+        union = set()
+        for t in gathered:
+            raw = bytes(np.asarray(t._value).astype(np.uint8))
+            union.update(tuple(p) for p in json.loads(
+                raw.rstrip(b"\x00").decode()))
+        return sorted(union)
+
+    def aggregate(self) -> Dict[str, dict]:
+        """Cross-rank reduction of the snapshot: counters and histogram
+        count/sum are SUM-reduced, gauges and histogram min/max take the
+        MAX/MIN envelope (a fleet-wide high-water mark is the max over
+        ranks). Rides distributed/fleet/metrics.py — identity at
+        world_size 1.
+
+        Every fm.* call is a collective, so ranks MUST issue the same
+        sequence: the schema union above aligns rank-dependent metric
+        sets (a retrace counter only rank 0 created, a histogram still
+        empty on rank 1) and its sorted order fixes the pairing; a
+        locally-missing metric contributes the reduction's neutral
+        element instead of skipping the collective."""
+        from ..distributed.env import get_world_size
+        from ..distributed.fleet import metrics as fm
+
+        snap = self.snapshot()
+        if get_world_size() <= 1:
+            return snap
+        for name, typ in self._schema_union(snap):
+            s = snap.get(name)
+            if s is None or s["type"] != typ:
+                s = snap[name] = (
+                    {"type": "histogram", "count": 0}
+                    if typ == "histogram" else {"type": typ, "value": None})
+            if typ == "counter":
+                s["value"] = float(fm.sum(s["value"] or 0.0))
+            elif typ == "gauge":
+                v = s["value"]
+                red = float(fm.max(v if v is not None else -np.inf))
+                s["value"] = None if red == -np.inf else red
+            elif typ == "histogram":
+                have = bool(s.get("count"))
+                n = int(fm.sum(s.get("count", 0)))
+                tot = float(fm.sum(s.get("sum", 0.0)))
+                mn = float(fm.min(s["min"] if have else np.inf))
+                mx = float(fm.max(s["max"] if have else -np.inf))
+                if n:
+                    s.update(count=n, sum=tot, mean=tot / n,
+                             min=mn, max=mx)
+                # reservoirs are rank-local; a p99 next to fleet-wide
+                # count/min/max would read as fleet-wide when it isn't
+                s.pop("p50", None)
+                s.pop("p99", None)
+        return snap
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry

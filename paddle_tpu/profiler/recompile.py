@@ -1,0 +1,145 @@
+"""Recompilation telemetry: make silent jit retraces visible.
+
+A hybrid/pipeline step function is supposed to trace ONCE and then hit
+the jit cache forever; every extra trace is minutes of XLA compile time
+silently folded into a training run (a changed batch shape, a dtype
+drift, a python-scalar argument). The reference framework never had this
+failure mode (programs were built ahead of time); a jit-staged framework
+needs a watcher.
+
+Mechanism: instrumented step functions call ``mark_trace(site, *trees)``
+at the TOP of their traced body. Python side effects run exactly once per
+trace, so the call itself is the cache-miss signal — zero per-step cost,
+no jax internals. The watcher keeps each site's abstract signature
+(shape/dtype of every leaf); any trace after a site's first is a
+**retrace** and is recorded with the shapes that triggered it, diffed
+against the previous signature.
+"""
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, List
+
+import jax
+
+from . import trace as _trace
+from .metrics import registry
+
+logger = logging.getLogger("paddle_tpu.profiler")
+
+_lock = threading.Lock()
+_sites: Dict[str, List[tuple]] = {}       # site -> signature history
+_retraces: List[dict] = []
+_MAX_HISTORY = 64
+_suppress = 0
+_site_seq = itertools.count()
+
+
+def unique_site(prefix: str) -> str:
+    """A process-unique site name for per-instance step functions (two
+    trainers must not alias one site — the second's FIRST trace would
+    read as the first's retrace)."""
+    return f"{prefix}#{next(_site_seq)}"
+
+
+@contextmanager
+def suppressed():
+    """Traces inside this context update signature history but not the
+    public retrace counter/log — for internal diagnostic lowerings
+    (aot_lower for collective accounting, memory_analysis) that re-trace
+    by design and are not silent recompiles."""
+    global _suppress
+    _suppress += 1
+    try:
+        yield
+    finally:
+        _suppress -= 1
+
+
+def _aval_sig(x: Any) -> tuple:
+    aval = getattr(x, "aval", None)
+    if aval is not None:
+        return (tuple(aval.shape), str(aval.dtype))
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return (tuple(shape), str(dtype))
+    return ((), type(x).__name__)
+
+
+def signature(*trees) -> tuple:
+    return tuple(_aval_sig(leaf)
+                 for t in trees for leaf in jax.tree_util.tree_leaves(t))
+
+
+def mark_trace(site: str, *trees) -> None:
+    """Record that ``site`` is being traced with these arguments. Call
+    from INSIDE the traced function body (first line). Signature history
+    is tracked unconditionally (a site first traced while profiling was
+    off must still detect its first retrace after enable); the public
+    counter/log only move while the profiler is enabled."""
+    sig = signature(*trees)
+    with _lock:
+        hist = _sites.setdefault(site, [])
+        is_retrace = bool(hist)
+        prev = hist[-1] if hist else None
+        hist.append(sig)
+        if len(hist) > _MAX_HISTORY:
+            del hist[: len(hist) - _MAX_HISTORY]
+    if is_retrace and _trace.is_enabled() and not _suppress:
+        # zip_longest: a leaf-count change (argument added/removed) must
+        # show up as a diff entry, not be truncated to "same signature"
+        ev = {"site": site, "trace_no": len(hist),
+              "prev_signature": prev, "signature": sig,
+              "changed": [
+                  {"index": i, "prev": p, "new": n}
+                  for i, (p, n) in enumerate(
+                      itertools.zip_longest(prev, sig)) if p != n]}
+        with _lock:
+            _retraces.append(ev)
+        registry().counter("profiler/retraces").add(1)
+        logger.warning(
+            "jit retrace at %s (trace #%d): %s", site, len(hist),
+            ev["changed"] if ev["changed"]
+            else "same signature (function object rebuilt)")
+
+
+def watch(fn, site: str = None):  # noqa: RUF013 - mirrors functools style
+    """Wrap an arbitrary function so every (re)trace of it is recorded:
+    ``step = jax.jit(profiler.watch(step_fn, "my.step"))``."""
+    name = site or getattr(fn, "__qualname__", getattr(fn, "__name__",
+                                                       "fn"))
+
+    def wrapped(*args, **kwargs):
+        mark_trace(name, args, kwargs)
+        return fn(*args, **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", "wrapped")
+    return wrapped
+
+
+def retraces() -> List[dict]:
+    with _lock:
+        return list(_retraces)
+
+
+def clear_log() -> None:
+    """Clear the public retrace log but KEEP signature history — a site
+    first traced before this call must still read as a retrace on its
+    next re-trace (enable() calls this; reset() drops history too)."""
+    with _lock:
+        _retraces.clear()
+
+
+def trace_counts() -> Dict[str, int]:
+    with _lock:
+        return {site: len(h) for site, h in _sites.items()}
+
+
+def reset() -> None:
+    with _lock:
+        _sites.clear()
+        _retraces.clear()

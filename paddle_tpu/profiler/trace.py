@@ -1,0 +1,235 @@
+"""Tracing layer: nested scopes that lower to the right mechanism per
+execution regime.
+
+Reference analogue: platform/profiler.h RecordEvent + the chrome-trace
+export of profiler.proto. TPU-native translation (SURVEY §5: the host
+never sees device op boundaries):
+
+  - **inside a jit trace** a scope is pure metadata — ``jax.named_scope``
+    prefixes every op traced under it, so XLA traces / HLO dumps attribute
+    device time to the phase. Host timing a tracer would measure tracing,
+    not execution, so no host span is recorded there.
+  - **outside jit** (eager ops, dispatch, h2d staging, host pre/post) a
+    scope is a ``perf_counter_ns`` span, nested via a thread-local stack,
+    and doubles as ``jax.profiler.TraceAnnotation`` so the span also shows
+    up inside a ``jax.profiler.start_trace`` device timeline.
+
+Disabled mode is the fast path: ``scope()`` is a no-op context manager
+guarded by one module-global bool — no allocation, no lock, no event.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+try:  # private jax API with a public-behavior contract (moe.py precedent)
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover - future jax renames
+    def _trace_state_clean():
+        return True
+
+
+_enabled = False
+_lock = threading.Lock()
+_events: List[tuple] = []      # (full_name, start_ns, end_ns, thread_id)
+# A million-step profiled fit must not grow host RAM without bound
+# (Histogram's reservoir rule): the chrome-trace span list keeps the
+# most recent _MAX_EVENTS, older ones are dropped (counted below) —
+# scope_summary stays EXACT via the incremental _agg aggregates.
+_MAX_EVENTS = 100_000
+_dropped = 0
+_agg: Dict[str, list] = {}     # name -> [count, total_ns, min_ns, max_ns]
+_t_enable_ns: Optional[int] = None
+_t_disable_ns: Optional[int] = None
+_jax_trace_dir: Optional[str] = None
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.stack: List[str] = []
+
+
+_tls = _TLS()
+
+
+def is_enabled() -> bool:
+    return _enabled
+
+
+def enable(trace_dir: Optional[str] = None, reset: bool = True) -> None:
+    """Turn profiling on. ``trace_dir`` additionally starts a jax/XLA
+    device trace (TensorBoard-loadable) into that directory; host scopes
+    ride along as TraceAnnotations."""
+    global _enabled, _t_enable_ns, _t_disable_ns, _jax_trace_dir
+    if reset:
+        reset_events()
+    _t_enable_ns = time.perf_counter_ns()
+    _t_disable_ns = None
+    if trace_dir:
+        _jax_trace_dir = trace_dir
+        jax.profiler.start_trace(trace_dir)
+    _enabled = True
+
+
+def disable() -> Dict[str, dict]:
+    """Turn profiling off; returns the per-scope summary (scope_summary)."""
+    global _enabled, _t_disable_ns, _jax_trace_dir
+    _enabled = False
+    _t_disable_ns = time.perf_counter_ns()
+    if _jax_trace_dir:
+        jax.profiler.stop_trace()
+        _jax_trace_dir = None
+    return scope_summary()
+
+
+def reset_events() -> None:
+    global _dropped
+    with _lock:
+        _events.clear()
+        _agg.clear()
+        _dropped = 0
+
+
+def enabled_window_s() -> float:
+    """Seconds the profiler has been (was) enabled — the denominator for
+    rate metrics (tokens/sec, steps/sec)."""
+    if _t_enable_ns is None:
+        return 0.0
+    end = _t_disable_ns if _t_disable_ns is not None \
+        else time.perf_counter_ns()
+    return max(end - _t_enable_ns, 0) / 1e9
+
+
+class scope:  # noqa: N801 - context manager, lowercase like jax.named_scope
+    """``with profiler.scope("hybrid/fwd"):`` — see module docstring for
+    the per-regime lowering. Nesting composes: host spans inherit the
+    enclosing scopes' names ("step/h2d"), traced scopes nest via
+    jax.named_scope's own stack."""
+
+    __slots__ = ("name", "_t0", "_full", "_jax_ctx", "_mode")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = 0
+        self._full = name
+        self._jax_ctx = None
+        self._mode = 0  # 0: off, 1: host span, 2: named_scope
+
+    def __enter__(self):
+        if not _enabled:
+            return self
+        if not _trace_state_clean():
+            # inside a jit/grad trace: metadata only
+            self._mode = 2
+            self._jax_ctx = jax.named_scope(self.name)
+            self._jax_ctx.__enter__()
+            return self
+        self._mode = 1
+        stack = _tls.stack
+        self._full = "/".join(stack + [self.name]) if stack else self.name
+        stack.append(self.name)
+        self._jax_ctx = jax.profiler.TraceAnnotation(self._full)
+        self._jax_ctx.__enter__()
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        if self._mode == 1:
+            t1 = time.perf_counter_ns()
+            if self._jax_ctx is not None:
+                self._jax_ctx.__exit__(None, None, None)
+            if _tls.stack and _tls.stack[-1] == self.name:
+                _tls.stack.pop()
+            global _dropped
+            dt = t1 - self._t0
+            with _lock:
+                a = _agg.get(self._full)
+                if a is None:
+                    _agg[self._full] = [1, dt, dt, dt]
+                else:
+                    a[0] += 1
+                    a[1] += dt
+                    if dt < a[2]:
+                        a[2] = dt
+                    if dt > a[3]:
+                        a[3] = dt
+                _events.append((self._full, self._t0, t1,
+                                threading.get_ident()))
+                if len(_events) > _MAX_EVENTS:
+                    drop = len(_events) - _MAX_EVENTS
+                    del _events[:drop]
+                    _dropped += drop
+        elif self._mode == 2 and self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        self._mode = 0
+        self._jax_ctx = None
+        return False
+
+
+class RecordEvent(scope):
+    """RAII span under the reference's name (profiler.h:127): explicit
+    ``begin()`` / ``end()`` in addition to the context-manager protocol."""
+
+    def begin(self):
+        return self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+def annotate(name: str):
+    """Pure device-side annotation: ALWAYS a ``jax.named_scope`` (zero
+    runtime cost — op-name metadata only), independent of the enabled
+    flag. Use inside jitted step functions so the phase names are baked
+    into the compiled program whether or not profiling is on when the
+    program is traced."""
+    return jax.named_scope(name)
+
+
+def events() -> List[tuple]:
+    with _lock:
+        return list(_events)
+
+
+def scope_summary() -> Dict[str, dict]:
+    """Per-scope host-span statistics: {full_name: {count, total_ms,
+    mean_ms, min_ms, max_ms}} — from the incremental aggregates, so the
+    numbers stay exact even after old spans age out of the bounded
+    chrome-trace event list."""
+    with _lock:
+        items = [(name, list(a)) for name, a in _agg.items()]
+    out = {}
+    for name, (n, tot, mn, mx) in items:
+        out[name] = {"count": n, "total_ms": round(tot / 1e6, 4),
+                     "mean_ms": round(tot / n / 1e6, 4),
+                     "min_ms": round(mn / 1e6, 4),
+                     "max_ms": round(mx / 1e6, 4)}
+    return out
+
+
+def chrome_trace(extra_metadata: Optional[dict] = None) -> dict:
+    """Collected host spans as a chrome://tracing / Perfetto-loadable
+    object ({"traceEvents": [...]}); counters from the metrics registry
+    ride along as metadata so one artifact carries the whole picture."""
+    evs = events()
+    trace_events = [
+        {"name": n, "ph": "X", "ts": t0 / 1e3, "dur": (t1 - t0) / 1e3,
+         "pid": 0, "tid": tid, "cat": "host"}
+        for n, t0, t1, tid in evs]
+    doc = {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    meta = dict(extra_metadata or {})
+    if _dropped:
+        meta["dropped_events"] = _dropped
+    doc["otherData"] = meta
+    return doc
+
+
+def export_chrome_trace(path: str,
+                        extra_metadata: Optional[dict] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(extra_metadata), f)
+    return path

@@ -2,11 +2,20 @@
 exercised without TPU hardware (SURVEY.md §4 implication (b): XLA's
 --xla_force_host_platform_device_count replaces the reference's
 "2 subprocesses on localhost" distributed-test trick)."""
+import os
+
 import jax
 
 # NOTE: env-var routes (JAX_PLATFORMS / XLA_FLAGS) are unreliable here —
-# the axon TPU plugin's sitecustomize interferes; jax.config is authoritative.
-jax.config.update("jax_num_cpu_devices", 8)
+# the axon TPU plugin's sitecustomize interferes; jax.config is authoritative
+# where it exists (jax >= 0.5). Older jax falls back to the XLA flag, which
+# only works because the CPU backend has not initialized yet at conftest
+# import. Never set both: newer jax rejects the combination at backend init.
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
 jax.config.update("jax_platforms", "cpu")
 
 # Golden-value tests compare against float64 numpy: use exact fp32 matmuls.
