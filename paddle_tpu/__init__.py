@@ -60,6 +60,7 @@ from .hapi import callbacks  # noqa: F401,E402
 from . import static  # noqa: F401,E402
 from .static import create_parameter  # noqa: F401,E402
 from . import distributed  # noqa: F401,E402
+from . import resilience  # noqa: F401,E402
 from . import vision  # noqa: F401,E402
 from . import ops  # noqa: F401,E402
 from . import incubate  # noqa: F401,E402

@@ -193,6 +193,16 @@ def save(directory: str, state, step: int, meta: Optional[dict] = None,
     nproc = jax.process_count()
     step_dir = os.path.join(directory, _STEP_FMT.format(step))
     os.makedirs(step_dir, exist_ok=True)
+    # re-saving a step that was committed before (a rollback replay, or
+    # a resumed run crossing its old save cadence): the stale COMMIT
+    # must come off BEFORE any shard byte is rewritten, or a crash
+    # mid-rewrite would leave a dir that latest_step trusts but whose
+    # shards are half old, half new.
+    commit_path = os.path.join(step_dir, _COMMIT)
+    if proc == 0 and os.path.exists(commit_path):
+        os.unlink(commit_path)
+        _fsync_dir(step_dir)
+    _barrier(f"ckpt_recommit_{step}")
 
     # inline part: device→host copies of owned shards (snapshot semantics —
     # training may mutate device state the moment this returns)
@@ -459,6 +469,48 @@ def restore(directory: str, template, step: Optional[int] = None,
     return jax.tree_util.tree_unflatten(treedef, out_flat)
 
 
+def restore_degraded(directory: str, template, verify: bool = True,
+                     on_fallback=None):
+    """Degraded-mode restore: newest committed step first, walking back
+    to older committed steps when a step turns out unreadable (CRC
+    mismatch, truncated or missing shard, lost manifest, mangled JSON)
+    instead of raising — a fleet restore must prefer losing a few steps
+    of progress over losing the job.
+
+    Every skipped step bumps the ``resilience/restore_fallbacks``
+    profiler counter and emits a warning; ``on_fallback(step, exc)``
+    observes each skip. Returns ``(state, meta, step)``; raises only
+    when NO committed step is readable.
+    """
+    import warnings
+
+    from ..profiler.metrics import registry as _registry
+
+    steps = all_steps(directory)
+    if not steps:
+        raise FileNotFoundError(f"no committed checkpoint in {directory}")
+    errors = []
+    for step in reversed(steps):
+        try:
+            state = restore(directory, template, step=step, verify=verify)
+            # a step whose META is mangled is as unreadable as one with
+            # bad shards — resume needs the rng/cursor in it, so the
+            # walk-back must validate (and hand back) the meta here,
+            # not die on a second read of it later
+            return state, load_meta(directory, step), step
+        except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+            errors.append((step, e))
+            _registry().counter("resilience/restore_fallbacks").add(1)
+            warnings.warn(
+                f"checkpoint step {step} unreadable ({e!r}); falling "
+                f"back to an older committed step", RuntimeWarning)
+            if on_fallback is not None:
+                on_fallback(step, e)
+    raise IOError(
+        f"no readable committed checkpoint in {directory}; tried "
+        + ", ".join(f"step {s}: {e!r}" for s, e in errors))
+
+
 # ---------------------------------------------------------------------------
 # manager
 # ---------------------------------------------------------------------------
@@ -506,6 +558,18 @@ class CheckpointManager:
             return None, None
         state = self.restore(template, step=step, verify=verify)
         return state, load_meta(self.directory, step)
+
+    def restore_degraded(self, template, verify: bool = True,
+                         on_fallback=None):
+        """Newest READABLE committed step (walk-back on corruption);
+        returns ``(state, meta, step)`` or ``(None, None, None)`` when
+        the directory holds no committed step at all."""
+        try:
+            return restore_degraded(self.directory, template,
+                                    verify=verify,
+                                    on_fallback=on_fallback)
+        except FileNotFoundError:
+            return None, None, None
 
     def _gc(self) -> None:
         if jax.process_index() != 0:

@@ -10,17 +10,22 @@ framework/distributed_strategy.proto:133). Here:
     through the async checkpoint (distributed/checkpoint.py) — training
     continues while bytes hit disk;
   - the checkpoint meta carries step, the framework RNG stream state, and
-    the data cursor, so a killed-and-restarted run continues the EXACT
-    loss curve (deterministic data order + RNG semantics, SURVEY §7
-    "loss-curve parity" hard part);
+    the REAL data cursor (which may run ahead of the step count after a
+    resilience rollback skipped poisoned batches), so a
+    killed-and-restarted run continues the EXACT loss curve
+    (deterministic data order + RNG semantics, SURVEY §7 "loss-curve
+    parity" hard part);
   - ``ElasticTrainer.run`` resumes from the newest COMMITTED step: a kill
-    mid-save lands on the previous one (COMMIT-marker crash consistency).
+    mid-save lands on the previous one (COMMIT-marker crash consistency);
+    with ``degraded_restore`` (default) a CORRUPT newest step walks back
+    to an older committed one instead of killing the restart
+    (checkpoint.restore_degraded, ``resilience/restore_fallbacks``).
 
 Usage::
 
     tr = HybridPipelineTrainer(model, opt, strategy, mesh)
     el = ElasticTrainer(tr, ckpt_dir, save_interval=100)
-    el.run(data_fn, total_steps)   # data_fn(step) -> batch tuple
+    el.run(data_fn, total_steps)   # data_fn(cursor) -> batch tuple
 """
 from __future__ import annotations
 
@@ -34,10 +39,24 @@ __all__ = ["ElasticTrainer"]
 
 class ElasticTrainer:
     def __init__(self, trainer, ckpt_dir: str, save_interval: int = 100,
-                 keep: int = 2):
+                 keep: int = 2, degraded_restore: bool = True,
+                 verify_restore: bool = False):
         self.trainer = trainer
         self.save_interval = save_interval
         self.manager = CheckpointManager(ckpt_dir, keep=keep)
+        # degraded_restore: resume() walks back past unreadable newest
+        # steps instead of raising. verify_restore: CRC-check shard
+        # files on restore (the walk-back can only SEE silent bit flips
+        # when this is on; the resilience runner enables it).
+        self.degraded_restore = degraded_restore
+        self.verify_restore = verify_restore
+        # the data cursor is REAL state, not an alias of step: a
+        # resilience rollback re-seeds it past a poisoned batch, after
+        # which cursor > step forever. data_fn(cursor) -> batch.
+        self.data_cursor = 0
+        # meta of the checkpoint the last resume() restored (extra keys
+        # like the resilience runner's skipped_cursors ride here)
+        self.last_meta: dict = {}
 
     # -- state capture -----------------------------------------------------
     def _meta(self, step: int, extra=None) -> dict:
@@ -45,7 +64,7 @@ class ElasticTrainer:
         meta = {"step": int(step),
                 "rng_key": key.tolist(),
                 "rng_dtype": str(key.dtype),
-                "data_cursor": int(step)}
+                "data_cursor": int(self.data_cursor)}
         if extra:
             meta.update(extra)
         return meta
@@ -57,16 +76,31 @@ class ElasticTrainer:
 
     # -- resume ------------------------------------------------------------
     def resume(self) -> int:
-        """Restore the newest committed checkpoint; returns the step to
-        continue FROM (0 if none)."""
-        step = self.manager.latest_step()
-        if step is None:
-            return 0
-        state = self.manager.restore(self.trainer.device_state(), step=step)
+        """Restore the newest readable committed checkpoint; returns the
+        step to continue FROM (0 if none). Restores the trainer state,
+        the RNG stream, and the data cursor."""
+        template = self.trainer.device_state()
+        if self.degraded_restore:
+            state, meta, step = self.manager.restore_degraded(
+                template, verify=self.verify_restore)
+            if step is None:
+                return 0
+        else:
+            step = self.manager.latest_step()
+            if step is None:
+                return 0
+            state = self.manager.restore(template, step=step,
+                                         verify=self.verify_restore)
+            meta = load_meta(self.manager.directory, step)
         self.trainer.load_device_state(state, step=step)
-        meta = load_meta(self.manager.directory, step)
+        self.last_meta = dict(meta or {})
         if meta:
             self._restore_rng(meta)
+            # pre-cursor checkpoints carried only step; cursor == step
+            # was exact for them (no rollback machinery existed)
+            self.data_cursor = int(meta.get("data_cursor", step))
+        else:
+            self.data_cursor = int(step)
         return int(step)
 
     # -- checkpointing -----------------------------------------------------
@@ -77,16 +111,18 @@ class ElasticTrainer:
 
     # -- the loop ----------------------------------------------------------
     def run(self, data_fn, total_steps: int, on_step=None) -> list:
-        """data_fn(step) -> batch tuple (the deterministic data cursor:
-        batch content is a pure function of the global step). Returns the
-        per-step losses of THIS process lifetime."""
+        """data_fn(cursor) -> batch tuple (the deterministic data
+        cursor: batch content is a pure function of the cursor, which
+        equals the global step until a rollback skips batches). Returns
+        the per-step losses of THIS process lifetime."""
         start = self.resume()
         losses = []
         for step in range(start, total_steps):
-            batch = data_fn(step)
+            batch = data_fn(self.data_cursor)
             if not isinstance(batch, tuple):
                 batch = (batch,)
             loss = self.trainer.step(*batch)
+            self.data_cursor += 1
             losses.append(float(np.asarray(loss)))
             done = step + 1
             if done % self.save_interval == 0 or done == total_steps:

@@ -72,7 +72,8 @@ class HybridPipelineTrainer:
                  conservative_fetch: bool = False,
                  update_scan: bool = False,
                  unroll_layers: Optional[bool] = None,
-                 free_eager: bool = False):
+                 free_eager: bool = False,
+                 guard_bad_steps: bool = False):
         """Memory knobs for billion-param single/few-chip configs
         (reference analogue: RecomputeConfig offload + ShardingConfig,
         distributed_strategy.proto:25-35):
@@ -143,6 +144,20 @@ class HybridPipelineTrainer:
         profile_step_phases(*batch): fwd/bwd/optim/comm phase split as
             ``phase/*_ms`` gauges (two extra compiles; comm is modeled
             from collective bytes — see the method docstring).
+        Resilience knob (paddle_tpu.resilience rides on it):
+
+        guard_bad_steps: bake a finite check on the loss AND every
+            clipped gradient leaf into the compiled step. A non-finite
+            step keeps params and optimizer state bit-identical (the
+            update is computed then deselected — momentum does not
+            decay, weight decay does not apply), so one poisoned batch
+            cannot touch the weights. ``last_step_ok`` reads the
+            previous step's verdict (lazy device sync);
+            ``inject_fault_scale(nan)`` poisons the NEXT step's loss —
+            the deterministic NaN-gradient hook the chaos harness uses.
+            Unsupported with offload/stream configs (the select would
+            force host-resident state through HBM twice).
+
         retrace telemetry: every (re)trace of the step program is logged
             to ``profiler.retraces()`` with the triggering batch shapes;
             diagnostic lowerings (``aot_lower``/``memory_analysis``) are
@@ -531,6 +546,19 @@ class HybridPipelineTrainer:
                     t._value.delete()
                 t._value = None
 
+        self.guard_bad_steps = bool(guard_bad_steps)
+        if self.guard_bad_steps and (offload_params or offload_optimizer
+                                     or stream_layers):
+            raise ValueError(
+                "guard_bad_steps is not supported with offload/stream "
+                "configs yet (the bad-step select would stream host-"
+                "resident state through HBM a second time)")
+        # device-side verdict of the last guarded step (None before the
+        # first step / when unguarded); _fault_scale poisons exactly one
+        # upcoming step's loss (chaos harness hook)
+        self._last_ok_dev = None
+        self._fault_scale: Optional[float] = None
+
         self._step = 0
         self._n_batch_args: Optional[int] = None
         self._step_fn = None
@@ -791,12 +819,15 @@ class HybridPipelineTrainer:
                 return np_, ns
             return core_upd(p, g, s_dev, lr, step_no, plr, wd, p.dtype, s)
 
+        guard = self.guard_bad_steps
+
         def step_fn(block_params, other_params, block_opt, other_opt,
-                    batch, lr, step_no, key):
+                    batch, lr, step_no, key, *guard_args):
             # python side effect at the top of the traced body: runs once
             # per trace, so every cache miss (silent recompile) is logged
             # with the batch shapes that triggered it
             _precomp.mark_trace(self._prof_site, batch)
+            fault = guard_args[0] if guard else None
             if offload_p:
                 # stream masters to HBM and cast; grads flow to the bf16
                 # compute copies (half the grad HBM of the f32 path)
@@ -813,11 +844,25 @@ class HybridPipelineTrainer:
                 bp_c, op_c = block_params, other_params
 
             def loss_of(bp, op):
-                return self._forward_loss(bp, op, batch, key)
+                l = self._forward_loss(bp, op, batch, key)
+                # fault is 1.0 in normal operation (exact IEEE noop);
+                # the chaos harness sets it to NaN for one step, which
+                # poisons the loss AND (through the cotangent) every
+                # gradient leaf — the guard below must catch all of it
+                return l * fault if guard else l
 
             loss, (g_blk, g_oth) = jax.value_and_grad(
                 loss_of, argnums=(0, 1))(bp_c, op_c)
             g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
+
+            if guard:
+                # one scalar verdict for the whole step: loss and every
+                # clipped grad leaf finite. isfinite-per-leaf (not a
+                # squared global norm) so legitimately-huge-but-finite
+                # grads cannot overflow the check itself.
+                ok = jnp.isfinite(loss)
+                for g_ in jax.tree_util.tree_leaves((g_blk, g_oth)):
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g_)))
 
             # offload_params: serialize the per-group host↔HBM update
             # streams (fetch k waits on update k-depth) — unconstrained,
@@ -863,6 +908,17 @@ class HybridPipelineTrainer:
                 new_oth_opt.append(ns)
                 if any_offload:
                     chain.append(np_)
+            if guard:
+                # bad step: deselect the whole update — params AND
+                # optimizer state stay bit-identical (zeroed grads would
+                # still decay momentum and apply weight decay)
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(ok, a, b), new, old)
+                return (loss, ok,
+                        keep(new_blk, block_params),
+                        keep(new_oth, other_params),
+                        keep(new_blk_opt, block_opt),
+                        keep(new_oth_opt, other_opt))
             return loss, new_blk, new_oth, new_blk_opt, new_oth_opt
 
         ns = lambda spec: NamedSharding(mesh, spec)
@@ -875,11 +931,14 @@ class HybridPipelineTrainer:
         oth_opt_sh = [{kk: ons(vv) for kk, vv in d.items()}
                       for d in self.other_opt_specs]
         self._batch_spec = self._make_batch_spec()
+        in_sh = (blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
+                 None, None, None, None)
+        out_sh = (ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh)
+        if guard:
+            in_sh = in_sh + (None,)                       # fault scalar
+            out_sh = (ns(P()), ns(P())) + out_sh[1:]      # + ok verdict
         self._step_fn = jax.jit(
-            step_fn,
-            in_shardings=(blk_sh, oth_sh, blk_opt_sh, oth_opt_sh,
-                          None, None, None, None),
-            out_shardings=(ns(P()), blk_sh, oth_sh, blk_opt_sh, oth_opt_sh),
+            step_fn, in_shardings=in_sh, out_shardings=out_sh,
             donate_argnums=(0, 1, 2, 3))
         self._n_batch_args = n_batch_args
 
@@ -1118,11 +1177,18 @@ class HybridPipelineTrainer:
         with h2d:
             vs = self._stage_batch(batch)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        args = (*self._state_args(), vs, lr,
+                jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+        if self.guard_bad_steps:
+            # fault defaults to the exact-noop 1.0; a pending injection
+            # (inject_fault_scale) poisons exactly this one step
+            args = args + (jnp.asarray(
+                1.0 if self._fault_scale is None else self._fault_scale,
+                jnp.float32),)
+            self._fault_scale = None
         if prof:
             with _ptrace.scope("hybrid/step"):
-                out = self._step_fn(
-                    *self._state_args(), vs, lr,
-                    jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+                out = self._step_fn(*args)
                 float(np.asarray(out[0]))          # truthful sync
             dt_ms = (time.perf_counter_ns() - t0) / 1e6
             reg = _preg()
@@ -1131,9 +1197,10 @@ class HybridPipelineTrainer:
             reg.histogram("hybrid/step_ms").observe(dt_ms)
             _pinstr.record_memory_high_water()
         else:
-            out = self._step_fn(
-                *self._state_args(), vs, lr,
-                jnp.asarray(self._step, jnp.int32), rng_mod.next_key())
+            out = self._step_fn(*args)
+        if self.guard_bad_steps:
+            self._last_ok_dev = out[1]
+            out = (out[0],) + out[2:]
         if self.stream_layers:
             (loss, self.block_vals, self.other_vals, self.block_comp,
              self.other_comp, self.block_opt, self.other_opt) = out
@@ -1144,6 +1211,28 @@ class HybridPipelineTrainer:
         return loss
 
     __call__ = step
+
+    # -- bad-step guard surface (paddle_tpu.resilience) --------------------
+    @property
+    def last_step_ok(self) -> bool:
+        """Verdict of the most recent guarded step (True before any step
+        or when the guard is off). Reading it syncs on the tiny verdict
+        scalar — the resilient runner already syncs on the loss, so this
+        costs nothing extra there."""
+        if self._last_ok_dev is None:
+            return True
+        return bool(np.asarray(self._last_ok_dev))
+
+    def inject_fault_scale(self, value: float) -> None:
+        """Chaos hook: multiply the NEXT step's loss by ``value`` (NaN
+        poisons loss and every gradient). One-shot; requires
+        guard_bad_steps so the poison cannot reach the weights."""
+        if not self.guard_bad_steps:
+            raise RuntimeError(
+                "inject_fault_scale requires guard_bad_steps=True — "
+                "injecting a NaN without the guard would poison the "
+                "weights permanently")
+        self._fault_scale = float(value)
 
     def _stage_arg(self, b):
         v = b._value if isinstance(b, Tensor) else jnp.asarray(b)
@@ -1271,12 +1360,14 @@ class HybridPipelineTrainer:
         # must not advance the training RNG stream. suppressed(): this
         # re-trace is by design, not a silent recompile — keep it out of
         # the profiler's retrace counter/log.
+        tail = ((jax.ShapeDtypeStruct((), jnp.float32),)
+                if self.guard_bad_steps else ())
         with _precomp.suppressed():
             return self._step_fn.lower(
                 *self._state_args(), tuple(vs),
                 jax.ShapeDtypeStruct((), jnp.float32),
                 jax.ShapeDtypeStruct((), jnp.int32),
-                jax.ShapeDtypeStruct((2,), jnp.uint32))
+                jax.ShapeDtypeStruct((2,), jnp.uint32), *tail)
 
     def aot_compile(self, *batch):
         return self.aot_lower(*batch).compile()

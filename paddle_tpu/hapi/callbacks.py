@@ -241,6 +241,79 @@ class ProfilerCallback(Callback):
             json.dump(summary, f, indent=2, default=float)
 
 
+class TerminateOnNaN(Callback):
+    """Stop ``Model.fit`` when the batch loss goes non-finite — the
+    hapi-level cousin of the trainer's compiled bad-step guard
+    (distributed/hybrid.py guard_bad_steps). fit() loops have no
+    update-skip hook, so the safe reaction is to stop before more
+    poisoned updates land; the per-event counter rides the same
+    ``resilience/*`` namespace the runner uses."""
+
+    def __init__(self, monitor="loss"):
+        super().__init__()
+        self.monitor = monitor
+        self.stopped_step = None
+
+    def on_train_batch_end(self, step, logs=None):
+        import math
+
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0] if cur else None
+        try:
+            v = float(cur)
+        except (TypeError, ValueError):
+            return
+        if math.isnan(v) or math.isinf(v):
+            from ..profiler.metrics import registry
+
+            registry().counter("resilience/nan_terminations").add(1)
+            self.stopped_step = step
+            print(f"TerminateOnNaN: {self.monitor}={v} at step {step}; "
+                  f"stopping training")
+            self.model.stop_training = True
+
+
+class PreemptionSave(Callback):
+    """Graceful-preemption for ``Model.fit``: installs the resilience
+    SIGTERM/SIGINT handler for the duration of training; on a request
+    it saves the model into ``save_dir`` after the in-flight batch and
+    stops the fit loop, so a supervisor restart resumes from the saved
+    weights instead of losing the epoch."""
+
+    def __init__(self, save_dir, name="preempted"):
+        super().__init__()
+        self.save_dir = save_dir
+        self.name = name
+        self.preempted = False
+        self._handler = None
+
+    def on_train_begin(self, logs=None):
+        from ..resilience.preemption import PreemptionHandler
+
+        self.preempted = False
+        self._handler = PreemptionHandler().install()
+
+    def on_train_batch_end(self, step, logs=None):
+        h = self._handler
+        if h is None or not h.requested or self.preempted:
+            return
+        from ..profiler.metrics import registry
+
+        self.preempted = True
+        os.makedirs(self.save_dir, exist_ok=True)
+        self.model.save(os.path.join(self.save_dir, self.name))
+        registry().counter("resilience/preemptions").add(1)
+        self.model.stop_training = True
+
+    def on_train_end(self, logs=None):
+        if self._handler is not None:
+            self._handler.uninstall()
+            self._handler = None
+
+
 class VisualDL(Callback):
     """Metrics writer (reference: hapi/callbacks.py VisualDL); writes a
     jsonl metrics log instead of the visualdl binary format."""

@@ -150,6 +150,20 @@ class Model:
                                                     for m in self._metrics])
         cbks.on_train_begin()
         self.stop_training = False
+        try:
+            self._fit_epochs(cbks, train_loader, eval_loader, epochs,
+                             eval_freq, accumulate_grad_batches,
+                             num_iters)
+        finally:
+            # ALWAYS runs, also when a batch raises: callbacks with
+            # global side effects (PreemptionSave's signal handlers,
+            # ProfilerCallback's enabled profiler/device trace) must
+            # tear them down or they outlive the failed fit
+            cbks.on_train_end(self._last_fit_logs)
+
+    def _fit_epochs(self, cbks, train_loader, eval_loader, epochs,
+                    eval_freq, accumulate_grad_batches, num_iters):
+        self._last_fit_logs = {}
         for epoch in range(epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
@@ -162,7 +176,14 @@ class Model:
                                        update=(step + 1) %
                                        accumulate_grad_batches == 0)
                 logs = self._make_logs(res)
+                self._last_fit_logs = logs
                 cbks.on_train_batch_end(step, logs)
+                # honored PER BATCH: TerminateOnNaN must stop before
+                # more poisoned updates land, and PreemptionSave must
+                # exit inside the preemption grace window — an
+                # epoch-boundary-only check defeats both
+                if self.stop_training:
+                    break
                 if num_iters is not None and step + 1 >= num_iters:
                     break
             cbks.on_epoch_end(epoch, logs)
@@ -175,7 +196,6 @@ class Model:
                 cbks.on_eval_end(eval_logs)
             if self.stop_training:
                 break
-        cbks.on_train_end(logs)
 
     @no_grad()
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

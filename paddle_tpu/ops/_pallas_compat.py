@@ -1,0 +1,22 @@
+"""jax version compatibility for the Pallas TPU kernels — the ONE place
+the pltpu compiler-params rename is absorbed (same rule as
+distributed/_compat.py: a per-site copy of a version shim drifts).
+
+jax < 0.5 names it ``TPUCompilerParams``; newer jax ``CompilerParams``.
+The kwargs are identical.
+"""
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _no_compiler_params(*_a, **_k):
+    raise ImportError(
+        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
+        "TPUCompilerParams on this jax version — update "
+        "paddle_tpu/ops/_pallas_compat.py")
+
+
+CompilerParams = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams",
+                                 _no_compiler_params))

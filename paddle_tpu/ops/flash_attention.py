@@ -39,18 +39,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# jax < 0.5 names it TPUCompilerParams; the kwargs are identical
-
-
-def _no_compiler_params(*_a, **_k):
-    raise ImportError(
-        "jax.experimental.pallas.tpu exposes neither CompilerParams nor "
-        "TPUCompilerParams on this jax version — update the alias here")
-
-
-_CompilerParams = getattr(pltpu, "CompilerParams",
-                          getattr(pltpu, "TPUCompilerParams",
-                                  _no_compiler_params))
+from ._pallas_compat import CompilerParams as _CompilerParams
 
 from ..tensor._helper import apply
 

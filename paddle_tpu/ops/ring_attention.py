@@ -359,8 +359,20 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = True,
     """shard_map wrapper: q/k/v are GLOBAL [B, S, H, D] arrays (or traced
     values inside a pjit program); sequence dim is sharded over
     `axis_name`, everything else stays in GSPMD auto mode (so dp-sharded
-    batch and tp-sharded heads compose)."""
-    spec = P(None, axis_name, None, None)
+    batch and tp-sharded heads compose).
+
+    jax < 0.5 (no ``jax.shard_map``): the old experimental dialect
+    cannot TRANSPOSE a partially-manual region (its ``auto=`` mode —
+    the ROADMAP open item), so the wrapper goes ALL-manual there
+    instead: manual over every mesh axis, with the batch dim explicitly
+    mapped to 'dp' and the head dim to 'tp' when those axes exist and
+    divide the dim (attention rows are independent per batch×head, so
+    any even split is exact). Unmapped extra axes replicate. Same math,
+    same ring — only the partitioning dialect differs. Routed through
+    ``distributed/_compat.shard_map`` so the translation cannot drift
+    per call site."""
+    from ..distributed._compat import shard_map as _shard_map
+
     # when already inside another shard_map (e.g. the 'pp' pipeline,
     # distributed/pipeline.py), the context mesh is an AbstractMesh with
     # that axis Manual — the nested shard_map must be given THAT mesh.
@@ -371,18 +383,34 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = True,
             use_mesh = am
     except AttributeError:
         pass
-    # inside this sp-manual region the other mesh axes stay GSPMD-auto;
-    # pass them as the kernels' auto-context so the chunk kernels nest a
-    # shard_map over them on the TPU target (Mosaic cannot live in a
-    # partially-manual region) — threaded through _ring_mha's static args
-    # so the transpose-time backward sees it too
-    remaining = tuple(a for a in mesh.axis_names if a != axis_name)
-    auto_ctx = (mesh, remaining) if remaining else None
 
-    mapped = jax.shard_map(
+    modern = hasattr(jax, "shard_map")
+    if modern:
+        spec = P(None, axis_name, None, None)
+        # inside this sp-manual region the other mesh axes stay
+        # GSPMD-auto; pass them as the kernels' auto-context so the
+        # chunk kernels nest a shard_map over them on the TPU target
+        # (Mosaic cannot live in a partially-manual region) — threaded
+        # through _ring_mha's static args so the transpose-time
+        # backward sees it too
+        remaining = tuple(a for a in mesh.axis_names if a != axis_name)
+        auto_ctx = (mesh, remaining) if remaining else None
+        manual = frozenset({axis_name})
+    else:
+        def _dim_axis(name, dim):
+            ok = (name in mesh.axis_names and mesh.shape[name] > 1
+                  and dim % mesh.shape[name] == 0)
+            return name if ok else None
+
+        b, _, h, _ = q.shape
+        spec = P(_dim_axis("dp", b), axis_name, _dim_axis("tp", h), None)
+        auto_ctx = None         # fully manual: no auto region to nest in
+        manual = None           # _compat: None == manual over ALL axes
+
+    mapped = _shard_map(
         lambda a, b_, c: _ring_mha(a, b_, c, causal, scale, axis_name,
                                    auto_ctx),
         mesh=use_mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False, axis_names=frozenset({axis_name}))
+        check_vma=False, axis_names=manual)
     return mapped(q, k, v)

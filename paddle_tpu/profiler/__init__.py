@@ -48,13 +48,13 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
 from .recompile import (mark_trace, retraces, suppressed,  # noqa: F401
                         trace_counts, unique_site, watch)
 from .trace import (RecordEvent, annotate, chrome_trace,  # noqa: F401
-                    export_chrome_trace, is_enabled, scope,
+                    export_chrome_trace, is_enabled, live_spans, scope,
                     scope_summary)
 
 __all__ = [
     "enable", "disable", "is_enabled", "reset",
     "scope", "RecordEvent", "annotate",
-    "scope_summary", "chrome_trace", "export_chrome_trace",
+    "scope_summary", "chrome_trace", "export_chrome_trace", "live_spans",
     "registry", "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "mark_trace", "watch", "retraces", "trace_counts", "suppressed",
     "unique_site",

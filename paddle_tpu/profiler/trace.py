@@ -48,12 +48,41 @@ _t_disable_ns: Optional[int] = None
 _jax_trace_dir: Optional[str] = None
 
 
+_live_stacks: Dict[int, List[str]] = {}   # thread id -> open scope names
+
+
+def _prune_dead_stacks_locked() -> None:
+    """Drop registrations of exited threads (_lock held). threading.local
+    frees the per-thread value on thread death but this registry would
+    keep a strong reference forever — per-epoch worker threads must not
+    grow it without bound."""
+    import sys
+
+    alive = set(sys._current_frames())
+    for tid in [t for t in _live_stacks if t not in alive]:
+        del _live_stacks[tid]
+
+
 class _TLS(threading.local):
     def __init__(self):
         self.stack: List[str] = []
+        # registered so OTHER threads (the resilience step watchdog) can
+        # see which scopes are open when a step hangs
+        with _lock:
+            _prune_dead_stacks_locked()
+            _live_stacks[threading.get_ident()] = self.stack
 
 
 _tls = _TLS()
+
+
+def live_spans() -> Dict[int, List[str]]:
+    """Currently-OPEN host scopes per thread id (the span stack a hung
+    step is stuck inside). Only threads with at least one open scope are
+    reported; empty when profiling is disabled (scopes no-op)."""
+    with _lock:
+        _prune_dead_stacks_locked()
+        return {tid: list(s) for tid, s in _live_stacks.items() if s}
 
 
 def is_enabled() -> bool:

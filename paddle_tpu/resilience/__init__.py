@@ -1,0 +1,53 @@
+"""paddle_tpu.resilience — the fault-tolerant training runtime.
+
+A place the framework SURPASSES the reference (SURVEY §5): the
+reference launcher only tears jobs down on failure; here preemptions,
+poisoned batches, hung steps, flaky data sources, and corrupted
+checkpoints are all survivable, deterministically tested events.
+
+Pieces (each importable alone):
+
+  - ``ResilientRunner`` / ``ResilienceConfig`` (runner.py): the
+    hardened loop — bad-step guard + K-consecutive rollback with
+    cursor re-seeding, graceful preemption checkpointing, watchdog,
+    degraded restore, retried data loading.
+  - ``StepWatchdog`` (watchdog.py): hung-step monitor; dumps live
+    thread stacks + profiler span state, optionally aborts.
+  - ``PreemptionHandler`` / ``PreemptedError`` (preemption.py):
+    SIGTERM/SIGINT → flag → finish step → committed checkpoint →
+    resumable exit status.
+  - ``chaos`` (chaos.py): the deterministic fault-injection harness
+    the test suite drives (NaN grads, truncated/corrupt/uncommitted
+    shards, data-loader exceptions, artificial hangs, self-preemption).
+
+Recovery events are profiler counters: ``resilience/steps_skipped``,
+``resilience/rollbacks``, ``resilience/restore_fallbacks``,
+``resilience/preemptions``, ``resilience/data_retries``,
+``resilience/watchdog_fires`` (paddle_tpu.profiler registry).
+
+Quick use::
+
+    tr = HybridPipelineTrainer(model, opt, strategy, mesh,
+                               guard_bad_steps=True)
+    runner = ResilientRunner(tr, ckpt_dir, save_interval=100,
+                             config=ResilienceConfig(
+                                 bad_step_limit=3,
+                                 watchdog_timeout_s=600))
+    result = runner.run(data_fn, total_steps)   # data_fn(cursor)
+    if result.preempted:
+        sys.exit(result.exit_code)              # supervisor restarts
+"""
+from __future__ import annotations
+
+from . import chaos  # noqa: F401
+from .preemption import (PREEMPT_EXIT_CODE, PreemptedError,  # noqa: F401
+                         PreemptionHandler)
+from .runner import ResilienceConfig, ResilientRunner, RunResult  # noqa: F401
+from .watchdog import WATCHDOG_EXIT_CODE, StepWatchdog  # noqa: F401
+
+__all__ = [
+    "ResilienceConfig", "ResilientRunner", "RunResult",
+    "PreemptionHandler", "PreemptedError", "PREEMPT_EXIT_CODE",
+    "StepWatchdog", "WATCHDOG_EXIT_CODE",
+    "chaos",
+]

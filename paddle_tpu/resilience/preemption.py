@@ -1,0 +1,110 @@
+"""Graceful-preemption signal handling.
+
+TPU fleets preempt VMs with a SIGTERM and a grace window; the reference
+framework's launcher reacts by tearing the whole job down
+(launch_utils.py terminate_local_procs). Here preemption is a normal,
+resumable event: the handler only RECORDS the request, the training
+loop (resilience/runner.py) finishes the in-flight step, forces a
+synchronous committed checkpoint, and exits with a resumable status —
+the restarted process continues the exact loss curve.
+
+The handler is deliberately async-signal-trivial: it flips a flag and
+remembers the signal number. No I/O, no locks, no collectives in the
+handler itself (a checkpoint collective issued from a signal frame
+could interleave with training collectives and deadlock XLA — the same
+rule SaveHandle.wait documents for background threads).
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Iterable, Optional
+
+__all__ = ["PreemptionHandler", "PreemptedError", "PREEMPT_EXIT_CODE"]
+
+# EX_TEMPFAIL: the conventional "transient failure, retry me" status —
+# a supervisor (k8s restartPolicy, the elastic launcher) distinguishes
+# it from a real crash and reschedules instead of alerting.
+PREEMPT_EXIT_CODE = 75
+
+
+class PreemptedError(RuntimeError):
+    """Raised by the resilient runner after the preemption checkpoint
+    is committed — opt-in via ``ResilienceConfig(raise_on_preempt=
+    True)``; the default path returns ``RunResult(preempted=True)``
+    instead. Carries everything a supervisor needs to resume."""
+
+    def __init__(self, step: int, signum: int, ckpt_dir: Optional[str]):
+        super().__init__(
+            f"preempted by signal {signum} at step {step}; committed "
+            f"checkpoint in {ckpt_dir!r} — exit {PREEMPT_EXIT_CODE} and "
+            f"restart to resume")
+        self.step = step
+        self.signum = signum
+        self.ckpt_dir = ckpt_dir
+        self.exit_code = PREEMPT_EXIT_CODE
+
+
+class PreemptionHandler:
+    """Install SIGTERM/SIGINT handlers that set a flag; the training
+    loop polls ``requested`` at step boundaries.
+
+    Context-manager use restores the previous handlers on exit. Only the
+    main thread may install signal handlers (CPython rule); installing
+    from another thread degrades to a no-op so library code can use the
+    handler unconditionally. ``request()`` triggers the same path
+    programmatically (chaos harness, cluster-notice pollers).
+    """
+
+    def __init__(self, signals: Iterable[int] = (signal.SIGTERM,
+                                                 signal.SIGINT)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self.signum: Optional[int] = None
+        self._prev: dict = {}
+        self._installed = False
+
+    # -- flag --------------------------------------------------------------
+    @property
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def request(self, signum: int = signal.SIGTERM) -> None:
+        self.signum = signum
+        self._event.set()
+
+    def clear(self) -> None:
+        self._event.clear()
+        self.signum = None
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    # -- installation ------------------------------------------------------
+    def _on_signal(self, signum, frame) -> None:
+        self.request(signum)
+
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            return self
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def __enter__(self) -> "PreemptionHandler":
+        return self.install()
+
+    def __exit__(self, *exc) -> bool:
+        self.uninstall()
+        return False

@@ -1,0 +1,350 @@
+"""The hardened training loop: bad-step guard + rollback, graceful
+preemption, step watchdog, degraded restore, retried data loading.
+
+``ResilientRunner`` wraps a ``HybridPipelineTrainer`` (or anything with
+the same ``step``/``device_state``/``load_device_state`` surface)
+behind an ``ElasticTrainer`` and runs the loop the ISSUE tentpole
+specifies:
+
+  1. **bad-step guard** — the trainer's compiled finite check
+     (``guard_bad_steps``) skips the update on a NaN/Inf step; the
+     runner counts consecutive bad steps and after
+     ``bad_step_limit`` of them ROLLS BACK to the newest readable
+     committed checkpoint and re-seeds the data cursor past the
+     offending batches (they land in a persisted skip set, so replay —
+     and any later restart — never feeds them again).
+  2. **graceful preemption** — SIGTERM/SIGINT set a flag; the in-flight
+     step finishes, a synchronous committed checkpoint lands, and
+     ``run`` returns a RunResult carrying the resumable exit code.
+  3. **step watchdog** — a monitor thread that dumps live stacks +
+     profiler span state on a hung step and optionally aborts so the
+     elastic restart path takes over (resilience/watchdog.py).
+  4. **degraded restore** — resume walks back past corrupt newest
+     steps (checkpoint.restore_degraded) instead of dying.
+  5. data loading rides ``utils.retry`` with exponential backoff.
+
+Every recovery event moves a profiler counter: ``resilience/
+steps_skipped``, ``resilience/rollbacks``, ``resilience/
+restore_fallbacks``, ``resilience/preemptions``, ``resilience/
+data_retries``, ``resilience/watchdog_fires``.
+
+Determinism contract: with a fixed ``ChaosPlan``, a run that is
+preempted, corrupted, and restarted produces the SAME per-step losses
+as an uninterrupted run (the chaos e2e test asserts this bitwise).
+
+Known limit (ROADMAP): rollback decisions are host-local. On a
+multi-host mesh every process computes the same verdict from the same
+replicated loss/grads, so they agree in lockstep — but there is no
+explicit cross-host agreement protocol yet for faults only one host
+sees (a local data-loader giving up, a local watchdog fire).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..distributed.elastic import ElasticTrainer
+from ..profiler.metrics import registry as _registry
+from ..utils.retry import retry
+from .preemption import PREEMPT_EXIT_CODE, PreemptionHandler
+from .watchdog import StepWatchdog
+
+__all__ = ["ResilienceConfig", "ResilientRunner", "RunResult"]
+
+
+class ResilienceConfig:
+    """Knobs of the hardened loop (README "Resilience" documents them).
+
+    bad_step_limit:         consecutive guarded-bad steps before a
+                            rollback (K).
+    watchdog_timeout_s:     None disables the watchdog.
+    watchdog_first_grace_s: extra allowance for a lifetime's first step
+                            (jit compile); default 10× the timeout.
+    watchdog_jitter:        deadline jitter fraction (fleet de-sync).
+    watchdog_abort:         hard-exit on fire (WATCHDOG_EXIT_CODE).
+    data_retry_attempts /   retry-with-exponential-backoff policy for
+    data_retry_base_delay:  data_fn calls (utils.retry).
+    verify_restore:         CRC-verify shards on resume (the walk-back
+                            can only SEE silent corruption when on).
+    raise_on_preempt:       raise PreemptedError after the preemption
+                            checkpoint commits, instead of returning a
+                            RunResult with preempted=True (default).
+    """
+
+    def __init__(self,
+                 bad_step_limit: int = 3,
+                 watchdog_timeout_s: Optional[float] = None,
+                 watchdog_first_grace_s: Optional[float] = None,
+                 watchdog_jitter: float = 0.1,
+                 watchdog_abort: bool = False,
+                 watchdog_dump_file: Optional[str] = None,
+                 watchdog_seed: int = 0,
+                 data_retry_attempts: int = 4,
+                 data_retry_base_delay: float = 0.05,
+                 data_retry_max_delay: float = 5.0,
+                 data_retry_jitter: float = 0.0,
+                 verify_restore: bool = True,
+                 raise_on_preempt: bool = False):
+        if bad_step_limit < 1:
+            raise ValueError("bad_step_limit must be >= 1")
+        self.bad_step_limit = int(bad_step_limit)
+        self.watchdog_timeout_s = watchdog_timeout_s
+        self.watchdog_first_grace_s = watchdog_first_grace_s if \
+            watchdog_first_grace_s is not None else (
+                10.0 * watchdog_timeout_s if watchdog_timeout_s else 0.0)
+        self.watchdog_jitter = watchdog_jitter
+        self.watchdog_abort = watchdog_abort
+        self.watchdog_dump_file = watchdog_dump_file
+        self.watchdog_seed = watchdog_seed
+        self.data_retry_attempts = int(data_retry_attempts)
+        self.data_retry_base_delay = float(data_retry_base_delay)
+        self.data_retry_max_delay = float(data_retry_max_delay)
+        self.data_retry_jitter = float(data_retry_jitter)
+        self.verify_restore = bool(verify_restore)
+        self.raise_on_preempt = bool(raise_on_preempt)
+
+
+class RunResult:
+    """What a resilient run lifetime produced.
+
+    losses:      {step: loss} for every step this LIFETIME executed and
+                 kept (rollback-discarded steps are removed).
+    preempted:   True when the run stopped on a preemption request
+                 after committing its checkpoint; ``exit_code`` is then
+                 the resumable status (75/EX_TEMPFAIL) a worker should
+                 exit with so the supervisor reschedules it.
+    completed:   reached total_steps.
+    """
+
+    def __init__(self, losses: Dict[int, float], start_step: int,
+                 final_step: int, total_steps: int, preempted: bool,
+                 rollbacks: int):
+        self.losses = losses
+        self.start_step = start_step
+        self.final_step = final_step
+        self.total_steps = total_steps
+        self.preempted = preempted
+        self.rollbacks = rollbacks
+
+    @property
+    def completed(self) -> bool:
+        return not self.preempted and self.final_step >= self.total_steps
+
+    @property
+    def exit_code(self) -> int:
+        return PREEMPT_EXIT_CODE if self.preempted else 0
+
+    def loss_list(self):
+        """Losses as a dense list ordered by step (steps this lifetime)."""
+        return [self.losses[s] for s in sorted(self.losses)]
+
+
+class ResilientRunner:
+    def __init__(self, trainer, ckpt_dir: str, save_interval: int = 100,
+                 keep: int = 3, config: Optional[ResilienceConfig] = None,
+                 chaos=None):
+        self.config = config or ResilienceConfig()
+        self.chaos = chaos
+        self.elastic = ElasticTrainer(
+            trainer, ckpt_dir, save_interval=save_interval, keep=keep,
+            degraded_restore=True,
+            verify_restore=self.config.verify_restore)
+        self.trainer = trainer
+        self.preemption = PreemptionHandler()
+        # cursors whose batches poisoned a rollback — never fed again;
+        # persisted in every checkpoint's meta so restarts keep them
+        self._skips: set = set()
+
+    # -- helpers -----------------------------------------------------------
+    def _extra_meta(self) -> dict:
+        return {"skipped_cursors": sorted(self._skips)}
+
+    def _merge_resumed_skips(self) -> None:
+        self._skips.update(
+            int(c) for c in self.elastic.last_meta.get(
+                "skipped_cursors", []))
+
+    def _advance_past_skips(self) -> None:
+        el = self.elastic
+        while el.data_cursor in self._skips:
+            el.data_cursor += 1
+
+    def _fetch(self, data_fn, cursor: int):
+        cfg = self.config
+        reg = _registry()
+
+        def _note(i, e, d):
+            reg.counter("resilience/data_retries").add(1)
+
+        return retry(lambda: data_fn(cursor),
+                     attempts=cfg.data_retry_attempts,
+                     base_delay=cfg.data_retry_base_delay,
+                     max_delay=cfg.data_retry_max_delay,
+                     jitter=cfg.data_retry_jitter,
+                     seed=cursor,          # deterministic per batch
+                     on_retry=_note)
+
+    def _rollback(self, bad_cursors, guarded: bool) -> int:
+        """K consecutive bad steps: restore the newest readable
+        committed checkpoint and blocklist the poisoned cursors.
+        Returns the step to continue from. With no committed checkpoint
+        yet, a GUARDED trainer just continues past the bad batches (the
+        compiled guard kept the weights clean; the cursors stay
+        blocklisted for any future replay) — an UNGUARDED one has
+        already taken the poisoned updates with nothing to restore, so
+        the only honest move is to fail loudly."""
+        el = self.elastic
+        _registry().counter("resilience/rollbacks").add(1)
+        self._skips.update(bad_cursors)
+        el.manager.wait()              # never restore under an async save
+        if el.manager.latest_step() is None:
+            if not guarded:
+                raise RuntimeError(
+                    f"{len(bad_cursors)} consecutive non-finite steps "
+                    "on a trainer WITHOUT guard_bad_steps and no "
+                    "committed checkpoint to roll back to: the weights "
+                    "are poisoned and unrecoverable. Enable "
+                    "guard_bad_steps or checkpoint before the first "
+                    "fault window.")
+            return -1                  # continue in place
+        step = el.resume()
+        self._merge_resumed_skips()
+        return step
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, data_fn, total_steps: int, on_step=None) -> RunResult:
+        cfg = self.config
+        el = self.elastic
+        tr = self.trainer
+        chaos = self.chaos
+        reg = _registry()
+        guarded = bool(getattr(tr, "guard_bad_steps", False))
+        fetch = chaos.wrap_data_fn(data_fn) if chaos is not None \
+            else data_fn
+
+        handler = self.preemption
+        handler.clear()
+        handler.install()
+        wd = None
+        if cfg.watchdog_timeout_s:
+            wd = StepWatchdog(cfg.watchdog_timeout_s,
+                              jitter_frac=cfg.watchdog_jitter,
+                              abort=cfg.watchdog_abort,
+                              dump_file=cfg.watchdog_dump_file,
+                              seed=cfg.watchdog_seed).start()
+            # the checkpoint restore below is as slow as a first compile
+            # on a big model/slow FS — it gets the same grace, or every
+            # resume of a large job would fire (and with abort, loop)
+            wd.pet(-1, grace_s=cfg.watchdog_first_grace_s)
+        rollbacks = 0
+        preempted = False
+        try:
+            start = el.resume()
+            self._merge_resumed_skips()
+            losses: Dict[int, float] = {}
+            consecutive_bad = 0
+            bad_cursors: list = []
+            first = True
+            step = start
+            while step < total_steps:
+                if wd is not None:
+                    wd.pet(step, grace_s=cfg.watchdog_first_grace_s
+                           if first else 0.0)
+                self._advance_past_skips()
+                cursor = el.data_cursor
+                batch = self._fetch(fetch, cursor)
+                if not isinstance(batch, tuple):
+                    batch = (batch,)
+                if chaos is not None:
+                    chaos.maybe_hang(step)
+                    if guarded and chaos.poisons(cursor):
+                        tr.inject_fault_scale(float("nan"))
+                loss = tr.step(*batch)
+                el.data_cursor = cursor + 1
+                lossf = float(np.asarray(loss))
+                first = False
+                ok = tr.last_step_ok if guarded else \
+                    not (math.isnan(lossf) or math.isinf(lossf))
+                if not ok:
+                    reg.counter("resilience/steps_skipped").add(1)
+                    consecutive_bad += 1
+                    bad_cursors.append(cursor)
+                    if consecutive_bad >= cfg.bad_step_limit:
+                        if wd is not None:
+                            # the rollback's checkpoint restore is as
+                            # slow as the startup one — same grace
+                            wd.pet(step,
+                                   grace_s=cfg.watchdog_first_grace_s)
+                        back = self._rollback(bad_cursors, guarded)
+                        rollbacks += 1
+                        consecutive_bad = 0
+                        bad_cursors = []
+                        if back >= 0:
+                            # replay: forget the steps being rolled over
+                            for s in [s for s in losses if s >= back]:
+                                del losses[s]
+                            step = back
+                            first = True   # restored state may retrace
+                        continue
+                else:
+                    consecutive_bad = 0
+                    bad_cursors = []
+                losses[step] = lossf
+                done = step + 1
+                # saveable: a GUARDED trainer's weights are clean even
+                # mid-bad-streak (the update was deselected); WITHOUT
+                # the guard (host-side NaN check only) the poisoned
+                # update already landed, and committing it would make
+                # the NaN weights the rollback/restart target — an
+                # unrecoverable livelock
+                saveable = guarded or consecutive_bad == 0
+                if chaos is not None:
+                    chaos.maybe_preempt(step)
+                if handler.requested:
+                    # the in-flight step finished above; now make the
+                    # exit resumable: one synchronous committed save.
+                    # NEVER mid-streak (even guarded): a preemption is
+                    # asymmetric — the uninterrupted run has no restore
+                    # point here, so committing one would shift the
+                    # K-streak rollback target and break loss-curve
+                    # parity. The restart resumes from the last
+                    # streak-free checkpoint and deterministically
+                    # replays the streak instead.
+                    if consecutive_bad == 0:
+                        if wd is not None:
+                            # a synchronous big-model save is as slow
+                            # as a restore — same grace, or abort mode
+                            # kills the commit it exists to protect
+                            wd.pet(step,
+                                   grace_s=cfg.watchdog_first_grace_s)
+                        el.save(done, extra=self._extra_meta(),
+                                async_=False)
+                    reg.counter("resilience/preemptions").add(1)
+                    preempted = True
+                    if on_step is not None:
+                        on_step(step, lossf)
+                    step = done
+                    break
+                if saveable and (done % el.save_interval == 0
+                                 or done == total_steps):
+                    el.save(done, extra=self._extra_meta())
+                if on_step is not None:
+                    on_step(step, lossf)
+                step = done
+            if wd is not None:     # joining the async save can be slow
+                wd.pet(step, grace_s=cfg.watchdog_first_grace_s)
+            el.manager.wait()
+            if preempted and cfg.raise_on_preempt:
+                from .preemption import PreemptedError
+
+                raise PreemptedError(step, handler.signum or 0,
+                                     el.manager.directory)
+            return RunResult(losses=losses, start_step=start,
+                             final_step=step, total_steps=total_steps,
+                             preempted=preempted, rollbacks=rollbacks)
+        finally:
+            if wd is not None:
+                wd.stop()
+            handler.uninstall()

@@ -1,0 +1,189 @@
+"""Step watchdog: a monitor thread that fires on hung training steps.
+
+A hung step on a real fleet (a wedged collective, a deadlocked host
+callback, a dead data source) looks exactly like a slow step from the
+outside — nothing raises, the job just stops. The watchdog turns that
+silence into a diagnosis: when no ``pet()`` arrives within the timeout
+it dumps every thread's live Python stack plus the profiler's open span
+stacks and per-scope summary (the spans say WHICH phase wedged), bumps
+``resilience/watchdog_fires``, and optionally aborts the process so the
+elastic restart path takes over.
+
+The effective deadline is jittered (multiplier in
+``[1, 1+jitter_frac]``, seeded RNG): a fleet-wide stall must not make
+every host dump and abort in the same instant, or the shared filesystem
+eats ten thousand simultaneous stack dumps. ``jitter_frac=0`` gives the
+deterministic deadline tests need.
+"""
+from __future__ import annotations
+
+import os
+import random
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+__all__ = ["StepWatchdog", "WATCHDOG_EXIT_CODE"]
+
+# EX_IOERR-adjacent but distinct from the preemption code: a supervisor
+# can tell "hung and self-aborted" from "preempted, resumable".
+WATCHDOG_EXIT_CODE = 74
+
+
+def dump_stacks(out=None) -> str:
+    """All threads' Python stacks + profiler live-span/scope state, as
+    one string (also written to ``out``, default stderr)."""
+    from ..profiler import trace as _ptrace
+
+    lines = ["=== resilience.watchdog: hung-step dump ==="]
+    live = _ptrace.live_spans()
+    if live:
+        lines.append("open profiler spans (thread -> scope stack):")
+        for tid, stack in sorted(live.items()):
+            lines.append(f"  thread {tid}: {' > '.join(stack)}")
+    summ = _ptrace.scope_summary()
+    if summ:
+        lines.append("profiler scope summary:")
+        for name, s in sorted(summ.items()):
+            lines.append(
+                f"  {name}: n={s['count']} mean={s['mean_ms']}ms "
+                f"max={s['max_ms']}ms")
+    names = {t.ident: t.name for t in threading.enumerate()}
+    for tid, frame in sys._current_frames().items():
+        lines.append(f"--- thread {tid} ({names.get(tid, '?')}) ---")
+        lines.append("".join(traceback.format_stack(frame)).rstrip())
+    text = "\n".join(lines) + "\n"
+    f = out if out is not None else sys.stderr
+    try:
+        f.write(text)
+        f.flush()
+    except (OSError, ValueError):
+        pass                      # a dump must never take the job down
+    return text
+
+
+class StepWatchdog:
+    """``start()`` the monitor, ``pet(step)`` after every completed
+    step, ``stop()`` when the loop exits (context manager does both).
+
+    timeout_s:    max wall time between pets before the watchdog fires.
+    jitter_frac:  deadline multiplier drawn uniformly from
+                  [1, 1+jitter_frac] per pet (seeded — deterministic).
+    on_fire:      callable(step, elapsed_s, dump_text) observing the
+                  fire (tests, alerting hooks).
+    abort:        after dumping, hard-exit with WATCHDOG_EXIT_CODE so a
+                  supervisor restarts the job (os._exit: a wedged XLA
+                  runtime cannot be trusted to run atexit handlers).
+    dump_file:    optional path; the dump is appended there as well as
+                  to stderr (shared-FS flight recorder).
+    """
+
+    def __init__(self, timeout_s: float, jitter_frac: float = 0.1,
+                 abort: bool = False,
+                 on_fire: Optional[Callable] = None,
+                 dump_file: Optional[str] = None,
+                 poll_s: Optional[float] = None,
+                 seed: int = 0):
+        if timeout_s <= 0:
+            raise ValueError("watchdog timeout_s must be > 0")
+        self.timeout_s = float(timeout_s)
+        self.jitter_frac = max(0.0, float(jitter_frac))
+        self.abort = bool(abort)
+        self.on_fire = on_fire
+        self.dump_file = dump_file
+        self.poll_s = poll_s if poll_s is not None \
+            else min(0.25, self.timeout_s / 4)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._deadline = 0.0
+        self._last_pet_t = 0.0
+        self._gen = 0               # pet generation: one fire per gen
+        self._step = -1
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.fired = False
+
+    def _new_deadline(self) -> float:
+        mult = 1.0 + self._rng.uniform(0.0, self.jitter_frac) \
+            if self.jitter_frac else 1.0
+        return time.monotonic() + self.timeout_s * mult
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "StepWatchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        with self._lock:
+            self._deadline = self._new_deadline()
+            self._last_pet_t = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._monitor, name="resilience-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def pet(self, step: int = -1, grace_s: float = 0.0) -> None:
+        """The step heartbeat: call after every completed step.
+        ``grace_s`` extends THIS deadline only — the runner grants it to
+        the first step of a lifetime, whose jit compile legitimately
+        dwarfs the steady-state timeout."""
+        with self._lock:
+            self._step = step
+            self._deadline = self._new_deadline() + max(0.0, grace_s)
+            self._last_pet_t = time.monotonic()
+            self._gen += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False
+
+    # -- monitor -----------------------------------------------------------
+    def _monitor(self) -> None:
+        fired_gen = None
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                overdue = time.monotonic() > self._deadline
+                step = self._step
+                gen = self._gen
+            if overdue and gen != fired_gen:
+                # one fire per pet generation: a continuing hang is not
+                # re-dumped every poll, but the monitor SURVIVES the
+                # fire — the next pet re-arms it for later hangs
+                fired_gen = gen
+                self._fire(step)
+
+    def _fire(self, step: int) -> None:
+        from ..profiler.metrics import registry as _registry
+
+        self.fired = True
+        _registry().counter("resilience/watchdog_fires").add(1)
+        elapsed = time.monotonic() - self._last_pet_t
+        text = dump_stacks()
+        if self.dump_file:
+            try:
+                with open(self.dump_file, "a") as f:
+                    f.write(text)
+            except OSError:
+                pass
+        if self.on_fire is not None:
+            try:
+                self.on_fire(step, elapsed, text)
+            except Exception:
+                traceback.print_exc()
+        if self.abort:
+            # the hung step may hold the GIL only intermittently and the
+            # XLA runtime may be wedged — os._exit is the only exit that
+            # cannot itself hang. The elastic restart resumes from the
+            # last committed checkpoint.
+            os._exit(WATCHDOG_EXIT_CODE)

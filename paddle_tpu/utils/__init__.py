@@ -1,7 +1,11 @@
 """Utility surface (reference: python/paddle/utils/)."""
-from . import custom_op, download  # noqa: F401
+from . import custom_op, download, retry  # noqa: F401
 from .custom_op import get_op, load_op_library, register_op  # noqa: F401
 from .deprecated import deprecated  # noqa: F401
 from .download import get_weights_path_from_url  # noqa: F401
+# NOTE: the retry FUNCTION is `paddle_tpu.utils.retry.retry` — rebinding
+# it here would shadow the submodule attribute and break
+# `import paddle_tpu.utils.retry`
+from .retry import RetryError  # noqa: F401
 from .install_check import run_check  # noqa: F401
 from .lazy_import import try_import  # noqa: F401

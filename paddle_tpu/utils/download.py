@@ -9,9 +9,26 @@ from __future__ import annotations
 
 import os
 
+from .retry import RetryError, retry
+
 __all__ = ["get_weights_path_from_url", "get_path_from_url"]
 
 WEIGHTS_HOME = os.path.expanduser("~/.cache/paddle/hapi/weights")
+
+
+def _read_bytes(path: str) -> bytes:
+    """Cached-file read behind retry: network filesystems (the cache dir
+    may be NFS/FUSE on a fleet host) throw transient OSErrors that a
+    couple of backoff attempts absorb (shared resilience retry())."""
+    def _once():
+        with open(path, "rb") as f:
+            return f.read()
+
+    try:
+        return retry(_once, attempts=3, base_delay=0.05,
+                     exceptions=(OSError,))
+    except RetryError as e:
+        raise e.last    # callers catch OSError/FileNotFoundError
 
 
 def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
@@ -21,8 +38,7 @@ def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
         if md5sum:
             import hashlib
 
-            with open(path, "rb") as f:
-                got = hashlib.md5(f.read()).hexdigest()
+            got = hashlib.md5(_read_bytes(path)).hexdigest()
             if got != md5sum:
                 raise RuntimeError(
                     f"cached file {path} is corrupt: md5 {got} != "

@@ -1,0 +1,102 @@
+"""retry(): bounded retry with exponential backoff.
+
+One small utility shared by every host-side I/O path that may see
+transient failures — data-loader calls (resilience/runner.py), cached
+weight reads (utils/download.py), checkpoint directory listings. Kept
+deliberately tiny and deterministic: with ``jitter=0`` the sleep
+sequence is ``base_delay * factor**k`` capped at ``max_delay``, so tests
+can assert the exact schedule.
+"""
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["retry", "RetryError"]
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted; ``last`` carries the final exception."""
+
+    def __init__(self, attempts: int, last: BaseException):
+        super().__init__(
+            f"retry: {attempts} attempt(s) failed; last error: {last!r}")
+        self.attempts = attempts
+        self.last = last
+
+
+def backoff_delays(attempts: int, base_delay: float, factor: float,
+                   max_delay: float, jitter: float = 0.0,
+                   seed: Optional[int] = None):
+    """The sleep schedule between attempts (attempts-1 entries).
+    ``jitter`` adds a uniform [0, jitter*delay) term; deterministic when
+    a seed is given (fleet-wide thundering-herd avoidance without
+    nondeterministic tests)."""
+    rng = random.Random(seed) if jitter else None
+    out = []
+    for k in range(max(0, attempts - 1)):
+        d = min(base_delay * (factor ** k), max_delay)
+        if rng is not None:
+            d += rng.uniform(0.0, jitter * d)
+        out.append(d)
+    return out
+
+
+def retry(fn: Optional[Callable] = None, *,
+          attempts: int = 4,
+          base_delay: float = 0.05,
+          factor: float = 2.0,
+          max_delay: float = 5.0,
+          jitter: float = 0.0,
+          seed: Optional[int] = None,
+          exceptions: Tuple[Type[BaseException], ...] = (Exception,),
+          on_retry: Optional[Callable] = None,
+          sleep: Callable[[float], None] = time.sleep):
+    """Call ``fn()`` up to ``attempts`` times with exponential backoff.
+
+    Usable three ways::
+
+        retry(lambda: flaky())                 # immediate call
+        @retry(attempts=6, exceptions=(OSError,))
+        def load(): ...                        # decorator with options
+        wrapped = retry(load, attempts=6)      # wrap, call later? no —
+                                               # positional fn is CALLED
+
+    A positional ``fn`` is invoked immediately and its result returned
+    (the common inline case); with no positional argument a decorator is
+    returned. ``on_retry(attempt_index, exception, delay)`` observes
+    every failed attempt that will be retried (the resilience runner
+    counts these into ``resilience/data_retries``).
+    """
+    delays = backoff_delays(attempts, base_delay, factor, max_delay,
+                            jitter=jitter, seed=seed)
+
+    def _run(f, *args, **kwargs):
+        last: Optional[BaseException] = None
+        for i in range(attempts):
+            try:
+                return f(*args, **kwargs)
+            except exceptions as e:   # noqa: PERF203 - retry loop
+                last = e
+                if i >= attempts - 1:
+                    break
+                d = delays[i]
+                if on_retry is not None:
+                    on_retry(i, e, d)
+                if d > 0:
+                    sleep(d)
+        raise RetryError(attempts, last)
+
+    if fn is not None:
+        return _run(fn)
+
+    def deco(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return _run(f, *args, **kwargs)
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,112 @@
+"""Chaos-harness worker (tests/test_chaos_e2e.py): trains gpt_tiny via
+ResilientRunner under a deterministic ChaosPlan built from env vars,
+appending "step,loss" lines to a log and one profiler-summary JSON line
+per lifetime to a .jsonl — the parent test preempts/corrupts/restarts
+it and asserts the final loss curve matches an uninterrupted run with
+the SAME plan, bitwise on the clean steps.
+
+Env knobs: CHAOS_NAN_CURSORS="3,4,5", CHAOS_FLAKY="6:2",
+CHAOS_PREEMPT_STEP="7", CHAOS_HANG="3:6.0", WATCHDOG_TIMEOUT_S,
+WATCHDOG_ABORT=1, BAD_STEP_LIMIT.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# exactly one force_host flag (the parent's conftest may have exported
+# its own 8-device one): last-wins parsing is not guaranteed
+_flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+          if "xla_force_host_platform_device_count" not in f]
+os.environ["XLA_FLAGS"] = " ".join(
+    _flags + ["--xla_force_host_platform_device_count=2"])
+
+import numpy as np  # noqa: E402
+
+
+def _env_ints(name):
+    v = os.environ.get(name, "").strip()
+    return [int(x) for x in v.split(",") if x] if v else []
+
+
+def _env_pairs(name, cast):
+    v = os.environ.get(name, "").strip()
+    out = {}
+    for part in v.split(","):
+        if part:
+            k, val = part.split(":")
+            out[int(k)] = cast(val)
+    return out
+
+
+def main():
+    ckpt_dir, log_path, profile_path, total = (
+        sys.argv[1], sys.argv[2], sys.argv[3], int(sys.argv[4]))
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.models import gpt_tiny
+    from paddle_tpu.resilience import ResilienceConfig, ResilientRunner
+    from paddle_tpu.resilience.chaos import ChaosPlan
+
+    paddle.seed(11)
+    net = gpt_tiny()
+    opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+    mesh = create_mesh({"dp": 2}, jax.devices()[:2])
+    tr = HybridPipelineTrainer(net, opt, DistributedStrategy(), mesh,
+                               n_micro=1, guard_bad_steps=True)
+
+    plan = ChaosPlan(
+        nan_cursors=_env_ints("CHAOS_NAN_CURSORS"),
+        flaky_cursors=_env_pairs("CHAOS_FLAKY", int),
+        hang_steps=_env_pairs("CHAOS_HANG", float),
+        preempt_after_step=(int(os.environ["CHAOS_PREEMPT_STEP"])
+                            if os.environ.get("CHAOS_PREEMPT_STEP")
+                            else None))
+    wd_timeout = float(os.environ.get("WATCHDOG_TIMEOUT_S", "0")) or None
+    cfg = ResilienceConfig(
+        bad_step_limit=int(os.environ.get("BAD_STEP_LIMIT", "3")),
+        watchdog_timeout_s=wd_timeout,
+        watchdog_jitter=0.0,
+        watchdog_abort=os.environ.get("WATCHDOG_ABORT") == "1",
+        watchdog_dump_file=os.environ.get("WATCHDOG_DUMP_FILE"),
+        data_retry_base_delay=0.01,
+        verify_restore=True)
+    runner = ResilientRunner(tr, ckpt_dir, save_interval=3, keep=3,
+                             config=cfg, chaos=plan)
+
+    def data_fn(cursor):
+        rng = np.random.RandomState(1000 + cursor)
+        return (rng.randint(0, 128, (4, 32)).astype(np.int32),)
+
+    log = open(log_path, "a")
+
+    def on_step(step, loss):
+        log.write(f"{step},{loss!r}\n")
+        log.flush()
+        os.fsync(log.fileno())
+
+    result = runner.run(data_fn, total, on_step=on_step)
+
+    # one profiler-summary line per lifetime: the parent unions the
+    # resilience/* counters across lifetimes
+    snap = profiler.summary()["metrics"]
+    with open(profile_path, "a") as f:
+        f.write(json.dumps({
+            "preempted": result.preempted,
+            "final_step": result.final_step,
+            "rollbacks": result.rollbacks,
+            "counters": {k: v.get("value") for k, v in snap.items()
+                         if k.startswith("resilience/")}}) + "\n")
+    if result.preempted:
+        print(f"PREEMPTED at {result.final_step}")
+        sys.exit(result.exit_code)
+    print("DONE")
+
+
+if __name__ == "__main__":
+    main()

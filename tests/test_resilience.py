@@ -1,0 +1,506 @@
+"""Resilient training runtime (paddle_tpu.resilience) — unit and
+integration coverage on the virtual CPU mesh, driven by the
+deterministic chaos harness (resilience/chaos.py).
+
+Covers: retry/backoff, the step watchdog, preemption flagging, the
+compiled bad-step guard (update-skip bit-exactness), rollback with
+cursor re-seeding, degraded checkpoint restore (kill-mid-save,
+truncated shard, flipped bytes, lost COMMIT, lost shard), and the
+ElasticTrainer data-cursor meta fix.
+"""
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as dck
+from paddle_tpu.distributed.elastic import ElasticTrainer
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+from paddle_tpu.distributed.mesh import create_mesh
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.profiler.metrics import registry
+from paddle_tpu.resilience import (ResilienceConfig, ResilientRunner,
+                                   PreemptionHandler, StepWatchdog, chaos)
+from paddle_tpu.utils.retry import RetryError, backoff_delays, retry
+
+pytestmark = pytest.mark.chaos
+
+
+def _counter(name):
+    return registry().counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# retry / backoff
+# ---------------------------------------------------------------------------
+
+
+def test_retry_recovers_after_transient_failures():
+    calls = {"n": 0}
+    slept = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry(flaky, attempts=4, base_delay=0.1, factor=2.0,
+                exceptions=(OSError,), sleep=slept.append)
+    assert out == "ok"
+    assert calls["n"] == 3
+    assert slept == [0.1, 0.2]          # deterministic backoff schedule
+
+
+def test_retry_exhausts_and_raises():
+    def always():
+        raise ValueError("nope")
+
+    with pytest.raises(RetryError) as ei:
+        retry(always, attempts=3, base_delay=0.0)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, ValueError)
+
+
+def test_retry_decorator_form():
+    calls = {"n": 0}
+
+    @retry(attempts=2, base_delay=0.0)
+    def f(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError
+        return x * 2
+
+    assert f(21) == 42
+
+
+def test_backoff_delays_capped_and_jittered_deterministically():
+    assert backoff_delays(5, 1.0, 2.0, 3.0) == [1.0, 2.0, 3.0, 3.0]
+    a = backoff_delays(4, 1.0, 2.0, 10.0, jitter=0.5, seed=7)
+    b = backoff_delays(4, 1.0, 2.0, 10.0, jitter=0.5, seed=7)
+    assert a == b                        # same seed, same schedule
+    base = backoff_delays(4, 1.0, 2.0, 10.0)
+    assert all(x >= y for x, y in zip(a, base))
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_on_hang_and_dumps_state():
+    fired = []
+    before = _counter("resilience/watchdog_fires")
+    wd = StepWatchdog(0.2, jitter_frac=0.0, abort=False, poll_s=0.05,
+                      on_fire=lambda step, el, text: fired.append(
+                          (step, text)))
+    with wd:
+        wd.pet(0)
+        time.sleep(0.7)                  # no pets: must fire
+    assert wd.fired
+    assert len(fired) == 1
+    step, text = fired[0]
+    assert step == 0
+    assert "hung-step dump" in text
+    assert "thread" in text              # live python stacks included
+    assert _counter("resilience/watchdog_fires") == before + 1
+
+
+def test_watchdog_stays_quiet_when_petted():
+    wd = StepWatchdog(0.3, jitter_frac=0.0, abort=False, poll_s=0.05)
+    with wd:
+        for s in range(6):
+            wd.pet(s)
+            time.sleep(0.1)
+    assert not wd.fired
+
+
+def test_watchdog_first_step_grace():
+    wd = StepWatchdog(0.2, jitter_frac=0.0, abort=False, poll_s=0.05)
+    with wd:
+        wd.pet(0, grace_s=1.0)           # compile allowance
+        time.sleep(0.6)                  # > timeout, < timeout+grace
+        assert not wd.fired
+        wd.pet(1)
+        time.sleep(0.1)
+    assert not wd.fired
+
+
+# ---------------------------------------------------------------------------
+# preemption handler
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_handler_flags_sigterm_and_restores():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert not h.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert h.wait(timeout=5)
+        assert h.requested
+        assert h.signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preemption_manual_request():
+    h = PreemptionHandler()
+    h.request()
+    assert h.requested
+    h.clear()
+    assert not h.requested
+
+
+# ---------------------------------------------------------------------------
+# degraded checkpoint restore (satellite: crash consistency via chaos)
+# ---------------------------------------------------------------------------
+
+
+def _mesh(shape):
+    n = int(np.prod(list(shape.values())))
+    return create_mesh(shape, jax.devices()[:n])
+
+
+def _saved_state(tmp_path, steps=(2, 4)):
+    mesh = _mesh({"dp": 2, "tp": 4})
+    out = {}
+    for s in steps:
+        x = jax.device_put(jnp.arange(64, dtype=jnp.float32) * s,
+                           NamedSharding(mesh, P("tp")))
+        state = {"x": x}
+        dck.save(str(tmp_path), state, step=s, meta={"step": s}).wait()
+        out[s] = state
+    return out
+
+
+def test_kill_mid_save_shard_present_commit_absent(tmp_path):
+    states = _saved_state(tmp_path)
+    chaos.simulate_kill_mid_save(str(tmp_path), step=6)
+    assert dck.latest_step(str(tmp_path)) == 4
+    st, meta, step = dck.restore_degraded(str(tmp_path), states[4])
+    assert step == 4
+    np.testing.assert_array_equal(
+        np.asarray(st["x"]), np.arange(64, dtype=np.float32) * 4)
+
+
+def test_truncated_shard_falls_back_to_previous_step(tmp_path):
+    states = _saved_state(tmp_path)
+    before = _counter("resilience/restore_fallbacks")
+    chaos.truncate_shard(str(tmp_path), keep_bytes=16)   # newest == 4
+    # even without CRC verify the short read is structurally detected
+    with pytest.warns(RuntimeWarning):
+        st, meta, step = dck.restore_degraded(str(tmp_path), states[4],
+                                        verify=False)
+    assert step == 2
+    np.testing.assert_array_equal(
+        np.asarray(st["x"]), np.arange(64, dtype=np.float32) * 2)
+    assert _counter("resilience/restore_fallbacks") == before + 1
+
+
+def test_flipped_byte_valid_length_needs_verify(tmp_path):
+    states = _saved_state(tmp_path)
+    chaos.flip_shard_byte(str(tmp_path), offset=10)
+    with pytest.warns(RuntimeWarning):
+        st, meta, step = dck.restore_degraded(str(tmp_path), states[4],
+                                        verify=True)
+    assert step == 2
+
+
+def test_deleted_commit_walks_back(tmp_path):
+    states = _saved_state(tmp_path)
+    chaos.delete_commit(str(tmp_path))                   # newest == 4
+    assert dck.latest_step(str(tmp_path)) == 2
+    st, meta, step = dck.restore_degraded(str(tmp_path), states[4])
+    assert step == 2
+
+
+def test_deleted_shard_walks_back(tmp_path):
+    states = _saved_state(tmp_path)
+    chaos.delete_shard(str(tmp_path))
+    with pytest.warns(RuntimeWarning):
+        st, meta, step = dck.restore_degraded(str(tmp_path), states[4],
+                                        verify=False)
+    assert step == 2
+
+
+def test_mangled_meta_walks_back(tmp_path):
+    states = _saved_state(tmp_path)
+    meta_path = tmp_path / "step_00000004" / "meta.json"
+    meta_path.write_text('{"step": 4, "trunc')        # mangled JSON
+    with pytest.warns(RuntimeWarning):
+        st, meta, step = dck.restore_degraded(str(tmp_path), states[4])
+    assert step == 2
+
+
+def test_all_steps_corrupt_raises(tmp_path):
+    states = _saved_state(tmp_path)
+    for s in (2, 4):
+        chaos.truncate_shard(str(tmp_path), step=s, keep_bytes=4)
+    with pytest.raises(IOError):
+        with pytest.warns(RuntimeWarning):
+            dck.restore_degraded(str(tmp_path), states[4], verify=False)
+
+
+def test_resave_same_step_removes_stale_commit_first(tmp_path):
+    """A rollback replay re-saves an already-committed step: the stale
+    COMMIT must be dropped before shard bytes are rewritten (crash
+    mid-rewrite must not leave a trusted-but-mixed directory)."""
+    mesh = _mesh({"dp": 2, "tp": 4})
+    x = jax.device_put(jnp.ones((8,)), NamedSharding(mesh, P("tp")))
+    dck.save(str(tmp_path), {"x": x}, step=1).wait()
+    commit = tmp_path / "step_00000001" / "COMMIT"
+    assert commit.exists()
+    h = dck.save(str(tmp_path), {"x": x * 3}, step=1)
+    h.wait()
+    assert commit.exists()
+    out = dck.restore(str(tmp_path), {"x": x})
+    np.testing.assert_array_equal(np.asarray(out["x"]), 3 * np.ones(8))
+
+
+# ---------------------------------------------------------------------------
+# bad-step guard (distributed/hybrid.py)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_trainer(guard=True, seed=11):
+    paddle.seed(seed)
+    # smallest legal config: these tests compile several independent
+    # step programs and the tier-1 suite is time-capped
+    from paddle_tpu.models import GPT, GPTConfig
+
+    net = GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16))
+    opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+    mesh = _mesh({"dp": 2})
+    return HybridPipelineTrainer(net, opt, DistributedStrategy(), mesh,
+                                 n_micro=1, guard_bad_steps=guard)
+
+
+def _batch(cursor):
+    rng = np.random.RandomState(1000 + cursor)
+    return (rng.randint(0, 128, (2, 16)).astype(np.int32),)
+
+
+def _flat_state(tr):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        tr.device_state())]
+
+
+def test_guard_skips_update_bit_exactly():
+    tr = _tiny_trainer()
+    tr.step(*_batch(0))
+    assert tr.last_step_ok
+    before = _flat_state(tr)
+    tr.inject_fault_scale(float("nan"))
+    loss = tr.step(*_batch(1))
+    assert np.isnan(np.asarray(loss))
+    assert not tr.last_step_ok
+    after = _flat_state(tr)
+    for a, b in zip(before, after):      # params AND optimizer state
+        np.testing.assert_array_equal(a, b)
+    # next clean step recovers
+    tr.step(*_batch(2))
+    assert tr.last_step_ok
+
+
+def test_guard_requires_flag_for_injection():
+    tr = _tiny_trainer(guard=False)
+    with pytest.raises(RuntimeError):
+        tr.inject_fault_scale(float("nan"))
+
+
+def test_guard_noop_on_clean_steps():
+    """The guard does not perturb clean training: numerically the
+    guarded curve tracks the unguarded one (they are DIFFERENT compiled
+    programs, so only near-equality is guaranteed across them), and two
+    guarded runs are bitwise identical (the determinism the chaos e2e
+    relies on)."""
+    a = _tiny_trainer(guard=True)
+    b = _tiny_trainer(guard=False)
+    a2 = _tiny_trainer(guard=True)
+    for c in range(3):
+        la = float(np.asarray(a.step(*_batch(c))))
+        lb = float(np.asarray(b.step(*_batch(c))))
+        la2 = float(np.asarray(a2.step(*_batch(c))))
+        np.testing.assert_allclose(la, lb, rtol=1e-5)
+        assert la == la2                 # guarded vs guarded: bitwise
+
+
+# ---------------------------------------------------------------------------
+# rollback + cursor re-seeding (ResilientRunner)
+# ---------------------------------------------------------------------------
+
+
+def test_rollback_after_k_bad_steps_reseeds_cursor(tmp_path):
+    before_rb = _counter("resilience/rollbacks")
+    before_sk = _counter("resilience/steps_skipped")
+    tr = _tiny_trainer()
+    # cursors 3,4,5 poison grads; ckpt lands at step 3 (save_interval 3),
+    # so the K=3 streak rolls back to it and replays with cursor 6
+    plan = chaos.ChaosPlan(nan_cursors={3, 4, 5})
+    runner = ResilientRunner(
+        tr, str(tmp_path / "ck"), save_interval=3,
+        config=ResilienceConfig(bad_step_limit=3), chaos=plan)
+    res = runner.run(_batch, 6)
+    assert res.completed
+    assert res.rollbacks == 1
+    assert _counter("resilience/rollbacks") == before_rb + 1
+    assert _counter("resilience/steps_skipped") == before_sk + 3
+    # poisoned cursors are blocklisted and persisted
+    assert {3, 4, 5} <= runner._skips
+    meta = dck.load_meta(str(tmp_path / "ck"),
+                         dck.latest_step(str(tmp_path / "ck")))
+    assert meta["skipped_cursors"] == [3, 4, 5]
+    # cursor ran ahead of step: 6 steps consumed cursors 0,1,2,6,7,8
+    assert meta["data_cursor"] == 9
+    assert meta["step"] == 6
+    # replay rewrote the rolled-back steps: the kept curve is all clean
+    assert sorted(res.losses) == list(range(6))
+    assert all(np.isfinite(v) for v in res.losses.values())
+
+
+def test_runner_data_retries_counted(tmp_path):
+    before = _counter("resilience/data_retries")
+    tr = _tiny_trainer()
+    plan = chaos.ChaosPlan(flaky_cursors={1: 2})
+    runner = ResilientRunner(
+        tr, str(tmp_path / "ck"), save_interval=4,
+        config=ResilienceConfig(data_retry_base_delay=0.01), chaos=plan)
+    res = runner.run(_batch, 3)
+    assert res.completed
+    assert _counter("resilience/data_retries") == before + 2
+
+
+def test_runner_preemption_commits_and_returns_resumable(tmp_path):
+    before = _counter("resilience/preemptions")
+    ck = str(tmp_path / "ck")
+    tr = _tiny_trainer()
+    plan = chaos.ChaosPlan(preempt_after_step=1)
+    runner = ResilientRunner(tr, ck, save_interval=100, chaos=plan)
+    res = runner.run(_batch, 6)
+    assert res.preempted and not res.completed
+    assert res.exit_code == 75
+    assert _counter("resilience/preemptions") == before + 1
+    # the preemption checkpoint is committed and resumable
+    assert dck.latest_step(ck) == 2
+    tr2 = _tiny_trainer()
+    runner2 = ResilientRunner(tr2, ck, save_interval=100)
+    res2 = runner2.run(_batch, 6)
+    assert res2.completed
+    assert res2.start_step == 2
+
+
+def test_preemption_mid_bad_streak_commits_nothing(tmp_path):
+    """A preemption landing inside a bad streak must NOT create a new
+    restore point (the uninterrupted run has none there — committing
+    one would shift its rollback target and break loss-curve parity);
+    the restart replays the streak from the last streak-free state."""
+    ck = str(tmp_path / "ck")
+    tr = _tiny_trainer()
+    plan = chaos.ChaosPlan(nan_cursors={0, 1}, preempt_after_step=0)
+    runner = ResilientRunner(tr, ck, save_interval=100, chaos=plan)
+    res = runner.run(_batch, 4)
+    assert res.preempted
+    assert dck.latest_step(ck) is None   # nothing committed mid-streak
+
+
+# ---------------------------------------------------------------------------
+# hapi callbacks (fit-level guards)
+# ---------------------------------------------------------------------------
+
+
+class _FitModelStub:
+    def __init__(self):
+        self.stop_training = False
+        self.saved = []
+
+    def save(self, path, training=True):
+        self.saved.append(path)
+
+
+def test_terminate_on_nan_callback_stops_fit():
+    from paddle_tpu.hapi.callbacks import TerminateOnNaN
+
+    cb = TerminateOnNaN()
+    m = _FitModelStub()
+    cb.set_model(m)
+    cb.on_train_batch_end(0, {"loss": 1.25})
+    assert not m.stop_training
+    cb.on_train_batch_end(1, {"loss": float("nan")})
+    assert m.stop_training
+    assert cb.stopped_step == 1
+
+
+def test_preemption_save_callback(tmp_path):
+    from paddle_tpu.hapi.callbacks import PreemptionSave
+
+    cb = PreemptionSave(str(tmp_path / "saves"))
+    m = _FitModelStub()
+    cb.set_model(m)
+    cb.on_train_begin()
+    try:
+        cb.on_train_batch_end(0, {"loss": 1.0})
+        assert not m.stop_training
+        cb._handler.request()            # deterministic preempt signal
+        cb.on_train_batch_end(1, {"loss": 1.0})
+        assert m.stop_training and cb.preempted
+        assert m.saved and m.saved[0].endswith("preempted")
+    finally:
+        cb.on_train_end()
+
+
+# ---------------------------------------------------------------------------
+# elastic data-cursor meta (satellite fix)
+# ---------------------------------------------------------------------------
+
+
+class _StubTrainer:
+    def __init__(self):
+        mesh = _mesh({"dp": 2})
+        self.state = {"w": jax.device_put(
+            jnp.arange(8, dtype=jnp.float32),
+            NamedSharding(mesh, P("dp")))}
+        self._step = 0
+
+    def device_state(self):
+        return dict(self.state)
+
+    def load_device_state(self, st, step=None):
+        self.state = dict(st)
+        if step is not None:
+            self._step = int(step)
+
+
+def test_elastic_meta_carries_real_cursor(tmp_path):
+    el = ElasticTrainer(_StubTrainer(), str(tmp_path), save_interval=10)
+    el.data_cursor = 9                   # cursor ran ahead (rollback skip)
+    el.save(5, async_=False)
+    meta = dck.load_meta(str(tmp_path), 5)
+    assert meta["step"] == 5
+    assert meta["data_cursor"] == 9      # NOT conflated with step
+
+    el2 = ElasticTrainer(_StubTrainer(), str(tmp_path))
+    assert el2.resume() == 5
+    assert el2.data_cursor == 9
+
+
+def test_elastic_resume_degraded_walks_back(tmp_path):
+    el = ElasticTrainer(_StubTrainer(), str(tmp_path), save_interval=10,
+                        verify_restore=True)
+    el.data_cursor = 3
+    el.save(3, async_=False)
+    el.data_cursor = 6
+    el.save(6, async_=False)
+    chaos.flip_shard_byte(str(tmp_path))          # newest (6) corrupt
+    el2 = ElasticTrainer(_StubTrainer(), str(tmp_path),
+                         verify_restore=True)
+    with pytest.warns(RuntimeWarning):
+        assert el2.resume() == 3
+    assert el2.data_cursor == 3
