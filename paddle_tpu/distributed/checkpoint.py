@@ -39,6 +39,7 @@ import json
 import os
 import shutil
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -48,6 +49,23 @@ from ..core.native import AsyncWriter, available as _native_available
 
 _STEP_FMT = "step_{:08d}"
 _COMMIT = "COMMIT"
+
+# streamed-snapshot default: one bounded D2H chunk at a time feeds the
+# writer, so host RAM holds ~2 chunks of not-yet-written bytes instead
+# of the whole state while copies overlap writes (and, via the
+# SaveHandle.wait_snapshot gate, subsequent training-step dispatch).
+_SNAPSHOT_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+def _ckpt_counters():
+    """(stall_ms, d2h_bytes) counters — the async-snapshot win is
+    MEASURED: stall_ms accumulates only time the training loop was
+    actually blocked (the inline part of save() plus any
+    wait_snapshot gate wait), d2h_bytes every device→host byte."""
+    from ..profiler.metrics import registry
+
+    reg = registry()
+    return reg.counter("ckpt/stall_ms"), reg.counter("ckpt/d2h_bytes")
 
 
 # ---------------------------------------------------------------------------
@@ -144,16 +162,39 @@ class SaveHandle:
     different hosts and deadlock XLA."""
 
     def __init__(self, step_dir: str, step: int, thread: threading.Thread,
-                 errbox: list):
+                 errbox: list, snap_event: Optional[threading.Event] = None):
         self._dir = step_dir
         self._step = step
         self._thread = thread
         self._err = errbox
         self._done = False
+        # None: the snapshot (device→host copy) happened inline in
+        # save(); an Event: the streamed-snapshot thread sets it once
+        # every byte of device state has been copied to host.
+        self._snap = snap_event
+
+    @property
+    def snapshot_done(self) -> bool:
+        return self._snap is None or self._snap.is_set()
+
+    def wait_snapshot(self) -> None:
+        """Block until the device state is fully copied to host — the
+        gate a training loop with DONATED state must pass before
+        dispatching the next step (the step would otherwise invalidate
+        the buffers the snapshot is still reading). File writes, fsync,
+        and COMMIT continue in the background; only ``wait()`` joins
+        those. The block time lands in ``ckpt/stall_ms``."""
+        if self._snap is None or self._snap.is_set():
+            return
+        stall, _ = _ckpt_counters()
+        t0 = time.perf_counter_ns()
+        self._snap.wait()
+        stall.add((time.perf_counter_ns() - t0) / 1e6)
 
     def wait(self) -> None:
         if self._done:
             return
+        self.wait_snapshot()
         self._thread.join()
         self._done = True
         # exchange error status BEFORE committing: a host whose shard
@@ -183,11 +224,27 @@ class SaveHandle:
 
 
 def save(directory: str, state, step: int, meta: Optional[dict] = None,
-         async_: bool = True) -> SaveHandle:
+         async_: bool = True, snapshot_async: bool = False,
+         snapshot_chunk_bytes: int = _SNAPSHOT_CHUNK_BYTES) -> SaveHandle:
     """Save a pytree of jax.Arrays as a sharded checkpoint.
 
     Returns a SaveHandle; the checkpoint is valid only after ``wait()``
     (CheckpointManager calls it for you at the next save/exit).
+
+    snapshot_async=False (default): device→host copies happen inline —
+    the call blocks for the full D2H of the owned shards (the measured
+    ~5 s stall for a ~10 GiB state over a 2 GiB/s link) and the state
+    may be mutated/donated the moment this returns.
+
+    snapshot_async=True: the call returns after recording shard
+    METADATA only; the device→host copies run on the background thread
+    in bounded ``snapshot_chunk_bytes`` chunks (async host-copy
+    lookahead of one chunk, each chunk fed straight to the writer), so
+    the copy overlaps whatever the host does next — data fetch, H2D
+    staging, loss sync. The caller MUST pass ``wait_snapshot()`` before
+    re-dispatching a step that donates the saved arrays: a donation
+    races the copy and fails the save loudly at ``wait()`` (never a
+    silent half-state — COMMIT only lands after every byte + fsync).
     """
     proc = jax.process_index()
     nproc = jax.process_count()
@@ -204,10 +261,14 @@ def save(directory: str, state, step: int, meta: Optional[dict] = None,
         _fsync_dir(step_dir)
     _barrier(f"ckpt_recommit_{step}")
 
-    # inline part: device→host copies of owned shards (snapshot semantics —
-    # training may mutate device state the moment this returns)
+    stall, d2h = _ckpt_counters()
+    t0 = time.perf_counter_ns()
+    # inline part: walk the owned shards. Sync mode copies each to host
+    # right here (snapshot semantics — training may mutate device state
+    # the moment this returns); async-snapshot mode records only the
+    # (device_shard, nbytes) plan, metadata reads that never sync.
     entries: Dict[str, dict] = {}
-    buffers: List[Tuple[str, np.ndarray]] = []
+    buffers: List[list] = []        # [shard_or_host, nbytes]
     offset = 0
     for key, arr in _flatten(state):
         if arr is None:
@@ -215,30 +276,91 @@ def save(directory: str, state, step: int, meta: Optional[dict] = None,
         arr = arr if isinstance(arr, jax.Array) else jax.numpy.asarray(arr)
         info = {"shape": [int(d) for d in arr.shape],
                 "dtype": _dtype_name(arr.dtype), "shards": []}
+        itemsize = jax.numpy.dtype(arr.dtype).itemsize
         for sh in arr.addressable_shards:
             if sh.replica_id != 0:
                 continue
-            host = np.ascontiguousarray(np.asarray(sh.data))
-            nbytes = host.nbytes
+            if snapshot_async:
+                data = sh.data
+                nbytes = int(np.prod(data.shape)) * itemsize \
+                    if data.shape else itemsize
+            else:
+                data = np.ascontiguousarray(np.asarray(sh.data))
+                nbytes = data.nbytes
             info["shards"].append({
                 "index": _norm_index(sh.index, arr.shape),
                 "offset": offset, "nbytes": int(nbytes)})
-            buffers.append((key, host))
+            buffers.append([data, int(nbytes)])
             offset += nbytes
         entries[key] = info
+    if not snapshot_async:
+        d2h.add(offset)
+    stall.add((time.perf_counter_ns() - t0) / 1e6)
 
     manifest = {"format": 1, "process": proc, "nprocs": nproc,
                 "step": int(step), "file": f"shard_p{proc}.bin",
                 "arrays": entries}
     errbox: list = []
+    snap_event = threading.Event() if snapshot_async else None
+
+    def _issue_copies(chunk):
+        # enqueue the D2H transfers for one chunk without blocking —
+        # chunk k+1's copies run while chunk k's bytes hit the writer
+        for slot in chunk:
+            start = getattr(slot[0], "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass            # materialize below still copies
 
     def _finish():
         try:
             w = _open_writer(os.path.join(step_dir, f"shard_p{proc}.bin"))
-            for _, host in buffers:
-                # byte view: memoryview can't express bf16, uint8 always
-                # works (reshape first — 0-d arrays can't change dtype)
-                w.write(host.reshape(-1).view(np.uint8).data)
+            if snapshot_async:
+                chunks: List[list] = [[]]
+                size = 0
+                for slot in buffers:
+                    if chunks[-1] and size + slot[1] > snapshot_chunk_bytes:
+                        chunks.append([])
+                        size = 0
+                    chunks[-1].append(slot)
+                    size += slot[1]
+                if chunks[0]:
+                    _issue_copies(chunks[0])
+                # phase 1 — D2H only: materialize every shard's host
+                # copy (chunk-bounded async-copy lookahead). No file
+                # I/O here: the wait_snapshot gate must release the
+                # moment the last device byte is on the host, not
+                # behind serialized writes + CRC of earlier chunks
+                # (file writes/fsync/COMMIT are wait()'s job, per the
+                # SaveHandle contract). Host RAM is unchanged by the
+                # split — np.asarray caches the host copy inside the
+                # shard either way.
+                hosts: List[np.ndarray] = []
+                for ci, chunk in enumerate(chunks):
+                    if ci + 1 < len(chunks):
+                        _issue_copies(chunks[ci + 1])
+                    for slot in chunk:
+                        host = np.ascontiguousarray(np.asarray(slot[0]))
+                        slot[0] = None
+                        hosts.append(host)
+                        d2h.add(host.nbytes)
+                # every device byte is on the host: training may donate
+                # the saved arrays from here on
+                snap_event.set()
+                # phase 2 — stream to disk in the background of the
+                # (now unblocked) training loop
+                for hi, host in enumerate(hosts):
+                    w.write(host.reshape(-1).view(np.uint8).data)
+                    hosts[hi] = None
+            else:
+                for slot in buffers:
+                    # byte view: memoryview can't express bf16, uint8
+                    # always works (reshape first — 0-d arrays can't
+                    # change dtype)
+                    w.write(slot[0].reshape(-1).view(np.uint8).data)
+                    slot[0] = None
             total, crc = w.close()
             manifest["file_crc32"] = int(crc)
             manifest["file_bytes"] = int(total)
@@ -249,11 +371,14 @@ def save(directory: str, state, step: int, meta: Optional[dict] = None,
             _fsync_dir(step_dir)
         except BaseException as e:  # surfaced by wait()
             errbox.append(e)
+        finally:
+            if snap_event is not None:
+                snap_event.set()     # error path: never hang the gate
 
     t = threading.Thread(target=_finish, name=f"ckpt-save-{step}",
                          daemon=False)
     t.start()
-    handle = SaveHandle(step_dir, step, t, errbox)
+    handle = SaveHandle(step_dir, step, t, errbox, snap_event=snap_event)
     if not async_:
         handle.wait()
     return handle
@@ -523,21 +648,37 @@ class CheckpointManager:
     first); ``restore_latest`` reads the newest committed step.
     """
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(self, directory: str, keep: int = 3,
+                 snapshot_async: bool = False,
+                 snapshot_chunk_bytes: int = _SNAPSHOT_CHUNK_BYTES):
         self.directory = directory
         self.keep = keep
+        # streamed-snapshot mode (save() docstring): D2H runs chunked on
+        # the writer thread; callers with donated state must pass
+        # wait_snapshot() before the next step dispatch.
+        self.snapshot_async = bool(snapshot_async)
+        self.snapshot_chunk_bytes = int(snapshot_chunk_bytes)
         self._pending: Optional[SaveHandle] = None
         os.makedirs(directory, exist_ok=True)
 
     def save(self, step: int, state, meta: Optional[dict] = None,
              async_: bool = True) -> SaveHandle:
         self.wait()
-        h = save(self.directory, state, step, meta=meta, async_=async_)
+        h = save(self.directory, state, step, meta=meta, async_=async_,
+                 snapshot_async=self.snapshot_async and async_,
+                 snapshot_chunk_bytes=self.snapshot_chunk_bytes)
         self._pending = h
 
         if not async_:
             self._gc()
         return h
+
+    def wait_snapshot(self) -> None:
+        """Gate: block until any in-flight save's device→host snapshot
+        is complete (no-op otherwise). MUST be passed before dispatching
+        a step that donates the saved state."""
+        if self._pending is not None:
+            self._pending.wait_snapshot()
 
     def wait(self) -> None:
         if self._pending is not None:

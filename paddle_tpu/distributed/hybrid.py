@@ -136,7 +136,12 @@ class HybridPipelineTrainer:
             loss, so it measures execution, not dispatch), moves the
             ``train/steps`` / ``train/tokens`` counters and the
             ``hybrid/step_ms`` histogram, and tracks the device-memory
-            high-water mark; ``trace_dir`` additionally captures a
+            high-water mark. An async-dispatch loop (elastic.py) sets
+            ``profiled_step_sync = False`` to keep the profiled step
+            from forcing the per-step sync it is hiding — the histogram
+            is then honestly named ``hybrid/dispatch_ms`` and the
+            deferred materializations record ``hybrid/sync_wait``;
+            ``trace_dir`` additionally captures a
             TensorBoard-loadable XLA device trace. ``fwd/stem``,
             ``fwd/blocks``, ``fwd/head`` named scopes are baked into the
             compiled program, so XLA traces attribute device time per
@@ -155,8 +160,11 @@ class HybridPipelineTrainer:
             previous step's verdict (lazy device sync);
             ``inject_fault_scale(nan)`` poisons the NEXT step's loss —
             the deterministic NaN-gradient hook the chaos harness uses.
-            Unsupported with offload/stream configs (the select would
-            force host-resident state through HBM twice).
+            Composes with ``offload_optimizer`` (the deselect runs on
+            the device copies fetched for the update, so no state is
+            double-streamed); unsupported with ``offload_params`` /
+            ``stream_layers`` (the param select would force host-
+            resident masters through HBM twice).
 
         retrace telemetry: every (re)trace of the step program is logged
             to ``profiler.retraces()`` with the triggering batch shapes;
@@ -547,12 +555,13 @@ class HybridPipelineTrainer:
                 t._value = None
 
         self.guard_bad_steps = bool(guard_bad_steps)
-        if self.guard_bad_steps and (offload_params or offload_optimizer
-                                     or stream_layers):
+        if self.guard_bad_steps and (offload_params or stream_layers):
             raise ValueError(
-                "guard_bad_steps is not supported with offload/stream "
-                "configs yet (the bad-step select would stream host-"
-                "resident state through HBM a second time)")
+                "guard_bad_steps is not supported with offload_params/"
+                "stream_layers yet (the bad-step select would stream "
+                "host-resident state through HBM a second time); "
+                "offload_optimizer alone composes — its deselect runs "
+                "on the device copies already fetched for the update")
         # device-side verdict of the last guarded step (None before the
         # first step / when unguarded); _fault_scale poisons exactly one
         # upcoming step's loss (chaos harness hook)
@@ -607,13 +616,17 @@ class HybridPipelineTrainer:
             # axes that stay GSPMD-auto inside the manual-pp region:
             # pallas kernels must nest a shard_map over them (Mosaic
             # cannot be auto-partitioned in a partially-manual region).
-            # pp == 1 runs fully auto — no scope needed.
+            # pp == 1 runs fully auto — no scope needed. On jax < 0.5
+            # the pipeline shard_map is manual over EVERY axis
+            # (pipeline.py legacy_all_manual), so there are no auto
+            # axes to declare either.
             auto_axes = tuple(a for a in self.mesh.axis_names
                               if a != "pp" and not (manual_sp and a == "sp"))
             auto_scope = (
                 (lambda: dctx.pipeline_auto_axes_scope(self.mesh,
                                                        auto_axes))
-                if self.pp > 1 else contextlib.nullcontext)
+                if self.pp > 1 and hasattr(jax, "shard_map")
+                else contextlib.nullcontext)
 
             def one_block(h, layer_params):
                 vals = [layer_params[s] for s in self.block_suffixes]
@@ -659,8 +672,14 @@ class HybridPipelineTrainer:
         # the vocab-sharded head's tp collectives ride GSPMD-auto inside
         # the manual-pp region like the blocks' do.
         import os
+        # jax < 0.5: the legacy shard_map's partial-eval drops the scalar-
+        # residual promotion for jax.checkpoint'ed bodies (the fused CE's
+        # scalar scan carry trips `_SpecError` at transpose time), so the
+        # head stays OUTSIDE the manual region there — the masked-psum
+        # egress below is the numerically-identical fallback.
         head_inside = not manual_sp and self.pp > 1 and not (
             _target_platform() == "cpu" and self.amp) and \
+            hasattr(jax, "shard_map") and \
             os.environ.get("PADDLE_TPU_HEAD_INSIDE", "1") != "0"
         with _swapped_state(other_tensors, other_cast), \
                 dctx.sequence_parallel_scope(self.mesh):
@@ -792,13 +811,30 @@ class HybridPipelineTrainer:
             return self._cast_back(np_, ns, store_p_dtype, store_s)
 
         def upd2(p, g, s, spec, lr, step_no, plr, wd, pspec=None,
-                 stacked=False):
+                 stacked=False, ok=None):
             """Update in f32 math, store back at the configured dtypes
-            (+ host placement handled by out_shardings when offloading)."""
+            (+ host placement handled by out_shardings when offloading).
+
+            ``ok`` (guard_bad_steps): the step verdict. The bad-step
+            deselect happens HERE, on the device-resident operands — the
+            pre-update param ``p`` and the fetched ``s_dev`` — not on the
+            host-resident inputs, so an offloaded optimizer state is
+            never streamed through HBM a second time just to undo the
+            update: the selected (old) values flow back to pinned_host
+            through the same out_shardings the updated ones would."""
             if offload_p:
                 p = jax.device_put(p, NamedSharding(
                     mesh_, pspec, memory_kind=self._dev_kind))
             s_dev = fetch_state(s, spec)
+
+            def deselect(np_, ns):
+                if ok is None:
+                    return np_, ns
+                np_ = jnp.where(ok, np_, p)
+                ns = {k: jnp.where(ok, v, s_dev[k])
+                      for k, v in ns.items()}
+                return np_, ns
+
             if scan_update and stacked and p.ndim >= 3:
                 lead = p.shape[0] * p.shape[1]
                 pf = p.reshape((lead,) + p.shape[2:])
@@ -816,8 +852,9 @@ class HybridPipelineTrainer:
                 np_ = npf.reshape(p.shape)
                 ns = {k: v.reshape(s_dev[k].shape)
                       for k, v in nsf.items()}
-                return np_, ns
-            return core_upd(p, g, s_dev, lr, step_no, plr, wd, p.dtype, s)
+                return deselect(np_, ns)
+            return deselect(
+                *core_upd(p, g, s_dev, lr, step_no, plr, wd, p.dtype, s))
 
         guard = self.guard_bad_steps
 
@@ -855,11 +892,16 @@ class HybridPipelineTrainer:
                 loss_of, argnums=(0, 1))(bp_c, op_c)
             g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
 
+            ok = None
             if guard:
                 # one scalar verdict for the whole step: loss and every
                 # clipped grad leaf finite. isfinite-per-leaf (not a
                 # squared global norm) so legitimately-huge-but-finite
-                # grads cannot overflow the check itself.
+                # grads cannot overflow the check itself. The deselect
+                # itself happens inside upd2 on device-resident values
+                # (see its docstring) — params AND optimizer state stay
+                # bit-identical on a bad step (momentum does not decay,
+                # weight decay does not apply).
                 ok = jnp.isfinite(loss)
                 for g_ in jax.tree_util.tree_leaves((g_blk, g_oth)):
                     ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g_)))
@@ -892,7 +934,8 @@ class HybridPipelineTrainer:
                                     block_opt[sfx])
                 np_, ns = upd2(p, g, s, self.block_opt_specs[sfx],
                                lr, step_no, lr_block[sfx], wd_block[sfx],
-                               pspec=self.block_specs[sfx], stacked=True)
+                               pspec=self.block_specs[sfx], stacked=True,
+                               ok=ok)
                 new_blk[sfx] = np_
                 new_blk_opt[sfx] = ns
                 if any_offload:
@@ -903,22 +946,14 @@ class HybridPipelineTrainer:
                     self.other_specs, lr_other, wd_other):
                 p, g, s = barriered(p, g, s)
                 np_, ns = upd2(p, g, s, sspec, lr, step_no, plr, wd,
-                               pspec=pspec)
+                               pspec=pspec, ok=ok)
                 new_oth.append(np_)
                 new_oth_opt.append(ns)
                 if any_offload:
                     chain.append(np_)
             if guard:
-                # bad step: deselect the whole update — params AND
-                # optimizer state stay bit-identical (zeroed grads would
-                # still decay momentum and apply weight decay)
-                keep = lambda new, old: jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(ok, a, b), new, old)
-                return (loss, ok,
-                        keep(new_blk, block_params),
-                        keep(new_oth, other_params),
-                        keep(new_blk_opt, block_opt),
-                        keep(new_oth_opt, other_opt))
+                return (loss, ok, new_blk, new_oth, new_blk_opt,
+                        new_oth_opt)
             return loss, new_blk, new_oth, new_blk_opt, new_oth_opt
 
         ns = lambda spec: NamedSharding(mesh, spec)
@@ -1187,14 +1222,28 @@ class HybridPipelineTrainer:
                 jnp.float32),)
             self._fault_scale = None
         if prof:
+            # profiled_step_sync (default True): sync on the loss so the
+            # step_ms histogram measures execution, not dispatch. An
+            # async-dispatch loop (elastic.py) sets it False — forcing a
+            # per-step sync here would serialize the very overlap being
+            # measured — and the deferred materialization records the
+            # honest hybrid/sync_wait span instead; the histogram is
+            # then named hybrid/dispatch_ms, because that is what it is.
+            sync = getattr(self, "profiled_step_sync", True)
             with _ptrace.scope("hybrid/step"):
                 out = self._step_fn(*args)
-                float(np.asarray(out[0]))          # truthful sync
+                if sync:
+                    # truthful sync; the inner span isolates how much of
+                    # the step was execution the host WAITED on vs
+                    # dispatch (the gap the async pipeline hides)
+                    with _ptrace.scope("sync_wait"):
+                        float(np.asarray(out[0]))
             dt_ms = (time.perf_counter_ns() - t0) / 1e6
             reg = _preg()
             reg.counter("train/steps").add(1)
             reg.counter("train/tokens").add(_pinstr.tokens_in_batch(vs))
-            reg.histogram("hybrid/step_ms").observe(dt_ms)
+            reg.histogram("hybrid/step_ms" if sync
+                          else "hybrid/dispatch_ms").observe(dt_ms)
             _pinstr.record_memory_high_water()
         else:
             out = self._step_fn(*args)
@@ -1222,6 +1271,14 @@ class HybridPipelineTrainer:
         if self._last_ok_dev is None:
             return True
         return bool(np.asarray(self._last_ok_dev))
+
+    def last_step_ok_device(self):
+        """The guarded verdict of the most recent step as the DEVICE
+        scalar (None before any guarded step) — the async step
+        pipeline's deferred-sync handle: the resilient runner captures
+        it per dispatched step and materializes a whole window at its
+        sync points instead of paying a host round-trip every step."""
+        return self._last_ok_dev
 
     def inject_fault_scale(self, value: float) -> None:
         """Chaos hook: multiply the NEXT step's loss by ``value`` (NaN

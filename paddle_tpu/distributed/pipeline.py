@@ -123,11 +123,27 @@ def pipeline_apply(mesh: Mesh, stage_fn: Callable, stacked_params: Any,
 
     param_specs = jax.tree_util.tree_map(
         lambda _: P(pp_axis), stacked_params)
-    manual = frozenset({pp_axis} if sp_axis is None else {pp_axis, sp_axis})
-    # params are pp-sharded but REPLICATED over sp: the shard_map transpose
-    # psums their cotangents over sp — promote that boundary too on CPU
-    # (same XLA:CPU bf16-collective crash as above; TPU unaffected).
-    param_f32 = boundary_f32 and sp_axis is not None
+    # jax < 0.5 (no ``jax.shard_map``): the old experimental dialect
+    # cannot TRANSPOSE a partially-manual region (``auto=`` non-empty —
+    # the same limitation ring_attention works around), so the schedule
+    # goes manual over EVERY mesh axis there instead. The specs are
+    # unchanged: params stay split over 'pp' only, so the entry reshards
+    # replicate them over dp/tp and each of those ranks runs the stage
+    # redundantly — same math, gradient-exact (the transpose psums the
+    # replicated params' cotangents over the extra axes, measured exact
+    # against the modern partial-manual program), only the partitioning
+    # dialect differs. dp/tp parallelism inside the schedule is a
+    # modern-jax (GSPMD-auto) feature; on old jax it degrades to
+    # replication, never to wrong numbers.
+    legacy_all_manual = not hasattr(jax, "shard_map")
+    manual = None if legacy_all_manual else \
+        frozenset({pp_axis} if sp_axis is None else {pp_axis, sp_axis})
+    # params are pp-sharded but REPLICATED over sp (and over EVERY other
+    # axis in the legacy all-manual fallback): the shard_map transpose
+    # psums their cotangents over the replicated axes — promote that
+    # boundary too on CPU (same XLA:CPU bf16-collective crash as above;
+    # TPU unaffected).
+    param_f32 = boundary_f32 and (sp_axis is not None or legacy_all_manual)
 
     def _pf(a):
         return a.astype(jnp.float32) if (param_f32

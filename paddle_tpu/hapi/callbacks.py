@@ -281,12 +281,20 @@ class PreemptionSave(Callback):
     SIGTERM/SIGINT handler for the duration of training; on a request
     it saves the model into ``save_dir`` after the in-flight batch and
     stops the fit loop, so a supervisor restart resumes from the saved
-    weights instead of losing the epoch."""
+    weights instead of losing the epoch.
 
-    def __init__(self, save_dir, name="preempted"):
+    ``manager``: optional object with a ``wait()`` method (a
+    ``CheckpointManager`` / ``ElasticTrainer.manager``) joined BEFORE
+    the preemption save — with the async step pipeline's streamed
+    snapshots a prior periodic save may still be copying/writing in the
+    background, and the preemption exit must not race it (the same
+    flush the resilient runner's preemption path performs)."""
+
+    def __init__(self, save_dir, name="preempted", manager=None):
         super().__init__()
         self.save_dir = save_dir
         self.name = name
+        self.manager = manager
         self.preempted = False
         self._handler = None
 
@@ -303,6 +311,8 @@ class PreemptionSave(Callback):
         from ..profiler.metrics import registry
 
         self.preempted = True
+        if self.manager is not None:       # join in-flight async saves
+            self.manager.wait()
         os.makedirs(self.save_dir, exist_ok=True)
         self.model.save(os.path.join(self.save_dir, self.name))
         registry().counter("resilience/preemptions").add(1)

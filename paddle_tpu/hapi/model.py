@@ -187,15 +187,19 @@ class Model:
                 if num_iters is not None and step + 1 >= num_iters:
                     break
             cbks.on_epoch_end(epoch, logs)
+            # stop BEFORE the epoch-tail eval: a preemption (or NaN
+            # stop) at batch k must not pay a full eval pass — on a
+            # fleet SIGTERM that pushes the exit past the grace window
+            # and the promised prompt resumable exit is SIGKILLed
+            # mid-eval instead
+            if self.stop_training:
+                break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_loader, verbose=0, callbacks=cbks
-                              if False else None)
+                self.evaluate(eval_loader, verbose=0, callbacks=None)
                 eval_logs = {m.name()[0] if isinstance(m.name(), list)
                              else m.name(): m.accumulate()
                              for m in self._metrics}
                 cbks.on_eval_end(eval_logs)
-            if self.stop_training:
-                break
 
     @no_grad()
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

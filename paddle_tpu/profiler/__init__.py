@@ -27,6 +27,16 @@ batches, and ``hapi.callbacks.ProfilerCallback`` for fit() loops. All
 hooks are behind a single enabled check — disabled cost is one bool
 read per step.
 
+Async-step-pipeline signals (ISSUE 3; distributed/elastic.py): the
+``hybrid/sync_wait`` span times every host←device loss materialization
+(under deferred sync it shrinks toward zero — execution already
+happened under later dispatches), ``elastic/loss_syncs`` counts them,
+``elastic/prefetch_depth`` gauges how many staged batches the input
+prefetcher had ready at each consume, and ``ckpt/stall_ms`` /
+``ckpt/d2h_bytes`` account the checkpoint snapshot: stall_ms is ONLY
+time the training loop was blocked (inline save + wait_snapshot gate),
+so sync-vs-streamed saves are directly comparable.
+
 Quick use::
 
     import paddle_tpu.profiler as profiler

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 import itertools
 import random as _random
-from queue import Queue
-from threading import Thread
+from queue import Empty, Queue
+from threading import Event, Thread
 
 __all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
            "firstn", "cache"]
@@ -87,29 +87,60 @@ def compose(*readers, check_alignment=True):
 
 
 def buffered(reader, size):
-    """Background-thread prefetch (reference decorator.py buffered)."""
+    """Background-thread prefetch (reference decorator.py buffered).
+
+    Cancellation-safe: a consumer that abandons the generator early
+    (``close()``, ``break``, garbage collection) must not leave the fill
+    thread blocked forever on a full queue holding the upstream reader
+    open. The finally-block sets a stop flag and DRAINS the queue — the
+    one blocked ``put`` completes, the producer sees the flag, closes
+    the upstream generator, and exits."""
     end = object()
 
     def __impl__():
         q: Queue = Queue(maxsize=size)
+        stop = Event()
 
         def fill():
+            it = None
             try:
-                for item in reader():
+                # reader() itself may raise (eager file open): inside
+                # the try, so the consumer gets the exception instead
+                # of hanging forever on an empty queue
+                it = reader()
+                for item in it:
                     q.put(item)
+                    if stop.is_set():
+                        return
                 q.put(end)
             except BaseException as e:  # surface, never hang the consumer
                 q.put(e)
+            finally:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
 
         t = Thread(target=fill, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is end:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is end:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # unblock a producer stuck in q.put: flag first, then drain
+            # (after the drain, at most one more put succeeds, after
+            # which the producer observes the flag and exits)
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except Empty:
+                    break
+            t.join(timeout=5.0)
 
     return __impl__
 

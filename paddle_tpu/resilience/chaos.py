@@ -31,7 +31,8 @@ from typing import Dict, Iterable, Optional
 
 __all__ = ["ChaosPlan", "ChaosDataError", "truncate_shard",
            "flip_shard_byte", "delete_commit", "delete_shard",
-           "simulate_kill_mid_save", "newest_committed_step"]
+           "simulate_kill_mid_save", "abandon_async_save",
+           "newest_committed_step"]
 
 
 class ChaosDataError(RuntimeError):
@@ -115,6 +116,18 @@ def simulate_kill_mid_save(ckpt_dir: str, step: int) -> str:
         f.write(b"\x00" * 64)
     # no manifest, no COMMIT
     return d
+
+
+def abandon_async_save(handle) -> str:
+    """Kill-mid-snapshot for a REAL streamed save (checkpoint.save
+    snapshot_async): join the writer thread — the most adversarial
+    surviving state, every shard byte + manifest durable on disk — but
+    never run ``wait()``, so COMMIT is never written. Deterministic
+    stand-in for a SIGKILL landing between the last fsync and the
+    commit marker; ``latest_step`` must keep resolving to the previous
+    committed step. Returns the uncommitted step directory."""
+    handle._thread.join()
+    return handle.directory
 
 
 # ---------------------------------------------------------------------------

@@ -13,6 +13,16 @@ remembers the signal number. No I/O, no locks, no collectives in the
 handler itself (a checkpoint collective issued from a signal frame
 could interleave with training collectives and deadlock XLA — the same
 rule SaveHandle.wait documents for background threads).
+
+Async-step-pipeline interplay (ISSUE 3): with deferred loss sync the
+loop may hold a window of dispatched-but-unmaterialized steps when the
+flag is seen. The preemption flush FIRST drains that window (running
+the normal bad-step accounting for each in-flight step — a preemption
+must not skip a rollback the synchronous loop would have taken), THEN
+takes the synchronous committed save; an in-flight streamed checkpoint
+snapshot is joined by that save's own manager.wait(). So the
+exit-checkpoint invariant is unchanged: the committed state is exactly
+the state after the last materialized clean step.
 """
 from __future__ import annotations
 

@@ -71,6 +71,29 @@ class ResilienceConfig:
     raise_on_preempt:       raise PreemptedError after the preemption
                             checkpoint commits, instead of returning a
                             RunResult with preempted=True (default).
+
+    Async step pipeline (distributed/elastic.py docstring; README
+    "Async step pipeline" has the guard/rollback interaction table):
+
+    async_dispatch:         defer loss AND guard-verdict syncs behind a
+                            bounded in-flight window so dispatch of
+                            step N+1 overlaps execution of step N. The
+                            window only opens once a COMMITTED
+                            checkpoint exists: a K-streak rollback with
+                            younger in-flight steps restores that
+                            checkpoint (state, RNG, cursor), which is
+                            what keeps deferred-mode loss curves
+                            bitwise-identical to synchronous mode.
+    sync_interval:          materialize the window at least this often.
+    max_inflight:           window size (default 2 steps).
+    prefetch_depth:         background input prefetch (+H2D staging)
+                            depth; 0 disables. Rollback invalidates
+                            every in-flight prefetched batch; the
+                            persisted skipped_cursors blocklist is
+                            honored before staging.
+    snapshot_async /        streamed checkpoint snapshots: D2H in
+    snapshot_chunk_bytes:   bounded chunks on the writer thread, gated
+                            before the next dispatch (checkpoint.save).
     """
 
     def __init__(self,
@@ -86,7 +109,13 @@ class ResilienceConfig:
                  data_retry_max_delay: float = 5.0,
                  data_retry_jitter: float = 0.0,
                  verify_restore: bool = True,
-                 raise_on_preempt: bool = False):
+                 raise_on_preempt: bool = False,
+                 async_dispatch: bool = False,
+                 sync_interval: int = 8,
+                 max_inflight: int = 2,
+                 prefetch_depth: int = 0,
+                 snapshot_async: bool = False,
+                 snapshot_chunk_bytes: Optional[int] = None):
         if bad_step_limit < 1:
             raise ValueError("bad_step_limit must be >= 1")
         self.bad_step_limit = int(bad_step_limit)
@@ -104,6 +133,12 @@ class ResilienceConfig:
         self.data_retry_jitter = float(data_retry_jitter)
         self.verify_restore = bool(verify_restore)
         self.raise_on_preempt = bool(raise_on_preempt)
+        self.async_dispatch = bool(async_dispatch)
+        self.sync_interval = max(1, int(sync_interval))
+        self.max_inflight = max(1, int(max_inflight))
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        self.snapshot_async = bool(snapshot_async)
+        self.snapshot_chunk_bytes = snapshot_chunk_bytes
 
 
 class RunResult:
@@ -150,12 +185,17 @@ class ResilientRunner:
         self.elastic = ElasticTrainer(
             trainer, ckpt_dir, save_interval=save_interval, keep=keep,
             degraded_restore=True,
-            verify_restore=self.config.verify_restore)
+            verify_restore=self.config.verify_restore,
+            snapshot_async=self.config.snapshot_async,
+            snapshot_chunk_bytes=self.config.snapshot_chunk_bytes)
         self.trainer = trainer
         self.preemption = PreemptionHandler()
         # cursors whose batches poisoned a rollback — never fed again;
         # persisted in every checkpoint's meta so restarts keep them
         self._skips: set = set()
+        # the active input prefetcher (async pipeline), exposed for the
+        # chaos tests' in-flight-discard assertions
+        self.prefetcher = None
 
     # -- helpers -----------------------------------------------------------
     def _extra_meta(self) -> dict:
@@ -215,12 +255,35 @@ class ResilientRunner:
 
     # -- the loop ----------------------------------------------------------
     def run(self, data_fn, total_steps: int, on_step=None) -> RunResult:
+        """The hardened loop, with the async step pipeline when the
+        config enables it: dispatched steps park their device loss AND
+        guard verdict in a bounded in-flight window; the per-step
+        bad-step/rollback/save logic runs at materialization time, in
+        step order, exactly as the synchronous loop would have run it.
+        The window only opens once a committed checkpoint exists — a
+        K-streak detected with younger steps already dispatched rolls
+        back to that checkpoint (restoring state, RNG and data cursor),
+        which discards the younger in-flight timeline deterministically
+        and keeps the loss curve bitwise-reproducible.
+
+        NOTE: ElasticTrainer.run has the plain (no-resilience) copy of
+        this window/drain/prefetch/gate sequencing — a semantic change
+        to the window in either loop almost certainly needs the same
+        change in the other (its run() docstring carries the same
+        cross-reference)."""
         cfg = self.config
         el = self.elastic
         tr = self.trainer
         chaos = self.chaos
         reg = _registry()
         guarded = bool(getattr(tr, "guard_bad_steps", False))
+        # deferred verdicts need the PER-STEP device scalar; a guarded
+        # trainer without the accessor must run with a closed window —
+        # the `last_step_ok` property only reads the LATEST dispatched
+        # step's verdict, which is the right step only when the drain
+        # happens immediately after its dispatch
+        get_ok = getattr(tr, "last_step_ok_device", None)
+        can_defer = not guarded or get_ok is not None
         fetch = chaos.wrap_data_fn(data_fn) if chaos is not None \
             else data_fn
 
@@ -240,99 +303,203 @@ class ResilientRunner:
             wd.pet(-1, grace_s=cfg.watchdog_first_grace_s)
         rollbacks = 0
         preempted = False
+        prefetcher = None
+        prev_profiled_sync = getattr(tr, "profiled_step_sync", True)
         try:
             start = el.resume()
             self._merge_resumed_skips()
+            have_ckpt = el.manager.latest_step() is not None
+            # async dispatch: a PROFILED trainer step must not force its
+            # own per-step loss sync (hybrid.py profiled_step_sync) —
+            # drain() records the honest hybrid/sync_wait span instead
+            # (restored in the finally below)
+            tr.profiled_step_sync = not cfg.async_dispatch
+            if cfg.prefetch_depth > 0:
+                from ..distributed.prefetch import BatchPrefetcher
+
+                # fetch rides the SAME retry wrapper; the persisted
+                # blocklist is consulted before a cursor is even read
+                prefetcher = BatchPrefetcher(
+                    lambda c: self._fetch(fetch, c),
+                    stage=el._stage_for_prefetch,
+                    depth=cfg.prefetch_depth,
+                    skip_fn=self._skips.__contains__).start(el.data_cursor)
+            self.prefetcher = prefetcher
             losses: Dict[int, float] = {}
+            pending: list = []    # (step, cursor, dev_loss, dev_verdict)
+            rolled: list = [None]  # (target_step, restored) from a drain
             consecutive_bad = 0
             bad_cursors: list = []
             first = True
             step = start
-            while step < total_steps:
+
+            def drain(keep: int = 0) -> bool:
+                """Materialize the oldest in-flight steps down to
+                ``keep``, running the bad-step accounting for each.
+                Returns False when a K-streak rollback interrupted the
+                drain: every younger in-flight step is discarded (its
+                timeline is gone — the restore rewound state, RNG and
+                cursor) and ``rolled[0]`` holds where to continue."""
+                nonlocal consecutive_bad, bad_cursors, rollbacks
+                while len(pending) > keep:
+                    s, cur, dev, okdev = pending.pop(0)
+                    lossf = el._sync_loss(dev)
+                    if guarded:
+                        ok = bool(np.asarray(okdev)) if okdev is not None \
+                            else tr.last_step_ok
+                    else:
+                        ok = not (math.isnan(lossf) or math.isinf(lossf))
+                    if not ok:
+                        reg.counter("resilience/steps_skipped").add(1)
+                        consecutive_bad += 1
+                        bad_cursors.append(cur)
+                        if consecutive_bad >= cfg.bad_step_limit:
+                            if wd is not None:
+                                # the rollback's checkpoint restore is
+                                # as slow as the startup one — same
+                                # grace
+                                wd.pet(s,
+                                       grace_s=cfg.watchdog_first_grace_s)
+                            back = self._rollback(bad_cursors, guarded)
+                            rollbacks += 1
+                            consecutive_bad = 0
+                            bad_cursors = []
+                            n_younger = len(pending)
+                            pending.clear()
+                            if prefetcher is not None:
+                                prefetcher.invalidate(el.data_cursor)
+                            if back >= 0:
+                                # replay: forget the rolled-over steps
+                                for s2 in [s2 for s2 in losses
+                                           if s2 >= back]:
+                                    del losses[s2]
+                                rolled[0] = (back, True)
+                            else:
+                                # continue in place (guarded, nothing
+                                # committed): re-run this step index
+                                # with the re-seeded cursor. The window
+                                # only opens once a checkpoint commits,
+                                # so younger in-flight steps here mean
+                                # every commit VANISHED mid-run — their
+                                # already-applied updates cannot be
+                                # rewound, and re-running their indices
+                                # would double-apply. Fail loudly.
+                                if n_younger:
+                                    raise RuntimeError(
+                                        f"K consecutive bad steps with "
+                                        f"no readable committed "
+                                        f"checkpoint while {n_younger} "
+                                        f"younger async-dispatched "
+                                        f"step(s) were in flight "
+                                        f"(commits removed mid-run?): "
+                                        f"state cannot be rewound")
+                                rolled[0] = (s, False)
+                            return False
+                    else:
+                        consecutive_bad = 0
+                        bad_cursors = []
+                    losses[s] = lossf
+                    if on_step is not None:
+                        on_step(s, lossf)
+                return True
+
+            def resume_after_rollback():
+                nonlocal step, first
+                back, restored = rolled[0]
+                step = back
+                if restored:
+                    first = True       # restored state may retrace
+                rolled[0] = None
+
+            while True:
+                if step >= total_steps:
+                    if not drain(0):
+                        resume_after_rollback()
+                        continue
+                    break
                 if wd is not None:
                     wd.pet(step, grace_s=cfg.watchdog_first_grace_s
                            if first else 0.0)
                 self._advance_past_skips()
                 cursor = el.data_cursor
-                batch = self._fetch(fetch, cursor)
-                if not isinstance(batch, tuple):
-                    batch = (batch,)
+                if prefetcher is not None:
+                    batch = prefetcher.get(cursor)
+                else:
+                    batch = self._fetch(fetch, cursor)
+                    if not isinstance(batch, tuple):
+                        batch = (batch,)
                 if chaos is not None:
                     chaos.maybe_hang(step)
                     if guarded and chaos.poisons(cursor):
                         tr.inject_fault_scale(float("nan"))
+                # streamed-snapshot gate LAST before the dispatch (which
+                # donates the state an in-flight save may still be
+                # copying to host): the fetch/staging above overlaps the
+                # snapshot's D2H
+                el.manager.wait_snapshot()
                 loss = tr.step(*batch)
                 el.data_cursor = cursor + 1
-                lossf = float(np.asarray(loss))
+                okdev = get_ok() if (guarded and get_ok is not None) \
+                    else None
                 first = False
-                ok = tr.last_step_ok if guarded else \
-                    not (math.isnan(lossf) or math.isinf(lossf))
-                if not ok:
-                    reg.counter("resilience/steps_skipped").add(1)
-                    consecutive_bad += 1
-                    bad_cursors.append(cursor)
-                    if consecutive_bad >= cfg.bad_step_limit:
-                        if wd is not None:
-                            # the rollback's checkpoint restore is as
-                            # slow as the startup one — same grace
-                            wd.pet(step,
-                                   grace_s=cfg.watchdog_first_grace_s)
-                        back = self._rollback(bad_cursors, guarded)
-                        rollbacks += 1
-                        consecutive_bad = 0
-                        bad_cursors = []
-                        if back >= 0:
-                            # replay: forget the steps being rolled over
-                            for s in [s for s in losses if s >= back]:
-                                del losses[s]
-                            step = back
-                            first = True   # restored state may retrace
-                        continue
-                else:
-                    consecutive_bad = 0
-                    bad_cursors = []
-                losses[step] = lossf
+                pending.append((step, cursor, loss, okdev))
                 done = step + 1
-                # saveable: a GUARDED trainer's weights are clean even
-                # mid-bad-streak (the update was deselected); WITHOUT
-                # the guard (host-side NaN check only) the poisoned
-                # update already landed, and committing it would make
-                # the NaN weights the rollback/restart target — an
-                # unrecoverable livelock
-                saveable = guarded or consecutive_bad == 0
+                step = done
+
+                # in-flight window: 0 (materialize now) unless async
+                # dispatch is on, a committed checkpoint anchors a
+                # potential rollback, AND per-step device verdicts are
+                # available (can_defer); sync_interval forces a drain
+                window = cfg.max_inflight if (cfg.async_dispatch
+                                              and have_ckpt
+                                              and can_defer) else 0
+                if window and done % cfg.sync_interval == 0:
+                    window = 0
+                if not drain(keep=window):
+                    resume_after_rollback()
+                    continue
+
                 if chaos is not None:
-                    chaos.maybe_preempt(step)
+                    chaos.maybe_preempt(done - 1)
                 if handler.requested:
-                    # the in-flight step finished above; now make the
-                    # exit resumable: one synchronous committed save.
-                    # NEVER mid-streak (even guarded): a preemption is
-                    # asymmetric — the uninterrupted run has no restore
-                    # point here, so committing one would shift the
-                    # K-streak rollback target and break loss-curve
-                    # parity. The restart resumes from the last
-                    # streak-free checkpoint and deterministically
-                    # replays the streak instead.
+                    # make the exit resumable: drain everything the
+                    # in-flight window holds, then one synchronous
+                    # committed save. NEVER mid-streak (even guarded):
+                    # a preemption is asymmetric — the uninterrupted
+                    # run has no restore point here, so committing one
+                    # would shift the K-streak rollback target and
+                    # break loss-curve parity. The restart resumes from
+                    # the last streak-free checkpoint and
+                    # deterministically replays the streak instead.
+                    if not drain(0):
+                        resume_after_rollback()
+                        continue
                     if consecutive_bad == 0:
                         if wd is not None:
                             # a synchronous big-model save is as slow
                             # as a restore — same grace, or abort mode
                             # kills the commit it exists to protect
-                            wd.pet(step,
+                            wd.pet(done,
                                    grace_s=cfg.watchdog_first_grace_s)
                         el.save(done, extra=self._extra_meta(),
                                 async_=False)
+                        have_ckpt = True
                     reg.counter("resilience/preemptions").add(1)
                     preempted = True
-                    if on_step is not None:
-                        on_step(step, lossf)
-                    step = done
                     break
-                if saveable and (done % el.save_interval == 0
-                                 or done == total_steps):
-                    el.save(done, extra=self._extra_meta())
-                if on_step is not None:
-                    on_step(step, lossf)
-                step = done
+                if done % el.save_interval == 0 or done == total_steps:
+                    if not drain(0):
+                        resume_after_rollback()
+                        continue
+                    # saveable: a GUARDED trainer's weights are clean
+                    # even mid-bad-streak (the update was deselected);
+                    # WITHOUT the guard (host-side NaN check only) the
+                    # poisoned update already landed, and committing it
+                    # would make the NaN weights the rollback/restart
+                    # target — an unrecoverable livelock
+                    if guarded or consecutive_bad == 0:
+                        el.save(done, extra=self._extra_meta())
+                        have_ckpt = True
             if wd is not None:     # joining the async save can be slow
                 wd.pet(step, grace_s=cfg.watchdog_first_grace_s)
             el.manager.wait()
@@ -345,6 +512,9 @@ class ResilientRunner:
                              final_step=step, total_steps=total_steps,
                              preempted=preempted, rollbacks=rollbacks)
         finally:
+            tr.profiled_step_sync = prev_profiled_sync
+            if prefetcher is not None:
+                prefetcher.stop()
             if wd is not None:
                 wd.stop()
             handler.uninstall()

@@ -22,8 +22,35 @@ jax.config.update("jax_platforms", "cpu")
 # (The perf path keeps the platform default — bf16 on the MXU.)
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# NOTE: jax's persistent compilation cache was evaluated here (the
+# suite re-compiles many identical tiny-model programs) and REJECTED:
+# this container's jaxlib 0.4.37 CPU backend segfaults mid-suite with
+# jax_compilation_cache_dir set (reproducible in tests that compile
+# while background threads run device transfers). Re-try after a jax
+# upgrade; do not re-enable on 0.4.37.
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+# Breadth-first ordering for time-capped runs: the tier-1 CI window is
+# hard-capped (870 s) and the suite does not fit inside it, so the
+# compile-heavy integration files (each test builds + jits one or more
+# hybrid trainers: tens of seconds per test) run LAST. The cap then
+# truncates the expensive tail instead of broad cheap coverage. A full
+# (uncapped) run is unaffected — every test still runs, only the order
+# changes; relative order within each group is preserved (stable sort).
+_COMPILE_HEAVY_FILES = frozenset({
+    "test_checkpoint.py",        # hybrid resume-exact: 3 trainers
+    "test_hybrid_models.py",     # bert/ernie/gpt hybrid compositions
+    "test_pipeline_schedules.py",  # GPipe + interleaved schedules
+    "test_stream_layers.py",     # per-layer offload streaming programs
+    "test_async_pipeline.py",    # elastic/runner async pipeline
+})
+
+
+def pytest_collection_modifyitems(config, items):
+    items.sort(key=lambda it: it.fspath.basename in _COMPILE_HEAVY_FILES)
 
 
 @pytest.fixture(autouse=True)

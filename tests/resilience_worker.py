@@ -7,7 +7,11 @@ the SAME plan, bitwise on the clean steps.
 
 Env knobs: CHAOS_NAN_CURSORS="3,4,5", CHAOS_FLAKY="6:2",
 CHAOS_PREEMPT_STEP="7", CHAOS_HANG="3:6.0", WATCHDOG_TIMEOUT_S,
-WATCHDOG_ABORT=1, BAD_STEP_LIMIT.
+WATCHDOG_ABORT=1, BAD_STEP_LIMIT; ASYNC_DISPATCH=1 runs the SAME plan
+through the async step pipeline (deferred loss/verdict sync, input
+prefetch, streamed snapshots — the chaos-smoke CI matrix leg; the
+bitwise loss-curve assertions are mode-internal, so they prove the
+async pipeline preserves the determinism contract).
 """
 import json
 import os
@@ -68,6 +72,7 @@ def main():
                             if os.environ.get("CHAOS_PREEMPT_STEP")
                             else None))
     wd_timeout = float(os.environ.get("WATCHDOG_TIMEOUT_S", "0")) or None
+    async_ = os.environ.get("ASYNC_DISPATCH") == "1"
     cfg = ResilienceConfig(
         bad_step_limit=int(os.environ.get("BAD_STEP_LIMIT", "3")),
         watchdog_timeout_s=wd_timeout,
@@ -75,7 +80,12 @@ def main():
         watchdog_abort=os.environ.get("WATCHDOG_ABORT") == "1",
         watchdog_dump_file=os.environ.get("WATCHDOG_DUMP_FILE"),
         data_retry_base_delay=0.01,
-        verify_restore=True)
+        verify_restore=True,
+        async_dispatch=async_,
+        sync_interval=4,
+        max_inflight=2,
+        prefetch_depth=2 if async_ else 0,
+        snapshot_async=async_)
     runner = ResilientRunner(tr, ckpt_dir, save_interval=3, keep=3,
                              config=cfg, chaos=plan)
 

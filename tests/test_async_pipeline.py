@@ -1,0 +1,390 @@
+"""Async step pipeline (ISSUE 3): overlapped input prefetch, deferred
+loss sync, and streamed checkpoint D2H — and their interplay with the
+resilience machinery.
+
+Proven here:
+  - deferred-sync loss curves are BITWISE-identical to synchronous-mode
+    curves on clean runs (the dispatched program is the same; only when
+    the host reads the scalar changes);
+  - the loop dispatches multiple steps before its first device sync
+    under ``async_dispatch`` (the CI perf-smoke leg);
+  - a rollback discards every in-flight prefetched batch and never
+    replays a blocklisted cursor;
+  - a kill mid-(streamed)-snapshot lands on the previous committed step
+    (COMMIT protocol unchanged);
+  - a streamed-snapshot save stalls the training loop strictly less
+    than the synchronous-snapshot save of the same state;
+  - the new profiler signals (``hybrid/sync_wait`` span,
+    ``elastic/prefetch_depth`` gauge, ``ckpt/stall_ms`` +
+    ``ckpt/d2h_bytes`` counters) are populated on the virtual CPU mesh;
+  - guard_bad_steps × offload_optimizer now composes (device-side
+    deselect), still bit-exact on a poisoned step;
+  - ``reader.buffered`` releases its producer thread (and closes the
+    upstream generator) when the consumer abandons the stream early.
+"""
+import math
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.distributed import checkpoint as dck
+from paddle_tpu.distributed.elastic import ElasticTrainer
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+from paddle_tpu.distributed.mesh import create_mesh
+from paddle_tpu.resilience import (ResilienceConfig, ResilientRunner,
+                                   chaos)
+
+pytestmark = pytest.mark.chaos
+
+
+def _mesh(shape):
+    n = int(np.prod(list(shape.values())))
+    return create_mesh(shape, jax.devices()[:n])
+
+
+def _tiny_trainer(guard=True, seed=11, **kw):
+    paddle.seed(seed)
+    from paddle_tpu.models import GPT, GPTConfig
+
+    net = GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16))
+    opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+    mesh = _mesh({"dp": 2})
+    return HybridPipelineTrainer(net, opt, DistributedStrategy(), mesh,
+                                 n_micro=1, guard_bad_steps=guard, **kw)
+
+
+def _batch(cursor):
+    rng = np.random.RandomState(1000 + cursor)
+    return (rng.randint(0, 128, (2, 16)).astype(np.int32),)
+
+
+# ---------------------------------------------------------------------------
+# deferred loss sync (ElasticTrainer async_dispatch)
+# ---------------------------------------------------------------------------
+
+
+def test_deferred_sync_bitwise_parity_and_signals(tmp_path):
+    """Acceptance: async dispatch + prefetch + streamed snapshots give a
+    loss curve bitwise-identical to the synchronous loop, and the new
+    profiler signals are populated on the virtual CPU mesh."""
+    el_sync = ElasticTrainer(_tiny_trainer(guard=False),
+                             str(tmp_path / "a"), save_interval=4)
+    ref = el_sync.run(_batch, 7)
+
+    profiler.enable()
+    try:
+        el_async = ElasticTrainer(
+            _tiny_trainer(guard=False), str(tmp_path / "b"),
+            save_interval=4, async_dispatch=True, sync_interval=5,
+            max_inflight=2, prefetch_depth=2, snapshot_async=True)
+        got = el_async.run(_batch, 7)
+        s = profiler.summary()
+    finally:
+        profiler.disable()
+
+    assert got == ref                      # bitwise, not allclose
+    assert "hybrid/sync_wait" in s["scopes"]
+    assert s["scopes"]["hybrid/sync_wait"]["count"] >= 7
+    depth = s["metrics"].get("elastic/prefetch_depth")
+    assert depth and depth["value"] is not None and depth["value"] >= 1
+    stall = s["metrics"].get("ckpt/stall_ms")
+    assert stall and stall["value"] is not None
+    assert s["metrics"]["ckpt/d2h_bytes"]["value"] > 0
+
+    # the async-snapshot checkpoints are committed (restore-exactness
+    # of streamed saves is proven byte-for-byte in the stall test below)
+    assert dck.latest_step(str(tmp_path / "b")) == 7
+
+
+@pytest.mark.slow
+def test_async_dispatch_defers_loss_sync(tmp_path):
+    """CPU perf smoke: with async_dispatch the loop dispatches up to
+    the in-flight window WITHOUT a device sync — the synchronous loop
+    syncs after every dispatch. slow-marked (two trainer compiles): the
+    chaos-smoke matrix (`-m chaos`, both legs) runs it on every push;
+    the tier-1 time cap keeps only the acceptance-critical async
+    tests."""
+
+    def record(async_):
+        events = []
+        tr = _tiny_trainer(guard=False)
+        el = ElasticTrainer(tr, str(tmp_path / f"d{async_}"),
+                            save_interval=100, async_dispatch=async_,
+                            sync_interval=100, max_inflight=2)
+        orig_step, orig_sync = tr.step, el._sync_loss
+        tr.step = lambda *b: (events.append("dispatch"), orig_step(*b))[1]
+        el._sync_loss = lambda d: (events.append("sync"),
+                                   orig_sync(d))[1]
+        el.run(_batch, 5)
+        return events
+
+    sync_events = record(False)
+    async_events = record(True)
+    assert sync_events[:4] == ["dispatch", "sync", "dispatch", "sync"]
+    # window of 2: three dispatches are in flight before the first sync
+    assert async_events[:4] == ["dispatch"] * 3 + ["sync"]
+    # every loss still materializes exactly once
+    assert async_events.count("sync") == 5
+
+
+# ---------------------------------------------------------------------------
+# streamed checkpoint snapshots
+# ---------------------------------------------------------------------------
+
+
+def _big_state(mesh, mb=128):
+    n = mb * 1024 * 1024 // 4 // 2048
+    x = jax.device_put(jnp.ones((n, 2048), jnp.float32),
+                       NamedSharding(mesh, P("dp", "tp")))
+    return {"w": x}
+
+
+def test_async_snapshot_stall_strictly_below_sync(tmp_path):
+    """Acceptance: a save under async snapshot records ckpt/stall_ms
+    strictly below the synchronous-mode stall for the same state size.
+    The overlap window here is the host work a training loop does
+    between a save and the next dispatch (data fetch, H2D staging,
+    logging) — emulated as a bounded wait on the gate side."""
+    mesh = _mesh({"dp": 2, "tp": 4})
+    reg = profiler.registry()
+
+    state = _big_state(mesh)
+    reg.reset()
+    h = dck.save(str(tmp_path), state, step=1, snapshot_async=False)
+    h.wait()
+    sync_stall = reg.counter("ckpt/stall_ms").value
+    sync_bytes = reg.counter("ckpt/d2h_bytes").value
+    assert sync_stall > 0 and sync_bytes > 0
+
+    state2 = jax.tree_util.tree_map(lambda a: a * 2.0, state)
+    jax.block_until_ready(state2)
+    reg.reset()
+    h2 = dck.save(str(tmp_path), state2, step=2, snapshot_async=True,
+                  snapshot_chunk_bytes=8 << 20)
+    time.sleep(0.6)          # the loop's fetch/stage/log overlap window
+    h2.wait_snapshot()
+    h2.wait()
+    async_stall = reg.counter("ckpt/stall_ms").value
+    assert reg.counter("ckpt/d2h_bytes").value == sync_bytes
+    assert async_stall < sync_stall, (async_stall, sync_stall)
+
+    # both steps committed and readable
+    out = dck.restore(str(tmp_path), state2, step=2, verify=True)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(state2["w"]))
+
+
+def test_kill_mid_snapshot_lands_on_previous_committed_step(tmp_path):
+    """Interplay (b): a crash during a streamed snapshot must not shift
+    the restore target — COMMIT only lands in wait(), so the previous
+    committed step stays newest."""
+    mesh = _mesh({"dp": 2, "tp": 4})
+    x = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                       NamedSharding(mesh, P("dp", "tp")))
+    dck.save(str(tmp_path), {"x": x}, step=1).wait()
+
+    h = dck.save(str(tmp_path), {"x": x * 3}, step=2,
+                 snapshot_async=True, snapshot_chunk_bytes=64)
+    d = chaos.abandon_async_save(h)       # SIGKILL between fsync+COMMIT
+    assert os.path.exists(d)
+    assert dck.latest_step(str(tmp_path)) == 1
+    state, meta, step = dck.restore_degraded(str(tmp_path), {"x": x})
+    assert step == 1
+    np.testing.assert_array_equal(np.asarray(state["x"]), np.asarray(x))
+
+
+# ---------------------------------------------------------------------------
+# async × resilience interplay (ResilientRunner)
+# ---------------------------------------------------------------------------
+
+
+def _run_chaotic(tmp_path, tag, async_, total=8):
+    plan = chaos.ChaosPlan(nan_cursors={3, 4, 5}, flaky_cursors={6: 1})
+    cfg = ResilienceConfig(
+        bad_step_limit=3, data_retry_base_delay=0.01,
+        async_dispatch=async_, sync_interval=5, max_inflight=2,
+        prefetch_depth=2 if async_ else 0, snapshot_async=async_)
+    tr = _tiny_trainer(guard=True)
+    runner = ResilientRunner(tr, str(tmp_path / tag), save_interval=3,
+                             config=cfg, chaos=plan)
+    consumed = []
+    orig = tr.step
+    tr.step = lambda *b: (consumed.append(runner.elastic.data_cursor),
+                          orig(*b))[1]
+    res = runner.run(_batch, total)
+    return res, runner, consumed
+
+
+@pytest.mark.slow
+def test_rollback_discards_prefetch_and_never_replays_blocklist(tmp_path):
+    """Interplay (a): the K-streak rollback invalidates the in-flight
+    prefetched batches, the poisoned cursors are blocklisted and never
+    fed to the trainer again, and the async-mode loss curve matches the
+    synchronous one bitwise (NaN steps NaN in both). slow-marked (two
+    trainer compiles); the chaos-smoke CI matrix runs it on every
+    push."""
+    res_s, _, consumed_s = _run_chaotic(tmp_path, "sync", False)
+    res_a, runner_a, consumed_a = _run_chaotic(tmp_path, "async", True)
+    assert res_s.completed and res_a.completed
+    assert res_s.rollbacks == res_a.rollbacks == 1
+
+    for s in sorted(res_s.losses):
+        a, b = res_s.losses[s], res_a.losses[s]
+        assert (math.isnan(a) and math.isnan(b)) or a == b, (s, a, b)
+
+    # the rollback re-seeded past the poisoned cursors: each was fed
+    # exactly once (the poisoning pass), never replayed after blocklist
+    for bad in (3, 4, 5):
+        assert consumed_a.count(bad) == 1, consumed_a
+        assert consumed_s.count(bad) == 1, consumed_s
+    assert {3, 4, 5} <= runner_a._skips
+    # in-flight prefetched batches of the discarded timeline were thrown
+    # away (rollback invalidation and/or cursor-mismatch refetch)
+    assert runner_a.prefetcher is not None
+    assert runner_a.prefetcher.discarded >= 1
+    # blocklist persisted for restarts
+    ck = str(tmp_path / "async")
+    meta = dck.load_meta(ck, dck.latest_step(ck))
+    assert meta["skipped_cursors"] == [3, 4, 5]
+
+
+@pytest.mark.slow
+def test_preemption_under_async_commits_and_resumes(tmp_path):
+    """Preemption flush with a non-empty in-flight window: the deferred
+    losses drain, one synchronous committed save lands, and the restart
+    resumes from it (exit stays resumable). slow-marked (two trainer
+    compiles); the chaos-smoke CI matrix runs it on every push."""
+    ck = str(tmp_path / "ck")
+    plan = chaos.ChaosPlan(preempt_after_step=2)
+    cfg = ResilienceConfig(async_dispatch=True, sync_interval=100,
+                           max_inflight=3, prefetch_depth=2,
+                           snapshot_async=True)
+    runner = ResilientRunner(_tiny_trainer(guard=True), ck,
+                             save_interval=2, config=cfg, chaos=plan)
+    res = runner.run(_batch, 6)
+    assert res.preempted and not res.completed
+    assert res.exit_code == 75
+    assert dck.latest_step(ck) == 3
+    assert sorted(res.losses) == [0, 1, 2]
+
+    runner2 = ResilientRunner(_tiny_trainer(guard=True), ck,
+                              save_interval=2, config=cfg)
+    res2 = runner2.run(_batch, 6)
+    assert res2.completed and res2.start_step == 3
+    assert sorted(res2.losses) == [3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# guard_bad_steps × offload_optimizer (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_guard_offload_optimizer_bit_exact_skip():
+    """The lifted restriction: with the optimizer state host-resident,
+    the bad-step deselect runs on the device copies already fetched for
+    the update — a poisoned step leaves params AND optimizer state
+    bit-identical, and clean steps keep training."""
+    os.environ["PADDLE_TPU_FAKE_PINNED_HOST"] = "1"
+    try:
+        paddle.seed(11)
+        from paddle_tpu.models import GPT, GPTConfig
+
+        net = GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                            num_heads=2, max_seq_len=16))
+        opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+        tr = HybridPipelineTrainer(net, opt, DistributedStrategy(),
+                                   _mesh({"dp": 2}), n_micro=1,
+                                   guard_bad_steps=True,
+                                   offload_optimizer=True)
+        l0 = float(np.asarray(tr.step(*_batch(0))))
+        assert tr.last_step_ok and np.isfinite(l0)
+        before = [np.asarray(x) for x in
+                  jax.tree_util.tree_leaves(tr.device_state())]
+        tr.inject_fault_scale(float("nan"))
+        loss = tr.step(*_batch(1))
+        assert np.isnan(np.asarray(loss))
+        assert not tr.last_step_ok
+        after = [np.asarray(x) for x in
+                 jax.tree_util.tree_leaves(tr.device_state())]
+        for a, b in zip(before, after):
+            np.testing.assert_array_equal(a, b)
+        tr.step(*_batch(2))
+        assert tr.last_step_ok
+    finally:
+        os.environ.pop("PADDLE_TPU_FAKE_PINNED_HOST", None)
+
+
+def test_guard_still_raises_for_stream_layers():
+    os.environ["PADDLE_TPU_FAKE_PINNED_HOST"] = "1"
+    try:
+        with pytest.raises(ValueError, match="guard_bad_steps"):
+            _tiny_trainer(guard=True, offload_optimizer=True,
+                          stream_layers=True)
+    finally:
+        os.environ.pop("PADDLE_TPU_FAKE_PINNED_HOST", None)
+
+
+# ---------------------------------------------------------------------------
+# reader.buffered producer leak (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_buffered_abandoned_consumer_releases_producer():
+    """Regression: abandoning the consumer used to leave the fill
+    thread blocked forever on q.put, holding the upstream reader open."""
+    from paddle_tpu.reader import buffered
+
+    closed = threading.Event()
+
+    def upstream():
+        try:
+            i = 0
+            while True:          # endless source, queue must fill
+                yield i
+                i += 1
+        finally:
+            closed.set()
+
+    before = {t.ident for t in threading.enumerate()}
+    g = buffered(upstream, 2)()
+    assert next(g) == 0
+    g.close()                    # abandon with a FULL queue
+    assert closed.wait(timeout=5.0), "upstream generator never closed"
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.01)
+    assert not leaked, f"fill thread leaked: {leaked}"
+
+    # normal completion and error surfacing are unchanged
+    assert list(buffered(lambda: iter(range(5)), 2)()) == list(range(5))
+
+    def bad():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = buffered(bad, 2)()
+    assert next(it) == 1
+    with pytest.raises(RuntimeError):
+        next(it)
+
+    # a reader that raises AT CALL TIME (eager file open) must surface
+    # in the consumer too, not strand it on an empty queue
+    def bad_call():
+        raise OSError("no such file")
+
+    with pytest.raises(OSError):
+        next(buffered(bad_call, 2)())
