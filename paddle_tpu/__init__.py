@@ -81,6 +81,7 @@ from .hapi.model import Model  # noqa: F401,E402
 from .jit.api import to_static  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
 from . import profiler  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 
 # paddle.disable_static / enable_static compat: this framework is always
 # "dygraph" at the API level; jit/pjit is the static path.

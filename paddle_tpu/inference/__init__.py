@@ -23,7 +23,15 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+__all__ = ["Config", "Predictor", "create_predictor", "ServingPredictor"]
+
+
+def __getattr__(name):   # PEP 562: ServingPredictor pulls in the serving
+    if name == "ServingPredictor":      # stack (jax) only when asked for
+        from .serving import ServingPredictor
+
+        return ServingPredictor
+    raise AttributeError(name)
 
 
 class Config:
@@ -123,13 +131,24 @@ class Predictor:
         # every invocation; a jit wrapper caches the executable lookup —
         # serving-path dispatch cost drops to a dict hit
         self._jit_calls = {}
-        # batch-size buckets: per-bucket artifacts, loaded lazily
+        # batch-size buckets: per-bucket artifacts, loaded lazily.
+        # LRU-capped: a serving front-end can legitimately save dozens of
+        # buckets, and each deserialized executable pins compiled code +
+        # a jit wrapper — evict cold buckets (reloadable from disk) and
+        # count it (cache_evict/predictor_exec in the profiler registry).
+        from ..utils.lru import LRUCache
+
         self._buckets = sorted(self._meta.get("batch_buckets", []))
-        self._bucket_exec = {}
+        self._bucket_exec = LRUCache(
+            Predictor.BUCKET_EXEC_CACHE_SIZE, "predictor_exec",
+            on_evict=lambda _b, exe: self._jit_calls.pop(id(exe), None))
         self._base_batch = None
         specs = self._meta.get("input_specs")
         if specs and len(specs[0][0]) > 0:
             self._base_batch = int(specs[0][0][0])
+
+    #: LRU capacity for lazily-deserialized per-bucket executables
+    BUCKET_EXEC_CACHE_SIZE = 8
 
     def _executable_for(self, n: int):
         """Smallest bucket >= n (or the base artifact when it fits)."""

@@ -1,0 +1,79 @@
+"""ServingPredictor: host the continuous-batching engine behind the
+Predictor surface.
+
+``inference.Predictor`` runs a saved artifact with a fixed program per
+batch bucket — right for classification-style traffic, wrong for
+autoregressive decode where requests have ragged lengths and finish at
+different times. ``ServingPredictor`` keeps the same calling shape
+(``run([inputs]) -> [outputs]``, ``get_input_names``) but is backed by
+``paddle_tpu.serving.ServingEngine``, so a deployment written against
+the Predictor API can switch to continuous batching by swapping the
+constructor.
+
+The engine needs live model weights (the paged tick re-stages KV pages
+every step — a frozen jax.export artifact can't host that), so this
+predictor is built FROM a ``GPT`` model, optionally restoring state
+saved by ``paddle_tpu.save``::
+
+    pred = ServingPredictor(model, max_new_tokens=64,
+                            num_slots=8, page_size=16)
+    out_ids, out_lens = pred.run([token_batch, lengths])
+
+Streaming submission is available on the underlying engine
+(``pred.engine.submit`` / ``pred.engine.run``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ServingPredictor"]
+
+
+class ServingPredictor:
+    """Predictor-shaped front end over ``serving.ServingEngine``."""
+
+    def __init__(self, model, max_new_tokens: int = 32,
+                 state_path: Optional[str] = None, **engine_knobs):
+        from ..serving import ServingConfig, ServingEngine
+
+        if state_path is not None:
+            import paddle_tpu as _paddle
+
+            model.set_state_dict(_paddle.load(state_path))
+        self.max_new_tokens = int(max_new_tokens)
+        self.engine = ServingEngine(model, ServingConfig(**engine_knobs))
+
+    def get_input_names(self) -> List[str]:
+        return ["tokens", "lengths"]
+
+    def run(self, inputs: Sequence[np.ndarray]):
+        """inputs: ``[tokens [N, T] int, lengths [N] int (optional)]``.
+        Rows are submitted as independent requests (``lengths`` strips
+        right padding; omitted means every row is full length) and
+        served concurrently by the engine. Returns
+        ``[ids [N, max_new_tokens], lengths [N]]`` — rows shorter than
+        ``max_new_tokens`` (EOS) are right-padded with the EOS id."""
+        toks = np.asarray(inputs[0], np.int32)
+        if toks.ndim != 2:
+            raise ValueError("tokens must be [N, T]")
+        n, t = toks.shape
+        lens = (np.asarray(inputs[1], np.int64).reshape(-1)
+                if len(inputs) > 1 else np.full(n, t, np.int64))
+        rids = [self.engine.submit(toks[i, :int(lens[i])],
+                                   self.max_new_tokens)
+                for i in range(n)]
+        results = self.engine.run()
+        eos = self.engine.config.eos_token_id
+        out = np.full((n, self.max_new_tokens),
+                      eos if eos is not None else 0, np.int32)
+        out_lens = np.zeros(n, np.int64)
+        for i, rid in enumerate(rids):
+            row = results[rid][:self.max_new_tokens]
+            out[i, :row.shape[0]] = row
+            out_lens[i] = row.shape[0]
+        self.engine.reset_results()
+        return [out, out_lens]
+
+    __call__ = run

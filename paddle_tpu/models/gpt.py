@@ -290,7 +290,8 @@ class GPT(nn.Layer):
                  decode_strategy: str = "greedy_search", top_k: int = 0,
                  top_p: float = 1.0, temperature: float = 1.0,
                  num_beams: int = 4, length_penalty: float = 0.0,
-                 eos_token_id=None, seed: int = 0):
+                 eos_token_id=None, seed: int = 0, paged: bool = False,
+                 page_size: int = 0):
         """Autoregressive generation with a preallocated KV cache, as one
         jitted program (prefill + lax.scan decode loop).
 
@@ -298,6 +299,16 @@ class GPT(nn.Layer):
         (the paddlenlp generate() surface; the reference era only has
         host-side beam_search ops, beam_search_op.cc). Returns
         (ids [B, max_new_tokens], scores [B]).
+
+        ``paged=True`` routes through the paged-KV serving engine
+        (paddle_tpu.serving) instead of the dense [B, S_max] cache:
+        same weights via the cached decode state, page-granular cache
+        HBM, fixed-shape decode ticks. Greedy paged output is bitwise
+        identical to the dense path (the wrapper picks a page size
+        dividing prompt+max_new so the attention reduction length
+        matches); sampling draws from per-request key chains, so paged
+        sampling is reproducible but not token-identical to the dense
+        shared-batch rng. Beam search has no paged path.
         """
         import numpy as _np
 
@@ -315,6 +326,14 @@ class GPT(nn.Layer):
         if decode_strategy not in ("greedy_search", "sampling",
                                    "beam_search"):
             raise ValueError(f"unknown decode_strategy {decode_strategy!r}")
+        if paged:
+            if decode_strategy == "beam_search":
+                raise NotImplementedError(
+                    "paged decode supports greedy_search/sampling; beam "
+                    "reordering needs per-beam page aliasing (ROADMAP)")
+            return self._generate_paged(
+                _np.asarray(ids_v), max_new_tokens, decode_strategy,
+                top_k, top_p, temperature, eos_token_id, seed, page_size)
         stacked, other = self._decode_state()
         cfg = self.config
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
@@ -322,11 +341,19 @@ class GPT(nn.Layer):
         dt = other["embeddings.wte.weight"].dtype
 
         # jit cache: retracing the whole prefill+scan program per call
-        # would cost seconds per generate() in a serving loop
+        # would cost seconds per generate() in a serving loop. Bounded:
+        # a serving workload feeds this an open-ended stream of
+        # (batch, len) shapes, so LRU-cap it and count evictions
+        # (cache_evict/gpt_gen_jit in the profiler registry).
         jkey = (b, t0, max_new_tokens, decode_strategy, top_k, top_p,
                 temperature, num_beams, length_penalty, eos_token_id,
                 str(dt))
-        jit_cache = self.__dict__.setdefault("_gen_jit", {})
+        if "_gen_jit" not in self.__dict__:
+            from ..utils.lru import LRUCache
+
+            self.__dict__["_gen_jit"] = LRUCache(GPT.GEN_JIT_CACHE_SIZE,
+                                                 "gpt_gen_jit")
+        jit_cache = self.__dict__["_gen_jit"]
         run = jit_cache.get(jkey)
         if run is None:
             def run_fn(stacked, other, tokens, rng):
@@ -365,6 +392,64 @@ class GPT(nn.Layer):
         ids, scores = run(stacked, other, ids_v, jax.random.PRNGKey(seed))
         return _T(ids), _T(scores)
 
+    #: LRU capacity for the per-shape generate() executables
+    GEN_JIT_CACHE_SIZE = 16
+    #: LRU capacity for cached paged serving engines (paged=True path)
+    PAGED_ENGINE_CACHE_SIZE = 4
+
+    def _generate_paged(self, ids_np, max_new_tokens, decode_strategy,
+                        top_k, top_p, temperature, eos_token_id, seed,
+                        page_size):
+        """generate() surface over the paged serving engine: one slot
+        per batch row, slot capacity == the dense path's S_max (the
+        wrapper picks the largest page size <= 16 dividing S_max, so
+        greedy output stays bitwise-identical to the dense cache)."""
+        import numpy as _np
+
+        from ..framework.tensor import Tensor as _T
+        from ..serving import ServingConfig, ServingEngine
+
+        b, t0 = ids_np.shape
+        smax = t0 + max_new_tokens
+        ps = page_size
+        if not ps:
+            ps = next(p for p in (16, 8, 4, 2, 1) if smax % p == 0)
+        if smax % ps:
+            raise ValueError(
+                f"page_size {ps} must divide prompt+max_new_tokens "
+                f"{smax} for the paged generate() path (bitwise parity "
+                "needs slot capacity == dense S_max)")
+        strategy = "sampling" if decode_strategy == "sampling" else "greedy"
+        ekey = (b, t0, max_new_tokens, ps, strategy, top_k, top_p,
+                temperature, eos_token_id)
+        if "_paged_engines" not in self.__dict__:
+            from ..utils.lru import LRUCache
+
+            self.__dict__["_paged_engines"] = LRUCache(
+                GPT.PAGED_ENGINE_CACHE_SIZE, "gpt_paged_engine")
+        engines = self.__dict__["_paged_engines"]
+        eng = engines.get(ekey)
+        if eng is None or eng._stacked is not self._decode_state()[0]:
+            eng = ServingEngine(self, ServingConfig(
+                num_slots=b, page_size=ps, pages_per_slot=smax // ps,
+                prefill_buckets=(t0,), decode=strategy,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_token_id=eos_token_id, seed=seed))
+            engines[ekey] = eng
+        base = _np.asarray(jax.random.PRNGKey(seed))
+        rids = [eng.submit(ids_np[i], max_new_tokens,
+                           key=_np.asarray(jax.random.fold_in(base, i)))
+                for i in range(b)]
+        results = eng.run()
+        out = _np.full((b, max_new_tokens),
+                       eos_token_id if eos_token_id is not None else 0,
+                       _np.int32)
+        for i, rid in enumerate(rids):
+            row = results[rid][:max_new_tokens]
+            out[i, :row.shape[0]] = row
+        eng.reset_results()
+        return _T(jnp.asarray(out)), _T(jnp.zeros((b,), jnp.float32))
+
     def _decode_state(self):
         """Cached (stacked, other) decode params; rebuilt only when the
         underlying param values changed (training step replaces them)."""
@@ -400,7 +485,31 @@ def _ln(x, w, b, eps):
     return (x - m) / jnp.sqrt(var + eps) * w + b
 
 
-def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0):
+def gpt_block_body(xc, p, eps, nh, hd, attend):
+    """One pre-norm transformer block over stacked decode params ``p``,
+    shared by the dense cached path (gpt_cached_apply) and the paged
+    serving tick (serving/engine.py) — the two must stay BITWISE
+    identical, so the block math lives in exactly one place and only the
+    cache handling differs: ``attend(q, kk, vv) -> (o [n,t,nh,hd],
+    extra)`` writes this layer's KV into its cache and attends."""
+    n, t = xc.shape[0], xc.shape[1]
+    h = nh * hd
+    hn = _ln(xc, p["ln_1.weight"], p["ln_1.bias"], eps)
+    qkv = hn @ p["attn.qkv_proj.weight"] + p["attn.qkv_proj.bias"]
+    qkv = qkv.reshape(n, t, 3, nh, hd)
+    q, kk, vv = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o, extra = attend(q, kk, vv)
+    o = o.reshape(n, t, h)
+    xc = xc + o @ p["attn.out_proj.weight"] + p["attn.out_proj.bias"]
+    h2 = _ln(xc, p["ln_2.weight"], p["ln_2.bias"], eps)
+    mid = jax.nn.gelu(h2 @ p["mlp.fc_in.weight"] + p["mlp.fc_in.bias"],
+                      approximate=True)
+    xc = xc + mid @ p["mlp.fc_out.weight"] + p["mlp.fc_out.bias"]
+    return xc, extra
+
+
+def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0,
+                     logits_index=None):
     """Pure-jax KV-cached forward for decoding (reference has no KV cache
     or generate() at all — its decoding is host-side beam_search ops,
     beam_search_op.cc; here decode is one compiled program).
@@ -408,6 +517,9 @@ def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0):
     stacked: {block_suffix: [L, ...]} block params; other: {name: val};
     ck/cv: [N, L, S_max, NH, D] caches; tokens [N, T] processed at
     positions pos0..pos0+T. Returns (last-token logits [N, V], ck, cv).
+    ``logits_index`` (may be traced): take logits at that query position
+    instead of the last — the serving prefill pads prompts to a length
+    bucket, so "last token" sits at true_len-1, not at T-1.
 
     Parity with GPT.forward is pinned by
     tests/test_generation.py::test_cached_prefill_matches_forward.
@@ -431,27 +543,26 @@ def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0):
     cvl = jnp.swapaxes(cv, 0, 1)
 
     def block(xc, inp):
-        p, k_c, v_c = inp
-        hn = _ln(xc, p["ln_1.weight"], p["ln_1.bias"], eps)
-        qkv = hn @ p["attn.qkv_proj.weight"] + p["attn.qkv_proj.bias"]
-        qkv = qkv.reshape(n, t, 3, nh, hd)
-        q, kk, vv = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_c = jax.lax.dynamic_update_slice(k_c, kk, (0, pos0, 0, 0))
-        v_c = jax.lax.dynamic_update_slice(v_c, vv, (0, pos0, 0, 0))
-        att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
-        att = jnp.where(mask, att, -1e9)
-        w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(xc.dtype)
-        o = jnp.einsum("bnts,bsnd->btnd", w, v_c).reshape(n, t, h)
-        xc = xc + o @ p["attn.out_proj.weight"] + p["attn.out_proj.bias"]
-        h2 = _ln(xc, p["ln_2.weight"], p["ln_2.bias"], eps)
-        mid = jax.nn.gelu(h2 @ p["mlp.fc_in.weight"] + p["mlp.fc_in.bias"],
-                          approximate=True)
-        xc = xc + mid @ p["mlp.fc_out.weight"] + p["mlp.fc_out.bias"]
-        return xc, (k_c, v_c)
+        p, k_c0, v_c0 = inp
+
+        def attend(q, kk, vv):
+            k_c = jax.lax.dynamic_update_slice(k_c0, kk, (0, pos0, 0, 0))
+            v_c = jax.lax.dynamic_update_slice(v_c0, vv, (0, pos0, 0, 0))
+            att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
+            att = jnp.where(mask, att, -1e9)
+            w = jax.nn.softmax(att.astype(jnp.float32),
+                               axis=-1).astype(xc.dtype)
+            return jnp.einsum("bnts,bsnd->btnd", w, v_c), (k_c, v_c)
+
+        return gpt_block_body(xc, p, eps, nh, hd, attend)
 
     x, (ckl, cvl) = jax.lax.scan(block, x, (stacked, ckl, cvl))
     x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
-    last = x[:, -1]
+    if logits_index is None:
+        last = x[:, -1]
+    else:
+        last = jax.lax.dynamic_index_in_dim(x, logits_index, axis=1,
+                                            keepdims=False)
     if "lm_head.weight" in other:
         logits = last @ other["lm_head.weight"]
     else:

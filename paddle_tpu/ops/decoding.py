@@ -69,17 +69,27 @@ def greedy_decode(step_fn: Callable, cache: Any, first_logits, start_pos,
 def apply_top_k_top_p(logits, top_k: int = 0, top_p: float = 1.0):
     """Mask logits outside the top-k / nucleus top-p set (paddlenlp-style
     filtering; the reference era exposes sampling via fluid.layers
-    sampling_id over user-filtered logits)."""
+    sampling_id over user-filtered logits).
+
+    Edge cases are clamped rather than propagated: ``top_k >= vocab``
+    and ``top_k <= 0`` (the common -1 "disabled" sentinel) filter
+    nothing, and a ``top_p`` so small that no prefix reaches it
+    (top_p <= p(argmax), including 0.0) keeps the argmax token — a
+    sampling step must never see an all-``NEG_INF`` row (categorical
+    over that row would pick uniformly at random)."""
     v = logits.shape[-1]
-    if top_k and top_k < v:
+    if 0 < top_k < v:
         kth = jnp.sort(logits, axis=-1)[..., v - top_k]
         logits = jnp.where(logits < kth[..., None], NEG_INF, logits)
     if top_p < 1.0:
         sorted_l = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_l, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest prefix with cumulative prob >= top_p
+        # keep the smallest prefix with cumulative prob >= top_p; the
+        # top-1 token is always kept (top_p <= p(argmax) would otherwise
+        # produce an empty keep-set and mask the whole row)
         keep_sorted = cum - probs < top_p
+        keep_sorted = keep_sorted.at[..., 0].set(True)
         kth = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf), axis=-1)
         logits = jnp.where(logits < kth[..., None], NEG_INF, logits)
     return logits

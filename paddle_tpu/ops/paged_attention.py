@@ -1,0 +1,180 @@
+"""Paged decode attention over a page-table KV cache.
+
+Serving keeps the KV cache as a fixed pool of fixed-size pages
+(``paddle_tpu.serving.paged_cache``) instead of one dense
+``[N, S_max, NH, D]`` slab per request batch: a request holds only the
+pages its sequence actually fills, so HBM scales with live tokens, not
+with ``S_max × slots``. This module is the attention read side of that
+layout — one decode step (query length 1 per slot) attending to every
+cached position of its own pages ("Ragged Paged Attention", PAPERS.md).
+
+Two implementations behind one entry point, following the
+``ops/int8_matmul.py`` precedent (kernel built and gated; the XLA
+spelling is the measured default until the kernel wins on hardware):
+
+- ``impl="xla"`` (default): gather the slot's pages into a contiguous
+  ``[B, S_cap, NH, D]`` view and run exactly the dense-cache attention
+  expression from ``models/gpt.py::gpt_cached_apply`` — same einsum
+  contractions, same mask constant, same f32 softmax. This is what
+  makes greedy paged decode **bitwise** equal to the dense ``generate``
+  path (tests/test_serving.py): XLA fuses the gather into the attention
+  so the page indirection costs index arithmetic, not a second cache.
+- ``impl="pallas"``: a ragged/paged Pallas kernel — grid
+  ``(slots, pages_per_slot)``, the page table scalar-prefetched so each
+  grid step DMAs one page directly from the pool (no materialized
+  gather), online-softmax accumulation in VMEM scratch across the page
+  axis. Gated behind the same TPU guard as ``ops/flash_attention.py``
+  (interpret mode on CPU). Numerics are allclose, not bitwise, vs the
+  XLA path (online softmax reassociates the reduction), so the serving
+  engine only selects it on explicit request.
+
+Layout note: pools are ``[num_pages, page_size, NH, D]`` per layer;
+page 0 is the null page (writes of inactive slots land there, gathers
+of unallocated table entries read it and are masked).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas_compat import CompilerParams as _CompilerParams
+
+__all__ = ["paged_decode_attention"]
+
+_NEG_INF = -1e9     # same masking constant as gpt_cached_apply
+
+
+def _interpret() -> bool:
+    from ..core.place import target_platform
+
+    return target_platform() == "cpu"
+
+
+def paged_decode_attention(q, k_pool, v_pool, page_table, attend_pos,
+                           impl: str = "xla"):
+    """One decode step of attention over paged KV.
+
+    q           [B, 1, NH, D]  single-position queries (t dim kept so the
+                               contraction matches gpt_cached_apply's)
+    k_pool      [P, ps, NH, D] per-layer key page pool
+    v_pool      [P, ps, NH, D] per-layer value page pool
+    page_table  [B, NPs] int32 page ids per slot (0 = null page)
+    attend_pos  [B] int32      last attendable position per slot
+                               (the slot's current write position)
+
+    Returns [B, 1, NH, D].
+    """
+    if impl == "xla":
+        return _paged_attention_xla(q, k_pool, v_pool, page_table,
+                                    attend_pos)
+    if impl == "pallas":
+        return _paged_attention_pallas(q, k_pool, v_pool, page_table,
+                                       attend_pos)
+    raise ValueError(f"unknown paged attention impl {impl!r}")
+
+
+def _paged_attention_xla(q, k_pool, v_pool, page_table, attend_pos):
+    """Gather-then-attend; the attention expression is copied verbatim
+    from gpt_cached_apply so the paged decode stays bitwise-parity with
+    the dense cache (same contraction order, same reduction length when
+    the slot capacity equals the dense S_max)."""
+    b = q.shape[0]
+    nps, ps = page_table.shape[1], k_pool.shape[1]
+    nh, hd = k_pool.shape[2], k_pool.shape[3]
+    s_cap = nps * ps
+    k_c = k_pool[page_table].reshape(b, s_cap, nh, hd)
+    v_c = v_pool[page_table].reshape(b, s_cap, nh, hd)
+    key_pos = jnp.arange(s_cap)
+    mask = key_pos[None, None, None, :] <= \
+        attend_pos[:, None, None, None]
+    att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
+    att = jnp.where(mask, att, _NEG_INF)
+    w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bnts,bsnd->btnd", w, v_c)
+
+
+# --------------------------------------------------------------------------
+# Pallas ragged/paged kernel
+# --------------------------------------------------------------------------
+
+def _paged_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, page_size: int, n_pages: int):
+    """Grid (b, j): slot b consumes its j-th page. The page table is
+    scalar-prefetched, so the BlockSpec index map DMAs page
+    ``pt[b, j]`` straight from the pool — the gathered [B, S_cap]
+    intermediate of the XLA path never exists. Running max / denominator
+    / accumulator live in VMEM scratch across the page axis."""
+    j = pl.program_id(1)
+    b = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)                    # [NH, D]
+    k = k_ref[0].astype(jnp.float32)                    # [ps, NH, D]
+    v = v_ref[0].astype(jnp.float32)
+    hd = q.shape[-1]
+    # s[n, p] = q[n] · k[p, n] / sqrt(D)
+    s = jax.lax.dot_general(
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32) / math.sqrt(hd)  # [NH, ps]
+    gpos = j * page_size + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 1)
+    s = jnp.where(gpos <= pos_ref[b], s, _NEG_INF)
+    m_prev = m_ref[:]                                    # [NH, 1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    p = jnp.exp(s - m_new)                               # [NH, ps]
+    corr = jnp.exp(m_prev - m_new)                       # [NH, 1]
+    l_ref[:] = corr * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+    # acc[n, d] += sum_p p[n, p] * v[p, n, d]
+    pv = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32)              # [NH, D]
+    acc_ref[:] = corr * acc_ref[:] + pv
+    m_ref[:] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, page_table, attend_pos):
+    b, _, nh, hd = q.shape
+    ps = k_pool.shape[1]
+    nps = page_table.shape[1]
+    q2 = q[:, 0]                                         # [B, NH, D]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nps),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd), lambda i, j, pt, pos: (i, 0, 0)),
+            pl.BlockSpec((1, ps, nh, hd),
+                         lambda i, j, pt, pos: (pt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, ps, nh, hd),
+                         lambda i, j, pt, pos: (pt[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd),
+                               lambda i, j, pt, pos: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, 1), jnp.float32),
+            pltpu.VMEM((nh, 1), jnp.float32),
+            pltpu.VMEM((nh, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, page_size=ps, n_pages=nps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nh, hd), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(page_table, attend_pos, q2, k_pool, v_pool)
+    return out[:, None]

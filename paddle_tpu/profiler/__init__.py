@@ -37,6 +37,20 @@ prefetcher had ready at each consume, and ``ckpt/stall_ms`` /
 time the training loop was blocked (inline save + wait_snapshot gate),
 so sync-vs-streamed saves are directly comparable.
 
+Serving signals (ISSUE 4; paddle_tpu.serving): gauges
+``serving/queue_depth``, ``serving/active_slots``,
+``serving/page_util`` (allocated fraction of the KV page pool),
+``serving/decode_batch`` (slots advanced by the last tick) and
+``serving/tokens_per_sec`` (set by ``ServingEngine.run``); counters
+``serving/tokens_generated``, ``serving/prefills``, ``serving/ticks``,
+``serving/preemptions``, ``serving/requests_finished`` and
+``serving/token_syncs`` (host materializations of deferred tick
+outputs); histogram ``serving/ttft_ms``. Per-shape executable caches
+(``GPT.generate``'s jit cache, the Predictor's bucket executables, the
+paged-engine cache) report LRU evictions as ``cache_evict/<name>``.
+Prefill length-bucket retraces surface at the ``serving.prefill#N``
+recompile site; the decode tick site must stay at one trace.
+
 Quick use::
 
     import paddle_tpu.profiler as profiler

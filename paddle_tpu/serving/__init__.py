@@ -1,0 +1,43 @@
+"""paddle_tpu.serving — continuous-batching decode runtime on a paged
+KV cache.
+
+The serving-side answer to the ROADMAP's "heavy traffic from millions
+of users": instead of one dense-cache ``generate()`` program per
+request batch, a fixed pool of KV **pages** (``paged_cache.py``) plus a
+fixed-shape jitted **decode tick** over cache slots (``engine.py``)
+lets requests join and leave mid-decode — admission fills slots as
+evictions free them, pages return to the pool the moment a request
+finishes, and the host overlaps scheduling with device execution via
+the PR-3 deferred-sync idiom. Attention over the paged layout lives in
+``ops/paged_attention.py`` (XLA gather reference + gated Pallas
+kernel).
+
+Quick use::
+
+    from paddle_tpu.serving import ServingEngine, ServingConfig
+    eng = ServingEngine(gpt_model, ServingConfig(num_slots=8,
+                                                 page_size=16))
+    rids = [eng.submit(prompt, max_new_tokens=64) for prompt in prompts]
+    outputs = eng.run()               # {rid: np.int32 ids}
+
+or, per request batch with the familiar surface::
+
+    ids, _ = gpt_model.generate(tokens, max_new_tokens=64, paged=True)
+
+Profiler integration (``paddle_tpu.profiler``): gauges
+``serving/queue_depth``, ``serving/active_slots``,
+``serving/page_util``, ``serving/tokens_per_sec``,
+``serving/decode_batch``; counters ``serving/tokens_generated``,
+``serving/prefills``, ``serving/ticks``, ``serving/preemptions``,
+``serving/requests_finished``, ``serving/token_syncs``; histogram
+``serving/ttft_ms``. Prefill length-bucket retraces are visible at the
+``serving.prefill#N`` site in ``profiler.recompile`` telemetry; the
+decode tick site must stay at ONE trace.
+"""
+from __future__ import annotations
+
+from .engine import Request, ServingConfig, ServingEngine  # noqa: F401
+from .paged_cache import NULL_PAGE, PageAllocator, PagePool  # noqa: F401
+
+__all__ = ["ServingEngine", "ServingConfig", "Request",
+           "PagePool", "PageAllocator", "NULL_PAGE"]

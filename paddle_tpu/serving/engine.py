@@ -1,0 +1,552 @@
+"""Continuous-batching decode engine over the paged KV cache.
+
+The dense ``GPT.generate`` path is one jitted prefill+scan program per
+request batch: every admitted prompt pays ``S_max`` of cache HBM,
+nobody can join or leave mid-decode, and mixed prompt lengths force
+padding waste or a retrace. This engine restructures serving the way
+the roadmap's cross-replica-sharding paper restructures the weight
+update — so the hardware never idles on work another request could
+fill:
+
+- **Fixed-shape decode tick.** One jitted program over ``num_slots``
+  cache slots advances every resident request by one token per call.
+  The program shape never depends on which slots are live, so it
+  traces exactly once (asserted via ``profiler.recompile`` telemetry).
+- **Continuous admission / eviction.** Requests are admitted into free
+  slots as others finish; EOS and max-token eviction return pages to
+  the pool mid-flight. Prefill runs in a small set of length buckets
+  (bounded, visible retraces), writing KV straight into the slot's
+  pages.
+- **Deferred host sync** (the PR-3 async-pipeline idiom): each tick's
+  token vector stays an unmaterialized device array; the host
+  dispatches tick N+1 (and prefills, via donated pool buffers) before
+  materializing tick N, keeping up to ``max_inflight`` ticks in
+  flight. Scheduling that must be host-deterministic (positions, page
+  growth, max-token stops) never reads device data; only EOS discovery
+  rides the lagged window.
+- **Exhaustion → preemption.** If the pool cannot grow a slot, the
+  engine drains, retries, then preempts the youngest request: its
+  generated prefix is requeued as a longer prompt. Re-prefill is
+  bitwise-equivalent to having continued (prefill and decode share the
+  same compiled math), and sampling keys are folded per absolute
+  position, so a preempted request's tokens do not depend on
+  scheduling.
+
+Greedy paged decode is **bitwise identical** to the dense
+``generate()`` on the same weights whenever the slot capacity
+``pages_per_slot * page_size`` equals the dense path's
+``prompt + max_new_tokens`` (the attention reduction length must match
+exactly — zero-tail padding is not bitwise-neutral). The
+``GPT.generate(paged=True)`` wrapper picks a divisor page size so this
+holds by construction; tests/test_serving.py pins it.
+
+Profiler signals: ``serving/queue_depth``, ``serving/active_slots``,
+``serving/page_util``, ``serving/ttft_ms`` (histogram),
+``serving/tokens_per_sec``, ``serving/tokens_generated``,
+``serving/prefills``, ``serving/ticks``, ``serving/preemptions``,
+``serving/requests_finished``, ``serving/token_syncs``.
+"""
+from __future__ import annotations
+
+import time
+import warnings
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..profiler import recompile as _recompile
+from ..profiler import registry as _registry
+from .paged_cache import PagePool
+
+__all__ = ["ServingConfig", "ServingEngine", "Request"]
+
+
+@contextmanager
+def _quiet_donation():
+    """CPU jax may decline buffer donation for the page pools; the
+    fallback copy is correct, just slower — don't spam the log for it.
+    Scoped to the engine's own dispatches: a global filter would also
+    swallow the training stack's donation-failure warnings (a real perf
+    signal in hybrid.py's jitted step)."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+@dataclass
+class ServingConfig:
+    """Engine knobs. Pool sizing math: the pool holds
+    ``num_pages - 1`` allocatable pages (page 0 is the null page) of
+    ``page_size`` tokens each, shared by ``num_slots`` resident
+    requests of at most ``pages_per_slot`` pages
+    (``slot_capacity = pages_per_slot * page_size`` tokens). Sizing
+    ``num_pages - 1 < num_slots * pages_per_slot`` oversubscribes the
+    pool — legal, served by preemption when it binds."""
+
+    num_slots: int = 8
+    page_size: int = 16
+    pages_per_slot: int = 0          # default: ceil(max_seq_len / page_size)
+    num_pages: int = 0               # default: full residency + null page
+    prefill_buckets: Tuple[int, ...] = ()   # default: pow2 ladder to capacity
+    max_inflight: int = 2            # unmaterialized decode ticks kept in flight
+    decode: str = "greedy"           # 'greedy' | 'sampling'
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+    attention_impl: str = "xla"      # 'xla' | 'pallas' (ops/paged_attention)
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: np.ndarray               # current prompt (grows on preemption)
+    max_new: int                     # tokens still wanted (shrinks on preempt)
+    key: np.ndarray                  # uint32[2] sampling key (absolute-pos folds)
+    out: List[int] = field(default_factory=list)
+    done: bool = False
+    submit_t: float = 0.0
+    first_token_t: Optional[float] = None
+    orig_prompt_len: int = 0         # for result accounting across preemption
+
+
+class _Inflight:
+    __slots__ = ("tok", "meta")
+
+    def __init__(self, tok, meta):
+        self.tok = tok               # device int32 array
+        self.meta = meta             # [(index_into_tok, slot, rid)]
+
+
+class ServingEngine:
+    """Continuous-batching serving runtime for a dense ``GPT`` model.
+
+    ::
+
+        eng = ServingEngine(model, ServingConfig(num_slots=8))
+        rid = eng.submit(prompt_ids, max_new_tokens=32)
+        out = eng.run()[rid]          # np.int32 generated ids
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None):
+        cfg = config or ServingConfig()
+        mcfg = model.config
+        if cfg.decode not in ("greedy", "sampling"):
+            raise ValueError(f"unknown decode mode {cfg.decode!r}")
+        self.config = cfg
+        self.model_config = mcfg
+        self._stacked, self._other = model._decode_state()
+        self._dtype = self._other["embeddings.wte.weight"].dtype
+        nh = mcfg.num_heads
+        hd = mcfg.hidden_size // nh
+        ps = cfg.page_size
+        pages_per_slot = cfg.pages_per_slot or -(-mcfg.max_seq_len // ps)
+        num_pages = cfg.num_pages or cfg.num_slots * pages_per_slot + 1
+        self.pool = PagePool(mcfg.num_layers, num_pages, ps, nh, hd,
+                             cfg.num_slots, pages_per_slot,
+                             dtype=self._dtype)
+        cap = self.pool.slot_capacity
+        if cfg.prefill_buckets:
+            buckets = sorted(set(int(b) for b in cfg.prefill_buckets))
+        else:
+            buckets, b = [], ps
+            while b < cap:
+                buckets.append(b)
+                b *= 2
+            buckets.append(cap)
+        if buckets[-1] < cap:
+            buckets.append(cap)
+        self.prefill_buckets = buckets
+        b_slots = cfg.num_slots
+        # host scheduling state (never reads device data)
+        self._slot_rid: List[Optional[int]] = [None] * b_slots
+        self._slot_len = np.zeros(b_slots, np.int32)      # tokens in cache
+        self._slot_dispatched = np.zeros(b_slots, np.int64)  # tokens emitted
+        self._slot_admit_seq = np.zeros(b_slots, np.int64)
+        self._admit_seq = 0
+        self._queue: deque[Request] = deque()
+        self._requests: Dict[int, Request] = {}
+        self._next_rid = 0
+        self._inflight: deque[_Inflight] = deque()
+        self.max_inflight_seen = 0
+        # device state
+        self._last_tok = jnp.zeros((b_slots,), jnp.int32)
+        self._keys = np.zeros((b_slots, 2), np.uint32)
+        self._base_key = np.asarray(jax.random.PRNGKey(cfg.seed))
+        # compiled programs: ONE tick site (asserted single-trace) and one
+        # prefill site shared by all buckets (retraces == extra buckets)
+        self._tick_site = _recompile.unique_site("serving.tick")
+        self._prefill_site = _recompile.unique_site("serving.prefill")
+        self._tick = jax.jit(self._make_tick(), donate_argnums=(2, 3))
+        self._prefills: Dict[int, object] = {}
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int,
+               key: Optional[np.ndarray] = None) -> int:
+        """Queue one request. Returns its request id."""
+        p = np.asarray(prompt_ids, np.int32).reshape(-1)
+        t0 = p.shape[0]
+        cap = self.pool.slot_capacity
+        if t0 < 1 or max_new_tokens < 1:
+            raise ValueError("need a non-empty prompt and max_new >= 1")
+        if t0 + max_new_tokens - 1 > cap:
+            raise ValueError(
+                f"prompt {t0} + {max_new_tokens} new tokens needs "
+                f"{t0 + max_new_tokens - 1} cache positions; slot capacity "
+                f"is {cap} (pages_per_slot * page_size) — raise "
+                "pages_per_slot or page_size")
+        if self.pool.pages_for(t0 + max_new_tokens - 1) > \
+                self.pool.allocator.num_pages - 1:
+            raise ValueError("request exceeds the whole page pool")
+        rid = self._next_rid
+        self._next_rid += 1
+        if key is None:
+            key = np.asarray(jax.random.fold_in(self._base_key, rid))
+        req = Request(rid=rid, prompt=p, max_new=int(max_new_tokens),
+                      key=np.asarray(key, np.uint32),
+                      submit_t=time.perf_counter(), orig_prompt_len=t0)
+        self._requests[rid] = req
+        self._queue.append(req)
+        return rid
+
+    def step(self) -> bool:
+        """One scheduler iteration: bound the in-flight window, admit
+        into free slots, grow pages (preempting on exhaustion), dispatch
+        one decode tick. Returns whether any device work was dispatched."""
+        self._drain(self.config.max_inflight)
+        dispatched = self._admit()
+        self._grow_pages()
+        dispatched = self._dispatch_tick() or dispatched
+        reg = _registry()
+        reg.gauge("serving/queue_depth").set(float(len(self._queue)))
+        reg.gauge("serving/active_slots").set(
+            float(sum(r is not None for r in self._slot_rid)))
+        reg.gauge("serving/page_util").set(self.pool.allocator.utilization())
+        return dispatched
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drive until every submitted request finished; returns
+        {rid: generated ids np.int32[<=max_new]}."""
+        t0 = time.perf_counter()
+        tokens0 = self._tokens_done()
+        while True:
+            progressed = self.step()
+            if not progressed:
+                if self._inflight:
+                    self._drain(0)
+                    continue
+                if all(r is None for r in self._slot_rid):
+                    if not self._queue:
+                        break
+                    # every slot free, window empty, still can't admit
+                    raise RuntimeError(
+                        "serving queue stalled: page pool too small for "
+                        "the queued prompt")
+                raise RuntimeError(
+                    "serving scheduler deadlock: resident requests but "
+                    "nothing dispatchable")
+        wall = max(time.perf_counter() - t0, 1e-9)
+        done = self._tokens_done() - tokens0
+        _registry().gauge("serving/tokens_per_sec").set(done / wall)
+        return {rid: np.asarray(r.out, np.int32)
+                for rid, r in self._requests.items() if r.done}
+
+    def drain(self, target: int = 0) -> None:
+        """Materialize in-flight ticks until at most ``target`` remain."""
+        self._drain(target)
+
+    def idle(self) -> bool:
+        """True when nothing is queued, resident, or in flight."""
+        return (not self._queue and not self._inflight
+                and all(r is None for r in self._slot_rid))
+
+    def reset_results(self) -> None:
+        """Forget finished requests (long-running host keeps memory flat)."""
+        self._requests = {rid: r for rid, r in self._requests.items()
+                          if not r.done}
+
+    def _tokens_done(self) -> int:
+        return sum(len(r.out) for r in self._requests.values())
+
+    # ------------------------------------------------------------------
+    # scheduling
+    # ------------------------------------------------------------------
+    def _drain(self, target: int) -> None:
+        """Materialize in-flight ticks oldest-first until at most
+        ``target`` remain. The ONLY place device data reaches the host."""
+        while len(self._inflight) > target:
+            ent = self._inflight.popleft()
+            toks = np.asarray(ent.tok)
+            _registry().counter("serving/token_syncs").add(1)
+            now = time.perf_counter()
+            for idx, slot, rid in ent.meta:
+                req = self._requests[rid]
+                if req.done:
+                    continue        # EOS discovered while this was in flight
+                tok = int(toks[idx])
+                req.out.append(tok)
+                _registry().counter("serving/tokens_generated").add(1)
+                if req.first_token_t is None:
+                    req.first_token_t = now
+                    _registry().histogram("serving/ttft_ms").observe(
+                        (now - req.submit_t) * 1000.0)
+                eos = self.config.eos_token_id
+                # max_new counts tokens wanted since the LAST (re)queue —
+                # preemption moved earlier output into the prompt and
+                # shrank max_new to the remainder
+                if (eos is not None and tok == eos) or \
+                        len(req.out) >= req.max_new:
+                    self._finish(slot, rid)
+
+    def _finish(self, slot: int, rid: int) -> None:
+        req = self._requests[rid]
+        req.done = True
+        # fold the preemption-era prefix back into the result
+        extra = req.prompt[req.orig_prompt_len:]
+        if extra.size:
+            req.out = list(extra) + req.out
+        if self._slot_rid[slot] == rid:
+            self.pool.release_slot(slot)
+            self._slot_rid[slot] = None
+            self._slot_len[slot] = 0
+        _registry().counter("serving/requests_finished").add(1)
+
+    def _admit(self) -> bool:
+        any_dispatch = False
+        free = [s for s, r in enumerate(self._slot_rid) if r is None]
+        while self._queue and free:
+            req = self._queue[0]
+            t0 = req.prompt.shape[0]
+            slot = free[-1]
+            if not self.pool.grow_slot(slot, self.pool.pages_for(t0)):
+                break               # pool exhausted; wait for evictions
+            self._queue.popleft()
+            free.pop()
+            self._slot_rid[slot] = req.rid
+            self._slot_len[slot] = t0
+            self._slot_dispatched[slot] = 1
+            self._admit_seq += 1
+            self._slot_admit_seq[slot] = self._admit_seq
+            self._dispatch_prefill(slot, req)
+            any_dispatch = True
+        return any_dispatch
+
+    def _bucket_for(self, t0: int) -> int:
+        for b in self.prefill_buckets:
+            if b >= t0:
+                return b
+        raise ValueError(f"prompt length {t0} exceeds largest prefill "
+                         f"bucket {self.prefill_buckets[-1]}")
+
+    def _dispatch_prefill(self, slot: int, req: Request) -> None:
+        t0 = req.prompt.shape[0]
+        bucket = self._bucket_for(t0)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :t0] = req.prompt
+        fn = self._prefills.get(bucket)
+        if fn is None:
+            fn = self._prefills[bucket] = jax.jit(
+                self._make_prefill(bucket), donate_argnums=(2, 3))
+        page_ids = np.ascontiguousarray(self.pool.tables[slot])
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok0 = fn(
+                self._stacked, self._other, self.pool.k, self.pool.v,
+                toks, np.int32(t0), page_ids, req.key)
+        self._last_tok = self._last_tok.at[slot].set(tok0[0])
+        self._keys[slot] = req.key
+        self._inflight.append(_Inflight(tok0, [(0, slot, req.rid)]))
+        self.max_inflight_seen = max(self.max_inflight_seen,
+                                     len(self._inflight))
+        _registry().counter("serving/prefills").add(1)
+
+    def _ticking_slots(self) -> List[int]:
+        """Slots that should advance this tick: resident, not finished,
+        and with emissions still owed. A slot whose final token is
+        already dispatched stops ticking immediately (max-token stop is
+        host-deterministic); EOS stops lag by <= max_inflight ticks."""
+        out = []
+        for s, rid in enumerate(self._slot_rid):
+            if rid is None:
+                continue
+            req = self._requests[rid]
+            if not req.done and self._slot_dispatched[s] < req.max_new:
+                out.append(s)
+        return out
+
+    def _grow_pages(self) -> None:
+        for s in self._ticking_slots():
+            if self._slot_rid[s] is None:
+                continue            # freed by an earlier drain/preempt
+            need_page = int(self._slot_len[s]) // self.pool.page_size
+            if need_page < self.pool.slot_pages(s):
+                continue
+            if self.pool.grow_slot(s, 1):
+                continue
+            # exhaustion: learn about in-flight finishes, then retry
+            self._drain(0)
+            if self._slot_rid[s] is None:
+                continue            # this very slot finished in the drain
+            if self.pool.grow_slot(s, 1):
+                continue
+            self._preempt_for(s)
+
+    def _preempt_for(self, needy_slot: int) -> None:
+        """Free pages by requeueing the youngest resident request (its
+        generated prefix becomes prompt, so no work is redone twice)."""
+        live = [s for s in range(self.config.num_slots)
+                if self._slot_rid[s] is not None]
+        victim = max(live, key=lambda s: self._slot_admit_seq[s])
+        rid = self._slot_rid[victim]
+        req = self._requests[rid]
+        # window was drained in _grow_pages, so req.out is current
+        req.prompt = np.concatenate(
+            [req.prompt, np.asarray(req.out, np.int32)])
+        req.max_new -= len(req.out)
+        req.out = []
+        self._queue.appendleft(req)
+        self.pool.release_slot(victim)
+        self._slot_rid[victim] = None
+        self._slot_len[victim] = 0
+        _registry().counter("serving/preemptions").add(1)
+        if victim != needy_slot and self._slot_rid[needy_slot] is not None:
+            if not self.pool.grow_slot(needy_slot, 1):
+                self._preempt_for(needy_slot)
+
+    def _dispatch_tick(self) -> bool:
+        ticking = self._ticking_slots()
+        if not ticking:
+            return False
+        tab = np.ascontiguousarray(self.pool.tables)
+        pos = np.ascontiguousarray(self._slot_len)
+        keys = np.ascontiguousarray(self._keys)
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok = self._tick(
+                self._stacked, self._other, self.pool.k, self.pool.v,
+                tab, pos, self._last_tok, keys)
+        self._last_tok = tok
+        meta = [(s, s, self._slot_rid[s]) for s in ticking]
+        self._inflight.append(_Inflight(tok, meta))
+        self.max_inflight_seen = max(self.max_inflight_seen,
+                                     len(self._inflight))
+        for s in ticking:
+            self._slot_len[s] += 1
+            self._slot_dispatched[s] += 1
+        _registry().counter("serving/ticks").add(1)
+        _registry().gauge("serving/decode_batch").set(float(len(ticking)))
+        return True
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _sample_tok(self, logits, keys, positions):
+        """Token choice from last-token logits [N, V]. Greedy mirrors
+        ops/decoding.greedy_decode (argmax of f32 log_softmax — parity);
+        sampling folds each slot's key by the ABSOLUTE position of the
+        emitted token, so a request's stream is independent of
+        scheduling/preemption."""
+        c = self.config
+        if c.decode == "greedy":
+            lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            return jnp.argmax(lp, axis=-1).astype(jnp.int32)
+        from ..ops.decoding import apply_top_k_top_p
+
+        lg = logits.astype(jnp.float32) / jnp.maximum(c.temperature, 1e-6)
+        lg = apply_top_k_top_p(lg, c.top_k, c.top_p)
+        lp = jax.nn.log_softmax(lg, axis=-1)
+
+        def one(key, pos, row):
+            return jax.random.categorical(jax.random.fold_in(key, pos), row)
+
+        return jax.vmap(one)(keys, positions, lp).astype(jnp.int32)
+
+    def _make_tick(self):
+        mcfg = self.model_config
+        ps = self.pool.page_size
+        nh = mcfg.num_heads
+        hd = mcfg.hidden_size // nh
+        eps = mcfg.layer_norm_eps
+        nslots = self.config.num_slots
+        impl = self.config.attention_impl
+        site = self._tick_site
+
+        from ..models.gpt import _ln, gpt_block_body
+        from ..ops.paged_attention import paged_decode_attention
+
+        def tick(stacked, other, kpool, vpool, tab, pos, tok, keys):
+            _recompile.mark_trace(site, kpool, tab, pos, tok)
+            wte = other["embeddings.wte.weight"]
+            wpe = other["embeddings.wpe.weight"]
+            x = wte[tok[:, None]] + wpe[pos[:, None]]        # [B, 1, h]
+            page = tab[jnp.arange(nslots), pos // ps]
+            off = pos % ps
+
+            def block(xc, inp):
+                p, kpl0, vpl0 = inp
+
+                def attend(q, kk, vv):
+                    kpl = kpl0.at[page, off].set(kk[:, 0])
+                    vpl = vpl0.at[page, off].set(vv[:, 0])
+                    o = paged_decode_attention(q, kpl, vpl, tab, pos,
+                                               impl=impl)
+                    return o, (kpl, vpl)
+
+                return gpt_block_body(xc, p, eps, nh, hd, attend)
+
+            x, (kpool, vpool) = jax.lax.scan(
+                block, x, (stacked, kpool, vpool))
+            x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
+            last = x[:, -1]
+            if "lm_head.weight" in other:
+                logits = last @ other["lm_head.weight"]
+            else:
+                logits = last @ wte.T
+            nxt = self._sample_tok(logits, keys, pos + 1)
+            return kpool, vpool, nxt
+
+        return tick
+
+    def _make_prefill(self, bucket: int):
+        """Prefill one request (padded to ``bucket``) through the SAME
+        dense cached forward as the non-paged path, with the scratch
+        cache sized to the slot capacity (reduction-length parity), then
+        scatter the computed KV into the slot's pages. Right-padding is
+        causal-masked garbage: padded positions write to allocated pages
+        but are masked until decode overwrites each one first."""
+        mcfg = self.model_config
+        cap = self.pool.slot_capacity
+        nps = self.pool.pages_per_slot
+        ps = self.pool.page_size
+        nh = mcfg.num_heads
+        hd = mcfg.hidden_size // nh
+        L = mcfg.num_layers
+        dt = self._dtype
+        site = self._prefill_site
+
+        from ..models.gpt import gpt_cached_apply
+
+        def prefill(stacked, other, kpool, vpool, tokens, true_len,
+                    page_ids, key):
+            _recompile.mark_trace(site, tokens, kpool)
+            ck = jnp.zeros((1, L, cap, nh, hd), dt)
+            cv = jnp.zeros((1, L, cap, nh, hd), dt)
+            logits, ck, cv = gpt_cached_apply(
+                mcfg, stacked, other, ck, cv, tokens, 0,
+                logits_index=true_len - 1)
+            kpages = ck[0].reshape(L, nps, ps, nh, hd)
+            vpages = cv[0].reshape(L, nps, ps, nh, hd)
+            kpool = kpool.at[:, page_ids].set(kpages)
+            vpool = vpool.at[:, page_ids].set(vpages)
+            tok0 = self._sample_tok(logits, key[None], true_len[None])
+            return kpool, vpool, tok0
+
+        return prefill

@@ -1,0 +1,142 @@
+"""Paged KV cache: fixed page pool + free-list allocator + page tables.
+
+The dense decode cache (``gpt_cached_apply``) charges every admitted
+request ``S_max`` positions of HBM for its whole lifetime. Here the
+cache is a pool of fixed-size pages shared by all slots; a request
+holds ``ceil(len/page_size)`` pages and returns them at eviction, so
+pool HBM tracks live tokens and a freed request's pages are reusable
+immediately — the allocation granularity that makes continuous
+batching admission-feasible mid-flight ("Ragged Paged Attention",
+PAPERS.md).
+
+Device state (``PagePool``): per-layer key/value pools stacked
+``[L, num_pages, page_size, NH, D]``. One page id addresses the same
+page row in every layer, so the allocator hands out a single id per
+page regardless of depth.
+
+Host state (``PageAllocator``): a LIFO free list over ids
+``1..num_pages-1``. **Page 0 is reserved as the null page**: inactive
+slots' table entries point at it, decode-tick writes for inactive
+slots land in it, and gathers through unallocated table entries read
+it (always masked). LIFO reuse is deliberate — it maximizes the chance
+a test (or a bug) sees a dirty page straight after free, which is
+exactly what the no-cross-request-leakage test pins down.
+
+Allocation and freeing are host-side bookkeeping only — no device op;
+the tables are tiny int32 arrays shipped with each tick's arguments.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+NULL_PAGE = 0
+
+
+class PageAllocator:
+    """LIFO free-list over page ids 1..num_pages-1 (0 is the null page)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is reserved)")
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        # companion set: O(1) double-free detection (the list alone
+        # would make release_slot O(pages_freed * free_list_len))
+        self._free_set = set(self._free)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def utilization(self) -> float:
+        """Allocated fraction of the allocatable pool (null page excluded)."""
+        return self.num_allocated / max(self.num_pages - 1, 1)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n page ids, or None (and no state change) if the pool can't
+        cover the request — admission control needs all-or-nothing."""
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        return out
+
+    def free(self, ids) -> None:
+        for i in ids:
+            i = int(i)
+            if i == NULL_PAGE:
+                raise ValueError("page 0 (null page) is not allocatable")
+            if i in self._free_set:
+                raise ValueError(f"double free of page {i}")
+            self._free.append(i)
+            self._free_set.add(i)
+
+
+class PagePool:
+    """Device page pools for all layers + host page tables for all slots."""
+
+    def __init__(self, num_layers: int, num_pages: int, page_size: int,
+                 num_heads: int, head_dim: int, num_slots: int,
+                 pages_per_slot: int, dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_heads = num_heads
+        self.head_dim = head_dim
+        self.num_slots = num_slots
+        self.pages_per_slot = pages_per_slot
+        shape = (num_layers, num_pages, page_size, num_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = PageAllocator(num_pages)
+        # host copy of the per-slot page tables; rows of evicted slots
+        # are zeroed (null page) so stale ids can never be gathered
+        self.tables = np.zeros((num_slots, pages_per_slot), np.int32)
+        # pages held per slot, in position order (prefix of the table row)
+        self._held: List[List[int]] = [[] for _ in range(num_slots)]
+
+    @property
+    def slot_capacity(self) -> int:
+        return self.pages_per_slot * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    def slot_pages(self, slot: int) -> int:
+        return len(self._held[slot])
+
+    def grow_slot(self, slot: int, n_pages: int) -> bool:
+        """Extend ``slot`` by ``n_pages`` pages; False (untouched) when
+        the pool can't cover it."""
+        if n_pages <= 0:
+            return True
+        held = self._held[slot]
+        if len(held) + n_pages > self.pages_per_slot:
+            raise ValueError(
+                f"slot {slot} would exceed pages_per_slot="
+                f"{self.pages_per_slot}")
+        got = self.allocator.alloc(n_pages)
+        if got is None:
+            return False
+        self.tables[slot, len(held):len(held) + n_pages] = got
+        held.extend(got)
+        return True
+
+    def release_slot(self, slot: int) -> int:
+        """Return all of ``slot``'s pages to the pool; zero its table
+        row. Returns how many pages were freed."""
+        held = self._held[slot]
+        n = len(held)
+        if n:
+            self.allocator.free(held)
+        self._held[slot] = []
+        self.tables[slot, :] = NULL_PAGE
+        return n

@@ -46,6 +46,7 @@ _COMPILE_HEAVY_FILES = frozenset({
     "test_pipeline_schedules.py",  # GPipe + interleaved schedules
     "test_stream_layers.py",     # per-layer offload streaming programs
     "test_async_pipeline.py",    # elastic/runner async pipeline
+    "test_serving.py",           # serving engines: tick + bucket prefills
 })
 
 

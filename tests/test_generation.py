@@ -119,6 +119,45 @@ class TestSampling:
         assert out[0, 0] > D.NEG_INF / 2 and out[0, 1] > D.NEG_INF / 2
         assert out[0, 2] <= D.NEG_INF / 2 and out[0, 3] <= D.NEG_INF / 2
 
+    def test_top_k_out_of_range_is_noop(self):
+        """k >= vocab AND k <= 0 (the -1 'disabled' sentinel) filter
+        nothing (regression: negative k indexed sorted[v-k] from the
+        top, silently degenerating sampling to greedy)."""
+        logits = jnp.asarray(np.random.RandomState(0)
+                             .randn(2, 8).astype(np.float32))
+        for k in (8, 9, 1000, 0, -1, -5):
+            np.testing.assert_array_equal(
+                np.asarray(D.apply_top_k_top_p(logits, top_k=k)),
+                np.asarray(logits))
+
+    def test_top_p_zero_keeps_argmax_not_all_neg_inf(self):
+        """top_p <= p(argmax) (including 0.0) must keep the argmax token
+        — an all-NEG_INF row would make categorical sampling uniform-
+        random (regression: empty nucleus masked the whole row)."""
+        logits = jnp.asarray(np.array([[0.1, 2.0, -1.0, 0.5]],
+                                      np.float32))
+        for p in (0.0, 1e-9, 0.3):
+            out = np.asarray(D.apply_top_k_top_p(logits, top_p=p))
+            assert out[0, 1] > D.NEG_INF / 2        # argmax survives
+            assert (out[0, [0, 2, 3]] <= D.NEG_INF / 2).all()
+
+    def test_top_k_then_degenerate_top_p_compose(self):
+        logits = jnp.asarray(np.array([[0.1, 2.0, -1.0, 0.5]],
+                                      np.float32))
+        out = np.asarray(D.apply_top_k_top_p(logits, top_k=2, top_p=0.0))
+        assert out[0, 1] > D.NEG_INF / 2
+        assert (np.asarray(out)[0, [0, 2, 3]] <= D.NEG_INF / 2).all()
+
+    def test_sampling_decode_with_top_p_zero_is_greedy(self):
+        net = _net(seed=9)
+        toks = np.random.RandomState(9).randint(0, 128, (2, 5)) \
+            .astype(np.int32)
+        g, _ = net.generate(paddle.to_tensor(toks), max_new_tokens=4)
+        s, _ = net.generate(paddle.to_tensor(toks), max_new_tokens=4,
+                            decode_strategy="sampling", top_p=0.0,
+                            seed=3)
+        np.testing.assert_array_equal(g.numpy(), s.numpy())
+
 
 def np_beam_search(table_lp, first_lp, k, steps):
     """Numpy beam reference over a Markov logprob table: logprob of token
