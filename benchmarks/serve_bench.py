@@ -1,8 +1,8 @@
 """Serving throughput: continuous batching (paged KV) vs sequential
-per-request ``generate()``.
+per-request ``generate()``, plus the prefix-caching TTFT comparison.
 
-Drives a Poisson arrival trace of mixed-prompt-length requests against
-BOTH decode paths on the same weights:
+Default mode drives a Poisson arrival trace of mixed-prompt-length
+requests against BOTH decode paths on the same weights:
 
   baseline   each request served alone, in arrival order, by the dense
              ``GPT.generate`` prefill+scan program (per-shape jit, warm)
@@ -10,16 +10,24 @@ BOTH decode paths on the same weights:
              into cache slots as others finish, one fixed-shape decode
              tick advancing every resident request per dispatch
 
+``--prefix-cache`` switches to the shared-system-prompt workload:
+N concurrent requests sharing one system prompt with short unique
+suffixes, served by a prefix-cache-ON engine vs a prefix-cache-OFF
+engine (both with chunked prefill, both warm). Headline: mean-TTFT
+ratio — the cached engine aliases the shared prompt's pages and
+prefills only each request's suffix, so first tokens arrive without
+re-running the system prompt per request. The profiler block carries
+``serving/prefix_hit_tokens`` as the direct evidence.
+
 The baseline is exactly what a naive deployment of this repo would run
 today, warmed so the comparison is decode-vs-decode, not
-compile-vs-decode. Headline: tokens/sec ratio at the configured
-concurrency; extras report page-pool utilization, decode-batch
-occupancy, TTFT percentiles and the profiler's serving counters.
+compile-vs-decode.
 
 Prints ONE JSON line (driver contract, same shape as bench.py).
 
-    python benchmarks/serve_bench.py           # full: 8 slots, 24 reqs
-    python benchmarks/serve_bench.py --tiny    # CI smoke: 2 min budget
+    python benchmarks/serve_bench.py                 # Poisson, 8 slots
+    python benchmarks/serve_bench.py --prefix-cache  # shared-prefix TTFT
+    python benchmarks/serve_bench.py --tiny [...]    # CI smoke sizes
 """
 from __future__ import annotations
 
@@ -70,6 +78,15 @@ def make_trace(n_requests, prompt_lens, max_new, arrival_rate_hz, seed=7):
     return trace
 
 
+def make_shared_prefix_requests(n, sys_len, sfx_len, max_new, seed=7):
+    """n prompts = one shared system prompt + a unique suffix each."""
+    rng = np.random.RandomState(seed)
+    system = rng.randint(0, 128, (sys_len,)).astype(np.int32)
+    return [(np.concatenate(
+        [system, rng.randint(0, 128, (sfx_len,)).astype(np.int32)]),
+        int(max_new)) for _ in range(n)]
+
+
 def run_baseline(net, trace):
     """Sequential per-request dense generate over the arrival trace."""
     import paddle_tpu as paddle
@@ -92,12 +109,14 @@ def run_baseline(net, trace):
     return tokens, wall, ttfts
 
 
-def build_engine(net, num_slots, page_size, pages_per_slot, buckets):
+def build_engine(net, num_slots, page_size, pages_per_slot,
+                 prefill_chunk=0, prefix_cache=True):
     from paddle_tpu.serving import ServingConfig, ServingEngine
 
     return ServingEngine(net, ServingConfig(
         num_slots=num_slots, page_size=page_size,
-        pages_per_slot=pages_per_slot, prefill_buckets=buckets))
+        pages_per_slot=pages_per_slot, prefill_chunk=prefill_chunk,
+        prefix_cache=prefix_cache))
 
 
 def run_engine(eng, trace):
@@ -131,30 +150,29 @@ def run_engine(eng, trace):
     return tokens, wall, ttfts, batch_occupancy, page_utils
 
 
+def run_concurrent(eng, reqs):
+    """Submit every request up front, run to completion."""
+    eng.reset_results()
+    t_start = time.perf_counter()
+    for prompt, max_new in reqs:
+        eng.submit(prompt, max_new)
+    eng.run()
+    wall = time.perf_counter() - t_start
+    results = {rid: r for rid, r in eng._requests.items() if r.done}
+    tokens = sum(len(r.out) for r in results.values())
+    ttfts = [(r.first_token_t - r.submit_t) * 1000.0
+             for r in results.values() if r.first_token_t]
+    return tokens, wall, ttfts
+
+
 def pct(xs, p):
     return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--tiny", action="store_true",
-                    help="CI smoke sizes (~2 min)")
-    ap.add_argument("--slots", type=int, default=8)
-    ap.add_argument("--requests", type=int, default=24)
-    ap.add_argument("--max-new", type=int, default=48)
-    ap.add_argument("--rate", type=float, default=200.0,
-                    help="Poisson arrival rate (req/s)")
-    args = ap.parse_args()
-
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    import paddle_tpu as paddle  # noqa: F401
+def bench_poisson(args, tiny):
+    import paddle_tpu as paddle
     import paddle_tpu.profiler as profiler
-    from paddle_tpu.profiler import registry
 
-    tiny = args.tiny
     n_req = 6 if tiny else args.requests
     max_new = 16 if tiny else args.max_new
     slots = 4 if tiny else args.slots
@@ -162,20 +180,20 @@ def main():
     page_size = 8 if tiny else 16
     cap_tokens = max(prompt_lens) + max_new
     pages_per_slot = -(-cap_tokens // page_size)
-    buckets = tuple(sorted(set(prompt_lens)))
 
     net = build_model(tiny)
     trace = make_trace(n_req, prompt_lens, max_new, args.rate)
 
     # ---- warm both paths (compile excluded from the measurement: the
-    # engine instance is reused, so its tick + per-bucket prefill
-    # programs are traced here, not on the clock) ----
+    # engine instance is reused, so its tick + prefill-chunk programs
+    # are traced here, not on the clock) ----
     for t0 in prompt_lens:
         p = np.zeros((t0,), np.int32)
         net.generate(paddle.to_tensor(p[None]), max_new_tokens=max_new)
-    eng = build_engine(net, slots, page_size, pages_per_slot, buckets)
+    eng = build_engine(net, slots, page_size, pages_per_slot)
     warm = make_trace(max(2, slots), prompt_lens, max_new, 1e9, seed=1)
     run_engine(eng, [(0.0, p, m) for _, p, m in warm])
+    eng.pool.drop_prefix_cache()        # measured run starts cold
 
     profiler.enable()
     bl_tokens, bl_wall, bl_ttft = run_baseline(net, trace)
@@ -188,7 +206,7 @@ def main():
     snap = {k: v.get("value", v.get("count"))
             for k, v in summ["metrics"].items()
             if k.startswith("serving/")}
-    out = {
+    return {
         "metric": "serving_continuous_batching_speedup",
         "value": round(speedup, 4),
         "unit": "x tokens/s vs sequential generate()",
@@ -219,6 +237,121 @@ def main():
                      "compile excluded for both"),
         },
     }
+
+
+def bench_shared_prefix(args, tiny):
+    import paddle_tpu.profiler as profiler
+
+    slots = 4 if tiny else args.slots
+    n_req = slots                       # all concurrent
+    sys_len = 32 if tiny else 64
+    sfx_len = 8
+    max_new = 8 if tiny else 32
+    page_size = 8 if tiny else 16
+    cap_tokens = sys_len + sfx_len + max_new
+    pages_per_slot = -(-cap_tokens // page_size)
+    chunk = 2 * page_size
+
+    net = build_model(tiny)
+    reqs = make_shared_prefix_requests(n_req, sys_len, sfx_len, max_new)
+
+    def fresh(prefix_cache):
+        eng = build_engine(net, slots, page_size, pages_per_slot,
+                           prefill_chunk=chunk,
+                           prefix_cache=prefix_cache)
+        # warm every compiled program (tick, prefill chunk, COW copy)
+        # off the clock, then flush results + cached pages so the
+        # measured run starts cold
+        run_concurrent(eng, reqs)
+        eng.pool.k, eng.pool.v = eng._copy(
+            eng.pool.k, eng.pool.v, np.int32(0), np.int32(0))
+        eng.pool.drop_prefix_cache()
+        eng.reset_results()
+        return eng
+
+    eng_off = fresh(prefix_cache=False)
+    eng_on = fresh(prefix_cache=True)
+
+    # one profiler window PER engine (enable resets the registry), so
+    # the evidence block for the cache-on run is not diluted by the
+    # cache-off engine's counters
+    profiler.enable()
+    off_tokens, off_wall, off_ttft = run_concurrent(eng_off, reqs)
+    summ_off = profiler.disable()
+    profiler.enable()
+    on_tokens, on_wall, on_ttft = run_concurrent(eng_on, reqs)
+    summ = profiler.disable()
+
+    mean_off = float(np.mean(off_ttft))
+    mean_on = float(np.mean(on_ttft))
+    speedup = mean_off / mean_on if mean_on else 0.0
+
+    def _snap(s):
+        return {k: v.get("value", v.get("count"))
+                for k, v in s["metrics"].items()
+                if k.startswith(("serving/", "cache_share/"))}
+
+    snap = _snap(summ)
+    snap_off = _snap(summ_off)
+    return {
+        "metric": "serving_prefix_cache_ttft_speedup",
+        "value": round(speedup, 4),
+        "unit": "x lower mean TTFT vs prefix-cache-off engine",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "model": {"hidden": net.config.hidden_size,
+                      "layers": net.config.num_layers,
+                      "vocab": net.config.vocab_size},
+            "requests": n_req, "slots": slots,
+            "system_prompt_tokens": sys_len,
+            "suffix_tokens": sfx_len, "max_new": max_new,
+            "page_size": page_size, "pages_per_slot": pages_per_slot,
+            "prefill_chunk": chunk,
+            "ttft_ms": {
+                "cache_mean": round(mean_on, 2),
+                "cache_p50": round(pct(on_ttft, 50), 2),
+                "cache_p95": round(pct(on_ttft, 95), 2),
+                "nocache_mean": round(mean_off, 2),
+                "nocache_p50": round(pct(off_ttft, 50), 2),
+                "nocache_p95": round(pct(off_ttft, 95), 2)},
+            "cache_tokens_per_sec": round(on_tokens / on_wall, 2),
+            "nocache_tokens_per_sec": round(off_tokens / off_wall, 2),
+            "cache_tokens": on_tokens, "nocache_tokens": off_tokens,
+            "profiler": snap,             # cache-on engine only
+            "profiler_nocache": snap_off,
+            "note": ("N concurrent requests share one system prompt; "
+                     "the cache-on engine prefills it once and every "
+                     "later admission aliases those pages (refcounted) "
+                     "and prefills only its unique suffix — chunked "
+                     "prefill in both engines, both warm, greedy "
+                     "decode (outputs bitwise-equal across engines)"),
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="CI smoke sizes (~2 min)")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="shared-system-prompt workload: prefix-cache-on"
+                         " vs -off TTFT comparison")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=48)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="Poisson arrival rate (req/s)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if args.prefix_cache:
+        out = bench_shared_prefix(args, args.tiny)
+    else:
+        out = bench_poisson(args, args.tiny)
     print(json.dumps(out))
 
 

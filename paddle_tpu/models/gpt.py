@@ -432,7 +432,7 @@ class GPT(nn.Layer):
         if eng is None or eng._stacked is not self._decode_state()[0]:
             eng = ServingEngine(self, ServingConfig(
                 num_slots=b, page_size=ps, pages_per_slot=smax // ps,
-                prefill_buckets=(t0,), decode=strategy,
+                prefill_chunk=t0, decode=strategy,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_token_id=eos_token_id, seed=seed))
             engines[ekey] = eng
@@ -568,6 +568,72 @@ def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0,
     else:
         logits = last @ wte.T
     return logits, jnp.swapaxes(ckl, 0, 1), jnp.swapaxes(cvl, 0, 1)
+
+
+def gpt_paged_suffix_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
+                           tokens, pos0, true_len, page_row,
+                           logits_index):
+    """Suffix-prefill forward over the PAGED cache: process one prompt
+    chunk ``tokens`` [1, T] at positions pos0..pos0+T-1 of the slot
+    whose page-table row is ``page_row`` [NPs], writing each position's
+    KV into the slot's pages and attending over (aliased prefix pages +
+    earlier chunks + this chunk's causal prefix). This is the engine's
+    prefix-cache / chunked-prefill path: ``gpt_cached_apply`` always
+    recomputes from position 0 into a fresh scratch cache, while here
+    positions below ``pos0`` are READ from pages another request (or an
+    earlier chunk) already filled.
+
+    ``pos0``/``true_len``/``logits_index`` may be traced (one compiled
+    program serves every chunk of every prompt). Pad positions at or
+    beyond ``true_len`` write to the null page (0) — never into a page
+    a neighbour might alias. Returns (logits at chunk index
+    ``logits_index`` [1, V], kpool, vpool).
+
+    Bitwise contract: per-position results match the whole-prompt
+    prefill because every reduction keeps the same length — heads/hidden
+    contractions are row-independent and attention always reduces over
+    the full slot capacity with exact-zero masked weights (see
+    ``ops/paged_attention.paged_prefill_attention``).
+    """
+    from ..ops.paged_attention import paged_prefill_attention
+
+    n, t = tokens.shape
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    eps = cfg.layer_norm_eps
+    ps = kpool.shape[2]
+    nps = page_row.shape[0]
+    wte = other["embeddings.wte.weight"]
+    wpe = other["embeddings.wpe.weight"]
+    pos = pos0 + jnp.arange(t)
+    x = wte[tokens] + wpe[pos][None]
+    # write targets: real positions go to their slot page, pads to the
+    # null page (clip keeps the page-table index in range for pads past
+    # the slot capacity)
+    page = jnp.where(pos < true_len,
+                     page_row[jnp.minimum(pos // ps, nps - 1)], 0)
+    off = pos % ps
+
+    def block(xc, inp):
+        p, kpl0, vpl0 = inp
+
+        def attend(q, kk, vv):
+            kpl = kpl0.at[page, off].set(kk[0])
+            vpl = vpl0.at[page, off].set(vv[0])
+            o = paged_prefill_attention(q, kpl, vpl, page_row[None], pos0)
+            return o, (kpl, vpl)
+
+        return gpt_block_body(xc, p, eps, nh, hd, attend)
+
+    x, (kpool, vpool) = jax.lax.scan(block, x, (stacked, kpool, vpool))
+    x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
+    last = jax.lax.dynamic_index_in_dim(x, logits_index, axis=1,
+                                        keepdims=False)
+    if "lm_head.weight" in other:
+        logits = last @ other["lm_head.weight"]
+    else:
+        logits = last @ wte.T
+    return logits, kpool, vpool
 
 
 def _gpt_decode_state(model: "GPT"):

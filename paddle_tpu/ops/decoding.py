@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["greedy_decode", "sampling_decode", "beam_search_decode",
-           "apply_top_k_top_p"]
+           "apply_top_k_top_p", "apply_top_k_top_p_per_row"]
 
 NEG_INF = -1e9
 
@@ -93,6 +93,40 @@ def apply_top_k_top_p(logits, top_k: int = 0, top_p: float = 1.0):
         kth = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf), axis=-1)
         logits = jnp.where(logits < kth[..., None], NEG_INF, logits)
     return logits
+
+
+def apply_top_k_top_p_per_row(logits, top_k, top_p):
+    """Vectorized ``apply_top_k_top_p``: ``top_k`` int32 [N] and
+    ``top_p`` float32 [N] filter each row of ``logits`` [N, V]
+    independently — the serving engine's per-request sampling params
+    ride the ONE fixed-shape decode tick as plain array arguments (no
+    retrace per parameter combination).
+
+    Per-row disable semantics are EXACT no-ops, matching the scalar
+    path bitwise: ``top_k <= 0`` or ``>= V`` keeps the row untouched
+    (threshold -inf), and ``top_p >= 1.0`` likewise. The nucleus rule
+    always keeps the argmax token (an all-``NEG_INF`` row would make
+    categorical sampling uniform)."""
+    v = logits.shape[-1]
+    tk = jnp.asarray(top_k)
+    tp = jnp.asarray(top_p)
+    # top-k: threshold at the k-th largest where enabled
+    sorted_d = jnp.sort(logits, axis=-1)[..., ::-1]       # descending
+    k_eff = jnp.clip(tk, 1, v)
+    kth = jnp.take_along_axis(sorted_d, (k_eff - 1)[..., None],
+                              axis=-1)[..., 0]
+    thr_k = jnp.where((tk > 0) & (tk < v), kth, -jnp.inf)
+    logits = jnp.where(logits < thr_k[..., None], NEG_INF, logits)
+    # top-p over the (top-k-filtered) rows, same keep-rule as the
+    # scalar path: smallest prefix reaching p, argmax always kept
+    sorted_f = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < tp[..., None]
+    keep_sorted = keep_sorted.at[..., 0].set(True)
+    kth_p = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1)
+    thr_p = jnp.where(tp < 1.0, kth_p, -jnp.inf)
+    return jnp.where(logits < thr_p[..., None], NEG_INF, logits)
 
 
 def sampling_decode(step_fn: Callable, cache: Any, first_logits, start_pos,

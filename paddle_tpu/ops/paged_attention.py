@@ -6,7 +6,9 @@ Serving keeps the KV cache as a fixed pool of fixed-size pages
 pages its sequence actually fills, so HBM scales with live tokens, not
 with ``S_max × slots``. This module is the attention read side of that
 layout — one decode step (query length 1 per slot) attending to every
-cached position of its own pages ("Ragged Paged Attention", PAPERS.md).
+cached position of its own pages ("Ragged Paged Attention", PAPERS.md) —
+plus the chunked-prefill read (``paged_prefill_attention``): a T-query
+prompt chunk attending over its slot's aliased-prefix pages and itself.
 
 Two implementations behind one entry point, following the
 ``ops/int8_matmul.py`` precedent (kernel built and gated; the XLA
@@ -44,7 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._pallas_compat import CompilerParams as _CompilerParams
 
-__all__ = ["paged_decode_attention"]
+__all__ = ["paged_decode_attention", "paged_prefill_attention"]
 
 _NEG_INF = -1e9     # same masking constant as gpt_cached_apply
 
@@ -92,6 +94,41 @@ def _paged_attention_xla(q, k_pool, v_pool, page_table, attend_pos):
     key_pos = jnp.arange(s_cap)
     mask = key_pos[None, None, None, :] <= \
         attend_pos[:, None, None, None]
+    att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
+    att = jnp.where(mask, att, _NEG_INF)
+    w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bnts,bsnd->btnd", w, v_c)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, page_table, pos0):
+    """Suffix-prefill (chunked) attention over paged KV.
+
+    q           [B, T, NH, D]  one prompt chunk's queries, occupying
+                               positions pos0..pos0+T-1
+    k_pool      [P, ps, NH, D] per-layer key page pool — the chunk's own
+                               KV must already be scattered in
+    v_pool      [P, ps, NH, D] per-layer value page pool
+    page_table  [B, NPs] int32 page ids per slot (0 = null page)
+    pos0        int32 scalar   chunk start position (shared by the batch)
+
+    Query i attends to cache positions <= pos0 + i, so the chunk sees
+    (aliased prefix pages + earlier chunks + its own causal prefix).
+    Same gather + einsum + mask + f32-softmax spelling as the decode
+    path (and hence as ``gpt_cached_apply``): per-query reduction
+    length is always the full slot capacity, which is what keeps
+    chunked prefill bitwise-equal to whole-prompt prefill — masked
+    positions contribute exactly-zero weights regardless of the dirty
+    page contents behind them. Returns [B, T, NH, D].
+    """
+    b, t = q.shape[0], q.shape[1]
+    nps, ps = page_table.shape[1], k_pool.shape[1]
+    nh, hd = k_pool.shape[2], k_pool.shape[3]
+    s_cap = nps * ps
+    k_c = k_pool[page_table].reshape(b, s_cap, nh, hd)
+    v_c = v_pool[page_table].reshape(b, s_cap, nh, hd)
+    key_pos = jnp.arange(s_cap)
+    mask = key_pos[None, None, None, :] <= \
+        (pos0 + jnp.arange(t))[None, None, :, None]
     att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
     att = jnp.where(mask, att, _NEG_INF)
     w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)

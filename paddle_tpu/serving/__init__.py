@@ -1,16 +1,21 @@
 """paddle_tpu.serving — continuous-batching decode runtime on a paged
-KV cache.
+KV cache with prefix sharing.
 
 The serving-side answer to the ROADMAP's "heavy traffic from millions
 of users": instead of one dense-cache ``generate()`` program per
 request batch, a fixed pool of KV **pages** (``paged_cache.py``) plus a
 fixed-shape jitted **decode tick** over cache slots (``engine.py``)
 lets requests join and leave mid-decode — admission fills slots as
-evictions free them, pages return to the pool the moment a request
-finishes, and the host overlaps scheduling with device execution via
-the PR-3 deferred-sync idiom. Attention over the paged layout lives in
-``ops/paged_attention.py`` (XLA gather reference + gated Pallas
-kernel).
+evictions free them, pages return to the pool the moment their LAST
+holder lets go (the allocator refcounts pages), and the host overlaps
+scheduling with device execution via the PR-3 deferred-sync idiom.
+Prompt prefixes are **shared**: fully-written prompt pages live in a
+hash-trie index (``PrefixCache``) and admission aliases the longest
+cached page-aligned prefix instead of recomputing it; prefill of the
+remaining suffix is **chunked** (Sarathi-style — bounded work per
+scheduler step, one compiled chunk shape). Attention over the paged
+layout lives in ``ops/paged_attention.py`` (XLA gather reference for
+decode AND chunked prefill + gated Pallas kernel).
 
 Quick use::
 
@@ -28,16 +33,20 @@ Profiler integration (``paddle_tpu.profiler``): gauges
 ``serving/queue_depth``, ``serving/active_slots``,
 ``serving/page_util``, ``serving/tokens_per_sec``,
 ``serving/decode_batch``; counters ``serving/tokens_generated``,
-``serving/prefills``, ``serving/ticks``, ``serving/preemptions``,
-``serving/requests_finished``, ``serving/token_syncs``; histogram
-``serving/ttft_ms``. Prefill length-bucket retraces are visible at the
-``serving.prefill#N`` site in ``profiler.recompile`` telemetry; the
-decode tick site must stay at ONE trace.
+``serving/prefills``, ``serving/prefill_chunks``, ``serving/ticks``,
+``serving/preemptions``, ``serving/requests_finished``,
+``serving/token_syncs``, ``serving/prefix_lookups``,
+``serving/prefix_hit_tokens``, ``cache_share/*`` (refcount traffic:
+shares, releases, cow_copies, prefix_evictions); histograms
+``serving/ttft_ms``, ``serving/prefill_queue_wait_ms``. Both compiled
+sites (``serving.tick#N``, ``serving.prefill#N``) must stay at ONE
+trace each — the chunked prefill has a single shape by construction.
 """
 from __future__ import annotations
 
 from .engine import Request, ServingConfig, ServingEngine  # noqa: F401
-from .paged_cache import NULL_PAGE, PageAllocator, PagePool  # noqa: F401
+from .paged_cache import (NULL_PAGE, PageAllocator, PagePool,  # noqa: F401
+                          PrefixCache)
 
 __all__ = ["ServingEngine", "ServingConfig", "Request",
-           "PagePool", "PageAllocator", "NULL_PAGE"]
+           "PagePool", "PageAllocator", "PrefixCache", "NULL_PAGE"]

@@ -12,39 +12,60 @@ fill:
   cache slots advances every resident request by one token per call.
   The program shape never depends on which slots are live, so it
   traces exactly once (asserted via ``profiler.recompile`` telemetry).
-- **Continuous admission / eviction.** Requests are admitted into free
-  slots as others finish; EOS and max-token eviction return pages to
-  the pool mid-flight. Prefill runs in a small set of length buckets
-  (bounded, visible retraces), writing KV straight into the slot's
-  pages.
+  Per-request sampling params (temperature / top-k / top-p) ride the
+  tick as ``[num_slots]`` arrays — vectorized inside the compiled
+  program, no retrace per parameter combination.
+- **Chunked prefill** (Sarathi-style). A prompt is prefilled in
+  fixed-size chunks, at most ``prefill_chunks_per_tick`` per scheduler
+  step, each attending over (aliased prefix pages + earlier chunks +
+  itself) via the suffix path ``models/gpt.gpt_paged_suffix_apply``.
+  A long prompt therefore never blocks resident decode slots for more
+  than one chunk's compute, and prefill compiles ONE chunk shape
+  (retraces collapse to a single ``serving.prefill`` trace) instead of
+  one program per length bucket.
+- **Prefix caching.** Fully-written prompt pages are registered in a
+  hash-trie index (``paged_cache.PrefixCache``) keyed on page-aligned
+  token chunks. Admission looks up the longest cached prefix, aliases
+  those pages into the slot's table (refcounted — a page frees only
+  when its last holder lets go), and prefills only the suffix; a
+  prompt diverging from a cached chunk mid-page copy-on-writes that
+  one tail page. Unreferenced cached pages are evicted LRU under pool
+  pressure. Preemption inserts the victim's own fully-written pages
+  before releasing the slot, so the requeued request re-aliases its
+  own work instead of re-prefilling it.
 - **Deferred host sync** (the PR-3 async-pipeline idiom): each tick's
   token vector stays an unmaterialized device array; the host
-  dispatches tick N+1 (and prefills, via donated pool buffers) before
-  materializing tick N, keeping up to ``max_inflight`` ticks in
+  dispatches tick N+1 (and prefill chunks, via donated pool buffers)
+  before materializing tick N, keeping up to ``max_inflight`` ticks in
   flight. Scheduling that must be host-deterministic (positions, page
   growth, max-token stops) never reads device data; only EOS discovery
   rides the lagged window.
-- **Exhaustion → preemption.** If the pool cannot grow a slot, the
-  engine drains, retries, then preempts the youngest request: its
-  generated prefix is requeued as a longer prompt. Re-prefill is
-  bitwise-equivalent to having continued (prefill and decode share the
-  same compiled math), and sampling keys are folded per absolute
-  position, so a preempted request's tokens do not depend on
-  scheduling.
+- **Exhaustion → eviction → preemption.** If the pool cannot grow a
+  slot, the engine evicts unreferenced cached pages, drains, retries,
+  then preempts the youngest request: its generated prefix is requeued
+  as a longer prompt (and its pages stay cached, so re-prefill is a
+  prefix hit). Sampling keys are folded per absolute position, so a
+  preempted request's tokens do not depend on scheduling.
 
 Greedy paged decode is **bitwise identical** to the dense
 ``generate()`` on the same weights whenever the slot capacity
 ``pages_per_slot * page_size`` equals the dense path's
 ``prompt + max_new_tokens`` (the attention reduction length must match
-exactly — zero-tail padding is not bitwise-neutral). The
-``GPT.generate(paged=True)`` wrapper picks a divisor page size so this
-holds by construction; tests/test_serving.py pins it.
+exactly — zero-tail padding is not bitwise-neutral). Prefix caching
+preserves this bitwise: aliased pages hold KV that is identical by
+construction (same tokens, same positions, same reduction lengths), so
+the cached engine, the uncached engine and the dense path all agree —
+tests/test_serving.py pins cached-vs-uncached across admission orders.
 
 Profiler signals: ``serving/queue_depth``, ``serving/active_slots``,
 ``serving/page_util``, ``serving/ttft_ms`` (histogram),
-``serving/tokens_per_sec``, ``serving/tokens_generated``,
-``serving/prefills``, ``serving/ticks``, ``serving/preemptions``,
-``serving/requests_finished``, ``serving/token_syncs``.
+``serving/prefill_queue_wait_ms`` (histogram: submit → first prefill
+chunk), ``serving/tokens_per_sec``, ``serving/tokens_generated``,
+``serving/prefills``, ``serving/prefill_chunks``, ``serving/ticks``,
+``serving/preemptions``, ``serving/requests_finished``,
+``serving/token_syncs``, ``serving/prefix_lookups``,
+``serving/prefix_hit_tokens``; refcount traffic under ``cache_share/*``
+(shares, releases, cow_copies, prefix_evictions).
 """
 from __future__ import annotations
 
@@ -53,7 +74,7 @@ import warnings
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -88,17 +109,22 @@ class ServingConfig:
     requests of at most ``pages_per_slot`` pages
     (``slot_capacity = pages_per_slot * page_size`` tokens). Sizing
     ``num_pages - 1 < num_slots * pages_per_slot`` oversubscribes the
-    pool — legal, served by preemption when it binds."""
+    pool — legal, served by prefix-cache eviction then preemption when
+    it binds. With ``prefix_cache`` on, shared prompt pages are charged
+    ONCE regardless of how many slots alias them, so effective
+    capacity grows with prompt overlap."""
 
     num_slots: int = 8
     page_size: int = 16
     pages_per_slot: int = 0          # default: ceil(max_seq_len / page_size)
     num_pages: int = 0               # default: full residency + null page
-    prefill_buckets: Tuple[int, ...] = ()   # default: pow2 ladder to capacity
-    max_inflight: int = 2            # unmaterialized decode ticks kept in flight
+    prefill_chunk: int = 0           # tokens per prefill chunk (0: 2 pages)
+    prefill_chunks_per_tick: int = 1  # prefill work budget per step
+    prefix_cache: bool = True        # share prompt-prefix pages
+    max_inflight: int = 2            # unmaterialized decode ticks in flight
     decode: str = "greedy"           # 'greedy' | 'sampling'
-    temperature: float = 1.0
-    top_k: int = 0
+    temperature: float = 1.0         # sampling defaults; per-request
+    top_k: int = 0                   #   overrides ride submit()
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
@@ -116,6 +142,9 @@ class Request:
     submit_t: float = 0.0
     first_token_t: Optional[float] = None
     orig_prompt_len: int = 0         # for result accounting across preemption
+    temperature: Optional[float] = None   # per-request sampling overrides
+    top_k: Optional[int] = None           #   (None -> engine config default)
+    top_p: Optional[float] = None
 
 
 class _Inflight:
@@ -124,6 +153,13 @@ class _Inflight:
     def __init__(self, tok, meta):
         self.tok = tok               # device int32 array
         self.meta = meta             # [(index_into_tok, slot, rid)]
+
+
+def _copy_pages(kpool, vpool, src, dst):
+    """Copy-on-write: duplicate page ``src`` into ``dst`` across all
+    layers (one compiled program, pools donated)."""
+    return (kpool.at[:, dst].set(kpool[:, src]),
+            vpool.at[:, dst].set(vpool[:, src]))
 
 
 class ServingEngine:
@@ -141,6 +177,8 @@ class ServingEngine:
         mcfg = model.config
         if cfg.decode not in ("greedy", "sampling"):
             raise ValueError(f"unknown decode mode {cfg.decode!r}")
+        if cfg.prefill_chunks_per_tick < 1:
+            raise ValueError("prefill_chunks_per_tick must be >= 1")
         self.config = cfg
         self.model_config = mcfg
         self._stacked, self._other = model._decode_state()
@@ -152,25 +190,19 @@ class ServingEngine:
         num_pages = cfg.num_pages or cfg.num_slots * pages_per_slot + 1
         self.pool = PagePool(mcfg.num_layers, num_pages, ps, nh, hd,
                              cfg.num_slots, pages_per_slot,
-                             dtype=self._dtype)
-        cap = self.pool.slot_capacity
-        if cfg.prefill_buckets:
-            buckets = sorted(set(int(b) for b in cfg.prefill_buckets))
-        else:
-            buckets, b = [], ps
-            while b < cap:
-                buckets.append(b)
-                b *= 2
-            buckets.append(cap)
-        if buckets[-1] < cap:
-            buckets.append(cap)
-        self.prefill_buckets = buckets
+                             dtype=self._dtype,
+                             prefix_cache=cfg.prefix_cache)
+        self.prefill_chunk = int(cfg.prefill_chunk) or 2 * ps
+        if self.prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         b_slots = cfg.num_slots
         # host scheduling state (never reads device data)
         self._slot_rid: List[Optional[int]] = [None] * b_slots
         self._slot_len = np.zeros(b_slots, np.int32)      # tokens in cache
+        self._slot_prompt = np.zeros(b_slots, np.int32)   # current prompt len
         self._slot_dispatched = np.zeros(b_slots, np.int64)  # tokens emitted
         self._slot_admit_seq = np.zeros(b_slots, np.int64)
+        self._slot_looked_up = [False] * b_slots
         self._admit_seq = 0
         self._queue: deque[Request] = deque()
         self._requests: Dict[int, Request] = {}
@@ -180,20 +212,32 @@ class ServingEngine:
         # device state
         self._last_tok = jnp.zeros((b_slots,), jnp.int32)
         self._keys = np.zeros((b_slots, 2), np.uint32)
+        # per-slot sampling params (fixed-shape tick arguments)
+        self._temps = np.full(b_slots, cfg.temperature, np.float32)
+        self._topks = np.full(b_slots, cfg.top_k, np.int32)
+        self._topps = np.full(b_slots, cfg.top_p, np.float32)
         self._base_key = np.asarray(jax.random.PRNGKey(cfg.seed))
-        # compiled programs: ONE tick site (asserted single-trace) and one
-        # prefill site shared by all buckets (retraces == extra buckets)
+        # compiled programs: ONE tick site (asserted single-trace) and ONE
+        # prefill-chunk site — chunked prefill has a single shape, so it
+        # also traces exactly once (the per-bucket retraces are gone)
         self._tick_site = _recompile.unique_site("serving.tick")
         self._prefill_site = _recompile.unique_site("serving.prefill")
         self._tick = jax.jit(self._make_tick(), donate_argnums=(2, 3))
-        self._prefills: Dict[int, object] = {}
+        self._prefill = jax.jit(self._make_prefill_chunk(),
+                                donate_argnums=(2, 3))
+        self._copy = jax.jit(_copy_pages, donate_argnums=(0, 1))
 
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int,
-               key: Optional[np.ndarray] = None) -> int:
-        """Queue one request. Returns its request id."""
+               key: Optional[np.ndarray] = None,
+               temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> int:
+        """Queue one request. ``temperature``/``top_k``/``top_p``
+        override the engine-global sampling params for this request
+        only (ignored under greedy decode). Returns its request id."""
         p = np.asarray(prompt_ids, np.int32).reshape(-1)
         t0 = p.shape[0]
         cap = self.pool.slot_capacity
@@ -214,17 +258,21 @@ class ServingEngine:
             key = np.asarray(jax.random.fold_in(self._base_key, rid))
         req = Request(rid=rid, prompt=p, max_new=int(max_new_tokens),
                       key=np.asarray(key, np.uint32),
-                      submit_t=time.perf_counter(), orig_prompt_len=t0)
+                      submit_t=time.perf_counter(), orig_prompt_len=t0,
+                      temperature=temperature, top_k=top_k, top_p=top_p)
         self._requests[rid] = req
         self._queue.append(req)
         return rid
 
     def step(self) -> bool:
         """One scheduler iteration: bound the in-flight window, admit
-        into free slots, grow pages (preempting on exhaustion), dispatch
-        one decode tick. Returns whether any device work was dispatched."""
+        into free slots, advance prefill by up to
+        ``prefill_chunks_per_tick`` chunks, grow pages (preempting on
+        exhaustion), dispatch one decode tick. Returns whether any
+        device work was dispatched."""
         self._drain(self.config.max_inflight)
-        dispatched = self._admit()
+        self._admit()
+        dispatched = self._prefill_chunks()
         self._grow_pages()
         dispatched = self._dispatch_tick() or dispatched
         reg = _registry()
@@ -308,78 +356,204 @@ class ServingEngine:
                         len(req.out) >= req.max_new:
                     self._finish(slot, rid)
 
+    def _insert_prefix(self, slot: int, tokens: np.ndarray,
+                       written: int) -> None:
+        """Register ``slot``'s fully-written pages (KV for
+        ``tokens[:written]``) in the prefix index."""
+        if self.pool.prefix is None:
+            return
+        n_full = min(written, tokens.shape[0]) // self.pool.page_size
+        if n_full:
+            self.pool.prefix.insert(
+                tokens[:n_full * self.pool.page_size],
+                [int(p) for p in self.pool.tables[slot, :n_full]])
+
     def _finish(self, slot: int, rid: int) -> None:
         req = self._requests[rid]
         req.done = True
+        if self._slot_rid[slot] == rid:
+            # cache the finished sequence's pages (prompt AND generated
+            # full pages) before release: an identical follow-up
+            # conversation prefix becomes a prefix hit
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.out, np.int32)])
+            self._insert_prefix(slot, seq, int(self._slot_len[slot]))
+            self.pool.release_slot(slot)
+            self._slot_rid[slot] = None
+            self._slot_len[slot] = 0
         # fold the preemption-era prefix back into the result
         extra = req.prompt[req.orig_prompt_len:]
         if extra.size:
             req.out = list(extra) + req.out
-        if self._slot_rid[slot] == rid:
-            self.pool.release_slot(slot)
-            self._slot_rid[slot] = None
-            self._slot_len[slot] = 0
         _registry().counter("serving/requests_finished").add(1)
 
-    def _admit(self) -> bool:
-        any_dispatch = False
+    def _admit(self) -> None:
+        """Move queued requests into free slots. Page allocation is
+        deferred to the per-chunk prefill path (so the prefix lookup
+        runs as late as possible — an identical prompt admitted a few
+        ticks later sees the first tenant's pages already cached)."""
         free = [s for s, r in enumerate(self._slot_rid) if r is None]
         while self._queue and free:
-            req = self._queue[0]
-            t0 = req.prompt.shape[0]
-            slot = free[-1]
-            if not self.pool.grow_slot(slot, self.pool.pages_for(t0)):
-                break               # pool exhausted; wait for evictions
-            self._queue.popleft()
-            free.pop()
+            req = self._queue.popleft()
+            slot = free.pop()
             self._slot_rid[slot] = req.rid
-            self._slot_len[slot] = t0
-            self._slot_dispatched[slot] = 1
+            self._slot_len[slot] = 0
+            self._slot_prompt[slot] = req.prompt.shape[0]
+            self._slot_dispatched[slot] = 0
+            self._slot_looked_up[slot] = False
             self._admit_seq += 1
             self._slot_admit_seq[slot] = self._admit_seq
-            self._dispatch_prefill(slot, req)
+            self._keys[slot] = req.key
+            c = self.config
+            self._temps[slot] = (c.temperature if req.temperature is None
+                                 else req.temperature)
+            self._topks[slot] = c.top_k if req.top_k is None else req.top_k
+            self._topps[slot] = c.top_p if req.top_p is None else req.top_p
+
+    # ------------------------------------------------------------------
+    # chunked prefill + prefix cache
+    # ------------------------------------------------------------------
+    def _prefill_chunks(self) -> bool:
+        """Advance prefilling slots by up to ``prefill_chunks_per_tick``
+        chunks, oldest admission first (completing one request's
+        prefill start-to-finish both minimizes its TTFT and publishes
+        its pages before the next identical prompt looks them up)."""
+        any_dispatch = False
+        for _ in range(self.config.prefill_chunks_per_tick):
+            pending = [s for s, rid in enumerate(self._slot_rid)
+                       if rid is not None
+                       and self._slot_len[s] < self._slot_prompt[s]]
+            if not pending:
+                break
+            s = min(pending, key=lambda x: self._slot_admit_seq[x])
+            if not self._advance_prefill(s):
+                break
             any_dispatch = True
         return any_dispatch
 
-    def _bucket_for(self, t0: int) -> int:
-        for b in self.prefill_buckets:
-            if b >= t0:
-                return b
-        raise ValueError(f"prompt length {t0} exceeds largest prefill "
-                         f"bucket {self.prefill_buckets[-1]}")
+    def _lookup_prefix(self, slot: int, req: Request) -> None:
+        """Alias the longest cached page-aligned prefix of the prompt
+        into ``slot`` (plus one copy-on-write page when the prompt
+        diverges from a cached chunk mid-page) and start prefill at the
+        first uncached position."""
+        if self.pool.prefix is None:
+            return
+        full_pages, partial = self.pool.prefix.lookup(req.prompt)
+        _registry().counter("serving/prefix_lookups").add(1)
+        hit = 0
+        if full_pages:
+            self.pool.share_into_slot(slot, full_pages)
+            hit = len(full_pages) * self.pool.page_size
+        if partial is not None:
+            src, lcp = partial
+            # pin the donor page: the grow below may evict unreferenced
+            # cached pages — src must not be reclaimed (or handed back
+            # as the destination) mid-copy
+            self.pool.allocator.share([src])
+            try:
+                if self.pool.grow_slot(slot, 1):
+                    dst = self.pool.tables[slot,
+                                           self.pool.slot_pages(slot) - 1]
+                    with _quiet_donation():
+                        self.pool.k, self.pool.v = self._copy(
+                            self.pool.k, self.pool.v,
+                            np.int32(src), np.int32(dst))
+                    hit += lcp
+                    _registry().counter("cache_share/cow_copies").add(1)
+            finally:
+                self.pool.allocator.free([src])
+        self._slot_len[slot] = hit
+        if hit:
+            _registry().counter("serving/prefix_hit_tokens").add(hit)
 
-    def _dispatch_prefill(self, slot: int, req: Request) -> None:
-        t0 = req.prompt.shape[0]
-        bucket = self._bucket_for(t0)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :t0] = req.prompt
-        fn = self._prefills.get(bucket)
-        if fn is None:
-            fn = self._prefills[bucket] = jax.jit(
-                self._make_prefill(bucket), donate_argnums=(2, 3))
-        page_ids = np.ascontiguousarray(self.pool.tables[slot])
+    def _advance_prefill(self, s: int) -> bool:
+        """Dispatch one prefill chunk for slot ``s`` (running the prefix
+        lookup first if this is the slot's first chunk). Returns whether
+        a chunk was dispatched; raises when the pool cannot cover the
+        chunk even after draining, prefix eviction and preemption."""
+        req = self._requests[self._slot_rid[s]]
+        if not self._slot_looked_up[s]:
+            self._slot_looked_up[s] = True
+            _registry().histogram("serving/prefill_queue_wait_ms").observe(
+                (time.perf_counter() - req.submit_t) * 1000.0)
+            self._lookup_prefix(s, req)
+        t0 = int(self._slot_prompt[s])
+        start = int(self._slot_len[s])
+        end = min(start + self.prefill_chunk, t0)
+        need = self.pool.pages_for(end) - self.pool.slot_pages(s)
+        if not self._acquire_pages(s, need):
+            return False             # finished in the drain / requeued
+        self._dispatch_prefill_chunk(s, req, start, end, t0)
+        return True
+
+    def _acquire_pages(self, s: int, need: int) -> bool:
+        """Grow slot ``s`` by ``need`` pages, escalating: free list
+        (+ prefix-cache LRU eviction inside ``grow_slot``) -> drain
+        in-flight finishes -> preempt youngest-first. The ONE
+        exhaustion-recovery path, shared by prefill chunks and decode
+        growth. Returns False when ``s`` itself was freed along the way
+        (finished in the drain, or became its own preemption victim);
+        raises only in the can't-happen state where the pool cannot
+        cover a request ``submit()`` already validated against it."""
+        if need <= 0 or self.pool.grow_slot(s, need):
+            return True
+        self._drain(0)
+        if self._slot_rid[s] is None:
+            return False
+        if self.pool.grow_slot(s, need):
+            return True
+        if not any(x != s and self._slot_rid[x] is not None
+                   for x in range(self.config.num_slots)):
+            raise RuntimeError(
+                "serving pool exhausted: cannot cover a resident "
+                "request even with the prefix cache drained and no "
+                "co-resident to preempt")
+        self._preempt_for(s, need)
+        return self._slot_rid[s] is not None
+
+    def _dispatch_prefill_chunk(self, s: int, req: Request, start: int,
+                                end: int, t0: int) -> None:
+        chunk = self.prefill_chunk
+        toks = np.zeros((1, chunk), np.int32)
+        toks[0, :end - start] = req.prompt[start:end]
+        page_row = np.ascontiguousarray(self.pool.tables[s])
         with _quiet_donation():
-            self.pool.k, self.pool.v, tok0 = fn(
+            self.pool.k, self.pool.v, tok0 = self._prefill(
                 self._stacked, self._other, self.pool.k, self.pool.v,
-                toks, np.int32(t0), page_ids, req.key)
-        self._last_tok = self._last_tok.at[slot].set(tok0[0])
-        self._keys[slot] = req.key
-        self._inflight.append(_Inflight(tok0, [(0, slot, req.rid)]))
-        self.max_inflight_seen = max(self.max_inflight_seen,
-                                     len(self._inflight))
-        _registry().counter("serving/prefills").add(1)
+                toks, np.int32(start), np.int32(t0), page_row, req.key,
+                self._temps[s:s + 1], self._topks[s:s + 1],
+                self._topps[s:s + 1])
+        _registry().counter("serving/prefill_chunks").add(1)
+        if end >= t0:                # final chunk: tok0 is real
+            self._last_tok = self._last_tok.at[s].set(tok0[0])
+            self._inflight.append(_Inflight(tok0, [(0, s, req.rid)]))
+            self.max_inflight_seen = max(self.max_inflight_seen,
+                                         len(self._inflight))
+            self._slot_dispatched[s] = 1
+            self._slot_len[s] = t0
+            _registry().counter("serving/prefills").add(1)
+        else:
+            self._slot_len[s] = end
+        # publish the pages this chunk completed (progressively: a long
+        # shared prompt becomes hittable page-by-page, mid-prefill)
+        self._insert_prefix(s, req.prompt, int(self._slot_len[s]))
 
+    # ------------------------------------------------------------------
+    # decode scheduling
+    # ------------------------------------------------------------------
     def _ticking_slots(self) -> List[int]:
-        """Slots that should advance this tick: resident, not finished,
-        and with emissions still owed. A slot whose final token is
-        already dispatched stops ticking immediately (max-token stop is
-        host-deterministic); EOS stops lag by <= max_inflight ticks."""
+        """Slots that should advance this tick: resident, prefill
+        complete, not finished, and with emissions still owed. A slot
+        whose final token is already dispatched stops ticking
+        immediately (max-token stop is host-deterministic); EOS stops
+        lag by <= max_inflight ticks."""
         out = []
         for s, rid in enumerate(self._slot_rid):
             if rid is None:
                 continue
             req = self._requests[rid]
-            if not req.done and self._slot_dispatched[s] < req.max_new:
+            if not req.done and \
+                    1 <= self._slot_dispatched[s] < req.max_new:
                 out.append(s)
         return out
 
@@ -390,37 +564,32 @@ class ServingEngine:
             need_page = int(self._slot_len[s]) // self.pool.page_size
             if need_page < self.pool.slot_pages(s):
                 continue
-            if self.pool.grow_slot(s, 1):
-                continue
-            # exhaustion: learn about in-flight finishes, then retry
-            self._drain(0)
-            if self._slot_rid[s] is None:
-                continue            # this very slot finished in the drain
-            if self.pool.grow_slot(s, 1):
-                continue
-            self._preempt_for(s)
+            self._acquire_pages(s, 1)
 
-    def _preempt_for(self, needy_slot: int) -> None:
-        """Free pages by requeueing the youngest resident request (its
-        generated prefix becomes prompt, so no work is redone twice)."""
+    def _preempt_for(self, needy_slot: int, need: int) -> None:
+        """Free ``need`` pages by requeueing the youngest resident
+        request (its generated prefix becomes prompt, so no work is
+        redone twice — and its fully-written pages go into the prefix
+        index first, so the re-prefill is a prefix hit)."""
         live = [s for s in range(self.config.num_slots)
                 if self._slot_rid[s] is not None]
         victim = max(live, key=lambda s: self._slot_admit_seq[s])
         rid = self._slot_rid[victim]
         req = self._requests[rid]
-        # window was drained in _grow_pages, so req.out is current
+        # window was drained before preemption, so req.out is current
         req.prompt = np.concatenate(
             [req.prompt, np.asarray(req.out, np.int32)])
         req.max_new -= len(req.out)
         req.out = []
+        self._insert_prefix(victim, req.prompt, int(self._slot_len[victim]))
         self._queue.appendleft(req)
         self.pool.release_slot(victim)
         self._slot_rid[victim] = None
         self._slot_len[victim] = 0
         _registry().counter("serving/preemptions").add(1)
         if victim != needy_slot and self._slot_rid[needy_slot] is not None:
-            if not self.pool.grow_slot(needy_slot, 1):
-                self._preempt_for(needy_slot)
+            if not self.pool.grow_slot(needy_slot, need):
+                self._preempt_for(needy_slot, need)
 
     def _dispatch_tick(self) -> bool:
         ticking = self._ticking_slots()
@@ -432,7 +601,10 @@ class ServingEngine:
         with _quiet_donation():
             self.pool.k, self.pool.v, tok = self._tick(
                 self._stacked, self._other, self.pool.k, self.pool.v,
-                tab, pos, self._last_tok, keys)
+                tab, pos, self._last_tok, keys,
+                np.ascontiguousarray(self._temps),
+                np.ascontiguousarray(self._topks),
+                np.ascontiguousarray(self._topps))
         self._last_tok = tok
         meta = [(s, s, self._slot_rid[s]) for s in ticking]
         self._inflight.append(_Inflight(tok, meta))
@@ -448,20 +620,21 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
-    def _sample_tok(self, logits, keys, positions):
+    def _sample_tok(self, logits, keys, positions, temps, top_ks, top_ps):
         """Token choice from last-token logits [N, V]. Greedy mirrors
         ops/decoding.greedy_decode (argmax of f32 log_softmax — parity);
-        sampling folds each slot's key by the ABSOLUTE position of the
-        emitted token, so a request's stream is independent of
-        scheduling/preemption."""
-        c = self.config
-        if c.decode == "greedy":
+        sampling applies the PER-ROW temperature/top-k/top-p arrays and
+        folds each slot's key by the ABSOLUTE position of the emitted
+        token, so a request's stream is independent of scheduling,
+        preemption, and its neighbours' sampling params."""
+        if self.config.decode == "greedy":
             lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
             return jnp.argmax(lp, axis=-1).astype(jnp.int32)
-        from ..ops.decoding import apply_top_k_top_p
+        from ..ops.decoding import apply_top_k_top_p_per_row
 
-        lg = logits.astype(jnp.float32) / jnp.maximum(c.temperature, 1e-6)
-        lg = apply_top_k_top_p(lg, c.top_k, c.top_p)
+        lg = logits.astype(jnp.float32) / \
+            jnp.maximum(temps, 1e-6)[:, None]
+        lg = apply_top_k_top_p_per_row(lg, top_ks, top_ps)
         lp = jax.nn.log_softmax(lg, axis=-1)
 
         def one(key, pos, row):
@@ -482,12 +655,26 @@ class ServingEngine:
         from ..models.gpt import _ln, gpt_block_body
         from ..ops.paged_attention import paged_decode_attention
 
-        def tick(stacked, other, kpool, vpool, tab, pos, tok, keys):
+        nps = self.pool.pages_per_slot
+        cap = nps * ps
+
+        def tick(stacked, other, kpool, vpool, tab, pos, tok, keys,
+                 temps, top_ks, top_ps):
             _recompile.mark_trace(site, kpool, tab, pos, tok)
             wte = other["embeddings.wte.weight"]
             wpe = other["embeddings.wpe.weight"]
             x = wte[tok[:, None]] + wpe[pos[:, None]]        # [B, 1, h]
-            page = tab[jnp.arange(nslots), pos // ps]
+            # a slot that finished at EXACT capacity keeps riding the
+            # fixed-shape tick until its tokens drain, with pos == cap;
+            # clamping that gather would silently stomp the slot's LAST
+            # page (absolute position cap - page_size) — which _finish
+            # is about to publish into the prefix index. Route every
+            # out-of-range write to the null page instead, like the
+            # prefill pad path.
+            page = jnp.where(
+                pos < cap,
+                tab[jnp.arange(nslots), jnp.minimum(pos // ps, nps - 1)],
+                0)
             off = pos % ps
 
             def block(xc, inp):
@@ -510,43 +697,38 @@ class ServingEngine:
                 logits = last @ other["lm_head.weight"]
             else:
                 logits = last @ wte.T
-            nxt = self._sample_tok(logits, keys, pos + 1)
+            nxt = self._sample_tok(logits, keys, pos + 1, temps,
+                                   top_ks, top_ps)
             return kpool, vpool, nxt
 
         return tick
 
-    def _make_prefill(self, bucket: int):
-        """Prefill one request (padded to ``bucket``) through the SAME
-        dense cached forward as the non-paged path, with the scratch
-        cache sized to the slot capacity (reduction-length parity), then
-        scatter the computed KV into the slot's pages. Right-padding is
-        causal-masked garbage: padded positions write to allocated pages
-        but are masked until decode overwrites each one first."""
+    def _make_prefill_chunk(self):
+        """One fixed-shape suffix-prefill program: process a
+        ``prefill_chunk``-token slice of one slot's prompt through
+        ``gpt_paged_suffix_apply`` (KV scattered straight into the
+        slot's pages; attention reads aliased prefix pages + the
+        chunk). The chunk start / true prompt length ride as traced
+        scalars, so EVERY chunk of EVERY prompt shares this one
+        compiled program — the per-bucket prefill retraces of the
+        whole-prompt design collapse to a single trace. The sampled
+        token is only meaningful on the final chunk (the host ignores
+        it otherwise)."""
         mcfg = self.model_config
-        cap = self.pool.slot_capacity
-        nps = self.pool.pages_per_slot
-        ps = self.pool.page_size
-        nh = mcfg.num_heads
-        hd = mcfg.hidden_size // nh
-        L = mcfg.num_layers
-        dt = self._dtype
         site = self._prefill_site
+        chunk = self.prefill_chunk
 
-        from ..models.gpt import gpt_cached_apply
+        from ..models.gpt import gpt_paged_suffix_apply
 
-        def prefill(stacked, other, kpool, vpool, tokens, true_len,
-                    page_ids, key):
-            _recompile.mark_trace(site, tokens, kpool)
-            ck = jnp.zeros((1, L, cap, nh, hd), dt)
-            cv = jnp.zeros((1, L, cap, nh, hd), dt)
-            logits, ck, cv = gpt_cached_apply(
-                mcfg, stacked, other, ck, cv, tokens, 0,
-                logits_index=true_len - 1)
-            kpages = ck[0].reshape(L, nps, ps, nh, hd)
-            vpages = cv[0].reshape(L, nps, ps, nh, hd)
-            kpool = kpool.at[:, page_ids].set(kpages)
-            vpool = vpool.at[:, page_ids].set(vpages)
-            tok0 = self._sample_tok(logits, key[None], true_len[None])
+        def prefill(stacked, other, kpool, vpool, tokens, pos0, true_len,
+                    page_row, key, temp, top_k, top_p):
+            _recompile.mark_trace(site, tokens, kpool, pos0)
+            li = jnp.clip(true_len - 1 - pos0, 0, chunk - 1)
+            logits, kpool, vpool = gpt_paged_suffix_apply(
+                mcfg, stacked, other, kpool, vpool, tokens, pos0,
+                true_len, page_row, li)
+            tok0 = self._sample_tok(logits, key[None], true_len[None],
+                                    temp, top_k, top_p)
             return kpool, vpool, tok0
 
         return prefill

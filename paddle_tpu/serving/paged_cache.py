@@ -1,4 +1,5 @@
-"""Paged KV cache: fixed page pool + free-list allocator + page tables.
+"""Paged KV cache: refcounted page pool + free-list allocator + page
+tables + prefix index.
 
 The dense decode cache (``gpt_cached_apply``) charges every admitted
 request ``S_max`` positions of HBM for its whole lifetime. Here the
@@ -15,19 +16,39 @@ page row in every layer, so the allocator hands out a single id per
 page regardless of depth.
 
 Host state (``PageAllocator``): a LIFO free list over ids
-``1..num_pages-1``. **Page 0 is reserved as the null page**: inactive
-slots' table entries point at it, decode-tick writes for inactive
-slots land in it, and gathers through unallocated table entries read
-it (always masked). LIFO reuse is deliberate — it maximizes the chance
-a test (or a bug) sees a dirty page straight after free, which is
-exactly what the no-cross-request-leakage test pins down.
+``1..num_pages-1`` with a **refcount per allocated page**. ``alloc``
+hands out pages at refcount 1; ``share`` lets a second holder (another
+slot's page table, or the prefix index) alias the same page; ``free``
+decrements and only returns the page to the free list at refcount 0.
+**Page 0 is reserved as the null page**: inactive slots' table entries
+point at it, decode-tick writes for inactive slots land in it, and
+gathers through unallocated table entries read it (always masked).
+LIFO reuse is deliberate — it maximizes the chance a test (or a bug)
+sees a dirty page straight after free, which is exactly what the
+no-cross-request-leakage test pins down.
 
-Allocation and freeing are host-side bookkeeping only — no device op;
-the tables are tiny int32 arrays shipped with each tick's arguments.
+Prefix index (``PrefixCache``): a hash-trie keyed on page-aligned
+token chunks. A request's fully-written prompt pages are inserted as a
+chain ``chunk -> page id``; admission walks the trie with the new
+prompt and aliases every matched page instead of re-prefilling it.
+Indexed pages are **immutable by construction** — writes only ever
+target positions at or beyond the write frontier, and a page enters
+the index only once the frontier has passed it — so sharing is safe
+without copies, except for one case: a prompt that diverges from a
+cached chunk mid-page can still reuse the agreeing positions by
+**copy-on-write** (the engine copies the cached page into a fresh one
+and overwrites from the divergence point). The index holds one
+refcount per cached page; unreferenced cached pages (refcount 1, index
+only) are evicted LRU leaf-first when the allocator runs dry.
+
+Allocation, sharing and freeing are host-side bookkeeping only — no
+device op; the tables are tiny int32 arrays shipped with each tick's
+arguments.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+import heapq
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -36,8 +57,15 @@ import jax.numpy as jnp
 NULL_PAGE = 0
 
 
+def _registry():
+    from ..profiler import registry
+
+    return registry()
+
+
 class PageAllocator:
-    """LIFO free-list over page ids 1..num_pages-1 (0 is the null page)."""
+    """LIFO free-list over page ids 1..num_pages-1 (0 is the null page)
+    with per-page refcounts for prefix sharing."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -47,6 +75,7 @@ class PageAllocator:
         # companion set: O(1) double-free detection (the list alone
         # would make release_slot O(pages_freed * free_list_len))
         self._free_set = set(self._free)
+        self._ref: Dict[int, int] = {}       # allocated page -> refcount
 
     @property
     def num_free(self) -> int:
@@ -60,24 +89,237 @@ class PageAllocator:
         """Allocated fraction of the allocatable pool (null page excluded)."""
         return self.num_allocated / max(self.num_pages - 1, 1)
 
+    def refcount(self, page: int) -> int:
+        """Current refcount of ``page`` (0 when free/never allocated)."""
+        return self._ref.get(int(page), 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """n page ids, or None (and no state change) if the pool can't
-        cover the request — admission control needs all-or-nothing."""
+        """n page ids at refcount 1, or None (and no state change) if the
+        pool can't cover the request — admission control needs
+        all-or-nothing."""
         if n > len(self._free):
             return None
         out = [self._free.pop() for _ in range(n)]
         self._free_set.difference_update(out)
+        for i in out:
+            self._ref[i] = 1
         return out
 
+    def share(self, ids) -> None:
+        """Add one reference to each (already allocated) page — a second
+        page table or the prefix index now aliases it."""
+        shared = 0
+        for i in ids:
+            i = int(i)
+            if i == NULL_PAGE:
+                raise ValueError("page 0 (null page) is not shareable")
+            if i not in self._ref:
+                raise ValueError(f"share of unallocated page {i}")
+            self._ref[i] += 1
+            shared += 1
+        if shared:
+            _registry().counter("cache_share/shares").add(shared)
+
     def free(self, ids) -> None:
+        """Drop one reference per page; a page returns to the free list
+        only when its refcount reaches 0. Freeing an unallocated page
+        raises (double-free of the LAST reference is a bug; releasing a
+        still-shared page is the normal sharing path)."""
+        released = 0
         for i in ids:
             i = int(i)
             if i == NULL_PAGE:
                 raise ValueError("page 0 (null page) is not allocatable")
-            if i in self._free_set:
+            if i in self._free_set or i not in self._ref:
                 raise ValueError(f"double free of page {i}")
-            self._free.append(i)
-            self._free_set.add(i)
+            self._ref[i] -= 1
+            if self._ref[i] == 0:
+                del self._ref[i]
+                self._free.append(i)
+                self._free_set.add(i)
+            else:
+                released += 1
+        if released:
+            _registry().counter("cache_share/releases").add(released)
+
+
+class _TrieNode:
+    __slots__ = ("chunk", "page", "children", "first_ix", "parent",
+                 "last_use")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int,
+                 parent: Optional["_TrieNode"]):
+        self.chunk = chunk
+        self.page = page
+        self.children: Dict[Tuple[int, ...], "_TrieNode"] = {}
+        # chunk[0] -> child nodes: partial-match (COW) candidates. A
+        # long-lived server accumulates one child per distinct suffix
+        # under a shared-prompt node; scanning ALL of them per
+        # admission would grow with history, while an LCP >= 1 match
+        # must share the first token — so the common miss is one dict
+        # probe.
+        self.first_ix: Dict[int, List["_TrieNode"]] = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Hash-trie prefix index over page-aligned token chunks.
+
+    Each node maps one ``page_size``-token chunk (in its parent's
+    context) to the pool page holding that chunk's KV. The index owns
+    one refcount per cached page; ``evict_for`` walks unreferenced
+    leaves (refcount 1 — nobody but the index holds them) in LRU order
+    when the allocator needs pages back. Lookup matches whole chunks
+    along the trie, then optionally one **partial** chunk (longest
+    common prefix against a child's tokens) for the engine's
+    copy-on-write tail path.
+    """
+
+    def __init__(self, page_size: int, allocator: PageAllocator):
+        self.page_size = int(page_size)
+        self.allocator = allocator
+        self._root = _TrieNode((), NULL_PAGE, None)
+        self._clock = 0
+
+    def __len__(self) -> int:
+        n, stack = 0, list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            n += 1
+            stack.extend(node.children.values())
+        return n
+
+    def _touch(self, node: _TrieNode) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    def lookup(self, tokens: np.ndarray):
+        """Longest cached prefix of ``tokens``, capped at ``len - 1``
+        (at least the last prompt position must be recomputed — its
+        logits seed decoding).
+
+        Returns ``(full_pages, partial)`` where ``full_pages`` is the
+        page id per fully-matched chunk (in order) and ``partial`` is
+        ``(page_id, lcp_len)`` for a chunk whose first ``lcp_len``
+        tokens agree with the remainder (COW candidate), or None."""
+        toks = np.asarray(tokens).reshape(-1)
+        usable = toks.shape[0] - 1
+        ps = self.page_size
+        pages: List[int] = []
+        node = self._root
+        while (len(pages) + 1) * ps <= usable:
+            key = tuple(int(t) for t in
+                        toks[len(pages) * ps:(len(pages) + 1) * ps])
+            nxt = node.children.get(key)
+            if nxt is None:
+                break
+            node = nxt
+            self._touch(node)
+            pages.append(node.page)
+        partial = None
+        rem = usable - len(pages) * ps
+        if rem > 0:
+            rem_toks = toks[len(pages) * ps:len(pages) * ps + rem]
+            best, best_child = 0, None
+            for child in node.first_ix.get(int(rem_toks[0]), []):
+                lcp = 0
+                for a, b in zip(child.chunk, rem_toks):
+                    if a != b:
+                        break
+                    lcp += 1
+                if lcp > best:
+                    best, best_child = lcp, child
+                    if lcp == rem:
+                        break
+            if best_child is not None:
+                self._touch(best_child)
+                partial = (best_child.page, best)
+        return pages, partial
+
+    def insert(self, tokens: np.ndarray, pages) -> int:
+        """Register ``pages[i]`` as holding the KV of chunk ``i`` of
+        ``tokens`` (which must cover ``len(pages)`` full chunks). Pages
+        already cached under the same chunk chain are left alone (the
+        first tenant wins). Returns how many pages were newly indexed
+        (each takes one index refcount)."""
+        toks = np.asarray(tokens).reshape(-1)
+        ps = self.page_size
+        if len(pages) * ps > toks.shape[0]:
+            raise ValueError("insert needs one full chunk per page")
+        parent = self._root
+        new = 0
+        for i, page in enumerate(pages):
+            key = tuple(int(t) for t in toks[i * ps:(i + 1) * ps])
+            node = parent.children.get(key)
+            if node is None:
+                node = _TrieNode(key, int(page), parent)
+                parent.children[key] = node
+                parent.first_ix.setdefault(key[0], []).append(node)
+                self.allocator.share([int(page)])
+                new += 1
+            self._touch(node)
+            parent = node
+        return new
+
+    def _evictable_leaves(self) -> List[_TrieNode]:
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            elif self.allocator.refcount(node.page) == 1:
+                out.append(node)
+        return out
+
+    def _drop(self, node: _TrieNode) -> None:
+        parent = node.parent
+        del parent.children[node.chunk]
+        bucket = parent.first_ix[node.chunk[0]]
+        bucket.remove(node)
+        if not bucket:
+            del parent.first_ix[node.chunk[0]]
+        self.allocator.free([node.page])
+
+    def evict_for(self, n: int) -> int:
+        """Free up to ``n`` pages by evicting unreferenced cached pages,
+        LRU leaf-first (evicting a mid-chain node would orphan its
+        children's pages). One DFS collects the candidates; dropping a
+        leaf can only newly expose its own parent, so the frontier is
+        maintained incrementally instead of re-walking the trie per
+        page. Returns how many pages were actually freed."""
+        frontier = [(nd.last_use, id(nd), nd)
+                    for nd in self._evictable_leaves()]
+        heapq.heapify(frontier)
+        freed = 0
+        while freed < n and frontier:
+            _, _, victim = heapq.heappop(frontier)
+            parent = victim.parent
+            self._drop(victim)
+            freed += 1
+            if parent is not self._root and not parent.children and \
+                    self.allocator.refcount(parent.page) == 1:
+                heapq.heappush(frontier,
+                               (parent.last_use, id(parent), parent))
+        if freed:
+            _registry().counter("cache_share/prefix_evictions").add(freed)
+        return freed
+
+    def clear(self) -> int:
+        """Drop every index entry (still-shared pages lose only the
+        index's refcount and survive in their slots). Returns the
+        number of entries dropped."""
+        dropped = 0
+        stack = list(self._root.children.values())
+        order: List[_TrieNode] = []
+        while stack:
+            node = stack.pop()
+            order.append(node)
+            stack.extend(node.children.values())
+        for node in reversed(order):     # children before parents
+            self._drop(node)
+            dropped += 1
+        return dropped
 
 
 class PagePool:
@@ -85,7 +327,8 @@ class PagePool:
 
     def __init__(self, num_layers: int, num_pages: int, page_size: int,
                  num_heads: int, head_dim: int, num_slots: int,
-                 pages_per_slot: int, dtype=jnp.float32):
+                 pages_per_slot: int, dtype=jnp.float32,
+                 prefix_cache: bool = False):
         self.num_layers = num_layers
         self.num_pages = num_pages
         self.page_size = page_size
@@ -97,6 +340,9 @@ class PagePool:
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
         self.allocator = PageAllocator(num_pages)
+        self.prefix: Optional[PrefixCache] = (
+            PrefixCache(page_size, self.allocator) if prefix_cache
+            else None)
         # host copy of the per-slot page tables; rows of evicted slots
         # are zeroed (null page) so stale ids can never be gathered
         self.tables = np.zeros((num_slots, pages_per_slot), np.int32)
@@ -113,9 +359,18 @@ class PagePool:
     def slot_pages(self, slot: int) -> int:
         return len(self._held[slot])
 
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, evicting unreferenced prefix-cache
+        pages LRU-first when the free list alone can't cover it."""
+        got = self.allocator.alloc(n)
+        if got is None and self.prefix is not None:
+            self.prefix.evict_for(n - self.allocator.num_free)
+            got = self.allocator.alloc(n)
+        return got
+
     def grow_slot(self, slot: int, n_pages: int) -> bool:
-        """Extend ``slot`` by ``n_pages`` pages; False (untouched) when
-        the pool can't cover it."""
+        """Extend ``slot`` by ``n_pages`` fresh pages; False (untouched)
+        when the pool can't cover it."""
         if n_pages <= 0:
             return True
         held = self._held[slot]
@@ -123,16 +378,36 @@ class PagePool:
             raise ValueError(
                 f"slot {slot} would exceed pages_per_slot="
                 f"{self.pages_per_slot}")
-        got = self.allocator.alloc(n_pages)
+        got = self._alloc(n_pages)
         if got is None:
             return False
         self.tables[slot, len(held):len(held) + n_pages] = got
         held.extend(got)
         return True
 
+    def share_into_slot(self, slot: int, pages) -> None:
+        """Alias already-allocated ``pages`` (a cached prefix) into the
+        next table positions of ``slot``, taking one refcount each."""
+        if not len(pages):
+            return
+        held = self._held[slot]
+        if len(held) + len(pages) > self.pages_per_slot:
+            raise ValueError(
+                f"slot {slot} would exceed pages_per_slot="
+                f"{self.pages_per_slot}")
+        self.allocator.share(pages)
+        self.tables[slot, len(held):len(held) + len(pages)] = \
+            np.asarray(pages, np.int32)
+        held.extend(int(p) for p in pages)
+
     def release_slot(self, slot: int) -> int:
-        """Return all of ``slot``'s pages to the pool; zero its table
-        row. Returns how many pages were freed."""
+        """Drop ``slot``'s reference on all of its pages (a page only
+        returns to the pool at refcount 0 — the prefix index or another
+        slot may still hold it); zero the slot's table row. Idempotent:
+        a second release of the same slot is a no-op (``_finish`` and
+        preemption may both reach it), while over-freeing an individual
+        page still raises inside the allocator. Returns how many page
+        references were dropped."""
         held = self._held[slot]
         n = len(held)
         if n:
@@ -140,3 +415,8 @@ class PagePool:
         self._held[slot] = []
         self.tables[slot, :] = NULL_PAGE
         return n
+
+    def drop_prefix_cache(self) -> int:
+        """Flush the prefix index (frees every unshared cached page);
+        no-op without a prefix cache. Returns entries dropped."""
+        return self.prefix.clear() if self.prefix is not None else 0
