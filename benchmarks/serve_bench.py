@@ -19,6 +19,16 @@ prefills only each request's suffix, so first tokens arrive without
 re-running the system prompt per request. The profiler block carries
 ``serving/prefix_hit_tokens`` as the direct evidence.
 
+``--attention-kernel {ragged-xla,ragged-pallas,legacy}`` selects the
+engine's attention/dispatch path for either workload (default: the
+unified mixed-row tick on the XLA gather spelling).
+``--kernel-matrix`` instead runs BOTH workloads under every kernel and
+reports unified-vs-legacy throughput + TTFT — the dispatch-collapse
+evidence (BENCH_SERVE_r08.json holds a full run). Engines are compared
+against each other (same weights, all warm); greedy outputs are
+bitwise-equal across ragged-xla and legacy, so the delta is pure
+dispatch/compute structure.
+
 The baseline is exactly what a naive deployment of this repo would run
 today, warmed so the comparison is decode-vs-decode, not
 compile-vs-decode.
@@ -27,6 +37,7 @@ Prints ONE JSON line (driver contract, same shape as bench.py).
 
     python benchmarks/serve_bench.py                 # Poisson, 8 slots
     python benchmarks/serve_bench.py --prefix-cache  # shared-prefix TTFT
+    python benchmarks/serve_bench.py --kernel-matrix # unified vs legacy
     python benchmarks/serve_bench.py --tiny [...]    # CI smoke sizes
 """
 from __future__ import annotations
@@ -110,13 +121,14 @@ def run_baseline(net, trace):
 
 
 def build_engine(net, num_slots, page_size, pages_per_slot,
-                 prefill_chunk=0, prefix_cache=True):
+                 prefill_chunk=0, prefix_cache=True,
+                 attention_kernel="ragged-xla"):
     from paddle_tpu.serving import ServingConfig, ServingEngine
 
     return ServingEngine(net, ServingConfig(
         num_slots=num_slots, page_size=page_size,
         pages_per_slot=pages_per_slot, prefill_chunk=prefill_chunk,
-        prefix_cache=prefix_cache))
+        prefix_cache=prefix_cache, attention_kernel=attention_kernel))
 
 
 def run_engine(eng, trace):
@@ -185,12 +197,13 @@ def bench_poisson(args, tiny):
     trace = make_trace(n_req, prompt_lens, max_new, args.rate)
 
     # ---- warm both paths (compile excluded from the measurement: the
-    # engine instance is reused, so its tick + prefill-chunk programs
-    # are traced here, not on the clock) ----
+    # engine instance is reused, so its compiled programs are traced
+    # here, not on the clock) ----
     for t0 in prompt_lens:
         p = np.zeros((t0,), np.int32)
         net.generate(paddle.to_tensor(p[None]), max_new_tokens=max_new)
-    eng = build_engine(net, slots, page_size, pages_per_slot)
+    eng = build_engine(net, slots, page_size, pages_per_slot,
+                       attention_kernel=args.attention_kernel)
     warm = make_trace(max(2, slots), prompt_lens, max_new, 1e9, seed=1)
     run_engine(eng, [(0.0, p, m) for _, p, m in warm])
     eng.pool.drop_prefix_cache()        # measured run starts cold
@@ -218,6 +231,7 @@ def bench_poisson(args, tiny):
             "requests": n_req, "slots": slots,
             "prompt_lens": list(prompt_lens), "max_new": max_new,
             "arrival_rate_hz": args.rate,
+            "attention_kernel": args.attention_kernel,
             "page_size": page_size, "pages_per_slot": pages_per_slot,
             "engine_tokens_per_sec": round(eng_tps, 2),
             "baseline_tokens_per_sec": round(bl_tps, 2),
@@ -258,7 +272,8 @@ def bench_shared_prefix(args, tiny):
     def fresh(prefix_cache):
         eng = build_engine(net, slots, page_size, pages_per_slot,
                            prefill_chunk=chunk,
-                           prefix_cache=prefix_cache)
+                           prefix_cache=prefix_cache,
+                           attention_kernel=args.attention_kernel)
         # warm every compiled program (tick, prefill chunk, COW copy)
         # off the clock, then flush results + cached pages so the
         # measured run starts cold
@@ -305,6 +320,7 @@ def bench_shared_prefix(args, tiny):
             "requests": n_req, "slots": slots,
             "system_prompt_tokens": sys_len,
             "suffix_tokens": sfx_len, "max_new": max_new,
+            "attention_kernel": args.attention_kernel,
             "page_size": page_size, "pages_per_slot": pages_per_slot,
             "prefill_chunk": chunk,
             "ttft_ms": {
@@ -329,6 +345,95 @@ def bench_shared_prefix(args, tiny):
     }
 
 
+def bench_kernel_matrix(args, tiny):
+    """Unified-tick vs legacy two-dispatch (vs the Pallas ragged
+    kernel) on BOTH workloads: the mixed Poisson arrival trace and the
+    shared-system-prompt concurrent burst. Engines only — the dense
+    baseline is bench_poisson's job; here the delta under test is
+    dispatch/compute structure at identical outputs (ragged-xla and
+    legacy are bitwise-equal greedy). Each cell is best-of ``--reps``
+    (this box's CPU timings are noisy; best-of measures the program,
+    not the scheduler jitter)."""
+    if args.reps < 1:
+        raise SystemExit("--reps must be >= 1")
+    kernels = ["legacy", "ragged-xla", "ragged-pallas"]
+    n_req = 6 if tiny else args.requests
+    max_new = 8 if tiny else args.max_new
+    slots = 4 if tiny else args.slots
+    prompt_lens = (8, 16) if tiny else (16, 32, 64)
+    page_size = 8 if tiny else 16
+    pages_per_slot = -(-(max(prompt_lens) + max_new) // page_size)
+    sys_len = 16 if tiny else 64
+    sfx_len = 8
+    shared_pps = -(-(sys_len + sfx_len + max_new) // page_size)
+
+    net = build_model(tiny)
+    trace = make_trace(n_req, prompt_lens, max_new, args.rate)
+    reqs = make_shared_prefix_requests(slots, sys_len, sfx_len, max_new)
+
+    def measure(kernel):
+        mixed_eng = build_engine(net, slots, page_size, pages_per_slot,
+                                 attention_kernel=kernel)
+        warm = make_trace(max(2, slots), prompt_lens, max_new, 1e9,
+                          seed=1)
+        run_engine(mixed_eng, [(0.0, p, m) for _, p, m in warm])
+        shared_eng = build_engine(net, slots, page_size, shared_pps,
+                                  prefill_chunk=2 * page_size,
+                                  attention_kernel=kernel)
+        run_concurrent(shared_eng, reqs)
+        best = {"mixed_tokens_per_sec": 0.0,
+                "shared_tokens_per_sec": 0.0}
+        for _ in range(args.reps):
+            mixed_eng.pool.drop_prefix_cache()
+            toks, wall, ttfts, _, _ = run_engine(mixed_eng, trace)
+            if toks / wall > best["mixed_tokens_per_sec"]:
+                best["mixed_tokens_per_sec"] = toks / wall
+                best["mixed_ttft_p50_ms"] = pct(ttfts, 50)
+                best["mixed_ttft_p95_ms"] = pct(ttfts, 95)
+            shared_eng.pool.drop_prefix_cache()
+            toks, wall, ttfts = run_concurrent(shared_eng, reqs)
+            if toks / wall > best["shared_tokens_per_sec"]:
+                best["shared_tokens_per_sec"] = toks / wall
+                best["shared_ttft_mean_ms"] = float(np.mean(ttfts))
+        return {k: round(v, 2) for k, v in best.items()}
+
+    cells = {k: measure(k) for k in kernels}
+    speedup = cells["ragged-xla"]["mixed_tokens_per_sec"] / \
+        max(cells["legacy"]["mixed_tokens_per_sec"], 1e-9)
+    return {
+        "metric": "serving_unified_tick_speedup",
+        "value": round(speedup, 4),
+        "unit": "x tokens/s, unified mixed-row tick vs legacy "
+                "two-dispatch (mixed Poisson workload)",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "model": {"hidden": net.config.hidden_size,
+                      "layers": net.config.num_layers,
+                      "vocab": net.config.vocab_size},
+            "kernels": cells,
+            "shared_prefix_ttft_speedup": round(
+                cells["legacy"]["shared_ttft_mean_ms"]
+                / max(cells["ragged-xla"]["shared_ttft_mean_ms"], 1e-9),
+                4),
+            "requests": n_req, "slots": slots,
+            "prompt_lens": list(prompt_lens), "max_new": max_new,
+            "page_size": page_size, "reps": args.reps,
+            "note": ("one jitted mixed-row tick (decode rows + prefill-"
+                     "chunk rows as ragged rows of one program, with a "
+                     "compiled decode-only fast path via lax.cond) vs "
+                     "the pre-unification decode-tick + separate "
+                     "prefill-program pair; greedy outputs bitwise-"
+                     "equal between ragged-xla and legacy. ragged-"
+                     "pallas runs the Pallas kernel in INTERPRET mode "
+                     "on this CPU backend — it lowers to per-grid-step "
+                     "XLA ops, so its numbers here measure interpret "
+                     "overhead, not the kernel (real-TPU measurement "
+                     "pending, ROADMAP); best-of-reps per cell since "
+                     "this box's CPU timings are noisy"),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
@@ -336,9 +441,19 @@ def main():
     ap.add_argument("--prefix-cache", action="store_true",
                     help="shared-system-prompt workload: prefix-cache-on"
                          " vs -off TTFT comparison")
+    ap.add_argument("--kernel-matrix", action="store_true",
+                    help="unified-tick vs legacy two-dispatch (and the "
+                         "interpret-mode Pallas kernel) on both "
+                         "workloads")
+    ap.add_argument("--attention-kernel", default="ragged-xla",
+                    choices=["ragged-xla", "ragged-pallas", "legacy"],
+                    help="engine attention/dispatch path for the "
+                         "single-workload modes")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=48)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="repetitions per kernel-matrix cell (best-of)")
     ap.add_argument("--rate", type=float, default=200.0,
                     help="Poisson arrival rate (req/s)")
     args = ap.parse_args()
@@ -348,7 +463,9 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
 
-    if args.prefix_cache:
+    if args.kernel_matrix:
+        out = bench_kernel_matrix(args, args.tiny)
+    elif args.prefix_cache:
         out = bench_shared_prefix(args, args.tiny)
     else:
         out = bench_poisson(args, args.tiny)

@@ -570,70 +570,141 @@ def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0,
     return logits, jnp.swapaxes(ckl, 0, 1), jnp.swapaxes(cvl, 0, 1)
 
 
-def gpt_paged_suffix_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
-                           tokens, pos0, true_len, page_row,
-                           logits_index):
-    """Suffix-prefill forward over the PAGED cache: process one prompt
-    chunk ``tokens`` [1, T] at positions pos0..pos0+T-1 of the slot
-    whose page-table row is ``page_row`` [NPs], writing each position's
-    KV into the slot's pages and attending over (aliased prefix pages +
-    earlier chunks + this chunk's causal prefix). This is the engine's
-    prefix-cache / chunked-prefill path: ``gpt_cached_apply`` always
-    recomputes from position 0 into a fresh scratch cache, while here
-    positions below ``pos0`` are READ from pages another request (or an
-    earlier chunk) already filled.
+def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
+                     tokens, tok_pos, tok_limit, row_tab, row_pos0,
+                     row_len, sample_ix, decode_rows: int,
+                     chunk_width: int, impl: str = "xla"):
+    """Mixed prefill/decode forward over the PAGED cache: every token
+    in flight rides one program. ``tokens`` [NT] is the flat token
+    buffer of one serving tick — ``decode_rows`` resident decode
+    tokens followed by the prefill chunks, ``chunk_width`` tokens
+    each; which is which is *only* metadata:
 
-    ``pos0``/``true_len``/``logits_index`` may be traced (one compiled
-    program serves every chunk of every prompt). Pad positions at or
-    beyond ``true_len`` write to the null page (0) — never into a page
-    a neighbour might alias. Returns (logits at chunk index
-    ``logits_index`` [1, V], kpool, vpool).
+    tok_pos    [NT] int32   absolute cache position of each token
+    tok_limit  [NT] int32   first non-writable position of the token's
+                            sequence — KV writes at ``tok_pos >=
+                            tok_limit`` route to the null page (decode
+                            rows: the slot capacity, so an
+                            exact-capacity rider never stomps its own
+                            published tail page; prefill rows: the
+                            true prompt length, so chunk padding never
+                            lands in a page a neighbour aliases; pad
+                            rows: 0)
+    row_tab    [R, NPs]     page-table row per ragged attention row,
+                            R = decode_rows + num_chunks (pad chunk
+                            rows: all-null tables)
+    row_pos0   [R] int32    first query position of each row
+    row_len    [R] int32    real queries per row (decode rows: 1)
+    sample_ix  [S] int32    flat indices whose final hidden states
+                            feed the logits head (one per emitter)
 
-    Bitwise contract: per-position results match the whole-prompt
-    prefill because every reduction keeps the same length — heads/hidden
-    contractions are row-independent and attention always reduces over
-    the full slot capacity with exact-zero masked weights (see
-    ``ops/paged_attention.paged_prefill_attention``).
+    Hidden-state compute (embeddings, LN, QKV/MLP matmuls) runs once
+    over the flat buffer; each token's KV is scattered to its own
+    page/offset; attention routes through the ONE
+    ``ragged_paged_attention`` entry point, with rows grouped by their
+    static query width — decode rows as ``[decode_rows, 1]`` and chunk
+    rows as ``[num_chunks, chunk_width]`` — so a decode-only tick pays
+    the pre-unification decode gather cost, not ``chunk_width×`` pad
+    queries ("Ragged Paged Attention", PAPERS.md: per-row
+    ``(pos0, true_len)`` metadata; the width grouping is the XLA-
+    friendly layout of the same raggedness, and the Pallas kernel
+    underneath handles either width in one grid). All metadata may be
+    traced: one compiled program serves every mix of resident decodes
+    and prompt chunks. Returns (logits [S, V], kpool, vpool).
+
+    Bitwise contract (the engine's parity tests rest on it):
+    per-token results are independent of which *other* rows share the
+    program — hidden/head contractions are row-independent, LN/GELU
+    are elementwise, and attention always reduces over the full slot
+    capacity with exact-zero masked weights (``ops/paged_attention._
+    gather_attend``, the one shared spelling) — so a decode row here
+    equals the old dedicated decode tick and a chunk row equals the
+    old suffix-prefill program, token for token, bit for bit.
     """
-    from ..ops.paged_attention import paged_prefill_attention
+    from ..ops.paged_attention import ragged_paged_attention
 
-    n, t = tokens.shape
+    nt = tokens.shape[0]
+    nd = decode_rows
+    nch = (nt - nd) // chunk_width if chunk_width else 0
     nh = cfg.num_heads
     hd = cfg.hidden_size // nh
     eps = cfg.layer_norm_eps
     ps = kpool.shape[2]
-    nps = page_row.shape[0]
+    nps = row_tab.shape[1]
     wte = other["embeddings.wte.weight"]
     wpe = other["embeddings.wpe.weight"]
-    pos = pos0 + jnp.arange(t)
-    x = wte[tokens] + wpe[pos][None]
-    # write targets: real positions go to their slot page, pads to the
-    # null page (clip keeps the page-table index in range for pads past
-    # the slot capacity)
-    page = jnp.where(pos < true_len,
-                     page_row[jnp.minimum(pos // ps, nps - 1)], 0)
-    off = pos % ps
+    x = wte[tokens[:, None]] + wpe[tok_pos[:, None]]    # [NT, 1, h]
+    # token -> ragged row (static: the flat layout never changes)
+    tok_row = jnp.concatenate(
+        [jnp.arange(nd, dtype=jnp.int32),
+         jnp.repeat(nd + jnp.arange(nch, dtype=jnp.int32),
+                    chunk_width)]) if nch else \
+        jnp.arange(nd, dtype=jnp.int32)
+    # write targets: real positions go to their slot page, everything
+    # at/past the limit to the null page (clip keeps the page-table
+    # index in range for positions past the slot capacity)
+    page = jnp.where(
+        tok_pos < tok_limit,
+        row_tab[tok_row, jnp.minimum(tok_pos // ps, nps - 1)],
+        0)
+    off = tok_pos % ps
 
     def block(xc, inp):
         p, kpl0, vpl0 = inp
 
         def attend(q, kk, vv):
-            kpl = kpl0.at[page, off].set(kk[0])
-            vpl = vpl0.at[page, off].set(vv[0])
-            o = paged_prefill_attention(q, kpl, vpl, page_row[None], pos0)
+            kpl = kpl0.at[page, off].set(kk[:, 0])
+            vpl = vpl0.at[page, off].set(vv[:, 0])
+            outs = []
+            if nd:
+                outs.append(ragged_paged_attention(
+                    q[:nd], kpl, vpl, row_tab[:nd], row_pos0[:nd],
+                    row_len[:nd], impl=impl))
+            if nch:
+                qp = q[nd:, 0].reshape(nch, chunk_width, nh, hd)
+                op = ragged_paged_attention(
+                    qp, kpl, vpl, row_tab[nd:], row_pos0[nd:],
+                    row_len[nd:], impl=impl)
+                outs.append(op.reshape(nch * chunk_width, 1, nh, hd))
+            o = outs[0] if len(outs) == 1 else \
+                jnp.concatenate(outs, axis=0)
             return o, (kpl, vpl)
 
         return gpt_block_body(xc, p, eps, nh, hd, attend)
 
     x, (kpool, vpool) = jax.lax.scan(block, x, (stacked, kpool, vpool))
     x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
-    last = jax.lax.dynamic_index_in_dim(x, logits_index, axis=1,
-                                        keepdims=False)
+    last = x[sample_ix, 0]                              # [S, h]
     if "lm_head.weight" in other:
         logits = last @ other["lm_head.weight"]
     else:
         logits = last @ wte.T
     return logits, kpool, vpool
+
+
+def gpt_paged_suffix_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
+                           tokens, pos0, true_len, page_row,
+                           logits_index):
+    """Suffix-prefill forward over the PAGED cache: one prompt chunk
+    ``tokens`` [1, T] at positions pos0..pos0+T-1 of the slot whose
+    page-table row is ``page_row`` [NPs]. Retired into the unified
+    ragged call — each chunk position becomes one ragged row of
+    ``gpt_ragged_apply`` (bitwise-identical per position, see its
+    contract); kept as the legacy two-dispatch engine mode's prefill
+    program and as the documented single-slot chunk surface.
+    ``pos0``/``true_len``/``logits_index`` may be traced. Returns
+    (logits at chunk index ``logits_index`` [1, V], kpool, vpool).
+    """
+    t = tokens.shape[1]
+    tok_pos = pos0 + jnp.arange(t)
+    tok_limit = jnp.broadcast_to(true_len, (t,))
+    sample_ix = jnp.asarray(logits_index, jnp.int32)[None]
+    return gpt_ragged_apply(cfg, stacked, other, kpool, vpool,
+                            tokens[0], tok_pos, tok_limit,
+                            page_row[None],
+                            jnp.asarray(pos0, jnp.int32)[None],
+                            jnp.full((1,), t, jnp.int32), sample_ix,
+                            decode_rows=0, chunk_width=t)
 
 
 def _gpt_decode_state(model: "GPT"):

@@ -1,37 +1,54 @@
-"""Paged decode attention over a page-table KV cache.
+"""Ragged paged attention over a page-table KV cache.
 
 Serving keeps the KV cache as a fixed pool of fixed-size pages
 (``paddle_tpu.serving.paged_cache``) instead of one dense
 ``[N, S_max, NH, D]`` slab per request batch: a request holds only the
 pages its sequence actually fills, so HBM scales with live tokens, not
 with ``S_max × slots``. This module is the attention read side of that
-layout — one decode step (query length 1 per slot) attending to every
-cached position of its own pages ("Ragged Paged Attention", PAPERS.md) —
-plus the chunked-prefill read (``paged_prefill_attention``): a T-query
-prompt chunk attending over its slot's aliased-prefix pages and itself.
+layout, unified the way "Ragged Paged Attention" (PAPERS.md) argues a
+TPU serving kernel should be: ONE entry point,
+``ragged_paged_attention``, over per-row metadata ``(page_table row,
+pos0, true_len)`` — a decode step is simply a row with
+``true_len == 1``, a prefill chunk is a row with ``true_len`` up to its
+chunk width, and both kinds share one program, one grid, one softmax
+spelling. The engine's mixed prefill/decode tick flattens every token
+in flight into rows of this one call (``models/gpt.py::
+gpt_ragged_apply``); the pre-unification entry points
+(``paged_decode_attention``, ``paged_prefill_attention``) survive as
+thin delegations for the legacy two-dispatch engine mode and tests.
 
-Two implementations behind one entry point, following the
+Two implementations behind the one entry point, following the
 ``ops/int8_matmul.py`` precedent (kernel built and gated; the XLA
 spelling is the measured default until the kernel wins on hardware):
 
-- ``impl="xla"`` (default): gather the slot's pages into a contiguous
-  ``[B, S_cap, NH, D]`` view and run exactly the dense-cache attention
+- ``impl="xla"`` (default): gather each row's pages into a contiguous
+  ``[R, S_cap, NH, D]`` view and run exactly the dense-cache attention
   expression from ``models/gpt.py::gpt_cached_apply`` — same einsum
-  contractions, same mask constant, same f32 softmax. This is what
-  makes greedy paged decode **bitwise** equal to the dense ``generate``
-  path (tests/test_serving.py): XLA fuses the gather into the attention
-  so the page indirection costs index arithmetic, not a second cache.
-- ``impl="pallas"``: a ragged/paged Pallas kernel — grid
-  ``(slots, pages_per_slot)``, the page table scalar-prefetched so each
-  grid step DMAs one page directly from the pool (no materialized
-  gather), online-softmax accumulation in VMEM scratch across the page
-  axis. Gated behind the same TPU guard as ``ops/flash_attention.py``
-  (interpret mode on CPU). Numerics are allclose, not bitwise, vs the
-  XLA path (online softmax reassociates the reduction), so the serving
-  engine only selects it on explicit request.
+  contractions, same mask constant, same f32 softmax — via the ONE
+  shared helper ``_gather_attend`` (decode, suffix prefill and the
+  ragged path all route here, so "same expression" is enforced by
+  code, not by a verbatim-copy comment). This is what makes greedy
+  paged decode **bitwise** equal to the dense ``generate`` path
+  (tests/test_serving.py): XLA fuses the gather into the attention so
+  the page indirection costs index arithmetic, not a second cache.
+- ``impl="pallas"``: the ragged Pallas kernel — grid
+  ``(rows, pages_per_slot)``, page table / pos0 / true_len
+  scalar-prefetched so each grid step DMAs one page directly from the
+  pool (no materialized gather), online-softmax accumulation in VMEM
+  scratch across the page axis, and **fully-masked page blocks
+  skipped**: a block whose first position exceeds the row's last
+  attendable position (``pos0 + true_len - 1``) contributes nothing,
+  so its compute is predicated off and its DMA is routed to the null
+  page by the index map (the grid still visits the step — the win is
+  skipped FLOPs + a cached null-page fetch, stated honestly). Gated
+  behind the same TPU guard as ``ops/flash_attention.py`` (interpret
+  mode on CPU). Numerics are allclose, not bitwise, vs the XLA path
+  (online softmax reassociates the reduction), so the serving engine
+  only selects it on explicit request, and a default flip waits for a
+  real-TPU measurement (ROADMAP).
 
 Layout note: pools are ``[num_pages, page_size, NH, D]`` per layer;
-page 0 is the null page (writes of inactive slots land there, gathers
+page 0 is the null page (writes of inactive rows land there, gathers
 of unallocated table entries read it and are masked).
 """
 from __future__ import annotations
@@ -46,7 +63,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._pallas_compat import CompilerParams as _CompilerParams
 
-__all__ = ["paged_decode_attention", "paged_prefill_attention"]
+__all__ = ["ragged_paged_attention", "paged_decode_attention",
+           "paged_prefill_attention"]
 
 _NEG_INF = -1e9     # same masking constant as gpt_cached_apply
 
@@ -57,97 +75,119 @@ def _interpret() -> bool:
     return target_platform() == "cpu"
 
 
-def paged_decode_attention(q, k_pool, v_pool, page_table, attend_pos,
-                           impl: str = "xla"):
-    """One decode step of attention over paged KV.
+def _gather_attend(q, k_pool, v_pool, page_table, qpos):
+    """THE dense paged-attention expression — the single spelling of
+    gather + mask + f32 softmax shared by every XLA entry point in this
+    module (and, transitively, the spelling ``gpt_cached_apply`` uses
+    on the dense cache: same contraction order, same mask constant,
+    same softmax dtype — which is what the engine's bitwise greedy
+    parity contract rests on).
 
-    q           [B, 1, NH, D]  single-position queries (t dim kept so the
-                               contraction matches gpt_cached_apply's)
+    q           [R, T, NH, D]  queries
     k_pool      [P, ps, NH, D] per-layer key page pool
     v_pool      [P, ps, NH, D] per-layer value page pool
-    page_table  [B, NPs] int32 page ids per slot (0 = null page)
-    attend_pos  [B] int32      last attendable position per slot
-                               (the slot's current write position)
+    page_table  [R, NPs] int32 page ids per row (0 = null page)
+    qpos        [R, T] int32   last attendable cache position per query
 
-    Returns [B, 1, NH, D].
+    Every reduction runs at the full slot capacity ``NPs * ps`` with
+    exact-zero weights behind the mask, so results are independent of
+    page layout and of whatever garbage sits in unattended positions.
+    Returns [R, T, NH, D].
+    """
+    r = q.shape[0]
+    nps, ps = page_table.shape[1], k_pool.shape[1]
+    nh, hd = k_pool.shape[2], k_pool.shape[3]
+    s_cap = nps * ps
+    k_c = k_pool[page_table].reshape(r, s_cap, nh, hd)
+    v_c = v_pool[page_table].reshape(r, s_cap, nh, hd)
+    key_pos = jnp.arange(s_cap)
+    mask = key_pos[None, None, None, :] <= qpos[:, None, :, None]
+    att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
+    att = jnp.where(mask, att, _NEG_INF)
+    w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bnts,bsnd->btnd", w, v_c)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_table, pos0, true_len,
+                           impl: str = "xla"):
+    """One attention call over ragged rows of the page pool.
+
+    q           [R, T, NH, D]  per-row query blocks (T static)
+    k_pool      [P, ps, NH, D] per-layer key page pool
+    v_pool      [P, ps, NH, D] per-layer value page pool
+    page_table  [R, NPs] int32 page ids per row (0 = null page)
+    pos0        [R] int32      absolute position of each row's query 0
+    true_len    [R] int32      real queries in the row (1 = decode row)
+
+    Query ``i`` of row ``r`` attends cache positions
+    ``<= pos0[r] + i``. Rows are fixed-shape: queries at
+    ``i >= true_len[r]`` are computed anyway and produce garbage the
+    caller must ignore (on the Pallas path their trailing page blocks
+    are additionally skipped, so the garbage differs between impls —
+    never compare pad queries). Returns [R, T, NH, D].
     """
     if impl == "xla":
-        return _paged_attention_xla(q, k_pool, v_pool, page_table,
-                                    attend_pos)
+        t = q.shape[1]
+        qpos = pos0[:, None] + jnp.arange(t, dtype=pos0.dtype)[None, :]
+        return _gather_attend(q, k_pool, v_pool, page_table, qpos)
     if impl == "pallas":
-        return _paged_attention_pallas(q, k_pool, v_pool, page_table,
-                                       attend_pos)
+        return _ragged_attention_pallas(q, k_pool, v_pool, page_table,
+                                        pos0, true_len)
     raise ValueError(f"unknown paged attention impl {impl!r}")
 
 
-def _paged_attention_xla(q, k_pool, v_pool, page_table, attend_pos):
-    """Gather-then-attend; the attention expression is copied verbatim
-    from gpt_cached_apply so the paged decode stays bitwise-parity with
-    the dense cache (same contraction order, same reduction length when
-    the slot capacity equals the dense S_max)."""
-    b = q.shape[0]
-    nps, ps = page_table.shape[1], k_pool.shape[1]
-    nh, hd = k_pool.shape[2], k_pool.shape[3]
-    s_cap = nps * ps
-    k_c = k_pool[page_table].reshape(b, s_cap, nh, hd)
-    v_c = v_pool[page_table].reshape(b, s_cap, nh, hd)
-    key_pos = jnp.arange(s_cap)
-    mask = key_pos[None, None, None, :] <= \
-        attend_pos[:, None, None, None]
-    att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
-    att = jnp.where(mask, att, _NEG_INF)
-    w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bnts,bsnd->btnd", w, v_c)
+def paged_decode_attention(q, k_pool, v_pool, page_table, attend_pos,
+                           impl: str = "xla"):
+    """One decode step of attention over paged KV: a ragged call where
+    every row is a single query at its slot's write position.
+
+    q           [B, 1, NH, D]  single-position queries
+    page_table  [B, NPs] int32 page ids per slot (0 = null page)
+    attend_pos  [B] int32      last attendable position per slot
+
+    Returns [B, 1, NH, D].
+    """
+    # validate before touching any argument: a bad impl must raise
+    # ValueError even with placeholder args (ones_like would TypeError
+    # first otherwise), and the delegation builds true_len eagerly
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown paged attention impl {impl!r}")
+    ones = jnp.ones_like(attend_pos)
+    return ragged_paged_attention(q, k_pool, v_pool, page_table,
+                                  attend_pos, ones, impl=impl)
 
 
 def paged_prefill_attention(q, k_pool, v_pool, page_table, pos0):
-    """Suffix-prefill (chunked) attention over paged KV.
-
-    q           [B, T, NH, D]  one prompt chunk's queries, occupying
-                               positions pos0..pos0+T-1
-    k_pool      [P, ps, NH, D] per-layer key page pool — the chunk's own
-                               KV must already be scattered in
-    v_pool      [P, ps, NH, D] per-layer value page pool
-    page_table  [B, NPs] int32 page ids per slot (0 = null page)
-    pos0        int32 scalar   chunk start position (shared by the batch)
-
-    Query i attends to cache positions <= pos0 + i, so the chunk sees
-    (aliased prefix pages + earlier chunks + its own causal prefix).
-    Same gather + einsum + mask + f32-softmax spelling as the decode
-    path (and hence as ``gpt_cached_apply``): per-query reduction
-    length is always the full slot capacity, which is what keeps
-    chunked prefill bitwise-equal to whole-prompt prefill — masked
-    positions contribute exactly-zero weights regardless of the dirty
-    page contents behind them. Returns [B, T, NH, D].
+    """Suffix-prefill (chunked) attention over paged KV: a ragged call
+    where each batch row is a T-query chunk starting at the shared
+    scalar position ``pos0`` (query i attends positions <= pos0 + i).
+    The chunk's own KV must already be scattered into the pool.
+    Returns [B, T, NH, D].
     """
     b, t = q.shape[0], q.shape[1]
-    nps, ps = page_table.shape[1], k_pool.shape[1]
-    nh, hd = k_pool.shape[2], k_pool.shape[3]
-    s_cap = nps * ps
-    k_c = k_pool[page_table].reshape(b, s_cap, nh, hd)
-    v_c = v_pool[page_table].reshape(b, s_cap, nh, hd)
-    key_pos = jnp.arange(s_cap)
-    mask = key_pos[None, None, None, :] <= \
-        (pos0 + jnp.arange(t))[None, None, :, None]
-    att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
-    att = jnp.where(mask, att, _NEG_INF)
-    w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bnts,bsnd->btnd", w, v_c)
+    row_pos0 = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
+    return ragged_paged_attention(q, k_pool, v_pool, page_table,
+                                  row_pos0,
+                                  jnp.full((b,), t, jnp.int32))
 
 
 # --------------------------------------------------------------------------
-# Pallas ragged/paged kernel
+# Pallas ragged kernel
 # --------------------------------------------------------------------------
 
-def _paged_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-                  m_ref, l_ref, acc_ref, *, page_size: int, n_pages: int):
-    """Grid (b, j): slot b consumes its j-th page. The page table is
-    scalar-prefetched, so the BlockSpec index map DMAs page
-    ``pt[b, j]`` straight from the pool — the gathered [B, S_cap]
-    intermediate of the XLA path never exists. Running max / denominator
-    / accumulator live in VMEM scratch across the page axis."""
+def _ragged_kernel(pt_ref, pos0_ref, tl_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, page_size: int, n_pages: int):
+    """Grid (r, j): row r consumes its j-th page. Page table, pos0 and
+    true_len are scalar-prefetched, so the BlockSpec index map DMAs
+    page ``pt[r, j]`` straight from the pool — the gathered
+    [R, S_cap] intermediate of the XLA path never exists — and routes
+    fully-masked blocks (``j*ps > pos0 + true_len - 1``, where nothing
+    in the page is attendable by any real query of the row) to the
+    null page with their compute predicated off. Running max /
+    denominator / accumulator live in VMEM scratch across the page
+    axis (online softmax)."""
+    r = pl.program_id(0)
     j = pl.program_id(1)
-    b = pl.program_id(0)
 
     @pl.when(j == 0)
     def _init():
@@ -155,63 +195,80 @@ def _paged_kernel(pt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32)                    # [NH, D]
-    k = k_ref[0].astype(jnp.float32)                    # [ps, NH, D]
-    v = v_ref[0].astype(jnp.float32)
-    hd = q.shape[-1]
-    # s[n, p] = q[n] · k[p, n] / sqrt(D)
-    s = jax.lax.dot_general(
-        q, k, (((1,), (2,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32) / math.sqrt(hd)  # [NH, ps]
-    gpos = j * page_size + jax.lax.broadcasted_iota(
-        jnp.int32, s.shape, 1)
-    s = jnp.where(gpos <= pos_ref[b], s, _NEG_INF)
-    m_prev = m_ref[:]                                    # [NH, 1]
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-    p = jnp.exp(s - m_new)                               # [NH, ps]
-    corr = jnp.exp(m_prev - m_new)                       # [NH, 1]
-    l_ref[:] = corr * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
-    # acc[n, d] += sum_p p[n, p] * v[p, n, d]
-    pv = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32)              # [NH, D]
-    acc_ref[:] = corr * acc_ref[:] + pv
-    m_ref[:] = m_new
+    last_attendable = pos0_ref[r] + tl_ref[r] - 1
+
+    @pl.when(j * page_size <= last_attendable)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)                # [T, NH, D]
+        k = k_ref[0].astype(jnp.float32)                # [ps, NH, D]
+        v = v_ref[0].astype(jnp.float32)
+        hd = q.shape[-1]
+        # s[n, t, p] = q[t, n] · k[p, n] / sqrt(D)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((1,), (1,))),
+            preferred_element_type=jnp.float32) / math.sqrt(hd)
+        # query t attends global position <= pos0 + t
+        gpos = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 2)
+        qpos = pos0_ref[r] + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(gpos <= qpos, s, _NEG_INF)
+        m_prev = m_ref[:]                               # [NH, T, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        p = jnp.exp(s - m_new)                          # [NH, T, ps]
+        corr = jnp.exp(m_prev - m_new)                  # [NH, T, 1]
+        l_ref[:] = corr * l_ref[:] + jnp.sum(p, axis=2, keepdims=True)
+        # acc[n, t, d] += sum_p p[n, t, p] * v[p, n, d]
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)         # [NH, T, D]
+        acc_ref[:] = corr * acc_ref[:] + pv
+        m_ref[:] = m_new
 
     @pl.when(j == n_pages - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        # rows whose every block was skipped (degenerate metadata) get
+        # zeros, not 0/0 NaN — they are never read, but NaN would trip
+        # debug_nans and pollute allclose diagnostics
+        l_safe = jnp.where(l_ref[:] == 0.0, 1.0, l_ref[:])
+        o_ref[0] = jnp.transpose(acc_ref[:] / l_safe,
+                                 (1, 0, 2)).astype(o_ref.dtype)
 
 
-def _paged_attention_pallas(q, k_pool, v_pool, page_table, attend_pos):
-    b, _, nh, hd = q.shape
+def _ragged_attention_pallas(q, k_pool, v_pool, page_table, pos0,
+                             true_len):
+    r, t, nh, hd = q.shape
     ps = k_pool.shape[1]
     nps = page_table.shape[1]
-    q2 = q[:, 0]                                         # [B, NH, D]
+
+    def _kv_index(i, j, pt, p0, tl):
+        # fully-masked block: fetch the (hot, tiny) null page instead
+        # of a live pool page the row will only mask away
+        return (jnp.where(j * ps <= p0[i] + tl[i] - 1, pt[i, j], 0),
+                0, 0, 0)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(b, nps),
+        num_scalar_prefetch=3,
+        grid=(r, nps),
         in_specs=[
-            pl.BlockSpec((1, nh, hd), lambda i, j, pt, pos: (i, 0, 0)),
-            pl.BlockSpec((1, ps, nh, hd),
-                         lambda i, j, pt, pos: (pt[i, j], 0, 0, 0)),
-            pl.BlockSpec((1, ps, nh, hd),
-                         lambda i, j, pt, pos: (pt[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, t, nh, hd),
+                         lambda i, j, pt, p0, tl: (i, 0, 0, 0)),
+            pl.BlockSpec((1, ps, nh, hd), _kv_index),
+            pl.BlockSpec((1, ps, nh, hd), _kv_index),
         ],
-        out_specs=pl.BlockSpec((1, nh, hd),
-                               lambda i, j, pt, pos: (i, 0, 0)),
+        out_specs=pl.BlockSpec((1, t, nh, hd),
+                               lambda i, j, pt, p0, tl: (i, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((nh, 1), jnp.float32),
-            pltpu.VMEM((nh, 1), jnp.float32),
-            pltpu.VMEM((nh, hd), jnp.float32),
+            pltpu.VMEM((nh, t, 1), jnp.float32),
+            pltpu.VMEM((nh, t, 1), jnp.float32),
+            pltpu.VMEM((nh, t, hd), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
-        functools.partial(_paged_kernel, page_size=ps, n_pages=nps),
+    return pl.pallas_call(
+        functools.partial(_ragged_kernel, page_size=ps, n_pages=nps),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, nh, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((r, t, nh, hd), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(page_table, attend_pos, q2, k_pool, v_pool)
-    return out[:, None]
+    )(page_table, pos0, true_len, q, k_pool, v_pool)

@@ -45,11 +45,15 @@ Serving signals (ISSUE 4; paddle_tpu.serving): gauges
 ``serving/tokens_generated``, ``serving/prefills``, ``serving/ticks``,
 ``serving/preemptions``, ``serving/requests_finished`` and
 ``serving/token_syncs`` (host materializations of deferred tick
-outputs); histogram ``serving/ttft_ms``. Per-shape executable caches
-(``GPT.generate``'s jit cache, the Predictor's bucket executables, the
-paged-engine cache) report LRU evictions as ``cache_evict/<name>``.
-Prefill length-bucket retraces surface at the ``serving.prefill#N``
-recompile site; the decode tick site must stay at one trace.
+outputs); histogram ``serving/ttft_ms``; gauges
+``serving/mixed_rows`` / ``serving/mixed_rows_decode`` /
+``serving/mixed_rows_prefill`` (the prefill-vs-decode row mix of the
+last unified tick). Per-shape executable caches (``GPT.generate``'s
+jit cache, the Predictor's bucket executables, the paged-engine cache)
+report LRU evictions as ``cache_evict/<name>``. The engine's ONE
+hot-path program surfaces at the ``serving.tick#N`` recompile site and
+must stay at one trace (``ServingEngine.compiled_sites``; the legacy
+benchmarking mode adds ``serving.prefill#N``).
 
 Quick use::
 

@@ -3,19 +3,24 @@ KV cache with prefix sharing.
 
 The serving-side answer to the ROADMAP's "heavy traffic from millions
 of users": instead of one dense-cache ``generate()`` program per
-request batch, a fixed pool of KV **pages** (``paged_cache.py``) plus a
-fixed-shape jitted **decode tick** over cache slots (``engine.py``)
-lets requests join and leave mid-decode — admission fills slots as
-evictions free them, pages return to the pool the moment their LAST
-holder lets go (the allocator refcounts pages), and the host overlaps
-scheduling with device execution via the PR-3 deferred-sync idiom.
-Prompt prefixes are **shared**: fully-written prompt pages live in a
-hash-trie index (``PrefixCache``) and admission aliases the longest
-cached page-aligned prefix instead of recomputing it; prefill of the
-remaining suffix is **chunked** (Sarathi-style — bounded work per
-scheduler step, one compiled chunk shape). Attention over the paged
-layout lives in ``ops/paged_attention.py`` (XLA gather reference for
-decode AND chunked prefill + gated Pallas kernel).
+request batch, a fixed pool of KV **pages** (``paged_cache.py``) plus
+ONE fixed-shape jitted **mixed-row tick** over cache slots
+(``engine.py``) lets requests join and leave mid-decode — admission
+fills slots as evictions free them, pages return to the pool the
+moment their LAST holder lets go (the allocator refcounts pages), and
+the host overlaps scheduling with device execution via the PR-3
+deferred-sync idiom. Prompt prefixes are **shared**: fully-written
+prompt pages live in a hash-trie index (``PrefixCache``) and admission
+aliases the longest cached page-aligned prefix instead of recomputing
+it; prefill of the remaining suffix is **chunked** (Sarathi-style —
+bounded work per scheduler step) and the chunks ride the SAME tick as
+resident decodes, as ragged rows of one
+``ops/paged_attention.ragged_paged_attention`` call per layer
+("Ragged Paged Attention": per-row ``(pos0, true_len)`` metadata; a
+decode row is simply ``true_len == 1``). XLA gather spelling is the
+measured default; a Pallas ragged kernel is interpret-verified and
+gated for the real-TPU follow-up; ``attention_kernel="legacy"`` keeps
+the pre-unification two-dispatch engine for benchmarking.
 
 Quick use::
 
@@ -32,15 +37,17 @@ or, per request batch with the familiar surface::
 Profiler integration (``paddle_tpu.profiler``): gauges
 ``serving/queue_depth``, ``serving/active_slots``,
 ``serving/page_util``, ``serving/tokens_per_sec``,
-``serving/decode_batch``; counters ``serving/tokens_generated``,
+``serving/decode_batch``, ``serving/mixed_rows`` (+ ``_decode`` /
+``_prefill`` split per tick); counters ``serving/tokens_generated``,
 ``serving/prefills``, ``serving/prefill_chunks``, ``serving/ticks``,
 ``serving/preemptions``, ``serving/requests_finished``,
 ``serving/token_syncs``, ``serving/prefix_lookups``,
 ``serving/prefix_hit_tokens``, ``cache_share/*`` (refcount traffic:
 shares, releases, cow_copies, prefix_evictions); histograms
-``serving/ttft_ms``, ``serving/prefill_queue_wait_ms``. Both compiled
-sites (``serving.tick#N``, ``serving.prefill#N``) must stay at ONE
-trace each — the chunked prefill has a single shape by construction.
+``serving/ttft_ms``, ``serving/prefill_queue_wait_ms``. The ONE
+compiled hot-path site (``serving.tick#N``) must stay at ONE trace —
+``ServingEngine.compiled_sites`` + the recompile registry make any
+regression assertable (tests do).
 """
 from __future__ import annotations
 

@@ -1,4 +1,4 @@
-"""Continuous-batching decode engine over the paged KV cache.
+"""Continuous-batching serving engine over the paged KV cache.
 
 The dense ``GPT.generate`` path is one jitted prefill+scan program per
 request batch: every admitted prompt pays ``S_max`` of cache HBM,
@@ -8,21 +8,30 @@ the roadmap's cross-replica-sharding paper restructures the weight
 update — so the hardware never idles on work another request could
 fill:
 
-- **Fixed-shape decode tick.** One jitted program over ``num_slots``
-  cache slots advances every resident request by one token per call.
-  The program shape never depends on which slots are live, so it
-  traces exactly once (asserted via ``profiler.recompile`` telemetry).
-  Per-request sampling params (temperature / top-k / top-p) ride the
-  tick as ``[num_slots]`` arrays — vectorized inside the compiled
-  program, no retrace per parameter combination.
-- **Chunked prefill** (Sarathi-style). A prompt is prefilled in
-  fixed-size chunks, at most ``prefill_chunks_per_tick`` per scheduler
-  step, each attending over (aliased prefix pages + earlier chunks +
-  itself) via the suffix path ``models/gpt.gpt_paged_suffix_apply``.
-  A long prompt therefore never blocks resident decode slots for more
-  than one chunk's compute, and prefill compiles ONE chunk shape
-  (retraces collapse to a single ``serving.prefill`` trace) instead of
-  one program per length bucket.
+- **ONE unified mixed-row tick.** A single jitted program per
+  scheduler step carries EVERY token in flight as a ragged row —
+  resident decodes (one-token rows) and up to
+  ``prefill_chunks_per_tick`` prompt chunks (``prefill_chunk``-token
+  rows) execute in the same program, through one
+  ``ops/paged_attention.ragged_paged_attention`` call per layer over
+  per-row ``(pos0, true_len)`` metadata ("Ragged Paged Attention",
+  PAPERS.md). The pre-unification design's TWO dispatch sites (a
+  decode tick plus a separate suffix-prefill program alternating on
+  the hot path) collapse to one; the program shape never depends on
+  the prefill/decode mix, so it traces exactly once (asserted via
+  ``profiler.recompile`` telemetry). Per-request sampling params ride
+  as ``[num_slots]`` arrays — no retrace per parameter combination.
+  ``attention_kernel="legacy"`` keeps the old two-dispatch engine as
+  an explicit benchmarking fallback (`serve_bench.py
+  --attention-kernel`); its math routes through the same shared
+  attention helper, so outputs stay bitwise-equal across modes.
+- **Chunked prefill** (Sarathi-style piggybacking). A prompt is
+  prefilled in fixed-size chunks riding the unified tick, at most
+  ``prefill_chunks_per_tick`` per scheduler step, each attending over
+  (aliased prefix pages + earlier chunks + itself). A long prompt
+  therefore never blocks resident decode slots for more than one
+  chunk's compute, and chunks add ZERO extra dispatches or compiled
+  programs.
 - **Prefix caching.** Fully-written prompt pages are registered in a
   hash-trie index (``paged_cache.PrefixCache``) keyed on page-aligned
   token chunks. Admission looks up the longest cached prefix, aliases
@@ -35,11 +44,10 @@ fill:
   own work instead of re-prefilling it.
 - **Deferred host sync** (the PR-3 async-pipeline idiom): each tick's
   token vector stays an unmaterialized device array; the host
-  dispatches tick N+1 (and prefill chunks, via donated pool buffers)
-  before materializing tick N, keeping up to ``max_inflight`` ticks in
-  flight. Scheduling that must be host-deterministic (positions, page
-  growth, max-token stops) never reads device data; only EOS discovery
-  rides the lagged window.
+  dispatches tick N+1 before materializing tick N, keeping up to
+  ``max_inflight`` ticks in flight. Scheduling that must be
+  host-deterministic (positions, page growth, max-token stops) never
+  reads device data; only EOS discovery rides the lagged window.
 - **Exhaustion → eviction → preemption.** If the pool cannot grow a
   slot, the engine evicts unreferenced cached pages, drains, retries,
   then preempts the youngest request: its generated prefix is requeued
@@ -51,11 +59,14 @@ Greedy paged decode is **bitwise identical** to the dense
 ``generate()`` on the same weights whenever the slot capacity
 ``pages_per_slot * page_size`` equals the dense path's
 ``prompt + max_new_tokens`` (the attention reduction length must match
-exactly — zero-tail padding is not bitwise-neutral). Prefix caching
-preserves this bitwise: aliased pages hold KV that is identical by
-construction (same tokens, same positions, same reduction lengths), so
-the cached engine, the uncached engine and the dense path all agree —
-tests/test_serving.py pins cached-vs-uncached across admission orders.
+exactly — zero-tail padding is not bitwise-neutral). The unified tick
+preserves this: per-token results are independent of which other rows
+share the program (see ``gpt_ragged_apply``'s contract), and prefix
+caching preserves it too (aliased pages hold KV that is identical by
+construction), so the cached engine, the uncached engine, the legacy
+two-dispatch engine and the dense path all agree —
+tests/test_serving.py pins cached-vs-uncached-vs-legacy across
+admission orders.
 
 Profiler signals: ``serving/queue_depth``, ``serving/active_slots``,
 ``serving/page_util``, ``serving/ttft_ms`` (histogram),
@@ -64,8 +75,11 @@ chunk), ``serving/tokens_per_sec``, ``serving/tokens_generated``,
 ``serving/prefills``, ``serving/prefill_chunks``, ``serving/ticks``,
 ``serving/preemptions``, ``serving/requests_finished``,
 ``serving/token_syncs``, ``serving/prefix_lookups``,
-``serving/prefix_hit_tokens``; refcount traffic under ``cache_share/*``
-(shares, releases, cow_copies, prefix_evictions).
+``serving/prefix_hit_tokens``, ``serving/mixed_rows`` (+ the
+``_decode``/``_prefill`` split: rows of each kind in the last unified
+tick — a dispatch-site regression shows up here and in the
+``serving.tick`` single-trace assertion); refcount traffic under
+``cache_share/*`` (shares, releases, cow_copies, prefix_evictions).
 """
 from __future__ import annotations
 
@@ -74,7 +88,7 @@ import warnings
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -86,6 +100,14 @@ from ..profiler import registry as _registry
 from .paged_cache import PagePool
 
 __all__ = ["ServingConfig", "ServingEngine", "Request"]
+
+#: attention_kernel values: the unified mixed-row tick on the XLA
+#: gather spelling (measured default), the unified tick on the Pallas
+#: ragged kernel (interpret-verified; real-TPU measurement pending per
+#: the int8_matmul precedent), and the pre-unification two-dispatch
+#: engine (decode tick + separate prefill program) kept for
+#: benchmarking the dispatch collapse.
+ATTENTION_KERNELS = ("ragged-xla", "ragged-pallas", "legacy")
 
 
 @contextmanager
@@ -119,7 +141,7 @@ class ServingConfig:
     pages_per_slot: int = 0          # default: ceil(max_seq_len / page_size)
     num_pages: int = 0               # default: full residency + null page
     prefill_chunk: int = 0           # tokens per prefill chunk (0: 2 pages)
-    prefill_chunks_per_tick: int = 1  # prefill work budget per step
+    prefill_chunks_per_tick: int = 1  # prefill rows per unified tick
     prefix_cache: bool = True        # share prompt-prefix pages
     max_inflight: int = 2            # unmaterialized decode ticks in flight
     decode: str = "greedy"           # 'greedy' | 'sampling'
@@ -128,7 +150,8 @@ class ServingConfig:
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
-    attention_impl: str = "xla"      # 'xla' | 'pallas' (ops/paged_attention)
+    attention_kernel: str = "ragged-xla"   # see ATTENTION_KERNELS
+    attention_impl: Optional[str] = None   # deprecated alias: 'xla'|'pallas'
 
 
 @dataclass
@@ -155,6 +178,10 @@ class _Inflight:
         self.meta = meta             # [(index_into_tok, slot, rid)]
 
 
+#: one selected-but-not-yet-dispatched prompt chunk of the unified tick
+_Chunk = Tuple[int, int, int, int, int]   # (slot, rid, start, end, t0)
+
+
 def _copy_pages(kpool, vpool, src, dst):
     """Copy-on-write: duplicate page ``src`` into ``dst`` across all
     layers (one compiled program, pools donated)."""
@@ -179,6 +206,26 @@ class ServingEngine:
             raise ValueError(f"unknown decode mode {cfg.decode!r}")
         if cfg.prefill_chunks_per_tick < 1:
             raise ValueError("prefill_chunks_per_tick must be >= 1")
+        kernel = cfg.attention_kernel
+        if cfg.attention_impl is not None:
+            if kernel != "ragged-xla":
+                raise ValueError(
+                    "attention_impl (deprecated) and attention_kernel "
+                    "are both set — drop attention_impl")
+            # pre-unification spelling: impl named only the attention
+            # implementation, the dispatch structure was fixed
+            kernel = {"xla": "ragged-xla",
+                      "pallas": "ragged-pallas"}.get(cfg.attention_impl)
+            if kernel is None:
+                raise ValueError(
+                    f"unknown attention impl {cfg.attention_impl!r}")
+        if kernel not in ATTENTION_KERNELS:
+            raise ValueError(
+                f"unknown attention kernel {kernel!r}; expected one of "
+                f"{ATTENTION_KERNELS}")
+        self._legacy = kernel == "legacy"
+        self._impl = "pallas" if kernel.endswith("pallas") else "xla"
+        self.attention_kernel = kernel
         self.config = cfg
         self.model_config = mcfg
         self._stacked, self._other = model._decode_state()
@@ -217,15 +264,32 @@ class ServingEngine:
         self._topks = np.full(b_slots, cfg.top_k, np.int32)
         self._topps = np.full(b_slots, cfg.top_p, np.float32)
         self._base_key = np.asarray(jax.random.PRNGKey(cfg.seed))
-        # compiled programs: ONE tick site (asserted single-trace) and ONE
-        # prefill-chunk site — chunked prefill has a single shape, so it
-        # also traces exactly once (the per-bucket retraces are gone)
+        # compiled programs. Unified (default): ONE mixed-row tick site
+        # serving decodes AND prefill chunks, asserted single-trace.
+        # Legacy: the pre-unification pair (decode tick + suffix-prefill
+        # chunk program), kept for the dispatch-collapse benchmark.
         self._tick_site = _recompile.unique_site("serving.tick")
-        self._prefill_site = _recompile.unique_site("serving.prefill")
-        self._tick = jax.jit(self._make_tick(), donate_argnums=(2, 3))
-        self._prefill = jax.jit(self._make_prefill_chunk(),
-                                donate_argnums=(2, 3))
+        if self._legacy:
+            self._prefill_site = _recompile.unique_site("serving.prefill")
+            self._tick = jax.jit(self._make_legacy_tick(),
+                                 donate_argnums=(2, 3))
+            self._prefill = jax.jit(self._make_prefill_chunk(),
+                                    donate_argnums=(2, 3))
+        else:
+            self._tick = jax.jit(self._make_unified_tick(),
+                                 donate_argnums=(2, 3))
         self._copy = jax.jit(_copy_pages, donate_argnums=(0, 1))
+
+    @property
+    def compiled_sites(self) -> Tuple[str, ...]:
+        """Recompile-telemetry site names of this engine's hot-path
+        dispatch programs — the unified engine has exactly ONE (the
+        mixed-row tick); only the legacy mode has a second (prefill).
+        Tests assert this, so silently re-growing a dispatch site is a
+        visible regression."""
+        if self._legacy:
+            return (self._tick_site, self._prefill_site)
+        return (self._tick_site,)
 
     # ------------------------------------------------------------------
     # public API
@@ -266,15 +330,21 @@ class ServingEngine:
 
     def step(self) -> bool:
         """One scheduler iteration: bound the in-flight window, admit
-        into free slots, advance prefill by up to
-        ``prefill_chunks_per_tick`` chunks, grow pages (preempting on
-        exhaustion), dispatch one decode tick. Returns whether any
-        device work was dispatched."""
+        into free slots, select up to ``prefill_chunks_per_tick``
+        prompt chunks, grow pages (preempting on exhaustion), dispatch
+        ONE unified tick carrying the selected chunks plus every
+        resident decode (legacy mode: the old chunk-then-tick dispatch
+        pair). Returns whether any device work was dispatched."""
         self._drain(self.config.max_inflight)
         self._admit()
-        dispatched = self._prefill_chunks()
-        self._grow_pages()
-        dispatched = self._dispatch_tick() or dispatched
+        if self._legacy:
+            dispatched = self._prefill_chunks()
+            self._grow_pages()
+            dispatched = self._dispatch_legacy_tick() or dispatched
+        else:
+            chunks = self._collect_chunks()
+            self._grow_pages()
+            dispatched = self._dispatch_unified(chunks)
         reg = _registry()
         reg.gauge("serving/queue_depth").set(float(len(self._queue)))
         reg.gauge("serving/active_slots").set(
@@ -411,25 +481,21 @@ class ServingEngine:
             self._topps[slot] = c.top_p if req.top_p is None else req.top_p
 
     # ------------------------------------------------------------------
-    # chunked prefill + prefix cache
+    # chunk selection + prefix cache (shared by both engine modes)
     # ------------------------------------------------------------------
-    def _prefill_chunks(self) -> bool:
-        """Advance prefilling slots by up to ``prefill_chunks_per_tick``
-        chunks, oldest admission first (completing one request's
-        prefill start-to-finish both minimizes its TTFT and publishes
-        its pages before the next identical prompt looks them up)."""
-        any_dispatch = False
-        for _ in range(self.config.prefill_chunks_per_tick):
-            pending = [s for s, rid in enumerate(self._slot_rid)
-                       if rid is not None
-                       and self._slot_len[s] < self._slot_prompt[s]]
-            if not pending:
-                break
-            s = min(pending, key=lambda x: self._slot_admit_seq[x])
-            if not self._advance_prefill(s):
-                break
-            any_dispatch = True
-        return any_dispatch
+    def _next_prefill_slot(self, pend: Dict[int, int]) -> Optional[int]:
+        """Oldest-admitted slot with prompt tokens still unscheduled
+        (completing one request's prefill start-to-finish both
+        minimizes its TTFT and publishes its pages before the next
+        identical prompt looks them up). ``pend`` overlays chunk ends
+        selected earlier in the same tick."""
+        pending = [s for s, rid in enumerate(self._slot_rid)
+                   if rid is not None
+                   and pend.get(s, int(self._slot_len[s]))
+                   < self._slot_prompt[s]]
+        if not pending:
+            return None
+        return min(pending, key=lambda x: self._slot_admit_seq[x])
 
     def _lookup_prefix(self, slot: int, req: Request) -> None:
         """Alias the longest cached page-aligned prefix of the prompt
@@ -466,25 +532,47 @@ class ServingEngine:
         if hit:
             _registry().counter("serving/prefix_hit_tokens").add(hit)
 
-    def _advance_prefill(self, s: int) -> bool:
-        """Dispatch one prefill chunk for slot ``s`` (running the prefix
-        lookup first if this is the slot's first chunk). Returns whether
-        a chunk was dispatched; raises when the pool cannot cover the
-        chunk even after draining, prefix eviction and preemption."""
-        req = self._requests[self._slot_rid[s]]
+    def _open_chunk(self, s: int,
+                    pend: Dict[int, int]) -> Optional[_Chunk]:
+        """Run the slot's first-chunk prefix lookup if due, then size
+        the next prompt chunk and acquire its pages. Returns the chunk
+        descriptor, or None when the slot was freed along the way
+        (finished in the drain, or became its own preemption victim)."""
+        rid = self._slot_rid[s]
+        req = self._requests[rid]
         if not self._slot_looked_up[s]:
             self._slot_looked_up[s] = True
             _registry().histogram("serving/prefill_queue_wait_ms").observe(
                 (time.perf_counter() - req.submit_t) * 1000.0)
             self._lookup_prefix(s, req)
         t0 = int(self._slot_prompt[s])
-        start = int(self._slot_len[s])
+        start = pend.get(s, int(self._slot_len[s]))
         end = min(start + self.prefill_chunk, t0)
         need = self.pool.pages_for(end) - self.pool.slot_pages(s)
         if not self._acquire_pages(s, need):
-            return False             # finished in the drain / requeued
-        self._dispatch_prefill_chunk(s, req, start, end, t0)
-        return True
+            return None
+        return (s, rid, start, end, t0)
+
+    def _collect_chunks(self) -> List[_Chunk]:
+        """Select up to ``prefill_chunks_per_tick`` prompt chunks and
+        acquire their pages WITHOUT dispatching — the unified tick
+        carries them as prefill rows. ``_slot_len`` commits only at
+        dispatch: page acquisition can preempt a slot whose chunk was
+        already selected (the chunk is then dropped), and publishing a
+        frontier the dropped chunk never wrote would poison the prefix
+        index."""
+        chunks: List[_Chunk] = []
+        pend: Dict[int, int] = {}
+        for _ in range(self.config.prefill_chunks_per_tick):
+            s = self._next_prefill_slot(pend)
+            if s is None:
+                break
+            chunk = self._open_chunk(s, pend)
+            if chunk is None:
+                break
+            pend[s] = chunk[3]
+            chunks.append(chunk)
+        return chunks          # _dispatch_unified drops stale entries
 
     def _acquire_pages(self, s: int, need: int) -> bool:
         """Grow slot ``s`` by ``need`` pages, escalating: free list
@@ -510,33 +598,6 @@ class ServingEngine:
                 "co-resident to preempt")
         self._preempt_for(s, need)
         return self._slot_rid[s] is not None
-
-    def _dispatch_prefill_chunk(self, s: int, req: Request, start: int,
-                                end: int, t0: int) -> None:
-        chunk = self.prefill_chunk
-        toks = np.zeros((1, chunk), np.int32)
-        toks[0, :end - start] = req.prompt[start:end]
-        page_row = np.ascontiguousarray(self.pool.tables[s])
-        with _quiet_donation():
-            self.pool.k, self.pool.v, tok0 = self._prefill(
-                self._stacked, self._other, self.pool.k, self.pool.v,
-                toks, np.int32(start), np.int32(t0), page_row, req.key,
-                self._temps[s:s + 1], self._topks[s:s + 1],
-                self._topps[s:s + 1])
-        _registry().counter("serving/prefill_chunks").add(1)
-        if end >= t0:                # final chunk: tok0 is real
-            self._last_tok = self._last_tok.at[s].set(tok0[0])
-            self._inflight.append(_Inflight(tok0, [(0, s, req.rid)]))
-            self.max_inflight_seen = max(self.max_inflight_seen,
-                                         len(self._inflight))
-            self._slot_dispatched[s] = 1
-            self._slot_len[s] = t0
-            _registry().counter("serving/prefills").add(1)
-        else:
-            self._slot_len[s] = end
-        # publish the pages this chunk completed (progressively: a long
-        # shared prompt becomes hittable page-by-page, mid-prefill)
-        self._insert_prefix(s, req.prompt, int(self._slot_len[s]))
 
     # ------------------------------------------------------------------
     # decode scheduling
@@ -591,7 +652,218 @@ class ServingEngine:
             if not self.pool.grow_slot(needy_slot, need):
                 self._preempt_for(needy_slot, need)
 
-    def _dispatch_tick(self) -> bool:
+    # ------------------------------------------------------------------
+    # unified dispatch: ONE program per scheduler step
+    # ------------------------------------------------------------------
+    def _dispatch_unified(self, chunks: List[_Chunk]) -> bool:
+        """Assemble and dispatch the mixed-row tick: one decode row per
+        slot (inactive slots write to the null page through their
+        zeroed table rows, exactly like the pre-unification tick) plus
+        one ``prefill_chunk``-token row block per selected chunk. A
+        chunk whose slot lost its request between selection and here
+        (decode growth preempted it) is dropped — its acquired pages
+        were already released with the slot."""
+        chunks = [c for c in chunks if self._slot_rid[c[0]] == c[1]]
+        ticking = self._ticking_slots()
+        if not ticking and not chunks:
+            return False
+        ns = self.config.num_slots
+        w = self.prefill_chunk
+        npf = self.config.prefill_chunks_per_tick
+        nps = self.pool.pages_per_slot
+        cap = self.pool.slot_capacity
+        nt = ns + npf * w
+        pf_toks = np.zeros(npf * w, np.int32)
+        tok_pos = np.zeros(nt, np.int32)
+        tok_limit = np.zeros(nt, np.int32)   # pad rows: limit 0 -> null page
+        tok_pos[:ns] = self._slot_len
+        tok_limit[:ns] = cap
+        # ragged row metadata: ns decode rows, then npf chunk rows (pad
+        # chunk rows keep an all-null table and attend one masked key)
+        row_tab = np.zeros((ns + npf, nps), np.int32)
+        row_tab[:ns] = self.pool.tables
+        row_pos0 = np.zeros(ns + npf, np.int32)
+        row_pos0[:ns] = self._slot_len
+        row_len = np.ones(ns + npf, np.int32)
+        sample_ix = np.zeros(ns, np.int32)
+        sample_pos = np.zeros(ns, np.int32)
+        emit = np.zeros(ns, bool)
+        for s in ticking:
+            sample_ix[s] = s
+            sample_pos[s] = self._slot_len[s] + 1
+            emit[s] = True
+        finishers = []
+        for c, (s, rid, start, end, t0) in enumerate(chunks):
+            base = ns + c * w
+            req = self._requests[rid]
+            pf_toks[c * w:c * w + (end - start)] = req.prompt[start:end]
+            tok_pos[base:base + w] = start + np.arange(w)
+            tok_limit[base:base + w] = t0
+            row_tab[ns + c] = self.pool.tables[s]
+            row_pos0[ns + c] = start
+            row_len[ns + c] = end - start
+            # the slot's decode row must sit at the post-chunk frontier
+            # (it garbage-writes there, overwritten by the next real
+            # token — never at a position this tick's chunk covers)
+            tok_pos[s] = end
+            row_pos0[s] = end
+            if end >= t0:
+                finishers.append((s, rid))
+                sample_ix[s] = base + (t0 - 1 - start)
+                sample_pos[s] = t0
+                emit[s] = True
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok, self._last_tok = self._tick(
+                self._stacked, self._other, self.pool.k, self.pool.v,
+                self._last_tok, pf_toks, tok_pos, tok_limit, row_tab,
+                row_pos0, row_len, sample_ix, sample_pos, emit,
+                np.bool_(len(chunks) > 0),
+                np.ascontiguousarray(self._keys),
+                np.ascontiguousarray(self._temps),
+                np.ascontiguousarray(self._topks),
+                np.ascontiguousarray(self._topps))
+        meta = [(s, s, self._slot_rid[s]) for s in ticking]
+        meta += [(s, s, rid) for s, rid in finishers]
+        if meta:
+            # chunk-only ticks (no decodes, no finishers) emit nothing
+            # worth syncing — queueing them would stall the host on a
+            # token vector nobody reads once the window fills
+            self._inflight.append(_Inflight(tok, meta))
+        self.max_inflight_seen = max(self.max_inflight_seen,
+                                     len(self._inflight))
+        for s in ticking:
+            self._slot_len[s] += 1
+            self._slot_dispatched[s] += 1
+        for s, rid, start, end, t0 in chunks:
+            self._slot_len[s] = end
+            if end >= t0:
+                self._slot_dispatched[s] = 1
+                _registry().counter("serving/prefills").add(1)
+            # publish the pages this chunk completed (progressively: a
+            # long shared prompt becomes hittable page-by-page)
+            self._insert_prefix(s, self._requests[rid].prompt, end)
+        reg = _registry()
+        reg.counter("serving/ticks").add(1)
+        if chunks:
+            reg.counter("serving/prefill_chunks").add(len(chunks))
+        reg.gauge("serving/decode_batch").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows").set(float(len(ticking)
+                                                  + len(chunks)))
+        reg.gauge("serving/mixed_rows_decode").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows_prefill").set(float(len(chunks)))
+        return True
+
+    def _make_unified_tick(self):
+        """The ONE compiled hot-path program: every resident decode and
+        every selected prefill chunk of a scheduler step, as ragged
+        rows of a single ``gpt_ragged_apply`` forward. All metadata is
+        fixed-shape (pad prefill rows ride with limit 0), so the
+        program traces exactly once across any prefill/decode mix,
+        admission order, or per-request sampling params. Decode token
+        values come from the DEVICE-side ``last_tok`` (the deferred
+        sync never materializes them on the host); the final chunk of
+        a prompt emits its slot's first token via ``sample_ix``, and
+        ``emit`` folds emitted tokens back into ``last_tok`` for the
+        next tick."""
+        mcfg = self.model_config
+        site = self._tick_site
+        impl = self._impl
+        ns = self.config.num_slots
+        w = self.prefill_chunk
+
+        from ..models.gpt import gpt_ragged_apply
+
+        def tick(stacked, other, kpool, vpool, last_tok, pf_toks,
+                 tok_pos, tok_limit, row_tab, row_pos0, row_len,
+                 sample_ix, sample_pos, emit, has_chunks, keys, temps,
+                 top_ks, top_ps):
+            _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                  last_tok)
+            tokens = jnp.concatenate([last_tok, pf_toks])
+
+            # ONE program, data-dependent prefill piggyback: both
+            # branches trace into this single executable (the site
+            # still traces exactly once); at runtime a decode-only
+            # tick takes the ns-token branch, so the prefill-row
+            # capacity costs nothing while nothing is prefilling —
+            # a fixed-shape program otherwise pays its worst-case mix
+            # every tick, which on the XLA path is real FLOPs, not
+            # skipped blocks.
+            def mixed(kpool, vpool):
+                return gpt_ragged_apply(
+                    mcfg, stacked, other, kpool, vpool, tokens,
+                    tok_pos, tok_limit, row_tab, row_pos0, row_len,
+                    sample_ix, decode_rows=ns, chunk_width=w,
+                    impl=impl)
+
+            def decode_only(kpool, vpool):
+                return gpt_ragged_apply(
+                    mcfg, stacked, other, kpool, vpool, tokens[:ns],
+                    tok_pos[:ns], tok_limit[:ns], row_tab[:ns],
+                    row_pos0[:ns], row_len[:ns], sample_ix,
+                    decode_rows=ns, chunk_width=w, impl=impl)
+
+            logits, kpool, vpool = jax.lax.cond(
+                has_chunks, mixed, decode_only, kpool, vpool)
+            nxt = self._sample_tok(logits, keys, sample_pos, temps,
+                                   top_ks, top_ps)
+            new_last = jnp.where(emit, nxt, last_tok)
+            return kpool, vpool, nxt, new_last
+
+        return tick
+
+    # ------------------------------------------------------------------
+    # legacy two-dispatch mode (attention_kernel="legacy"): the
+    # pre-unification engine — a dedicated decode tick plus a separate
+    # suffix-prefill program alternating on the hot path. Kept ONLY so
+    # serve_bench.py can measure what the dispatch collapse buys;
+    # outputs are bitwise-equal to the unified tick (same shared
+    # attention spelling underneath).
+    # ------------------------------------------------------------------
+    def _prefill_chunks(self) -> bool:
+        """Advance prefilling slots by up to ``prefill_chunks_per_tick``
+        immediately-dispatched chunks, oldest admission first."""
+        any_dispatch = False
+        for _ in range(self.config.prefill_chunks_per_tick):
+            s = self._next_prefill_slot({})
+            if s is None:
+                break
+            chunk = self._open_chunk(s, {})
+            if chunk is None:
+                break
+            self._dispatch_prefill_chunk(*chunk)
+            any_dispatch = True
+        return any_dispatch
+
+    def _dispatch_prefill_chunk(self, s: int, rid: int, start: int,
+                                end: int, t0: int) -> None:
+        req = self._requests[rid]
+        chunk = self.prefill_chunk
+        toks = np.zeros((1, chunk), np.int32)
+        toks[0, :end - start] = req.prompt[start:end]
+        page_row = np.ascontiguousarray(self.pool.tables[s])
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok0 = self._prefill(
+                self._stacked, self._other, self.pool.k, self.pool.v,
+                toks, np.int32(start), np.int32(t0), page_row, req.key,
+                self._temps[s:s + 1], self._topks[s:s + 1],
+                self._topps[s:s + 1])
+        _registry().counter("serving/prefill_chunks").add(1)
+        if end >= t0:                # final chunk: tok0 is real
+            self._last_tok = self._last_tok.at[s].set(tok0[0])
+            self._inflight.append(_Inflight(tok0, [(0, s, req.rid)]))
+            self.max_inflight_seen = max(self.max_inflight_seen,
+                                         len(self._inflight))
+            self._slot_dispatched[s] = 1
+            self._slot_len[s] = t0
+            _registry().counter("serving/prefills").add(1)
+        else:
+            self._slot_len[s] = end
+        # publish the pages this chunk completed (progressively: a long
+        # shared prompt becomes hittable page-by-page, mid-prefill)
+        self._insert_prefix(s, req.prompt, int(self._slot_len[s]))
+
+    def _dispatch_legacy_tick(self) -> bool:
         ticking = self._ticking_slots()
         if not ticking:
             return False
@@ -614,11 +886,15 @@ class ServingEngine:
             self._slot_len[s] += 1
             self._slot_dispatched[s] += 1
         _registry().counter("serving/ticks").add(1)
-        _registry().gauge("serving/decode_batch").set(float(len(ticking)))
+        reg = _registry()
+        reg.gauge("serving/decode_batch").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows_decode").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows_prefill").set(0.0)
         return True
 
     # ------------------------------------------------------------------
-    # compiled programs
+    # compiled program bodies
     # ------------------------------------------------------------------
     def _sample_tok(self, logits, keys, positions, temps, top_ks, top_ps):
         """Token choice from last-token logits [N, V]. Greedy mirrors
@@ -642,14 +918,14 @@ class ServingEngine:
 
         return jax.vmap(one)(keys, positions, lp).astype(jnp.int32)
 
-    def _make_tick(self):
+    def _make_legacy_tick(self):
         mcfg = self.model_config
         ps = self.pool.page_size
         nh = mcfg.num_heads
         hd = mcfg.hidden_size // nh
         eps = mcfg.layer_norm_eps
         nslots = self.config.num_slots
-        impl = self.config.attention_impl
+        impl = self._impl
         site = self._tick_site
 
         from ..models.gpt import _ln, gpt_block_body
@@ -704,14 +980,11 @@ class ServingEngine:
         return tick
 
     def _make_prefill_chunk(self):
-        """One fixed-shape suffix-prefill program: process a
-        ``prefill_chunk``-token slice of one slot's prompt through
-        ``gpt_paged_suffix_apply`` (KV scattered straight into the
-        slot's pages; attention reads aliased prefix pages + the
-        chunk). The chunk start / true prompt length ride as traced
-        scalars, so EVERY chunk of EVERY prompt shares this one
-        compiled program — the per-bucket prefill retraces of the
-        whole-prompt design collapse to a single trace. The sampled
+        """Legacy mode's second compiled program: one fixed-shape
+        suffix-prefill over ``gpt_paged_suffix_apply`` (itself now a
+        delegation into the unified ragged forward). The chunk start /
+        true prompt length ride as traced scalars, so every chunk of
+        every prompt shares this one compiled program. The sampled
         token is only meaningful on the final chunk (the host ignores
         it otherwise)."""
         mcfg = self.model_config

@@ -167,9 +167,11 @@ class TestPagedParity:
         """Five mixed-length requests through TWO slots: continuous
         admission, slot reuse, chunked prefill at both lengths — every
         output bitwise equal to its own dense generate(). Also pins the
-        retrace telemetry: the decode tick traces ONCE, and chunked
-        prefill has ONE shape so it traces once too (the per-bucket
-        retraces of the old design are gone)."""
+        dispatch-site contract of the unified engine: ONE compiled
+        hot-path program (the mixed-row tick) that traces exactly ONCE
+        — there is no separate ``serving.prefill`` program anymore, and
+        any regression re-growing a dispatch site or retracing the tick
+        fails here."""
         import paddle_tpu.profiler as profiler
         from paddle_tpu.profiler import recompile
 
@@ -189,10 +191,8 @@ class TestPagedParity:
             assert len(set(want.tolist())) >= 4   # varied => real signal
             np.testing.assert_array_equal(out[rid], want)
         counts = recompile.trace_counts()
-        tick = [k for k in counts if k.startswith("serving.tick")]
-        pre = [k for k in counts if k.startswith("serving.prefill")]
-        assert counts[tick[0]] == 1              # fixed-shape: ONE trace
-        assert counts[pre[0]] == 1               # ONE chunk shape
+        assert eng.compiled_sites == (eng._tick_site,)   # ONE site
+        assert counts[eng._tick_site] == 1               # ONE trace
         retraces = [r for r in recompile.retraces()
                     if r["site"].startswith("serving.")]
         assert not retraces
@@ -386,6 +386,7 @@ class TestPrefixCaching:
         r_long = eng.submit(long, 8)
         eng.step()                         # admit long + first chunk
         interleaved = 0
+        mixed_ticks = 0
         while int(eng._slot_len[[s for s, r in enumerate(eng._slot_rid)
                                  if r == r_long][0]]) < 40:
             before = int(eng._slot_dispatched[
@@ -396,7 +397,14 @@ class TestPrefixCaching:
                 [s for s, r in enumerate(eng._slot_rid)
                  if r == r_short][0]])
             interleaved += after - before
+            # the unified tick carried BOTH kinds of rows in one
+            # program: the mixed-row gauges are the direct evidence
+            if registry().gauge("serving/mixed_rows_prefill").value and \
+                    registry().gauge("serving/mixed_rows_decode").value:
+                mixed_ticks += 1
         assert interleaved >= 3            # decode advanced per chunk
+        assert mixed_ticks >= 3            # decode+prefill in ONE tick
+        assert registry().gauge("serving/mixed_rows").value >= 1
         assert registry().counter("serving/prefill_chunks").value \
             - chunks0 == 5                 # 40 tokens / 8-token chunks
         out = eng.run()
@@ -537,6 +545,167 @@ class TestPagedAttentionKernel:
         with pytest.raises(ValueError):
             paged_decode_attention(None, None, None, None, None,
                                    impl="cuda")
+
+
+class TestRaggedAttention:
+    """ops/paged_attention.ragged_paged_attention — the ONE attention
+    entry point over per-row (pos0, true_len) metadata that serves
+    decode rows (true_len == 1) and prefill-chunk rows in the same
+    call (and, on the Pallas path, the same grid)."""
+
+    def _pools(self, seed=0, pages=9, ps=8, nh=4, hd=16):
+        r = np.random.RandomState(seed)
+        k = jnp.asarray(r.randn(pages, ps, nh, hd).astype(np.float32))
+        v = jnp.asarray(r.randn(pages, ps, nh, hd).astype(np.float32))
+        return r, k, v
+
+    def test_ragged_rows_match_legacy_spellings_bitwise(self):
+        """A decode call IS a ragged call with true_len == 1 rows; a
+        chunk call IS a ragged call with chunk-width rows — all three
+        entry points route through the one shared gather/mask/softmax
+        helper, so the equality must be bitwise (this is what the
+        engine's greedy parity contract rests on)."""
+        from paddle_tpu.ops.paged_attention import (
+            paged_decode_attention, paged_prefill_attention,
+            ragged_paged_attention)
+
+        r, kpool, vpool = self._pools()
+        tab = jnp.asarray(r.randint(1, 9, (3, 4)).astype(np.int32))
+        pos = jnp.asarray(np.array([5, 17, 31], np.int32))
+        q1 = jnp.asarray(r.randn(3, 1, 4, 16).astype(np.float32))
+        dec = paged_decode_attention(q1, kpool, vpool, tab, pos)
+        rag = ragged_paged_attention(q1, kpool, vpool, tab, pos,
+                                     jnp.ones((3,), jnp.int32))
+        np.testing.assert_array_equal(np.asarray(dec), np.asarray(rag))
+        qc = jnp.asarray(r.randn(2, 8, 4, 16).astype(np.float32))
+        tabc = tab[:2]
+        pre = paged_prefill_attention(qc, kpool, vpool, tabc,
+                                      jnp.int32(9))
+        ragc = ragged_paged_attention(
+            qc, kpool, vpool, tabc, jnp.full((2,), 9, jnp.int32),
+            jnp.full((2,), 8, jnp.int32))
+        np.testing.assert_array_equal(np.asarray(pre), np.asarray(ragc))
+
+    def test_pallas_matches_xla_mixed_rows(self):
+        """Interpret-mode Pallas vs XLA allclose over one metadata
+        matrix mixing every serving row kind: decode rows at position
+        0 / mid-page / page boundary / exact slot capacity, rows whose
+        tables hold NULL pages (partially-grown slots), rows ALIASING
+        the same physical pages (prefix sharing + COW donors), and the
+        null-page-routed write target of the exact-capacity regression
+        (pos == cap reads only masked garbage)."""
+        from paddle_tpu.ops.paged_attention import ragged_paged_attention
+
+        r, kpool, vpool = self._pools(seed=3)
+        tab = jnp.asarray(np.array([
+            [3, 0, 0, 0],      # one-page slot: three null entries
+            [3, 5, 0, 0],      # aliases row 0's page (prefix share)
+            [3, 5, 7, 2],      # fully grown, same prefix chain
+            [8, 0, 0, 0],      # COW'd divergent tail page
+        ], np.int32))
+        pos0 = jnp.asarray(np.array([0, 9, 31, 7], np.int32))
+        tl = jnp.ones((4,), jnp.int32)
+        q = jnp.asarray(r.randn(4, 1, 4, 16).astype(np.float32))
+        ref = ragged_paged_attention(q, kpool, vpool, tab, pos0, tl)
+        ker = ragged_paged_attention(q, kpool, vpool, tab, pos0, tl,
+                                     impl="pallas")
+        np.testing.assert_allclose(np.asarray(ker), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_pallas_matches_xla_ragged_chunk_rows(self):
+        """Chunk-width rows with RAGGED true_len: the kernel skips
+        fully-masked page blocks per row (its block-skip predicate is
+        pos0 + true_len - 1), so only the real queries — i < true_len —
+        are comparable; pad queries are explicitly garbage on both
+        paths."""
+        from paddle_tpu.ops.paged_attention import ragged_paged_attention
+
+        r, kpool, vpool = self._pools(seed=5)
+        tab = jnp.asarray(np.array([[3, 5, 7, 2],
+                                    [3, 5, 0, 0],
+                                    [6, 1, 4, 0]], np.int32))
+        pos0 = jnp.asarray(np.array([8, 8, 0], np.int32))
+        tl = jnp.asarray(np.array([8, 5, 1], np.int32))   # ragged
+        q = jnp.asarray(r.randn(3, 8, 4, 16).astype(np.float32))
+        ref = np.asarray(ragged_paged_attention(
+            q, kpool, vpool, tab, pos0, tl))
+        ker = np.asarray(ragged_paged_attention(
+            q, kpool, vpool, tab, pos0, tl, impl="pallas"))
+        for row, n in enumerate(np.asarray(tl)):
+            np.testing.assert_allclose(ker[row, :n], ref[row, :n],
+                                       rtol=2e-5, atol=2e-5)
+
+
+class TestUnifiedVsLegacy:
+    def test_legacy_two_dispatch_matches_unified_bitwise(self):
+        """attention_kernel='legacy' keeps the pre-unification engine
+        (decode tick + separate prefill program) for the dispatch-
+        collapse benchmark. Outputs must stay bitwise-equal to the
+        unified engine — the math is the same shared helper, only the
+        dispatch structure differs: ONE site (traced once) unified,
+        TWO sites legacy."""
+        from paddle_tpu.profiler import recompile
+
+        net = _net()
+        cfgkw = dict(num_slots=2, page_size=8, pages_per_slot=4,
+                     prefill_chunk=8)
+        rng = np.random.RandomState(21)
+        prompts = [rng.randint(0, 128, (t,)).astype(np.int32)
+                   for t in (8, 16, 12)]
+        uni = ServingEngine(net, ServingConfig(**cfgkw))
+        leg = ServingEngine(net, ServingConfig(
+            attention_kernel="legacy", **cfgkw))
+        u_rids = [uni.submit(p, 8) for p in prompts]
+        l_rids = [leg.submit(p, 8) for p in prompts]
+        u_out, l_out = uni.run(), leg.run()
+        for ur, lr in zip(u_rids, l_rids):
+            np.testing.assert_array_equal(u_out[ur], l_out[lr])
+        assert len(uni.compiled_sites) == 1
+        assert len(leg.compiled_sites) == 2
+        counts = recompile.trace_counts()
+        assert all(counts[site] == 1 for site in uni.compiled_sites)
+        assert all(counts[site] == 1 for site in leg.compiled_sites)
+
+    def test_kernel_selection_and_deprecated_alias(self):
+        net = _net()
+        cfgkw = dict(num_slots=1, page_size=8, pages_per_slot=2)
+        eng = ServingEngine(net, ServingConfig(
+            attention_impl="pallas", **cfgkw))
+        assert eng.attention_kernel == "ragged-pallas"
+        assert ServingEngine(net, ServingConfig(
+            **cfgkw)).attention_kernel == "ragged-xla"
+        with pytest.raises(ValueError):
+            ServingEngine(net, ServingConfig(
+                attention_kernel="cuda", **cfgkw))
+        with pytest.raises(ValueError):
+            ServingEngine(net, ServingConfig(
+                attention_impl="cuda", **cfgkw))
+
+
+@pytest.mark.slow
+class TestRaggedPallasEngine:
+    def test_pallas_engine_greedy_matches_xla_engine(self):
+        """The unified tick on the Pallas ragged kernel (interpret mode
+        on CPU), end to end: mixed prefill/decode rows, slot reuse.
+        Online softmax is allclose-not-bitwise vs the XLA gather, so
+        greedy argmax agreement is pinned against the XLA ENGINE on
+        this fixed seed (ties at float-ulp gaps would be a different
+        token — deterministic here, and a mismatch would mean the
+        kernel's numerics drifted beyond allclose)."""
+        net = _net()
+        cfgkw = dict(num_slots=2, page_size=8, pages_per_slot=3,
+                     prefill_chunk=8)
+        rng = np.random.RandomState(13)
+        prompts = [rng.randint(0, 128, (8,)).astype(np.int32)
+                   for _ in range(3)]
+        pal = ServingEngine(net, ServingConfig(
+            attention_kernel="ragged-pallas", **cfgkw))
+        xla = ServingEngine(net, ServingConfig(**cfgkw))
+        p_rids = [pal.submit(p, 16) for p in prompts]
+        x_rids = [xla.submit(p, 16) for p in prompts]
+        p_out, x_out = pal.run(), xla.run()
+        for pr, xr in zip(p_rids, x_rids):
+            np.testing.assert_array_equal(p_out[pr], x_out[xr])
 
 
 class TestServingPredictor:
