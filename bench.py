@@ -113,7 +113,12 @@ def profiler_block(tr, args, phases=True):
                 "collective_bytes_per_step":
                     gauge("comm/collective_bytes_per_step"),
                 "peak_bytes_in_use": gauge("memory/peak_bytes_in_use"),
-                "retraces": len(s["retraces"])}
+                "retraces": len(s["retraces"]),
+                # compiled-program inventory (xla_stats): compile
+                # wall-time + cost-analysis FLOPs/bytes per dispatch
+                # site — populated by profile_step_phases, {} when the
+                # phases pass was skipped
+                "xla_programs": s.get("programs", {})}
     except Exception as e:      # telemetry must never kill a bench line
         return {"error": f"{type(e).__name__}: {e}"[:160]}
     finally:

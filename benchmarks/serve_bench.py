@@ -35,10 +35,21 @@ compile-vs-decode.
 
 Prints ONE JSON line (driver contract, same shape as bench.py).
 
+The Poisson and --prefix-cache blocks carry the full registry
+snapshot, the per-request latency-breakdown table + rolling TTFT/TPOT
+p50/p90/p95/p99 (profiler event timelines), the compiled-program
+inventory (compile wall-time + cost-analysis FLOPs/bytes per dispatch
+site), and the measured event-log overhead on the decode hot loop
+(--kernel-matrix cells stay lean: throughput + TTFT per kernel).
+``--sink-dir`` additionally streams everything to disk (metrics.jsonl
++ events.jsonl + metrics.prom — the ISSUE 8 persistent-sink artifact;
+tools/check_sink_schema.py validates it in CI).
+
     python benchmarks/serve_bench.py                 # Poisson, 8 slots
     python benchmarks/serve_bench.py --prefix-cache  # shared-prefix TTFT
     python benchmarks/serve_bench.py --kernel-matrix # unified vs legacy
     python benchmarks/serve_bench.py --tiny [...]    # CI smoke sizes
+    python benchmarks/serve_bench.py --sink-dir DIR  # + persistent sink
 """
 from __future__ import annotations
 
@@ -178,7 +189,11 @@ def run_concurrent(eng, reqs):
 
 
 def pct(xs, p):
-    return float(np.percentile(np.asarray(xs), p)) if xs else 0.0
+    # the registry/event-timeline nearest-rank convention — the bench
+    # block must report the same p95 as the sink for the same data
+    from paddle_tpu.profiler.metrics import percentile
+
+    return float(percentile(sorted(xs), p)) if xs else 0.0
 
 
 def bench_poisson(args, tiny):
@@ -206,16 +221,46 @@ def bench_poisson(args, tiny):
                        attention_kernel=args.attention_kernel)
     warm = make_trace(max(2, slots), prompt_lens, max_new, 1e9, seed=1)
     run_engine(eng, [(0.0, p, m) for _, p, m in warm])
+    eng.pool.drop_prefix_cache()
+
+    # ---- event-log overhead: the SAME warm engine + trace with event
+    # emission off vs on. Its hot-loop cost is what the ISSUE 8
+    # acceptance bounds (<2% tokens/s); the sink's background flush
+    # thread never sits on the hot loop, so events are the whole of
+    # the per-tick overhead surface. Single-run wall clocks on this
+    # box swing far more than the effect being measured, so both arms
+    # run ``reps`` times INTERLEAVED (drift hits both equally) and the
+    # comparison is best-of-reps per arm — the kernel-matrix
+    # noise-floor precedent.
+    from paddle_tpu.profiler import events as _pevents
+
+    reps = max(2, args.reps)
+    off_tps = on_tps = 0.0
+    for _ in range(reps):
+        for enabled in (False, True):
+            _pevents.set_enabled(enabled)
+            eng.pool.drop_prefix_cache()
+            toks, wall, *_ = run_engine(eng, trace)
+            if enabled:
+                on_tps = max(on_tps, toks / wall)
+            else:
+                off_tps = max(off_tps, toks / wall)
+    _pevents.set_enabled(True)
     eng.pool.drop_prefix_cache()        # measured run starts cold
 
     profiler.enable()
     bl_tokens, bl_wall, bl_ttft = run_baseline(net, trace)
     eng_tokens, eng_wall, eng_ttft, occ, putil = run_engine(eng, trace)
+    lat_rows = profiler.latency_table()
+    lat_stats = profiler.request_latency_stats()
+    inventory = eng.record_program_stats()
     summ = profiler.disable()
 
     bl_tps = bl_tokens / bl_wall
     eng_tps = eng_tokens / eng_wall
     speedup = eng_tps / bl_tps if bl_tps else 0.0
+    overhead_pct = (off_tps - on_tps) / off_tps * 100.0 if off_tps \
+        else 0.0
     snap = {k: v.get("value", v.get("count"))
             for k, v in summ["metrics"].items()
             if k.startswith("serving/")}
@@ -243,12 +288,30 @@ def bench_poisson(args, tiny):
                         "engine_p95": round(pct(eng_ttft, 95), 2),
                         "baseline_p50": round(pct(bl_ttft, 50), 2),
                         "baseline_p95": round(pct(bl_ttft, 95), 2)},
+            # per-request latency breakdowns + rolling TTFT/TPOT
+            # percentiles from the event timelines, the full registry
+            # snapshot, and the compiled-program inventory (ISSUE 8:
+            # kernel-matrix runs carry percentiles, not just means)
+            "request_latency": lat_stats,
+            "latency_table": lat_rows,
+            "registry": summ["metrics"],
+            "xla_programs": inventory,
+            "events_overhead_pct": round(overhead_pct, 2),
+            "events_off_tokens_per_sec": round(off_tps, 2),
+            "events_on_tokens_per_sec": round(on_tps, 2),
+            "events_overhead_reps": reps,
             "profiler": snap,
             "note": ("baseline pays one dense [1, S_max] cache + scan "
                      "program per request; the engine amortizes one "
                      "fixed-shape batch tick across every resident "
-                     "request — measured warm on the CPU backend, "
-                     "compile excluded for both"),
+                     "request — measured warm on the box's default "
+                     "jax backend, compile excluded for both. "
+                     "events_overhead_pct "
+                     "compares best-of-reps events-off vs events-on "
+                     "runs of the same warm engine+trace, interleaved "
+                     "(lifecycle-edge emission is the whole hot-loop "
+                     "cost; the sink flushes on a background thread); "
+                     "residual small/negative values are timer noise"),
         },
     }
 
@@ -295,6 +358,9 @@ def bench_shared_prefix(args, tiny):
     summ_off = profiler.disable()
     profiler.enable()
     on_tokens, on_wall, on_ttft = run_concurrent(eng_on, reqs)
+    lat_rows = profiler.latency_table()     # cache-on window only
+    lat_stats = profiler.request_latency_stats()
+    inventory = eng_on.record_program_stats()
     summ = profiler.disable()
 
     mean_off = float(np.mean(off_ttft))
@@ -333,6 +399,10 @@ def bench_shared_prefix(args, tiny):
             "cache_tokens_per_sec": round(on_tokens / on_wall, 2),
             "nocache_tokens_per_sec": round(off_tokens / off_wall, 2),
             "cache_tokens": on_tokens, "nocache_tokens": off_tokens,
+            "request_latency": lat_stats,   # cache-on window only
+            "latency_table": lat_rows,
+            "registry": summ["metrics"],
+            "xla_programs": inventory,
             "profiler": snap,             # cache-on engine only
             "profiler_nocache": snap_off,
             "note": ("N concurrent requests share one system prompt; "
@@ -456,6 +526,10 @@ def main():
                     help="repetitions per kernel-matrix cell (best-of)")
     ap.add_argument("--rate", type=float, default=200.0,
                     help="Poisson arrival rate (req/s)")
+    ap.add_argument("--sink-dir", default=None,
+                    help="enable the persistent metrics sink into this "
+                         "directory (metrics.jsonl + events.jsonl + "
+                         "metrics.prom, final flush on exit)")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -463,12 +537,25 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
 
+    if args.sink_dir:
+        import paddle_tpu.profiler as profiler
+
+        profiler.enable_sink(args.sink_dir, interval_s=5.0)
+
     if args.kernel_matrix:
         out = bench_kernel_matrix(args, args.tiny)
     elif args.prefix_cache:
         out = bench_shared_prefix(args, args.tiny)
     else:
         out = bench_poisson(args, args.tiny)
+
+    if args.sink_dir:
+        import paddle_tpu.profiler as profiler
+
+        s = profiler.active_sink()
+        profiler.disable_sink("exit")   # deterministic final flush
+        out.setdefault("extra", {})["sink"] = {
+            "dir": args.sink_dir, "flushes": s.flushes if s else 0}
     print(json.dumps(out))
 
 

@@ -1312,11 +1312,18 @@ class HybridPipelineTrainer:
         host-timed inside it; nested prefixes are compiled and timed
         instead — fwd (loss only), fwd+bwd (value_and_grad), full step —
         and bwd = fwdbwd − fwd, optim = step − fwdbwd. ``comm`` is a
-        model, not a measurement: collective bytes parsed from the
-        lowered program over the nominal link bandwidth
-        (profiler.instrument.estimate_comm_ms); 0 on one chip. Costs two
-        extra compiles and runs ``iters`` REAL optimizer steps (training
-        state advances). Offload/stream configs skip the fwd/bwd split
+        two-number split (profiler.instrument.record_phases): the
+        nominal-bandwidth model (``phase/comm_ms`` — collective bytes
+        over link rate) AND measured step wall time apportioned by
+        XLA's cost-analysis byte accounting
+        (``phase/comm_measured_ms`` — real clock, modeled
+        attribution); both 0 on one chip. Also folds the step program's
+        compile wall-time + cost-analysis FLOPs/bytes into the
+        profiler's program inventory (xla_stats, keyed by the
+        ``hybrid.step#N`` site). Costs three
+        extra diagnostic compiles (fwd, fwd+bwd, and the timed
+        inventory compile) and runs ``iters`` REAL optimizer steps
+        (training state advances). Offload/stream configs skip the fwd/bwd split
         (their step streams host-resident state the sub-programs would
         misattribute) and report step + comm only.
         """
@@ -1340,11 +1347,21 @@ class HybridPipelineTrainer:
                 lambda: fb(self.block_vals, self.other_vals), iters)
         t_step = _pinstr.time_compiled(lambda: self.step(*batch), iters)
 
-        st = _pinstr.record_collectives_from(
-            self.aot_lower(*batch), self.mesh)
+        lowered = self.aot_lower(*batch)
+        st = _pinstr.record_collectives_from(lowered, self.mesh)
+        # compiled-program accounting: compile wall-time + XLA's own
+        # cost analysis into the program inventory, keyed by the same
+        # site name the retrace telemetry uses — and the cost-analysis
+        # byte total turns the comm phase into a measured/estimated
+        # split (phase/comm_measured_ms: measured step time apportioned
+        # by collective-byte share) next to the nominal-bandwidth model
+        from ..profiler import xla_stats as _xstats
+
+        ps = _xstats.record_lowered(self._prof_site, lowered)
         return _pinstr.record_phases(
             fwd_s=t_fwd, fwdbwd_s=t_fb, step_s=t_step,
-            comm_bytes=st["total_bytes"], platform=_target_platform())
+            comm_bytes=st["total_bytes"], platform=_target_platform(),
+            cost_bytes_accessed=ps.bytes_accessed)
 
     def memory_analysis(self, *batch):
         """Compiled-memory report of the train step (bytes), from XLA's

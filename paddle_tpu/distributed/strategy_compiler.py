@@ -453,10 +453,16 @@ class HybridParallelTrainer:
                 jnp.asarray(0.0, jnp.float32), jnp.asarray(0, jnp.int32),
                 key)
         st = _pinstr.record_collectives_from(lowered, self.mesh)
+        # same program inventory + measured/estimated comm split as
+        # HybridPipelineTrainer.profile_step_phases
+        from ..profiler import xla_stats as _xstats
+
+        ps = _xstats.record_lowered(self._prof_site, lowered)
         return _pinstr.record_phases(
             fwd_s=t_fwd, fwdbwd_s=t_fb, step_s=t_step,
             comm_bytes=st["total_bytes"],
-            platform=self.mesh.devices.flat[0].platform)
+            platform=self.mesh.devices.flat[0].platform,
+            cost_bytes_accessed=ps.bytes_accessed)
 
     def sync_to_layer(self):
         """Write device state back into the eager Layer (for save/eval)."""

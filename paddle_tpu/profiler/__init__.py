@@ -1,5 +1,15 @@
 """paddle_tpu.profiler — unified runtime observability.
 
+Three always-on pieces ride alongside (ISSUE 8): per-request **event
+timelines** + the **flight recorder** (events.py — serving lifecycle
+edges, latency breakdowns, rolling TTFT/TPOT percentiles, post-mortem
+dumps on watchdog fire/rollback), the **persistent metrics sink**
+(sink.py — registry + event log as JSONL and a Prometheus textfile,
+flushed on interval/exit/preempt/watchdog/rollback), and
+**compiled-program accounting** (xla_stats.py — compile wall-time +
+``cost_analysis()`` FLOPs/bytes per dispatch site, the inventory that
+keys against recompile-telemetry names).
+
 Three pillars, one switch (``profiler.enable()``):
 
 1. **Tracing** (``trace.py``): ``profiler.scope("name")`` /
@@ -66,7 +76,12 @@ Quick use::
 """
 from __future__ import annotations
 
-from . import instrument, metrics, recompile, trace  # noqa: F401
+from . import events, instrument, metrics, recompile  # noqa: F401
+from . import sink, trace, xla_stats  # noqa: F401
+from .events import (EventLog, FlightRecorder, dump_flight,  # noqa: F401
+                     emit, flight_recorder, latency_breakdown,
+                     latency_table, request_latency_stats)
+from .events import log as event_log  # noqa: F401
 from .instrument import (collective_stats, device_memory_stats,  # noqa: F401
                          estimate_comm_ms, record_collective_stats,
                          record_collectives_from, record_memory_high_water,
@@ -75,9 +90,13 @@ from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       registry)
 from .recompile import (mark_trace, retraces, suppressed,  # noqa: F401
                         trace_counts, unique_site, watch)
+from .sink import (MetricsSink, active_sink, disable_sink,  # noqa: F401
+                   enable_sink, flush_active, prometheus_text)
 from .trace import (RecordEvent, annotate, chrome_trace,  # noqa: F401
                     export_chrome_trace, is_enabled, live_spans, scope,
                     scope_summary)
+from .xla_stats import program_inventory, record_compiled  # noqa: F401
+from .xla_stats import record_lowered  # noqa: F401
 
 __all__ = [
     "enable", "disable", "is_enabled", "reset",
@@ -91,20 +110,36 @@ __all__ = [
     "record_phases", "device_memory_stats", "record_memory_high_water",
     "tokens_in_batch",
     "summary",
+    # per-request event timelines + flight recorder (events.py)
+    "emit", "event_log", "EventLog", "latency_breakdown", "latency_table",
+    "request_latency_stats", "flight_recorder", "FlightRecorder",
+    "dump_flight",
+    # persistent metrics sink (sink.py)
+    "MetricsSink", "enable_sink", "disable_sink", "active_sink",
+    "flush_active", "prometheus_text",
+    # compiled-program accounting (xla_stats.py)
+    "record_lowered", "record_compiled", "program_inventory",
 ]
 
 
 def enable(trace_dir=None, reset: bool = True) -> None:
     """Turn profiling on. ``reset`` (default) clears prior host spans,
-    the metrics registry, and the public retrace log, so the window's
-    counters and rates cover only this session; retrace signature
-    HISTORY is kept (a step function first traced before enable must
-    still read as a retrace on its next re-trace). ``trace_dir``
-    additionally starts a jax/XLA device trace into that directory."""
+    the metrics registry, the event log, the program inventory, and the
+    public retrace log, so the window's counters and rates cover only
+    this session; retrace signature HISTORY is kept (a step function
+    first traced before enable must still read as a retrace on its next
+    re-trace), and event SEQUENCE NUMBERS are kept (an active sink's
+    cursor survives the reset). ``trace_dir`` additionally starts a
+    jax/XLA device trace into that directory."""
     if reset:
+        # an active sink drains the ring first — a reset must not eat
+        # events the sink promised to persist exactly once
+        sink.flush_active("reset")
         trace.reset_events()
         metrics.registry().reset()
         recompile.clear_log()
+        events.log().clear()
+        xla_stats.reset()
     trace.enable(trace_dir=trace_dir, reset=False)
 
 
@@ -116,10 +151,15 @@ def disable() -> dict:
 
 
 def reset() -> None:
-    """Clear spans, metrics, and retrace history (enabled flag kept)."""
+    """Clear spans, metrics, events, the program inventory, and retrace
+    history (enabled flag and event sequence numbers kept; an active
+    sink drains the event ring before it empties)."""
+    sink.flush_active("reset")
     trace.reset_events()
     metrics.registry().reset()
     recompile.reset()
+    events.log().clear()
+    xla_stats.reset()
 
 
 def summary(aggregate: bool = False) -> dict:
@@ -144,4 +184,5 @@ def summary(aggregate: bool = False) -> dict:
             "metrics": snap,
             "rates": rates,
             "phases_ms": phases,
-            "retraces": recompile.retraces()}
+            "retraces": recompile.retraces(),
+            "programs": xla_stats.inventory()}

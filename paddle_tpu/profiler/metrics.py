@@ -24,6 +24,17 @@ import numpy as np
 _RESERVOIR = 1024
 
 
+def percentile(sorted_vals, q: float) -> Optional[float]:
+    """Nearest-rank percentile over a PRE-SORTED sequence (None when
+    empty). The repo's one quantile convention — Histogram reservoirs,
+    event-timeline stats (events._percentiles) and serve_bench all call
+    this helper, so a p95 means the same thing in every artifact."""
+    n = len(sorted_vals)
+    if n == 0:
+        return None
+    return sorted_vals[min(int(q / 100.0 * n), n - 1)]
+
+
 class Counter:
     """Monotonic accumulator (tokens seen, steps run, retraces, bytes)."""
 
@@ -109,19 +120,18 @@ class Histogram:
 
     def percentile(self, q: float) -> Optional[float]:
         with self._lock:
-            if not self._recent:
-                return None
-            s = sorted(self._recent)
-            i = min(int(q / 100.0 * len(s)), len(s) - 1)
-            return s[i]
+            return percentile(sorted(self._recent), q)
 
     def snapshot(self) -> dict:
-        if self._n == 0:
-            return {"type": "histogram", "count": 0}
-        return {"type": "histogram", "count": self._n,
-                "sum": self._sum, "mean": self._sum / self._n,
-                "min": self._min, "max": self._max,
-                "p50": self.percentile(50), "p99": self.percentile(99)}
+        with self._lock:
+            if self._n == 0:
+                return {"type": "histogram", "count": 0}
+            s = sorted(self._recent)
+            return {"type": "histogram", "count": self._n,
+                    "sum": self._sum, "mean": self._sum / self._n,
+                    "min": self._min, "max": self._max,
+                    "p50": percentile(s, 50), "p90": percentile(s, 90),
+                    "p95": percentile(s, 95), "p99": percentile(s, 99)}
 
 
 class MetricsRegistry:
@@ -237,8 +247,8 @@ class MetricsRegistry:
                              min=mn, max=mx)
                 # reservoirs are rank-local; a p99 next to fleet-wide
                 # count/min/max would read as fleet-wide when it isn't
-                s.pop("p50", None)
-                s.pop("p99", None)
+                for q in ("p50", "p90", "p95", "p99"):
+                    s.pop(q, None)
         return snap
 
 

@@ -1,0 +1,327 @@
+"""Persistent metrics sink: the registry + event log, on disk.
+
+Until this module, every metric and event died with the process — a
+watchdog abort, a SIGTERM preemption, or a plain crash left NOTHING to
+read. The sink is a background writer that periodically (and
+deterministically at every exit edge) flushes:
+
+- ``metrics.jsonl`` — one JSON line per flush: timestamp, reason,
+  ``events_lost`` (events that aged out of the ring before this flush
+  could persist them — a sustained emit rate above capacity/interval
+  shows up HERE, not as silence) and the full registry snapshot
+  (append-only, crash-tolerant: a torn last line loses one flush,
+  never the file);
+- ``events.jsonl`` — the event log streamed exactly once via a
+  sequence cursor (one JSON object per event, append-only; the cursor
+  advances only after a successful append, so an I/O error re-sends
+  the WHOLE segment next flush — at-least-once under errors. A
+  partially-landed segment therefore leaves a damaged file: a torn
+  line and/or duplicate seqs that tools/check_sink_schema.py flags by
+  design — the deliberate trade is that write failures surface in
+  validation rather than events silently vanishing. Ring-overflow
+  losses (events aged out between flushes) appear as seq GAPS here and
+  are counted per flush in metrics.jsonl's ``events_lost``);
+- ``metrics.prom`` — the LATEST snapshot in Prometheus textfile-
+  collector format, rewritten atomically (tmp + rename) so a scraper
+  never reads a half-written file.
+
+Flush edges, all carrying a ``reason`` in the metrics line:
+
+- ``interval`` — the background thread, every ``interval_s``;
+- ``exit`` — ``close()``; ``enable_sink`` registers an atexit hook so
+  a normal interpreter exit always flushes;
+- ``preempt`` — the resilience runner flushes after the SIGTERM
+  preemption checkpoint commits (riding the PR 2 preemption path; the
+  signal handler itself stays async-signal-trivial);
+- ``watchdog`` — StepWatchdog._fire flushes BEFORE an abort's
+  ``os._exit`` (which skips atexit by design);
+- ``rollback`` — the resilient runner's bad-step rollback, before the
+  restore overwrites the state the telemetry describes;
+- ``reset`` — ``profiler.enable(reset=True)`` / ``profiler.reset()``
+  drain the event ring into the sink before emptying it.
+
+Every flush also ``mark()``s the flight recorder, so a later dump's
+metric deltas read "since the last flush" — the incident window.
+
+One sink is active per process (``enable_sink`` replaces and closes a
+prior one). A new sink rotates any pre-existing ``metrics.jsonl`` /
+``events.jsonl`` aside (first free ``.N`` suffix): each sink session
+owns fresh files whose flush_seq/seq start at this session's values,
+so reusing a ``--sink-dir`` across runs keeps every file individually
+schema-valid and old post-mortems readable. The writer thread holds no
+jax state and issues no collectives — pure host I/O, safe next to XLA
+(SaveHandle rule).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+from . import events as _events
+from .metrics import registry
+
+__all__ = ["MetricsSink", "enable_sink", "disable_sink", "active_sink",
+           "flush_active", "prometheus_text"]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(prefix: str, name: str) -> str:
+    return _NAME_RE.sub("_", f"{prefix}_{name}")
+
+
+def _rotate(path: str) -> None:
+    """Move a non-empty artifact from an earlier sink session aside —
+    appending this session's seq-0 lines after it would break the
+    per-file strictly-increasing flush_seq/seq contract the schema
+    validator enforces."""
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return
+    k = 1
+    while os.path.exists(f"{path}.{k}"):
+        k += 1
+    os.replace(path, f"{path}.{k}")
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(snapshot: Dict[str, dict],
+                    prefix: str = "paddle_tpu") -> str:
+    """Registry snapshot -> Prometheus textfile exposition. Counters
+    get the conventional ``_total`` suffix; histograms export as
+    summaries (count/sum + p50/p90/p95/p99 quantile samples from the
+    bounded reservoir — rank-local, like the snapshot itself)."""
+    lines = []
+    for name in sorted(snapshot):
+        s = snapshot[name]
+        typ = s.get("type")
+        if typ == "counter":
+            n = _prom_name(prefix, name) + "_total"
+            lines += [f"# TYPE {n} counter", f"{n} {_fmt(s['value'])}"]
+        elif typ == "gauge":
+            if s.get("value") is None:
+                continue
+            n = _prom_name(prefix, name)
+            lines += [f"# TYPE {n} gauge", f"{n} {_fmt(s['value'])}"]
+        elif typ == "histogram":
+            n = _prom_name(prefix, name)
+            lines.append(f"# TYPE {n} summary")
+            lines.append(f"{n}_count {_fmt(s.get('count', 0))}")
+            if s.get("count"):
+                lines.append(f"{n}_sum {_fmt(s['sum'])}")
+                for q, key in ((0.5, "p50"), (0.9, "p90"),
+                               (0.95, "p95"), (0.99, "p99")):
+                    if s.get(key) is not None:
+                        lines.append(
+                            f'{n}{{quantile="{q}"}} {_fmt(s[key])}')
+    return "\n".join(lines) + "\n"
+
+
+class MetricsSink:
+    """See module docstring. ``start()`` launches the interval thread;
+    ``flush(reason)`` is safe from any thread; ``close()`` is
+    idempotent and always ends with a final flush."""
+
+    def __init__(self, directory: str, interval_s: float = 10.0,
+                 prefix: str = "paddle_tpu",
+                 metrics_file: str = "metrics.jsonl",
+                 events_file: str = "events.jsonl",
+                 prom_file: str = "metrics.prom",
+                 event_log: Optional[_events.EventLog] = None):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.interval_s = float(interval_s)
+        self.prefix = prefix
+        self._metrics_path = os.path.join(directory, metrics_file)
+        self._events_path = os.path.join(directory, events_file)
+        self._prom_path = os.path.join(directory, prom_file)
+        _rotate(self._metrics_path)   # prom is rewritten atomically —
+        _rotate(self._events_path)    # latest-wins is its contract
+        self._event_log = event_log or _events.log()
+        self._cursor = 0           # event-log seq already persisted
+        self._flushes = 0
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "MetricsSink":
+        if self._thread is None and not self._closed:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="profiler-sink", daemon=True)
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.flush("interval")
+            except Exception:  # pragma: no cover - keep the writer alive
+                pass
+
+    def close(self, reason: str = "exit",
+              timeout: Optional[float] = None) -> None:
+        """``timeout`` bounds each lock wait (same contract as
+        ``flush``): a writer thread wedged in hung I/O must not hang
+        process exit — the atexit hook passes one, skipping the final
+        flush rather than blocking forever."""
+        if not self._lock.acquire(timeout=-1 if timeout is None
+                                  else timeout):
+            self._closed = True       # wedged writer: give up the flush
+            self._stop.set()
+            return
+        try:
+            if self._closed:
+                return
+            self._closed = True
+        finally:
+            self._lock.release()
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5)
+        self._thread = None
+        if not self._lock.acquire(timeout=-1 if timeout is None
+                                  else timeout):
+            return
+        try:
+            self._flush_locked(reason)
+        finally:
+            self._lock.release()
+
+    def __enter__(self) -> "MetricsSink":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- flushing ----------------------------------------------------------
+    def flush(self, reason: str = "manual",
+              timeout: Optional[float] = None) -> Optional[dict]:
+        """``timeout`` makes the flush best-effort: if the writer lock
+        cannot be acquired in time (the interval thread wedged in hung
+        I/O while holding it), return None instead of blocking. The
+        watchdog's fire path uses this — a stuck flush must never stand
+        between the watchdog and its abort ``os._exit``."""
+        if not self._lock.acquire(timeout=-1 if timeout is None
+                                  else timeout):
+            return None
+        try:
+            if self._closed:
+                return None
+            return self._flush_locked(reason)
+        finally:
+            self._lock.release()
+
+    def _flush_locked(self, reason: str) -> dict:
+        with self._lock:
+            snap = registry().snapshot()
+            # stamp-then-increment BEFORE any I/O: a flush that dies
+            # mid-write leaves a GAP in flush_seq, never a duplicate
+            # (the schema validator requires strictly-increasing seqs)
+            seq = self._flushes
+            self._flushes += 1
+            evs, cursor = self._event_log.since(self._cursor)
+            # ring overflow between flushes ages events out before they
+            # persist: the segment then starts past the cursor (or the
+            # cursor jumps with no events at all). Count the gap — the
+            # loss lands in this flush's metrics line, never silent.
+            first = evs[0].seq if evs else cursor
+            lost = max(0, first - self._cursor)
+            if evs:
+                seg = "".join(json.dumps(ev.to_dict()) + "\n"
+                              for ev in evs)
+                with open(self._events_path, "a") as f:
+                    f.write(seg)
+            elif not os.path.exists(self._events_path):
+                # schema contract: the file exists even before the
+                # first event (a validator must not special-case it)
+                open(self._events_path, "a").close()
+            # the cursor advances only once the segment hit the file —
+            # an I/O error above re-sends it on the next flush
+            self._cursor = cursor
+            line = {"ts": round(time.time(), 6), "reason": reason,
+                    "flush_seq": seq, "events_lost": lost,
+                    "metrics": snap}
+            with open(self._metrics_path, "a") as f:
+                f.write(json.dumps(line) + "\n")
+            tmp = self._prom_path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(prometheus_text(snap, self.prefix))
+            os.replace(tmp, self._prom_path)
+            # deltas in a later flight dump read "since the last flush"
+            _events.flight_recorder().mark()
+            return line
+
+    @property
+    def flushes(self) -> int:
+        return self._flushes
+
+
+# ---------------------------------------------------------------------------
+# process-global active sink
+# ---------------------------------------------------------------------------
+_active: Optional[MetricsSink] = None
+_atexit_registered = False
+
+
+def _atexit_close() -> None:  # pragma: no cover - interpreter teardown
+    s = _active
+    if s is not None:
+        try:
+            # bounded: a writer wedged in hung I/O (holding the flush
+            # lock) must not hang interpreter exit
+            s.close("exit", timeout=10.0)
+        except Exception:
+            pass
+
+
+def enable_sink(directory: str, **kwargs) -> MetricsSink:
+    """Create + start the process's active sink (closing any prior
+    one) and register the exit flush. kwargs ride to MetricsSink."""
+    global _active, _atexit_registered
+    if _active is not None:
+        _active.close("replaced")
+    _active = MetricsSink(directory, **kwargs).start()
+    if not _atexit_registered:
+        atexit.register(_atexit_close)
+        _atexit_registered = True
+    return _active
+
+
+def disable_sink(reason: str = "disabled") -> None:
+    global _active
+    if _active is not None:
+        _active.close(reason)
+        _active = None
+
+
+def active_sink() -> Optional[MetricsSink]:
+    return _active
+
+
+def flush_active(reason: str,
+                 timeout: Optional[float] = None) -> Optional[dict]:
+    """Flush the active sink if there is one; never raises (called
+    from watchdog fires and preemption paths). ``timeout`` bounds the
+    wait for a wedged writer — see MetricsSink.flush."""
+    s = _active
+    if s is None:
+        return None
+    try:
+        return s.flush(reason, timeout=timeout)
+    except Exception:  # pragma: no cover - post-mortem shield
+        return None

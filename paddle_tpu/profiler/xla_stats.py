@@ -1,0 +1,142 @@
+"""Compiled-program accounting: a per-process program inventory.
+
+The recompile telemetry (recompile.py) says WHEN a dispatch site
+traced; this module says WHAT each site's compiled program costs:
+compile wall-time, and XLA's own ``compiled.cost_analysis()`` FLOPs /
+bytes-accessed. The inventory is keyed by the same site names the
+recompile watcher uses (``serving.tick#0``, ``hybrid.step#1``, ...),
+so "which program", "how often traced" and "what it costs" join on one
+key. This is the harness ROADMAP items 2/3 need: a kernel or
+quantization experiment's before/after is attributable per compiled
+program, not inferred from whole-run wall clock.
+
+Callers hold a ``jax.stages.Lowered`` (``jitted.lower(*avals)`` —
+ShapeDtypeStructs are enough, nothing materializes):
+
+    stats = xla_stats.record_lowered("serving.tick#0", lowered)
+
+``record_lowered`` times the ``compile()`` call (honest wall-time of
+THIS compilation — on a warm XLA process-level cache it measures the
+cache hit, which is the cost the caller actually paid) and folds the
+cost analysis into the registry as ``xla/<site>/compile_ms`` /
+``.../flops`` / ``.../bytes_accessed`` gauges plus the inventory.
+
+CPU caveat (documented, not hidden): the CPU backend's cost analysis
+reports ``flops``/``bytes accessed`` from the optimized HLO but no
+per-op timing model; on some backends/versions ``cost_analysis()``
+raises — recorded as ``cost_available: False`` with compile time
+still kept. Accounting never raises into the caller's hot path.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from . import recompile as _recompile
+from .metrics import registry
+
+__all__ = ["ProgramStats", "record_lowered", "record_compiled",
+           "normalize_cost", "inventory", "program_inventory", "get",
+           "reset"]
+
+
+class ProgramStats:
+    """One dispatch site's compiled-program record."""
+
+    __slots__ = ("site", "compile_ms", "flops", "bytes_accessed",
+                 "cost", "recorded_unix")
+
+    def __init__(self, site: str, compile_ms: Optional[float],
+                 flops: Optional[float], bytes_accessed: Optional[float],
+                 cost: dict):
+        self.site = site
+        self.compile_ms = compile_ms
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.cost = cost
+        self.recorded_unix = time.time()
+
+    def to_dict(self) -> dict:
+        return {
+            "site": self.site,
+            "compile_ms": None if self.compile_ms is None
+            else round(self.compile_ms, 3),
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "cost_available": bool(self.cost),
+        }
+
+
+_lock = threading.Lock()
+_programs: Dict[str, ProgramStats] = {}
+
+
+def normalize_cost(ca) -> dict:
+    """``cost_analysis()`` returns a list of per-device dicts on some
+    jax versions, a dict on others, None on backends without it — one
+    plain dict out (empty when unavailable)."""
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    return dict(ca) if ca else {}
+
+
+def record_compiled(site: str, compiled,
+                    compile_s: Optional[float] = None) -> ProgramStats:
+    """Fold an already-compiled program's cost analysis (and, when the
+    caller timed it, the compile wall-time) into the inventory +
+    registry."""
+    try:
+        cost = normalize_cost(compiled.cost_analysis())
+    except Exception:
+        cost = {}
+    flops = cost.get("flops")
+    byts = cost.get("bytes accessed")
+    stats = ProgramStats(site, None if compile_s is None
+                         else compile_s * 1e3,
+                         None if flops is None else float(flops),
+                         None if byts is None else float(byts), cost)
+    with _lock:
+        _programs[site] = stats
+    reg = registry()
+    if stats.compile_ms is not None:
+        reg.gauge(f"xla/{site}/compile_ms").set(stats.compile_ms)
+    if stats.flops is not None:
+        reg.gauge(f"xla/{site}/flops").set(stats.flops)
+    if stats.bytes_accessed is not None:
+        reg.gauge(f"xla/{site}/bytes_accessed").set(stats.bytes_accessed)
+    reg.counter("xla/programs_recorded").add(1)
+    return stats
+
+
+def record_lowered(site: str, lowered) -> ProgramStats:
+    """Compile ``lowered`` (timed — the recorded compile wall-time)
+    and record its cost analysis. The compile runs suppressed: it is a
+    diagnostic lowering by design, not a silent recompile."""
+    with _recompile.suppressed():
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+    return record_compiled(site, compiled, compile_s=dt)
+
+
+def get(site: str) -> Optional[ProgramStats]:
+    with _lock:
+        return _programs.get(site)
+
+
+def inventory() -> Dict[str, dict]:
+    """JSON-ready {site: stats} — what bench blocks and the sink
+    embed."""
+    with _lock:
+        return {site: s.to_dict() for site, s in sorted(_programs.items())}
+
+
+#: package-level spelling (``profiler.program_inventory()``) — the
+#: module-local name stays the short one
+program_inventory = inventory
+
+
+def reset() -> None:
+    with _lock:
+        _programs.clear()

@@ -234,9 +234,21 @@ class ResilientRunner:
         compiled guard kept the weights clean; the cursors stay
         blocklisted for any future replay) — an UNGUARDED one has
         already taken the poisoned updates with nothing to restore, so
-        the only honest move is to fail loudly."""
+        the only honest move is to fail loudly.
+
+        Before the restore, the flight recorder dumps (reason
+        "rollback") and the active sink flushes: the window of metric
+        deltas + events leading INTO the bad streak is the post-mortem
+        evidence, and the restore is about to overwrite the live state
+        it describes."""
+        from ..profiler import events as _pevents
+        from ..profiler import sink as _psink
+
         el = self.elastic
         _registry().counter("resilience/rollbacks").add(1)
+        _pevents.emit("rollback", bad_cursors=sorted(bad_cursors))
+        _pevents.dump_flight("rollback")
+        _psink.flush_active("rollback")
         self._skips.update(bad_cursors)
         el.manager.wait()              # never restore under an async save
         if el.manager.latest_step() is None:
@@ -485,6 +497,13 @@ class ResilientRunner:
                                 async_=False)
                         have_ckpt = True
                     reg.counter("resilience/preemptions").add(1)
+                    # persist the lifetime's telemetry AFTER the commit
+                    # (the PR 2 rule: the handler stays async-signal-
+                    # trivial; all I/O happens here at the step
+                    # boundary, before the resumable exit)
+                    from ..profiler import sink as _psink
+
+                    _psink.flush_active("preempt")
                     preempted = True
                     break
                 if done % el.save_interval == 0 or done == total_steps:

@@ -5,9 +5,11 @@ callback, a dead data source) looks exactly like a slow step from the
 outside — nothing raises, the job just stops. The watchdog turns that
 silence into a diagnosis: when no ``pet()`` arrives within the timeout
 it dumps every thread's live Python stack plus the profiler's open span
-stacks and per-scope summary (the spans say WHICH phase wedged), bumps
-``resilience/watchdog_fires``, and optionally aborts the process so the
-elastic restart path takes over.
+stacks and per-scope summary (the spans say WHICH phase wedged), writes
+a flight-recorder JSON (recent events + metric deltas + open spans —
+``profiler.events.dump_flight``), flushes the active metrics sink with
+reason ``"watchdog"``, bumps ``resilience/watchdog_fires``, and
+optionally aborts the process so the elastic restart path takes over.
 
 The effective deadline is jittered (multiplier in
 ``[1, 1+jitter_frac]``, seeded RNG): a fleet-wide stall must not make
@@ -104,6 +106,10 @@ class StepWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fired = False
+        #: where the last fire's flight-recorder JSON landed (None when
+        #: neither dump_file nor an active sink gave it a home, the
+        #: file write failed, or persistence timed out on wedged I/O)
+        self.flight_path: Optional[str] = None
 
     def _new_deadline(self) -> float:
         mult = 1.0 + self._rng.uniform(0.0, self.jitter_frac) \
@@ -164,18 +170,47 @@ class StepWatchdog:
                 self._fire(step)
 
     def _fire(self, step: int) -> None:
+        from ..profiler import events as _pevents
+        from ..profiler import sink as _psink
         from ..profiler.metrics import registry as _registry
 
         self.fired = True
         _registry().counter("resilience/watchdog_fires").add(1)
         elapsed = time.monotonic() - self._last_pet_t
         text = dump_stacks()
-        if self.dump_file:
-            try:
-                with open(self.dump_file, "a") as f:
-                    f.write(text)
-            except OSError:
-                pass
+        _pevents.emit("watchdog_fire", step=step,
+                      elapsed_s=round(elapsed, 3))
+
+        # post-mortem persistence: the stack dump, the flight-recorder
+        # JSON (recent events + metric deltas + open spans, written
+        # next to the stack dump or into the active sink's directory),
+        # and a sink flush so metrics.jsonl carries a final "watchdog"
+        # line. With abort on, the os._exit below skips atexit BY
+        # DESIGN, so this is the last chance anything persists — but
+        # the hang being diagnosed may BE a wedged filesystem, so ALL
+        # of this file I/O runs on a bounded daemon thread: expired,
+        # the abort proceeds without the artifact rather than never.
+        holder = {}
+
+        def _persist() -> None:
+            if self.dump_file:
+                try:
+                    with open(self.dump_file, "a") as f:
+                        f.write(text)
+                except OSError:
+                    pass
+            holder["flight"] = _pevents.dump_flight(
+                "watchdog", path=(self.dump_file + ".flight.json")
+                if self.dump_file else None)
+            # bounded too: the sink's writer thread may be wedged in
+            # hung I/O while HOLDING the flush lock
+            _psink.flush_active("watchdog", timeout=5.0)
+
+        pt = threading.Thread(target=_persist, name="watchdog-persist",
+                              daemon=True)
+        pt.start()
+        pt.join(timeout=10.0)
+        self.flight_path = holder.get("flight")
         if self.on_fire is not None:
             try:
                 self.on_fire(step, elapsed, text)

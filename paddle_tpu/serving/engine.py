@@ -71,7 +71,10 @@ admission orders.
 Profiler signals: ``serving/queue_depth``, ``serving/active_slots``,
 ``serving/page_util``, ``serving/ttft_ms`` (histogram),
 ``serving/prefill_queue_wait_ms`` (histogram: submit → first prefill
-chunk), ``serving/tokens_per_sec``, ``serving/tokens_generated``,
+chunk, FRESH admissions only), ``serving/requeue_wait_ms`` (histogram:
+preempt → re-prefill start — requeue cycles used to fold back into the
+submit-anchored wait, conflating scheduler delay with preemption
+cost), ``serving/tokens_per_sec``, ``serving/tokens_generated``,
 ``serving/prefills``, ``serving/prefill_chunks``, ``serving/ticks``,
 ``serving/preemptions``, ``serving/requests_finished``,
 ``serving/token_syncs``, ``serving/prefix_lookups``,
@@ -80,6 +83,22 @@ chunk), ``serving/tokens_per_sec``, ``serving/tokens_generated``,
 tick — a dispatch-site regression shows up here and in the
 ``serving.tick`` single-trace assertion); refcount traffic under
 ``cache_share/*`` (shares, releases, cow_copies, prefix_evictions).
+
+Event timeline (ISSUE 8; profiler/events.py): every request lifecycle
+edge emits a typed event into the profiler's bounded event log —
+``submit``, ``admit``, ``prefix_hit``, ``cow_copy``, ``chunk`` (one
+per dispatched prefill chunk), ``first_token``, ``preempt``,
+``requeue``, ``finish`` (stamped with ``ttft_ms``/``tpot_ms``/
+``tokens``/``reason``) — each tagged with the engine id (``eng``) and
+request id, so ``profiler.latency_breakdown(rid)`` reconstructs queue
+wait / prefill / decode / preempted time per request and
+``ServingEngine.latency_stats(window_s=...)`` reports rolling-window
+TTFT/TPOT p50/p90/p95/p99. Emission is lifecycle-edge-rate (O(1) per
+residency period, never per token or per tick), so the decode hot
+loop pays one bool read; serve_bench measures the residual honestly.
+``record_program_stats()`` folds each compiled hot-path program's
+compile wall-time + ``cost_analysis()`` FLOPs/bytes into the
+profiler's program inventory, keyed by ``compiled_sites``.
 """
 from __future__ import annotations
 
@@ -95,11 +114,16 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..profiler import events as _events
 from ..profiler import recompile as _recompile
 from ..profiler import registry as _registry
 from .paged_cache import PagePool
 
 __all__ = ["ServingConfig", "ServingEngine", "Request"]
+
+#: engine ids stamped on every event (``eng`` attr) so co-resident
+#: engines' timelines don't alias in the process-global log
+_ENGINE_SEQ = iter(range(1 << 30))
 
 #: attention_kernel values: the unified mixed-row tick on the XLA
 #: gather spelling (measured default), the unified tick on the Pallas
@@ -163,6 +187,8 @@ class Request:
     out: List[int] = field(default_factory=list)
     done: bool = False
     submit_t: float = 0.0
+    queue_t: float = 0.0             # (re)queue anchor: submit, or requeue
+    preempts: int = 0                # times this request was preempted
     first_token_t: Optional[float] = None
     orig_prompt_len: int = 0         # for result accounting across preemption
     temperature: Optional[float] = None   # per-request sampling overrides
@@ -226,6 +252,10 @@ class ServingEngine:
         self._legacy = kernel == "legacy"
         self._impl = "pallas" if kernel.endswith("pallas") else "xla"
         self.attention_kernel = kernel
+        self._eng_id = next(_ENGINE_SEQ)
+        # {site: (jitted fn, arg avals)} captured at first dispatch —
+        # record_program_stats() re-lowers from these for cost analysis
+        self._program_args: Dict[str, tuple] = {}
         self.config = cfg
         self.model_config = mcfg
         self._stacked, self._other = model._decode_state()
@@ -291,6 +321,50 @@ class ServingEngine:
             return (self._tick_site, self._prefill_site)
         return (self._tick_site,)
 
+    def _emit(self, kind: str, rid: int, **attrs) -> None:
+        _events.emit(kind, rid=rid, eng=self._eng_id, **attrs)
+
+    def _note_avals(self, site: str, fn, args: tuple) -> None:
+        """Remember a dispatch site's argument avals (shape/dtype only
+        — captured BEFORE dispatch, since donation invalidates the pool
+        buffers) the first time it dispatches."""
+        if site in self._program_args:
+            return
+
+        def aval(a):
+            if hasattr(a, "shape") and hasattr(a, "dtype"):
+                return jax.ShapeDtypeStruct(np.shape(a), a.dtype)
+            x = np.asarray(a)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+        self._program_args[site] = (
+            fn, jax.tree_util.tree_map(aval, args))
+
+    def record_program_stats(self) -> Dict[str, dict]:
+        """Fold compile wall-time + ``cost_analysis()`` FLOPs/bytes of
+        every hot-path program that has dispatched at least once into
+        the profiler's program inventory (``xla_stats``), keyed by
+        ``compiled_sites`` names. Re-lowers from the captured avals and
+        compiles OFF the hot path (a diagnostic compile, suppressed in
+        retrace telemetry; on a warm XLA cache it times the cache hit).
+        Returns {site: stats-dict}."""
+        from ..profiler import xla_stats as _xla
+
+        out = {}
+        for site, (fn, avals) in sorted(self._program_args.items()):
+            out[site] = _xla.record_lowered(
+                site, fn.lower(*avals)).to_dict()
+        return out
+
+    def latency_stats(self, window_s: Optional[float] = None) -> dict:
+        """Rolling-window TTFT/TPOT p50/p90/p95/p99 over requests
+        finished in the last ``window_s`` seconds (None: everything
+        still in the event ring). Reads the process-global event log —
+        finished requests of OTHER live engines are included; use
+        ``profiler.latency_table()`` rows (grouped by ``eng``) to
+        split."""
+        return _events.request_latency_stats(window_s=window_s)
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -320,12 +394,15 @@ class ServingEngine:
         self._next_rid += 1
         if key is None:
             key = np.asarray(jax.random.fold_in(self._base_key, rid))
+        now = time.perf_counter()
         req = Request(rid=rid, prompt=p, max_new=int(max_new_tokens),
                       key=np.asarray(key, np.uint32),
-                      submit_t=time.perf_counter(), orig_prompt_len=t0,
+                      submit_t=now, queue_t=now, orig_prompt_len=t0,
                       temperature=temperature, top_k=top_k, top_p=top_p)
         self._requests[rid] = req
         self._queue.append(req)
+        self._emit("submit", rid, prompt_tokens=t0,
+                   max_new=int(max_new_tokens))
         return rid
 
     def step(self) -> bool:
@@ -418,13 +495,15 @@ class ServingEngine:
                     req.first_token_t = now
                     _registry().histogram("serving/ttft_ms").observe(
                         (now - req.submit_t) * 1000.0)
+                    self._emit("first_token", rid, slot=slot)
                 eos = self.config.eos_token_id
                 # max_new counts tokens wanted since the LAST (re)queue —
                 # preemption moved earlier output into the prompt and
                 # shrank max_new to the remainder
-                if (eos is not None and tok == eos) or \
-                        len(req.out) >= req.max_new:
-                    self._finish(slot, rid)
+                if eos is not None and tok == eos:
+                    self._finish(slot, rid, reason="eos")
+                elif len(req.out) >= req.max_new:
+                    self._finish(slot, rid, reason="max_new")
 
     def _insert_prefix(self, slot: int, tokens: np.ndarray,
                        written: int) -> None:
@@ -438,7 +517,8 @@ class ServingEngine:
                 tokens[:n_full * self.pool.page_size],
                 [int(p) for p in self.pool.tables[slot, :n_full]])
 
-    def _finish(self, slot: int, rid: int) -> None:
+    def _finish(self, slot: int, rid: int,
+                reason: str = "max_new") -> None:
         req = self._requests[rid]
         req.done = True
         if self._slot_rid[slot] == rid:
@@ -456,6 +536,16 @@ class ServingEngine:
         if extra.size:
             req.out = list(extra) + req.out
         _registry().counter("serving/requests_finished").add(1)
+        now = time.perf_counter()
+        tokens = len(req.out)
+        ttft = tpot = None
+        if req.first_token_t is not None:
+            ttft = (req.first_token_t - req.submit_t) * 1000.0
+            tpot = (now - req.first_token_t) * 1000.0 / max(tokens - 1, 1)
+        self._emit("finish", rid, tokens=tokens, reason=reason,
+                   preempts=req.preempts,
+                   ttft_ms=None if ttft is None else round(ttft, 3),
+                   tpot_ms=None if tpot is None else round(tpot, 3))
 
     def _admit(self) -> None:
         """Move queued requests into free slots. Page allocation is
@@ -473,6 +563,7 @@ class ServingEngine:
             self._slot_looked_up[slot] = False
             self._admit_seq += 1
             self._slot_admit_seq[slot] = self._admit_seq
+            self._emit("admit", req.rid, slot=slot)
             self._keys[slot] = req.key
             c = self.config
             self._temps[slot] = (c.temperature if req.temperature is None
@@ -526,11 +617,27 @@ class ServingEngine:
                             np.int32(src), np.int32(dst))
                     hit += lcp
                     _registry().counter("cache_share/cow_copies").add(1)
+                    self._emit("cow_copy", req.rid, slot=slot, tokens=lcp)
             finally:
                 self.pool.allocator.free([src])
         self._slot_len[slot] = hit
         if hit:
             _registry().counter("serving/prefix_hit_tokens").add(hit)
+            self._emit("prefix_hit", req.rid, slot=slot, tokens=hit)
+
+    def _observe_wait(self, req: "Request") -> None:
+        """One wait sample per admission cycle. Fresh admissions anchor
+        at submit (scheduler delay); requeued victims anchor at their
+        preemption (preemption cost) — folding both into one
+        submit-anchored histogram conflated the two (ISSUE 8
+        satellite). Called at the cycle's first chunk open, or from
+        ``_preempt_for`` when a cycle is preempted before it ever
+        opened one — so qw count == requests and rw count ==
+        preemptions hold under every interleaving."""
+        wait_ms = (time.perf_counter() - req.queue_t) * 1000.0
+        name = "serving/requeue_wait_ms" if req.preempts \
+            else "serving/prefill_queue_wait_ms"
+        _registry().histogram(name).observe(wait_ms)
 
     def _open_chunk(self, s: int,
                     pend: Dict[int, int]) -> Optional[_Chunk]:
@@ -542,8 +649,7 @@ class ServingEngine:
         req = self._requests[rid]
         if not self._slot_looked_up[s]:
             self._slot_looked_up[s] = True
-            _registry().histogram("serving/prefill_queue_wait_ms").observe(
-                (time.perf_counter() - req.submit_t) * 1000.0)
+            self._observe_wait(req)
             self._lookup_prefix(s, req)
         t0 = int(self._slot_prompt[s])
         start = pend.get(s, int(self._slot_len[s]))
@@ -638,16 +744,26 @@ class ServingEngine:
         rid = self._slot_rid[victim]
         req = self._requests[rid]
         # window was drained before preemption, so req.out is current
+        self._emit("preempt", rid, slot=victim, generated=len(req.out))
+        if not self._slot_looked_up[victim]:
+            # this admission cycle never opened a chunk: its wait
+            # sample ends here (by preemption, not prefill start) —
+            # without it the cycle's bucket is silently short a sample
+            self._observe_wait(req)
         req.prompt = np.concatenate(
             [req.prompt, np.asarray(req.out, np.int32)])
         req.max_new -= len(req.out)
         req.out = []
+        req.preempts += 1
+        req.queue_t = time.perf_counter()
         self._insert_prefix(victim, req.prompt, int(self._slot_len[victim]))
         self._queue.appendleft(req)
         self.pool.release_slot(victim)
         self._slot_rid[victim] = None
         self._slot_len[victim] = 0
         _registry().counter("serving/preemptions").add(1)
+        self._emit("requeue", rid, prompt_tokens=int(req.prompt.shape[0]),
+                   max_new=req.max_new)
         if victim != needy_slot and self._slot_rid[needy_slot] is not None:
             if not self.pool.grow_slot(needy_slot, need):
                 self._preempt_for(needy_slot, need)
@@ -712,9 +828,7 @@ class ServingEngine:
                 sample_ix[s] = base + (t0 - 1 - start)
                 sample_pos[s] = t0
                 emit[s] = True
-        with _quiet_donation():
-            self.pool.k, self.pool.v, tok, self._last_tok = self._tick(
-                self._stacked, self._other, self.pool.k, self.pool.v,
+        args = (self._stacked, self._other, self.pool.k, self.pool.v,
                 self._last_tok, pf_toks, tok_pos, tok_limit, row_tab,
                 row_pos0, row_len, sample_ix, sample_pos, emit,
                 np.bool_(len(chunks) > 0),
@@ -722,6 +836,10 @@ class ServingEngine:
                 np.ascontiguousarray(self._temps),
                 np.ascontiguousarray(self._topks),
                 np.ascontiguousarray(self._topps))
+        self._note_avals(self._tick_site, self._tick, args)
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok, self._last_tok = \
+                self._tick(*args)
         meta = [(s, s, self._slot_rid[s]) for s in ticking]
         meta += [(s, s, rid) for s, rid in finishers]
         if meta:
@@ -736,6 +854,8 @@ class ServingEngine:
             self._slot_dispatched[s] += 1
         for s, rid, start, end, t0 in chunks:
             self._slot_len[s] = end
+            self._emit("chunk", rid, slot=s, start=start, end=end,
+                       final=bool(end >= t0))
             if end >= t0:
                 self._slot_dispatched[s] = 1
                 _registry().counter("serving/prefills").add(1)
@@ -842,13 +962,16 @@ class ServingEngine:
         toks = np.zeros((1, chunk), np.int32)
         toks[0, :end - start] = req.prompt[start:end]
         page_row = np.ascontiguousarray(self.pool.tables[s])
-        with _quiet_donation():
-            self.pool.k, self.pool.v, tok0 = self._prefill(
-                self._stacked, self._other, self.pool.k, self.pool.v,
+        args = (self._stacked, self._other, self.pool.k, self.pool.v,
                 toks, np.int32(start), np.int32(t0), page_row, req.key,
                 self._temps[s:s + 1], self._topks[s:s + 1],
                 self._topps[s:s + 1])
+        self._note_avals(self._prefill_site, self._prefill, args)
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok0 = self._prefill(*args)
         _registry().counter("serving/prefill_chunks").add(1)
+        self._emit("chunk", rid, slot=s, start=start, end=end,
+                   final=bool(end >= t0))
         if end >= t0:                # final chunk: tok0 is real
             self._last_tok = self._last_tok.at[s].set(tok0[0])
             self._inflight.append(_Inflight(tok0, [(0, s, req.rid)]))
@@ -870,13 +993,14 @@ class ServingEngine:
         tab = np.ascontiguousarray(self.pool.tables)
         pos = np.ascontiguousarray(self._slot_len)
         keys = np.ascontiguousarray(self._keys)
-        with _quiet_donation():
-            self.pool.k, self.pool.v, tok = self._tick(
-                self._stacked, self._other, self.pool.k, self.pool.v,
+        args = (self._stacked, self._other, self.pool.k, self.pool.v,
                 tab, pos, self._last_tok, keys,
                 np.ascontiguousarray(self._temps),
                 np.ascontiguousarray(self._topks),
                 np.ascontiguousarray(self._topps))
+        self._note_avals(self._tick_site, self._tick, args)
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok = self._tick(*args)
         self._last_tok = tok
         meta = [(s, s, self._slot_rid[s]) for s in ticking]
         self._inflight.append(_Inflight(tok, meta))

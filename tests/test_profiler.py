@@ -377,6 +377,11 @@ class TestInstrumentedTrainer:
         assert {"fwd_ms", "bwd_ms", "optim_ms", "comm_ms"} <= \
             set(s["phases_ms"])
         assert s["rates"]["tokens_per_sec"] > 0
+        # compiled-program accounting rides the phases pass: the step
+        # program lands in the inventory keyed by its dispatch site,
+        # with a timed compile (cost analysis is backend-dependent)
+        (site,) = [k for k in s["programs"] if k.startswith("hybrid.step")]
+        assert s["programs"][site]["compile_ms"] > 0
         assert s["retraces"] == []             # nothing silent so far
         # induced shape change -> the step retraces EXACTLY once
         tr.step(toks[:, :16])

@@ -1,0 +1,573 @@
+"""ISSUE 8 observability layer: per-request event timelines, the
+persistent metrics sink, the flight recorder, and compiled-program
+accounting (profiler/{events,sink,xla_stats}.py).
+
+Layout honors the tier-1 cap note: everything here except the
+xla_stats leg is pure host code (no jit compiles), so the in-cap cost
+is milliseconds. The SIGTERM-preemption sink flush (a full
+ResilientRunner lifetime: trainer compile + chaos self-preempt) is
+slow+chaos-marked and runs in the chaos-smoke CI matrix.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import events as pevents
+from paddle_tpu.profiler import sink as psink
+from paddle_tpu.profiler import xla_stats
+from paddle_tpu.profiler.events import EventLog, FlightRecorder
+from paddle_tpu.profiler.metrics import registry
+from paddle_tpu.profiler.sink import MetricsSink, prometheus_text
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_state():
+    """Each test sees an empty registry/event ring and no active sink
+    (sequence numbers intentionally keep advancing across tests — that
+    is the documented clear() contract)."""
+    psink.disable_sink()
+    profiler.reset()
+    pevents.set_enabled(True)
+    yield
+    psink.disable_sink()
+    profiler.reset()
+
+
+# ---------------------------------------------------------------------------
+# metrics: p90/p95 (satellite — serving SLOs are quoted p95)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_summary_has_p90_p95():
+    h = registry().histogram("t/ms")
+    for v in range(1, 101):
+        h.observe(float(v))
+    s = h.snapshot()
+    assert s["p50"] == 51.0 and s["p99"] == 100.0
+    assert s["p90"] == 91.0 and s["p95"] == 96.0
+
+
+def test_shared_nearest_rank_percentile_convention():
+    """ONE quantile convention across registry, event timelines and
+    the bench block — all three call metrics.percentile."""
+    from paddle_tpu.profiler.metrics import Histogram, percentile
+
+    assert percentile([], 99) is None
+    assert percentile([1.0, 2.0, 3.0, 4.0], 50) == 3.0   # nearest-rank
+    vals = [5.0, 1.0, 9.0, 3.0, 7.0]
+    h = Histogram("x")
+    for v in vals:
+        h.observe(v)
+    p = pevents._percentiles(vals)
+    for q in (50, 90, 95, 99):
+        assert p[f"p{q}"] == round(h.percentile(q), 3)
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+def test_event_ring_bounds_and_drop_accounting():
+    lg = EventLog(capacity=4)
+    for i in range(10):
+        lg.emit("submit", rid=i)
+    assert len(lg.events()) == 4
+    assert lg.dropped == 6
+    assert lg.total == 10
+    assert [e.rid for e in lg.events()] == [6, 7, 8, 9]
+
+
+def test_event_seq_survives_clear_and_cursor_streams_once():
+    lg = EventLog(capacity=100)
+    lg.emit("a")
+    lg.emit("b")
+    evs, cur = lg.since(0)
+    assert [e.kind for e in evs] == ["a", "b"]
+    lg.emit("c")
+    evs, cur = lg.since(cur)             # only the new event
+    assert [e.kind for e in evs] == ["c"]
+    seq_before = lg.next_seq
+    lg.clear()
+    assert lg.next_seq == seq_before     # cursors stay valid
+    lg.emit("d")
+    evs, cur = lg.since(cur)
+    assert [e.kind for e in evs] == ["d"]
+
+
+def test_disabled_log_emits_nothing():
+    lg_total = pevents.log().total
+    pevents.set_enabled(False)
+    assert pevents.emit("submit", rid=1) is None
+    pevents.set_enabled(True)
+    assert pevents.log().total == lg_total
+
+
+# ---------------------------------------------------------------------------
+# timeline breakdown: ordering invariants under preempt-requeue
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_lifecycle(lg, rid, t0_ns, preempt=False):
+    """Emit a request lifecycle with hand-controlled clock deltas by
+    patching Event timestamps after emission (the breakdown consumes
+    t_ns, so the math is exactly checkable)."""
+    def at(kind, dt_ms, **attrs):
+        ev = lg.emit(kind, rid=rid, **attrs)
+        ev.t_ns = t0_ns + int(dt_ms * 1e6)
+        return ev
+
+    at("submit", 0.0)
+    at("admit", 10.0)                    # 10ms queue wait
+    if preempt:
+        at("first_token", 30.0)          # 20ms prefill
+        at("preempt", 40.0)              # 10ms decode, then preempted
+        at("requeue", 40.0)
+        at("admit", 70.0)                # 30ms requeued
+        at("chunk", 80.0, final=True)    # 10ms re-prefill: still
+        at("finish", 100.0, tokens=8,    # preemption cost, not decode
+           ttft_ms=30.0, tpot_ms=5.0, reason="max_new")
+    else:
+        at("first_token", 30.0)
+        at("finish", 100.0, tokens=8, ttft_ms=30.0, tpot_ms=10.0,
+           reason="eos")
+
+
+def test_breakdown_plain_request():
+    lg = EventLog()
+    _synthetic_lifecycle(lg, rid=1, t0_ns=0)
+    b = pevents.breakdown_from_events(lg.events(rid=1))
+    assert b["complete"] and b["preempts"] == 0
+    assert b["queue_wait_ms"] == 10.0
+    assert b["prefill_ms"] == 20.0
+    assert b["decode_ms"] == 70.0
+    assert b["preempted_ms"] == 0.0
+    assert b["ttft_ms"] == 30.0 and b["total_ms"] == 100.0
+    assert b["tokens"] == 8 and b["reason"] == "eos"
+
+
+def test_breakdown_preempt_requeue_charges_preempted_time():
+    lg = EventLog()
+    _synthetic_lifecycle(lg, rid=2, t0_ns=0, preempt=True)
+    b = pevents.breakdown_from_events(lg.events(rid=2))
+    assert b["complete"] and b["preempts"] == 1
+    assert b["preempted_ms"] == 40.0     # preempt -> end of re-prefill
+    assert b["decode_ms"] == 30.0        # re-prefill NOT charged here
+    assert b["queue_wait_ms"] == 10.0    # NOT inflated by the requeue
+    # every bucket accounted: sums to total wall time
+    assert (b["queue_wait_ms"] + b["prefill_ms"] + b["decode_ms"]
+            + b["preempted_ms"]) == b["total_ms"] == 100.0
+
+
+def test_breakdown_head_truncated_not_complete():
+    # submit aged out of the ring, finish still in it: whole buckets
+    # are missing, so the breakdown must not claim complete (docstring:
+    # partial sequences flag "complete": False)
+    lg = EventLog()
+    lg.emit("admit", rid=3)
+    lg.emit("first_token", rid=3)
+    lg.emit("finish", rid=3, tokens=4, ttft_ms=12.5, tpot_ms=2.0,
+            reason="eos")
+    b = pevents.breakdown_from_events(lg.events(rid=3))
+    assert b["complete"] is False
+    assert "total_ms" not in b           # no submit anchor to measure from
+    assert b["ttft_ms"] == 12.5          # engine-stamped backfill survives
+
+
+def test_timeline_ordering_invariant_submit_admit_first_finish():
+    lg = EventLog()
+    for rid in (1, 2):
+        _synthetic_lifecycle(lg, rid=rid, t0_ns=rid * 10 ** 9,
+                             preempt=(rid == 2))
+    for rid in (1, 2):
+        t = {}
+        for ev in lg.events(rid=rid):
+            t.setdefault(ev.kind, ev.t_ns)   # first occurrence
+        assert t["submit"] <= t["admit"] <= t["first_token"] \
+            <= t["finish"]
+
+
+def test_latency_table_carries_engine_id():
+    # co-resident engines reuse rids: rows must be attributable
+    lg = EventLog()
+    for eng in ("a", "b"):
+        for kind in ("submit", "admit", "first_token", "finish"):
+            lg.emit(kind, rid=0, eng=eng)
+    rows = pevents.latency_table(event_log=lg)
+    assert [(r["eng"], r["rid"]) for r in rows] == [("a", 0), ("b", 0)]
+
+
+def test_request_latency_stats_rolling_window():
+    lg = EventLog()
+    now = time.perf_counter_ns()
+    for i, age_s in enumerate((100.0, 50.0, 1.0)):
+        ev = lg.emit("finish", rid=i, ttft_ms=float(i), tpot_ms=1.0)
+        ev.t_ns = now - int(age_s * 1e9)
+    st = pevents.request_latency_stats(event_log=lg, now_ns=now)
+    assert st["requests"] == 3
+    st = pevents.request_latency_stats(window_s=60.0, event_log=lg,
+                                       now_ns=now)
+    assert st["requests"] == 2
+    assert {"p50", "p90", "p95", "p99"} <= st["ttft_ms"].keys()
+
+
+# ---------------------------------------------------------------------------
+# persistent sink
+# ---------------------------------------------------------------------------
+
+
+def test_sink_flush_writes_all_three_artifacts(tmp_path):
+    d = str(tmp_path / "sink")
+    registry().counter("t/steps").add(3)
+    registry().histogram("t/ms").observe(5.0)
+    pevents.emit("submit", rid=1)
+    with MetricsSink(d, interval_s=60.0) as s:
+        s.flush("manual")
+        pevents.emit("finish", rid=1, ttft_ms=1.0)
+    # close() flushed the tail: both events present exactly once
+    ev_lines = [json.loads(x) for x in
+                open(os.path.join(d, "events.jsonl"))]
+    assert [e["kind"] for e in ev_lines] == ["submit", "finish"]
+    assert ev_lines[0]["seq"] < ev_lines[1]["seq"]
+    m_lines = [json.loads(x) for x in
+               open(os.path.join(d, "metrics.jsonl"))]
+    assert [m["reason"] for m in m_lines] == ["manual", "exit"]
+    assert m_lines[0]["metrics"]["t/steps"]["value"] == 3
+    prom = open(os.path.join(d, "metrics.prom")).read()
+    assert "paddle_tpu_t_steps_total 3" in prom
+    assert 'paddle_tpu_t_ms{quantile="0.95"} 5' in prom
+
+
+def test_sink_close_idempotent_and_replaced_sink_flushes(tmp_path):
+    a = psink.enable_sink(str(tmp_path / "a"), interval_s=60.0)
+    b = psink.enable_sink(str(tmp_path / "b"), interval_s=60.0)
+    assert psink.active_sink() is b
+    reasons = [json.loads(x)["reason"]
+               for x in open(os.path.join(a.directory, "metrics.jsonl"))]
+    assert reasons[-1] == "replaced"
+    a.close()                            # second close: no extra line
+    assert len([1 for _ in
+                open(os.path.join(a.directory, "metrics.jsonl"))]) \
+        == len(reasons)
+    psink.disable_sink()
+    assert psink.active_sink() is None
+
+
+def test_sink_interval_thread_flushes(tmp_path):
+    d = str(tmp_path / "sink")
+    registry().counter("t/x").add(1)
+    with MetricsSink(d, interval_s=0.05) as s:
+        deadline = time.time() + 5.0
+        while s.flushes < 2 and time.time() < deadline:
+            time.sleep(0.02)
+    m_lines = [json.loads(x) for x in
+               open(os.path.join(d, "metrics.jsonl"))]
+    assert any(m["reason"] == "interval" for m in m_lines)
+    assert m_lines[-1]["reason"] == "exit"
+
+
+def test_sink_dir_reuse_rotates_stale_artifacts(tmp_path):
+    """A second sink session in the same --sink-dir must not append
+    its seq-0 lines after the first session's higher seqs (the schema
+    validator requires per-file strictly-increasing seqs): stale
+    metrics/events files rotate to a .N suffix instead."""
+    d = str(tmp_path / "sink")
+    pevents.emit("submit", rid=1)
+    with MetricsSink(d, interval_s=60.0):
+        pass                             # close() flushes
+    pevents.emit("submit", rid=2)
+    with MetricsSink(d, interval_s=60.0):
+        pass
+    assert os.path.exists(os.path.join(d, "metrics.jsonl.1"))
+    assert os.path.exists(os.path.join(d, "events.jsonl.1"))
+    for fname in ("metrics.jsonl", "metrics.jsonl.1"):
+        seqs = [json.loads(x)["flush_seq"]
+                for x in open(os.path.join(d, fname))]
+        assert seqs == sorted(set(seqs))  # strictly increasing per file
+    assert [json.loads(x)["flush_seq"]
+            for x in open(os.path.join(d, "metrics.jsonl"))][0] == 0
+
+
+def test_sink_failed_event_write_resends_segment_no_dup_seq(tmp_path):
+    """An I/O error mid-flush must not lose the event segment (cursor
+    advances only after a successful append) and must not reuse a
+    flush_seq (stamp-then-increment: failures leave gaps, never
+    duplicates)."""
+    d = str(tmp_path / "sink")
+    s = MetricsSink(d, interval_s=60.0)   # not started: no thread
+    pevents.emit("submit", rid=7)
+    good = s._events_path
+    s._events_path = os.path.join(d, "no-such-dir", "events.jsonl")
+    with pytest.raises(OSError):
+        s.flush("manual")
+    s._events_path = good
+    s.close()                             # retry flush on close
+    ev_lines = [json.loads(x) for x in
+                open(os.path.join(d, "events.jsonl"))]
+    assert [e["rid"] for e in ev_lines] == [7]   # re-sent exactly once
+    m_seqs = [json.loads(x)["flush_seq"] for x in
+              open(os.path.join(d, "metrics.jsonl"))]
+    assert m_seqs == [1]                  # seq 0 burned by the failure
+
+
+def test_sink_counts_ring_overflow_as_events_lost(tmp_path):
+    """Events aged out of the ring between flushes must not vanish
+    silently: the seq gap is counted in the flush's metrics line."""
+    lg = EventLog(capacity=4)
+    s = MetricsSink(str(tmp_path), interval_s=60.0, event_log=lg)
+    for i in range(3):
+        lg.emit("submit", rid=i)
+    assert s.flush("manual")["events_lost"] == 0
+    for i in range(10):                   # seqs 3..12; ring keeps 9..12
+        lg.emit("submit", rid=i)
+    assert s.flush("manual")["events_lost"] == 6
+    s.close()
+    rows = [json.loads(x) for x in
+            open(os.path.join(str(tmp_path), "metrics.jsonl"))]
+    assert [r["events_lost"] for r in rows[:2]] == [0, 6]
+
+
+def test_flush_timeout_skips_wedged_writer(tmp_path):
+    """The watchdog-fire flush must not block behind a wedged writer
+    lock (hung I/O on the interval thread) — timed acquire returns
+    None and the abort path proceeds."""
+    import threading
+
+    s = MetricsSink(str(tmp_path), interval_s=60.0)
+    held = threading.Event()
+    release = threading.Event()
+
+    def wedge():
+        with s._lock:
+            held.set()
+            release.wait(10)
+
+    t = threading.Thread(target=wedge, daemon=True)
+    t.start()
+    assert held.wait(5)
+    t0 = time.perf_counter()
+    assert s.flush("watchdog", timeout=0.2) is None
+    assert time.perf_counter() - t0 < 5.0
+    release.set()
+    t.join(5)
+    assert s.flush("manual") is not None  # healthy lock: flush works
+    s.close()
+
+
+def test_close_timeout_skips_wedged_writer(tmp_path):
+    """atexit's close must not hang process exit behind a wedged
+    writer either — bounded acquire gives up the final flush."""
+    import threading
+
+    s = MetricsSink(str(tmp_path), interval_s=60.0)
+    held = threading.Event()
+    release = threading.Event()
+
+    def wedge():
+        with s._lock:
+            held.set()
+            release.wait(10)
+
+    t = threading.Thread(target=wedge, daemon=True)
+    t.start()
+    assert held.wait(5)
+    t0 = time.perf_counter()
+    s.close("exit", timeout=0.2)          # must return promptly
+    assert time.perf_counter() - t0 < 5.0
+    assert s.flushes == 0                 # final flush skipped...
+    release.set()
+    t.join(5)
+    assert s.flush("manual") is None      # ...and the sink is closed
+    s.close()                             # idempotent
+
+
+def test_prometheus_text_sanitizes_and_types():
+    registry().counter("serving/tokens.generated").add(2)
+    registry().gauge("mem/peak").set(1.5)
+    text = prometheus_text(registry().snapshot())
+    assert "# TYPE paddle_tpu_serving_tokens_generated_total counter" \
+        in text
+    assert "paddle_tpu_serving_tokens_generated_total 2" in text
+    assert "paddle_tpu_mem_peak 1.5" in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_deltas_and_dump(tmp_path):
+    fr = FlightRecorder(tail_events=8)
+    registry().counter("t/ticks").add(5)
+    fr.mark()
+    registry().counter("t/ticks").add(2)     # moved since mark
+    registry().counter("t/still").add(0)     # untouched
+    pevents.emit("watchdog_fire", step=3)
+    path = str(tmp_path / "flight.json")
+    doc = fr.dump(path, reason="test")
+    assert doc["kind"] == "flight_recorder_dump"
+    assert doc["reason"] == "test"
+    assert doc["metric_deltas_since_mark"]["t/ticks"] == 2.0
+    assert "t/still" not in doc["metric_deltas_since_mark"]
+    assert any(e["kind"] == "watchdog_fire" for e in doc["events"])
+    on_disk = json.load(open(path))
+    assert on_disk["reason"] == "test"
+
+
+def test_dump_flight_defaults_into_active_sink_dir(tmp_path):
+    assert pevents.dump_flight("nowhere") is None   # no sink, no path
+    psink.enable_sink(str(tmp_path / "sink"), interval_s=60.0)
+    p = pevents.dump_flight("bad step!")
+    assert p is not None and os.path.exists(p)
+    assert "bad-step-" in os.path.basename(p)       # sanitized reason
+    json.load(open(p))
+
+
+def test_dump_flight_failed_write_returns_none(tmp_path):
+    # an unwritable home must not advertise a path that does not exist
+    # (watchdog.flight_path's documented None signal depends on this)
+    missing = str(tmp_path / "no-such-dir" / "flight.json")
+    assert pevents.dump_flight("hang", path=missing) is None
+    doc = pevents.flight_recorder().dump(missing, reason="hang")
+    assert "write_error" in doc
+
+
+def test_watchdog_fire_leaves_flight_dump_and_sink_line(tmp_path):
+    """The ISSUE acceptance artifact: a hang leaves a post-mortem on
+    disk — flight JSON in the sink directory plus a final metrics line
+    with reason "watchdog" — with no cooperation from the hung loop."""
+    from paddle_tpu.resilience import StepWatchdog
+
+    d = str(tmp_path / "sink")
+    psink.enable_sink(d, interval_s=60.0)
+    fired = []
+    wd = StepWatchdog(0.15, jitter_frac=0.0, abort=False, poll_s=0.05,
+                      on_fire=lambda s, el, t: fired.append(s))
+    with wd:
+        wd.pet(7)
+        time.sleep(0.6)                  # no pets: fires
+    assert wd.fired and fired == [7]
+    assert wd.flight_path is not None and os.path.exists(wd.flight_path)
+    doc = json.load(open(wd.flight_path))
+    assert doc["reason"] == "watchdog"
+    assert any(e["kind"] == "watchdog_fire" for e in doc["events"])
+    psink.disable_sink()
+    reasons = [json.loads(x)["reason"]
+               for x in open(os.path.join(d, "metrics.jsonl"))]
+    assert "watchdog" in reasons
+
+
+def test_watchdog_dump_file_hosts_flight_json(tmp_path):
+    from paddle_tpu.resilience import StepWatchdog
+
+    df = str(tmp_path / "wd.txt")
+    wd = StepWatchdog(0.15, jitter_frac=0.0, abort=False, poll_s=0.05,
+                      dump_file=df)
+    with wd:
+        wd.pet(0)
+        time.sleep(0.6)
+    assert wd.fired
+    assert os.path.exists(df)                      # stack dump
+    assert wd.flight_path == df + ".flight.json"   # flight JSON beside
+    json.load(open(wd.flight_path))
+
+
+# ---------------------------------------------------------------------------
+# compiled-program accounting
+# ---------------------------------------------------------------------------
+
+
+def test_xla_stats_record_lowered_inventory_and_gauges():
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.tanh(x) @ x
+
+    lowered = jax.jit(f).lower(
+        jax.ShapeDtypeStruct((8, 8), jnp.float32))
+    st = xla_stats.record_lowered("test.prog#0", lowered)
+    assert st.compile_ms is not None and st.compile_ms > 0
+    inv = xla_stats.inventory()
+    assert "test.prog#0" in inv
+    assert inv["test.prog#0"]["compile_ms"] == round(st.compile_ms, 3)
+    g = registry().gauge("xla/test.prog#0/compile_ms").value
+    assert g == round(st.compile_ms, 3) or g == st.compile_ms
+    # CPU backend reports flops/bytes from the optimized HLO
+    if st.cost:
+        assert st.flops is not None and st.flops > 0
+        assert registry().gauge("xla/test.prog#0/flops").value > 0
+    # re-record replaces, not duplicates
+    xla_stats.record_compiled("test.prog#0", lowered.compile())
+    assert len(xla_stats.inventory()) == 1
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM preemption -> sink flush (slow+chaos: full runner lifetime)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_preemption_flushes_sink_jsonl_complete(tmp_path):
+    """chaos self_preempt: the resilient runner commits its preemption
+    checkpoint AND flushes the sink with reason "preempt" before the
+    resumable exit — metrics.jsonl/events.jsonl are complete, parseable
+    artifacts of the preempted lifetime."""
+    from test_resilience import _batch, _tiny_trainer
+
+    from paddle_tpu.resilience import ResilientRunner, chaos
+
+    d = str(tmp_path / "sink")
+    psink.enable_sink(d, interval_s=60.0)
+    tr = _tiny_trainer()
+    plan = chaos.ChaosPlan(preempt_after_step=1)
+    runner = ResilientRunner(tr, str(tmp_path / "ck"),
+                             save_interval=100, chaos=plan)
+    res = runner.run(_batch, 6)
+    assert res.preempted and res.exit_code == 75
+    m_lines = [json.loads(x) for x in
+               open(os.path.join(d, "metrics.jsonl"))]
+    assert any(m["reason"] == "preempt" for m in m_lines)
+    pre = [m for m in m_lines if m["reason"] == "preempt"][-1]
+    assert pre["metrics"]["resilience/preemptions"]["value"] >= 1
+    psink.disable_sink()
+    for x in open(os.path.join(d, "events.jsonl")):
+        json.loads(x)                    # parseable end to end
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_rollback_leaves_flight_dump(tmp_path):
+    """K consecutive NaN steps: the rollback path writes a flight dump
+    (reason "rollback") into the sink dir and flushes a "rollback"
+    metrics line before restoring — the bad-step guard's post-mortem."""
+    from test_resilience import _batch, _tiny_trainer
+
+    from paddle_tpu.resilience import (ResilienceConfig,
+                                       ResilientRunner, chaos)
+
+    d = str(tmp_path / "sink")
+    psink.enable_sink(d, interval_s=60.0)
+    tr = _tiny_trainer()
+    # same known-good shape as test_rollback_after_k_bad_steps_...:
+    # ckpt at step 3, K=3 streak on cursors 3,4,5 rolls back to it
+    plan = chaos.ChaosPlan(nan_cursors={3, 4, 5})
+    runner = ResilientRunner(
+        tr, str(tmp_path / "ck"), save_interval=3,
+        config=ResilienceConfig(bad_step_limit=3), chaos=plan)
+    res = runner.run(_batch, 6)
+    assert res.completed and res.rollbacks == 1
+    flights = [f for f in os.listdir(d) if f.startswith("flight-")]
+    assert len(flights) == 1
+    doc = json.load(open(os.path.join(d, flights[0])))
+    assert doc["reason"] == "rollback"
+    assert any(e["kind"] == "rollback" for e in doc["events"])
+    psink.disable_sink()
+    reasons = [json.loads(x)["reason"]
+               for x in open(os.path.join(d, "metrics.jsonl"))]
+    assert "rollback" in reasons

@@ -307,6 +307,100 @@ class TestPrefixCaching:
         eng.pool.drop_prefix_cache()
         assert eng.pool.allocator.num_allocated == 0
 
+    def test_event_timeline_and_requeue_wait_under_preemption(self):
+        """ISSUE 8: per-request event timelines under preempt-requeue —
+        (a) ordering invariants submit <= admit <= first_token <=
+        finish per request, with preempt -> requeue -> re-admit in
+        order; (b) the latency breakdown charges preempted time to its
+        own bucket; (c) regression: a preempt->requeue cycle lands in
+        serving/requeue_wait_ms, NOT back in the submit-anchored
+        serving/prefill_queue_wait_ms (which previously conflated
+        scheduler delay with preemption cost)."""
+        from paddle_tpu.profiler import (event_log, latency_breakdown,
+                                         registry)
+
+        net = _net()
+        # pool smaller than residency: preemption guaranteed (same
+        # shape as test_preempt_requeue_reuses_own_prefix)
+        eng = ServingEngine(net, ServingConfig(
+            num_slots=2, page_size=8, pages_per_slot=3, num_pages=5,
+            prefill_chunk=8))
+        qw0 = registry().histogram("serving/prefill_queue_wait_ms").count
+        rw0 = registry().histogram("serving/requeue_wait_ms").count
+        pre0 = registry().counter("serving/preemptions").value
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 128, (8,)).astype(np.int32)
+                   for _ in range(3)]
+        rids = [eng.submit(p, 16) for p in prompts]
+        eng.run()
+        preempts = registry().counter("serving/preemptions").value - pre0
+        assert preempts > 0
+
+        def mine(rid):
+            return [e for e in event_log().events(rid=rid)
+                    if e.attrs.get("eng") == eng._eng_id]
+
+        preempted_rids = 0
+        for rid in rids:
+            evs = mine(rid)
+            first = {}
+            for e in evs:
+                first.setdefault(e.kind, e.t_ns)
+            assert first["submit"] <= first["admit"] \
+                <= first["first_token"] <= first["finish"]
+            # every preempt is followed by a requeue then a re-admit
+            kinds = [e.kind for e in evs]
+            for i, k in enumerate(kinds):
+                if k == "preempt":
+                    assert "requeue" in kinds[i + 1:]
+                    assert "admit" in kinds[i + 1:]
+            b = latency_breakdown(rid)
+            assert b["complete"] and b["tokens"] == 16
+            if b["preempts"]:
+                preempted_rids += 1
+                assert b["preempted_ms"] > 0.0
+        assert preempted_rids > 0
+        # (c) the wait-accounting split: one submit-anchored wait per
+        # FRESH admission, one requeue wait per preemption
+        qw = registry().histogram("serving/prefill_queue_wait_ms").count
+        rw = registry().histogram("serving/requeue_wait_ms").count
+        assert qw - qw0 == len(rids)
+        assert rw - rw0 == preempts
+
+    def test_preempt_before_first_chunk_still_counts_fresh_wait(self):
+        """An admission cycle preempted before it ever opened a prefill
+        chunk must still record its wait sample at the preemption
+        (previously lost: the one first-chunk-open observation then
+        landed in requeue_wait_ms because preempts was already 1) — so
+        qw == requests / rw == preemptions hold under EVERY
+        interleaving, not just chunk-opens-before-preempt."""
+        from paddle_tpu.profiler import registry
+
+        net = _net()
+        eng = ServingEngine(net, ServingConfig(
+            num_slots=2, page_size=8, pages_per_slot=4, num_pages=9,
+            prefill_chunk=8, prefill_chunks_per_tick=1))
+        qw0 = registry().histogram("serving/prefill_queue_wait_ms").count
+        rw0 = registry().histogram("serving/requeue_wait_ms").count
+        pre0 = registry().counter("serving/preemptions").value
+        rng = np.random.RandomState(5)
+        r0 = eng.submit(rng.randint(0, 128, (16,)).astype(np.int32), 8)
+        eng.step()                  # r0 admitted, opens its first chunk
+        r1 = eng.submit(rng.randint(0, 128, (8,)).astype(np.int32), 8)
+        eng.step()                  # r1 admitted; chunk budget spent on r0
+        s1 = eng._slot_rid.index(r1)
+        assert not eng._slot_looked_up[s1]    # r1 never opened a chunk
+        eng.drain(0)
+        eng._preempt_for(eng._slot_rid.index(r0), 0)  # victim: youngest=r1
+        assert eng._slot_rid[s1] is None
+        out = eng.run()
+        assert len(out[r1]) == 8              # r1 still completes
+        assert registry().counter("serving/preemptions").value - pre0 == 1
+        qw = registry().histogram("serving/prefill_queue_wait_ms").count
+        rw = registry().histogram("serving/requeue_wait_ms").count
+        assert qw - qw0 == 2                  # fresh sample NOT lost
+        assert rw - rw0 == 1                  # one preemption, one requeue
+
     def test_cow_tail_page_isolation(self):
         """Two requests diverging MID-page: the second copy-on-writes
         the partially-agreeing tail page instead of aliasing it, so its
@@ -665,6 +759,26 @@ class TestUnifiedVsLegacy:
         counts = recompile.trace_counts()
         assert all(counts[site] == 1 for site in uni.compiled_sites)
         assert all(counts[site] == 1 for site in leg.compiled_sites)
+
+    def test_program_inventory_covers_every_dispatched_site(self):
+        """ISSUE 8 regression: record_program_stats() must return one
+        inventory entry per compiled_sites program that dispatched —
+        the avals are captured at first dispatch, and losing that
+        capture silently empties the xla_programs bench block (the
+        sink-schema CI leg caught exactly that)."""
+        net = _net()
+        eng = ServingEngine(net, ServingConfig(
+            num_slots=2, page_size=8, pages_per_slot=3,
+            prefill_chunk=8))
+        rid = eng.submit(np.arange(8, dtype=np.int32) % 128, 4)
+        eng.run()
+        inv = eng.record_program_stats()
+        assert set(inv) == set(eng.compiled_sites)
+        for site, rec in inv.items():
+            assert rec["site"] == site
+            assert rec["compile_ms"] > 0.0
+            assert {"flops", "bytes_accessed", "cost_available"} \
+                <= set(rec)
 
     def test_kernel_selection_and_deprecated_alias(self):
         net = _net()

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""Validate a persistent-metrics-sink directory (and optionally a
+serve_bench JSON block) against tools/sink_schema.json.
+
+CI's sink-schema leg runs::
+
+    python benchmarks/serve_bench.py --tiny --sink-dir /tmp/sink \
+        > /tmp/serve.json
+    python tools/check_sink_schema.py /tmp/sink \
+        --bench-json /tmp/serve.json
+
+and fails the build on any violation: a torn/garbled JSONL line, a
+non-monotonic event sequence, a malformed Prometheus exposition, a
+bench block missing the p50/p90/p95/p99 TTFT/TPOT percentiles or the
+compiled-program inventory. stdlib only (the CI image installs jax +
+numpy + pytest, nothing else).
+
+Note on events.jsonl seq monotonicity: the sink's writer is
+at-least-once under I/O errors — a partially-landed segment is re-sent
+WHOLE on the next flush, so a mid-write failure leaves a torn line
+and/or duplicate seqs. This checker flagging such a file is the
+intended behavior, not a false positive: the file is damaged, and the
+sink's contract (see profiler/sink.py) is that damage surfaces here
+instead of events silently vanishing. On the clean path seqs are
+strictly increasing. Seq GAPS (not flagged here) are ring-overflow
+losses: events aged out before a flush could persist them — counted
+per flush as ``events_lost`` in metrics.jsonl, which this checker
+requires to be present.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+_ERRORS = []
+
+
+def err(msg: str) -> None:
+    _ERRORS.append(msg)
+
+
+def check_metrics_jsonl(path: str, schema: dict) -> None:
+    sc = schema["metrics_jsonl"]
+    if not os.path.exists(path):
+        return err(f"{path}: missing")
+    last_seq = -1
+    n = 0
+    for i, line in enumerate(open(path)):
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError as e:
+            return err(f"{path}:{i + 1}: unparseable line ({e})")
+        n += 1
+        for k in sc["required"]:
+            if k not in row:
+                err(f"{path}:{i + 1}: missing key {k!r}")
+        if row.get("reason") not in sc["reasons"]:
+            err(f"{path}:{i + 1}: unknown reason {row.get('reason')!r}")
+        if not isinstance(row.get("ts"), (int, float)):
+            err(f"{path}:{i + 1}: ts not a number")
+        el = row.get("events_lost")
+        if not isinstance(el, int) or el < 0:
+            err(f"{path}:{i + 1}: events_lost {el!r} not a "
+                "non-negative int")
+        seq = row.get("flush_seq")
+        if not isinstance(seq, int) or seq <= last_seq:
+            err(f"{path}:{i + 1}: flush_seq {seq!r} not strictly "
+                f"increasing (prev {last_seq})")
+        last_seq = seq if isinstance(seq, int) else last_seq
+        for name, m in (row.get("metrics") or {}).items():
+            typ = m.get("type")
+            if typ not in sc["metric_types"]:
+                err(f"{path}:{i + 1}: metric {name!r} has unknown "
+                    f"type {typ!r}")
+            if typ == "histogram" and m.get("count"):
+                for q in sc["histogram_quantiles_when_nonempty"]:
+                    if q not in m:
+                        err(f"{path}:{i + 1}: non-empty histogram "
+                            f"{name!r} missing {q}")
+    if n == 0:
+        err(f"{path}: empty (no flush ever landed)")
+
+
+def check_events_jsonl(path: str, schema: dict) -> None:
+    sc = schema["events_jsonl"]
+    if not os.path.exists(path):
+        return err(f"{path}: missing (the sink writes it even before "
+                   "the first event)")
+    last = -1
+    for i, line in enumerate(open(path)):
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError as e:
+            return err(f"{path}:{i + 1}: unparseable line ({e})")
+        for k in sc["required"]:
+            if k not in ev:
+                err(f"{path}:{i + 1}: missing key {k!r}")
+        if not isinstance(ev.get("kind"), str) or not ev.get("kind"):
+            err(f"{path}:{i + 1}: kind not a non-empty string")
+        if not isinstance(ev.get("t_ns"), int):
+            err(f"{path}:{i + 1}: t_ns not an int")
+        seq = ev.get("seq")
+        if not isinstance(seq, int) or seq <= last:
+            err(f"{path}:{i + 1}: seq {seq!r} not strictly increasing "
+                f"(prev {last}) — the exactly-once cursor is broken")
+        last = seq if isinstance(seq, int) else last
+
+
+_SAMPLE_RE = re.compile(
+    r'^([A-Za-z_:][A-Za-z0-9_:]*)(\{quantile="[0-9.]+"\})?'
+    r" (-?[0-9.]+(?:[eE][+-]?[0-9]+)?|-?inf|nan)$")
+_TYPE_RE = re.compile(r"^# TYPE ([A-Za-z_:][A-Za-z0-9_:]*) (\w+)$")
+
+
+def check_prometheus(path: str, schema: dict) -> None:
+    sc = schema["prometheus"]
+    if not os.path.exists(path):
+        return err(f"{path}: missing")
+    declared = {}
+    for i, line in enumerate(open(path)):
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if not m:
+                err(f"{path}:{i + 1}: malformed comment {line!r}")
+            elif m.group(2) not in sc["types"]:
+                err(f"{path}:{i + 1}: unknown TYPE {m.group(2)!r}")
+            else:
+                declared[m.group(1)] = m.group(2)
+                if not m.group(1).startswith(sc["name_prefix"]):
+                    err(f"{path}:{i + 1}: {m.group(1)!r} lacks the "
+                        f"{sc['name_prefix']!r} prefix")
+                if m.group(2) == "counter" and \
+                        not m.group(1).endswith(sc["counter_suffix"]):
+                    err(f"{path}:{i + 1}: counter {m.group(1)!r} "
+                        f"lacks the {sc['counter_suffix']} suffix")
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            err(f"{path}:{i + 1}: malformed sample {line!r}")
+            continue
+        base = m.group(1)
+        known = any(base == d or base.startswith(d + "_")
+                    for d in declared)
+        if not known:
+            err(f"{path}:{i + 1}: sample {base!r} has no TYPE "
+                "declaration")
+    if not declared:
+        err(f"{path}: no TYPE declarations at all")
+
+
+def check_bench_json(path: str, schema: dict) -> None:
+    sc = schema["bench_extra"]
+    try:
+        extra = json.load(open(path))["extra"]
+    except Exception as e:
+        return err(f"{path}: unreadable bench JSON ({e})")
+    lat = extra.get("request_latency")
+    if not isinstance(lat, dict):
+        return err(f"{path}: extra.request_latency missing")
+    if lat.get("requests", 0) > 0:
+        for h in sc["request_latency_histograms"]:
+            for q in sc["percentiles"]:
+                if q not in (lat.get(h) or {}):
+                    err(f"{path}: request_latency.{h} missing {q}")
+    rows = extra.get("latency_table")
+    if not rows:
+        err(f"{path}: extra.latency_table missing or empty")
+    else:
+        for k in sc["latency_table_row"]:
+            if k not in rows[0]:
+                err(f"{path}: latency_table rows missing {k!r}")
+    progs = extra.get("xla_programs")
+    if not progs:
+        err(f"{path}: extra.xla_programs missing or empty")
+    else:
+        entry = next(iter(progs.values()))
+        for k in sc["xla_programs_entry"]:
+            if k not in entry:
+                err(f"{path}: xla_programs entries missing {k!r}")
+    if "registry" not in extra:
+        err(f"{path}: extra.registry (full snapshot) missing")
+    if "events_overhead_pct" not in extra:
+        err(f"{path}: extra.events_overhead_pct missing")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("sink_dir", help="directory a MetricsSink wrote")
+    ap.add_argument("--bench-json", default=None,
+                    help="serve_bench stdout JSON to validate as well")
+    ap.add_argument("--schema", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "sink_schema.json"))
+    args = ap.parse_args()
+
+    schema = json.load(open(args.schema))
+    check_metrics_jsonl(
+        os.path.join(args.sink_dir, "metrics.jsonl"), schema)
+    check_events_jsonl(
+        os.path.join(args.sink_dir, "events.jsonl"), schema)
+    check_prometheus(
+        os.path.join(args.sink_dir, "metrics.prom"), schema)
+    if args.bench_json:
+        check_bench_json(args.bench_json, schema)
+
+    if _ERRORS:
+        print(f"sink schema: {len(_ERRORS)} violation(s)")
+        for e in _ERRORS:
+            print(f"  {e}")
+        return 1
+    print("sink schema: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
