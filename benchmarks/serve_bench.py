@@ -415,6 +415,227 @@ def bench_shared_prefix(args, tiny):
     }
 
 
+def build_early_exit_draft(net, layers):
+    """A draft model that is the target's first ``layers`` blocks plus
+    its embeddings/final-norm/head — the layer-skip self-drafting
+    construction (Draft&Verify-style early exit). With GPT-2-scale
+    init (0.02) the residual stream changes slowly per block, so the
+    truncated model's argmax agrees with the full model's often enough
+    to be a genuine draft-friendly regime WITHOUT any training; an
+    independent random draft would accept ~0 and only measure
+    overhead. Acceptance only affects speed, never output — the spec
+    engine's greedy stream is bitwise the plain engine's either way
+    (asserted below)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPT, GPTConfig
+
+    c = net.config
+    paddle.seed(1)
+    d = GPT(GPTConfig(vocab_size=c.vocab_size, hidden_size=c.hidden_size,
+                      num_layers=layers, num_heads=c.num_heads,
+                      max_seq_len=c.max_seq_len,
+                      initializer_range=c.initializer_range))
+    d.eval()
+
+    def copy_params(dst, src):
+        for (_, dp), (_, sp) in zip(dst.named_parameters(),
+                                    src.named_parameters()):
+            dp.set_value(sp)
+
+    copy_params(d.embeddings, net.embeddings)
+    for i in range(layers):
+        copy_params(d.blocks[i], net.blocks[i])
+    copy_params(d.ln_f, net.ln_f)
+    return d
+
+
+def bench_spec(args, tiny):
+    """Speculative vs plain engine, greedy, same weights and arrival
+    trace per cell; outputs are asserted BITWISE equal between the two
+    engines, so the measured delta is pure dispatch/overlap structure.
+    The draft is an early-exit copy of the target (``--draft-layers``
+    blocks, ``--draft-k`` tokens per verify).
+
+    Two cells, because where speculation wins is a property of the
+    REGIME, not the trick: the headline ``low_batch`` cell is
+    decode-heavy at small residency — each tick underutilizes the
+    backend, so verifying k+1 positions per dispatch is nearly free
+    (this is the latency-bound regime real TPU decode lives in). The
+    full mode adds a ``compute_bound`` cell (bigger model, full
+    residency, Poisson arrivals) where CPU wall-clock is dominated by
+    FLOPs — speculation never reduces target FLOPs (it removes
+    sequential dispatches; rejected drafts + the draft itself ADD
+    compute), so the margin there comes only from BLAS batching
+    efficiency and shrinks toward (or below) 1x as the draft deepens —
+    the measured draft-depth sensitivity is stated in the note. Best-of
+    ``--reps`` per arm per cell (kernel-matrix noise-floor precedent).
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.profiler import registry
+    from paddle_tpu.serving import ServingConfig, ServingEngine, SpecConfig
+
+    reps = max(1, args.reps)
+    k = args.draft_k
+
+    def make_net(hidden, layers, vocab, msl, heads):
+        # draft-friendly greedy regime: DEFAULT init (0.02) so the
+        # early-exit draft actually agrees with the target —
+        # serve_bench's usual 0.2 init makes every layer matter and
+        # the accept rate collapses; throughput, not output variety,
+        # is what this mode measures (parity is asserted
+        # engine-vs-engine regardless)
+        paddle.seed(0)
+        net = GPT(GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                            num_layers=layers, num_heads=heads,
+                            max_seq_len=msl))
+        net.eval()
+        return net
+
+    def measure(net, draft_layers, cell_k, slots, n_req, prompt_lens,
+                max_new, rate, page_size):
+        draft = build_early_exit_draft(net, draft_layers)
+        pages_per_slot = -(-(max(prompt_lens) + max_new) // page_size)
+        trace = make_trace(n_req, prompt_lens, max_new, rate)
+        plain = build_engine(net, slots, page_size, pages_per_slot,
+                             attention_kernel=args.attention_kernel)
+        spec = ServingEngine(net, ServingConfig(
+            num_slots=slots, page_size=page_size,
+            pages_per_slot=pages_per_slot,
+            attention_kernel=args.attention_kernel,
+            spec=SpecConfig(draft_model=draft, k=cell_k)))
+        warm = make_trace(max(2, slots), prompt_lens, max_new, 1e9,
+                          seed=1)
+        for eng in (plain, spec):
+            run_engine(eng, [(0.0, p, m) for _, p, m in warm])
+            eng.pool.drop_prefix_cache()
+            eng.reset_results()
+        a0 = registry().counter("serving/spec_accepted_tokens").value
+        d0 = registry().counter("serving/spec_drafted_tokens").value
+        best = {"plain": 0.0, "spec": 0.0}
+        outs = {}
+        ticks = {}
+        for _ in range(reps):
+            for name, eng in (("plain", plain), ("spec", spec)):
+                eng.pool.drop_prefix_cache()
+                t0 = registry().counter("serving/ticks").value
+                g0 = registry().counter(
+                    "serving/tokens_generated").value
+                ar0 = registry().counter(
+                    "serving/spec_accepted_tokens").value
+                toks, wall, *_ = run_engine(eng, trace)
+                res = {r.prompt.tobytes(): list(r.out)
+                       for r in eng._requests.values() if r.done}
+                eng.reset_results()
+                if toks / wall > best[name]:
+                    best[name] = toks / wall
+                    outs[name] = res
+                    ticks[name] = (
+                        registry().counter("serving/ticks").value - t0,
+                        registry().counter(
+                            "serving/tokens_generated").value - g0,
+                        registry().counter(
+                            "serving/spec_accepted_tokens").value - ar0)
+        # the acceptance invariant, asserted on the bench path too
+        assert outs["plain"] == outs["spec"], \
+            "spec output diverged from plain greedy engine"
+        accepted = registry().counter(
+            "serving/spec_accepted_tokens").value - a0
+        drafted = registry().counter(
+            "serving/spec_drafted_tokens").value - d0
+        return spec, {
+            "model": {"hidden": net.config.hidden_size,
+                      "layers": net.config.num_layers,
+                      "vocab": net.config.vocab_size},
+            "draft": {"layers": draft_layers, "k": cell_k},
+            "slots": slots, "requests": n_req,
+            "prompt_lens": list(prompt_lens), "max_new": max_new,
+            "arrival_rate_hz": rate, "page_size": page_size,
+            "speedup": round(best["spec"] / max(best["plain"], 1e-9), 4),
+            "spec_tokens_per_sec": round(best["spec"], 2),
+            "plain_tokens_per_sec": round(best["plain"], 2),
+            "accept_rate": round(accepted / drafted, 4) if drafted
+            else 0.0,
+            "spec_verify_ticks": ticks["spec"][0],
+            "plain_decode_ticks": ticks["plain"][0],
+            # per best spec rep: ALL emissions (corrections, plain
+            # rows, finisher firsts included) vs accepted DRAFTS only
+            "tokens_per_verify_tick": round(
+                ticks["spec"][1] / max(ticks["spec"][0], 1), 3),
+            "accepted_tokens_per_verify_tick": round(
+                ticks["spec"][2] / max(ticks["spec"][0], 1), 3),
+        }
+
+    profiler.enable()
+    cells = {}
+    dl = max(1, min(args.draft_layers, 3))
+    if tiny:
+        net = make_net(64, 4, 128, 128, 4)
+        spec_eng, cells["low_batch"] = measure(
+            net, dl, k, 4, 6, (8, 16), 32, 1e9, 8)
+    else:
+        net = make_net(64, 4, 128, 128, 4)
+        spec_eng, cells["low_batch"] = measure(
+            net, dl, k, 4, 8, (8, 16), 48, 1e9, 8)
+        big = make_net(256, 6, 512, 256, 8)
+        _, cells["compute_bound"] = measure(
+            big, max(1, min(args.draft_layers, 5)), k, args.slots,
+            args.requests, (16, 32, 64), args.max_new, args.rate, 16)
+    lat_stats = profiler.request_latency_stats()
+    lat_rows = profiler.latency_table()
+    inventory = spec_eng.record_program_stats()
+    summ = profiler.disable()
+    snap = {kk: v.get("value", v.get("count"))
+            for kk, v in summ["metrics"].items()
+            if kk.startswith("serving/")}
+    return {
+        "metric": "serving_spec_decode_speedup",
+        "value": cells["low_batch"]["speedup"],
+        "unit": "x tokens/s, speculative vs plain engine "
+                "(decode-heavy low-batch burst, greedy)",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "cells": cells,
+            "reps": reps,
+            "draft_kind": "early-exit (first blocks of the target + "
+                          "shared embeddings/head)",
+            "request_latency": lat_stats,
+            "latency_table": lat_rows,
+            "registry": summ["metrics"],
+            "xla_programs": inventory,
+            "profiler": snap,
+            "note": ("speculative greedy output asserted BITWISE "
+                     "equal to the plain engine's in every cell (the "
+                     "acceptance invariant). The draft is an "
+                     "untrained early-exit copy of the target — with "
+                     "0.02-scale init the truncated residual stream "
+                     "agrees with the full model often (a genuinely "
+                     "draft-friendly regime); trained draft/target "
+                     "pairs land elsewhere on the accept-rate curve. "
+                     "low_batch is the headline: small residency, "
+                     "decode-heavy — each tick underutilizes the "
+                     "backend, so one verify of k+1 positions beats "
+                     "k+1 sequential ticks. compute_bound is the "
+                     "honest stress cell: CPU wall-clock there equals "
+                     "FLOPs, which speculation never reduces "
+                     "(rejected drafts + the draft model ADD some) "
+                     "and spec mode gives up the deferred-sync window "
+                     "(acceptance must materialize before the next "
+                     "tick is schedulable) — its margin is mostly "
+                     "BLAS batching efficiency (one [rows, h] matmul "
+                     "beats k+1 thin ones) and is draft-depth "
+                     "sensitive: 1-layer drafts measured ~1.5x across "
+                     "runs of both cells on this box, while a 2-layer "
+                     "draft dropped compute_bound to 0.72x (draft "
+                     "FLOPs are pure overhead there). Real TPU decode "
+                     "is memory-latency-bound like low_batch, not "
+                     "FLOPs-bound; CPU timing therefore understates "
+                     "the TPU win"),
+        },
+    }
+
+
 def bench_kernel_matrix(args, tiny):
     """Unified-tick vs legacy two-dispatch (vs the Pallas ragged
     kernel) on BOTH workloads: the mixed Poisson arrival trace and the
@@ -515,6 +736,15 @@ def main():
                     help="unified-tick vs legacy two-dispatch (and the "
                          "interpret-mode Pallas kernel) on both "
                          "workloads")
+    ap.add_argument("--spec-decode", action="store_true",
+                    help="speculative decoding: spec engine (early-"
+                         "exit draft, greedy acceptance) vs the plain "
+                         "engine on the Poisson workload")
+    ap.add_argument("--draft-layers", type=int, default=1,
+                    help="early-exit draft depth (target blocks "
+                         "copied; clamped below the target's depth)")
+    ap.add_argument("--draft-k", type=int, default=4,
+                    help="draft tokens speculated per verify tick")
     ap.add_argument("--attention-kernel", default="ragged-xla",
                     choices=["ragged-xla", "ragged-pallas", "legacy"],
                     help="engine attention/dispatch path for the "
@@ -531,6 +761,9 @@ def main():
                          "directory (metrics.jsonl + events.jsonl + "
                          "metrics.prom, final flush on exit)")
     args = ap.parse_args()
+    if args.spec_decode and args.attention_kernel == "legacy":
+        ap.error("--spec-decode needs the unified tick; "
+                 "--attention-kernel legacy has no verify-row path")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -544,6 +777,8 @@ def main():
 
     if args.kernel_matrix:
         out = bench_kernel_matrix(args, args.tiny)
+    elif args.spec_decode:
+        out = bench_spec(args, args.tiny)
     elif args.prefix_cache:
         out = bench_shared_prefix(args, args.tiny)
     else:

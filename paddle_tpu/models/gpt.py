@@ -573,7 +573,8 @@ def gpt_cached_apply(cfg: GPTConfig, stacked, other, ck, cv, tokens, pos0,
 def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
                      tokens, tok_pos, tok_limit, row_tab, row_pos0,
                      row_len, sample_ix, decode_rows: int,
-                     chunk_width: int, impl: str = "xla"):
+                     chunk_width: int, impl: str = "xla",
+                     spec_k: int = 0):
     """Mixed prefill/decode forward over the PAGED cache: every token
     in flight rides one program. ``tokens`` [NT] is the flat token
     buffer of one serving tick — ``decode_rows`` resident decode
@@ -612,6 +613,18 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
     traced: one compiled program serves every mix of resident decodes
     and prompt chunks. Returns (logits [S, V], kpool, vpool).
 
+    ``spec_k > 0`` (speculative decoding, serving/spec.py) widens each
+    of the ``decode_rows`` slot rows into a **verify row** of
+    ``1 + spec_k`` tokens: the flat buffer becomes ``decode_rows`` last
+    tokens, then ``decode_rows * spec_k`` draft tokens (slot-major),
+    then the chunks. The slot rows' attention groups as
+    ``[decode_rows, 1 + spec_k]``; logits can be sampled at EVERY
+    verify position (a verify row is exactly a chunk-shaped row whose
+    logits are kept per position, not just at the end). A slot that is
+    not speculating this tick rides the same group with
+    ``row_len == 1`` — its draft positions are pad queries
+    (``tok_limit == 0`` routes their KV writes to the null page).
+
     Bitwise contract (the engine's parity tests rest on it):
     per-token results are independent of which *other* rows share the
     program — hidden/head contractions are row-independent, LN/GELU
@@ -619,13 +632,16 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
     capacity with exact-zero masked weights (``ops/paged_attention._
     gather_attend``, the one shared spelling) — so a decode row here
     equals the old dedicated decode tick and a chunk row equals the
-    old suffix-prefill program, token for token, bit for bit.
+    old suffix-prefill program, token for token, bit for bit; a verify
+    position equals the decode row the non-speculative engine would
+    have run at that position.
     """
     from ..ops.paged_attention import ragged_paged_attention
 
     nt = tokens.shape[0]
     nd = decode_rows
-    nch = (nt - nd) // chunk_width if chunk_width else 0
+    base = nd * (1 + spec_k)
+    nch = (nt - base) // chunk_width if chunk_width else 0
     nh = cfg.num_heads
     hd = cfg.hidden_size // nh
     eps = cfg.layer_norm_eps
@@ -634,12 +650,15 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
     wte = other["embeddings.wte.weight"]
     wpe = other["embeddings.wpe.weight"]
     x = wte[tokens[:, None]] + wpe[tok_pos[:, None]]    # [NT, 1, h]
-    # token -> ragged row (static: the flat layout never changes)
-    tok_row = jnp.concatenate(
-        [jnp.arange(nd, dtype=jnp.int32),
-         jnp.repeat(nd + jnp.arange(nch, dtype=jnp.int32),
-                    chunk_width)]) if nch else \
-        jnp.arange(nd, dtype=jnp.int32)
+    # token -> ragged row (static: the flat layout never changes);
+    # draft tokens share their slot's row (same page table)
+    parts = [jnp.arange(nd, dtype=jnp.int32)]
+    if spec_k:
+        parts.append(jnp.repeat(jnp.arange(nd, dtype=jnp.int32), spec_k))
+    if nch:
+        parts.append(jnp.repeat(nd + jnp.arange(nch, dtype=jnp.int32),
+                                chunk_width))
+    tok_row = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     # write targets: real positions go to their slot page, everything
     # at/past the limit to the null page (clip keeps the page-table
     # index in range for positions past the slot capacity)
@@ -656,12 +675,24 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
             kpl = kpl0.at[page, off].set(kk[:, 0])
             vpl = vpl0.at[page, off].set(vv[:, 0])
             outs = []
-            if nd:
+            if nd and spec_k:
+                # verify grouping [nd, 1 + spec_k]: each slot's last
+                # token plus its drafts as one chunk-shaped row; the
+                # outputs un-interleave back into flat-buffer order
+                qv = jnp.concatenate(
+                    [q[:nd], q[nd:base, 0].reshape(nd, spec_k, nh, hd)],
+                    axis=1)
+                ov = ragged_paged_attention(
+                    qv, kpl, vpl, row_tab[:nd], row_pos0[:nd],
+                    row_len[:nd], impl=impl)
+                outs.append(ov[:, :1])
+                outs.append(ov[:, 1:].reshape(nd * spec_k, 1, nh, hd))
+            elif nd:
                 outs.append(ragged_paged_attention(
                     q[:nd], kpl, vpl, row_tab[:nd], row_pos0[:nd],
                     row_len[:nd], impl=impl))
             if nch:
-                qp = q[nd:, 0].reshape(nch, chunk_width, nh, hd)
+                qp = q[base:, 0].reshape(nch, chunk_width, nh, hd)
                 op = ragged_paged_attention(
                     qp, kpl, vpl, row_tab[nd:], row_pos0[nd:],
                     row_len[nd:], impl=impl)

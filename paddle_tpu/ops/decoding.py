@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["greedy_decode", "sampling_decode", "beam_search_decode",
-           "apply_top_k_top_p", "apply_top_k_top_p_per_row"]
+           "apply_top_k_top_p", "apply_top_k_top_p_per_row",
+           "spec_accept_length"]
 
 NEG_INF = -1e9
 
@@ -127,6 +128,37 @@ def apply_top_k_top_p_per_row(logits, top_k, top_p):
     kth_p = jnp.min(jnp.where(keep_sorted, sorted_f, jnp.inf), axis=-1)
     thr_p = jnp.where(tp < 1.0, kth_p, -jnp.inf)
     return jnp.where(logits < thr_p[..., None], NEG_INF, logits)
+
+
+def spec_accept_length(draft_toks, target_toks, n_draft):
+    """Greedy speculative acceptance: the length of the longest draft
+    prefix the target model agrees with (the classic spec-decoding
+    rule, serving/spec.py).
+
+    draft_toks   [N, k] int32  draft tokens d_1..d_k per row
+    target_toks  [N, k] int32  the target's greedy argmax at each
+                               draft token's PREDECESSOR position —
+                               ``target_toks[:, j]`` is what the target
+                               would emit where the draft guessed
+                               ``draft_toks[:, j]``
+    n_draft      [N] int32     drafts actually offered per row (<= k);
+                               positions past it never count
+
+    Returns accepted [N] int32 in ``[0, n_draft]``: draft j+1 is
+    accepted iff drafts 1..j were AND ``d_{j+1} == t_j``. A row with
+    ``n_draft == 0`` (plain decode row riding a spec tick) returns 0.
+    The emitted tokens are then ``target_toks[:, :accepted]`` plus the
+    correction token — always the target's own argmax stream, which is
+    what makes greedy spec-decode bitwise identical to non-speculative
+    greedy decode.
+    """
+    k = draft_toks.shape[1]
+    offered = jnp.arange(k, dtype=jnp.int32)[None, :] < \
+        jnp.asarray(n_draft, jnp.int32)[:, None]
+    match = (draft_toks == target_toks) & offered
+    # cumprod turns the first mismatch into a permanent 0: the sum is
+    # the longest all-accepted prefix, not the total match count
+    return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
 
 
 def sampling_decode(step_fn: Callable, cache: Any, first_logits, start_pos,

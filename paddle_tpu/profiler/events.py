@@ -53,10 +53,19 @@ __all__ = [
 ]
 
 #: well-known serving lifecycle kinds (informational — emit() accepts
-#: any string; the resilience runner adds "rollback")
+#: any string; the resilience runner adds "rollback"). Speculative
+#: decoding (ISSUE 9) adds three: ``draft`` (the slot's draft-cache
+#: catch-up began — once per admission cycle), ``verify`` (the first
+#: verify tick carrying the request's drafts — once per cycle), and
+#: ``accept`` (per speculating verify tick, attrs ``accepted`` /
+#: ``drafted``). ``accept`` is the one deliberately-denser kind:
+#: bounded by verify ticks, i.e. at most one event per ~k emitted
+#: tokens, and only ever emitted by a spec-enabled engine — plain
+#: engines keep the strict O(1)-per-residency lifecycle rate.
 EVENT_KINDS = (
     "submit", "admit", "prefix_hit", "cow_copy", "chunk",
-    "first_token", "preempt", "requeue", "finish", "rollback",
+    "first_token", "draft", "verify", "accept",
+    "preempt", "requeue", "finish", "rollback",
 )
 
 
@@ -207,9 +216,15 @@ def breakdown_from_events(evs: List[Event]) -> Optional[dict]:
     forced: requeue wait, re-admission, and the re-prefill chunks —
     tracked via the ``final`` attr the engine stamps on ``chunk``
     events — are all preemption cost, not decode), plus the finish
-    event's ttft/tpot/tokens attrs. Partial sequences (events aged out
-    of the ring, request still running) yield a breakdown of what is
-    known, flagged ``"complete": False``."""
+    event's ttft/tpot/tokens attrs. Speculative-decoding events ride
+    the decode bucket: ``draft``/``verify``/``accept`` never move the
+    state machine (time keeps accruing to the current state, so the
+    four buckets still sum to the total); ``accept`` events are instead
+    FOLDED into ``spec_accepted``/``spec_drafted`` counts on the
+    result (present only when the request speculated). Partial
+    sequences (events aged out of the ring, request still running)
+    yield a breakdown of what is known, flagged
+    ``"complete": False``."""
     if not evs:
         return None
     out = {k: 0.0 for k in
@@ -220,6 +235,8 @@ def breakdown_from_events(evs: List[Event]) -> Optional[dict]:
     t_first_tok = None
     seen_first = False
     preempts = 0
+    spec_accepted = 0
+    spec_drafted = 0
     finish: Optional[Event] = None
 
     def charge(t_ns: int) -> None:
@@ -251,6 +268,9 @@ def breakdown_from_events(evs: List[Event]) -> Optional[dict]:
             seen_first = True
             if t_first_tok is None:
                 t_first_tok = ev.t_ns
+        elif k == "accept":
+            spec_accepted += int(ev.attrs.get("accepted") or 0)
+            spec_drafted += int(ev.attrs.get("drafted") or 0)
         elif k == "preempt":
             charge(ev.t_ns)
             state = "requeued"
@@ -266,6 +286,9 @@ def breakdown_from_events(evs: List[Event]) -> Optional[dict]:
     result = {"rid": rid, **{k: round(v, 3) for k, v in out.items()},
               "preempts": preempts,
               "complete": finish is not None and t_submit is not None}
+    if spec_drafted:
+        result["spec_accepted"] = spec_accepted
+        result["spec_drafted"] = spec_drafted
     if t_submit is not None and t_first_tok is not None:
         result["ttft_ms"] = round((t_first_tok - t_submit) / 1e6, 3)
     if t_submit is not None and finish is not None:

@@ -205,12 +205,52 @@ class MetricsRegistry:
                 raw.rstrip(b"\x00").decode()))
         return sorted(union)
 
+    def _gather_reservoir(self, name: str) -> List[float]:
+        """All ranks' reservoir samples for histogram ``name`` merged
+        into one list (just the local reservoir at world_size 1).
+        Rides one max-length allreduce + one NaN-padded allgather per
+        histogram; every rank issues the identical collective sequence
+        even when it lacks the metric locally (the schema-union rule —
+        an empty reservoir still participates). Width 0 (no rank has a
+        sample) skips the gather on every rank alike."""
+        from ..distributed.collective import all_gather
+        from ..distributed.env import get_world_size
+        from ..distributed.fleet import metrics as fm
+        from ..framework.tensor import Tensor
+
+        with self._lock:
+            m = self._metrics.get(name)
+        if isinstance(m, Histogram):
+            with m._lock:
+                local = list(m._recent)
+        else:
+            local = []
+        if get_world_size() <= 1:
+            return local
+        width = int(fm.max(len(local)))
+        if width == 0:
+            return []
+        buf = np.full(width, np.nan, np.float64)
+        buf[:len(local)] = local
+        gathered: list = []
+        all_gather(gathered, Tensor(buf))
+        out: List[float] = []
+        for t in gathered:
+            vals = np.asarray(t._value, np.float64).reshape(-1)
+            out.extend(float(v) for v in vals[~np.isnan(vals)])
+        return out
+
     def aggregate(self) -> Dict[str, dict]:
         """Cross-rank reduction of the snapshot: counters and histogram
         count/sum are SUM-reduced, gauges and histogram min/max take the
         MAX/MIN envelope (a fleet-wide high-water mark is the max over
-        ranks). Rides distributed/fleet/metrics.py — identity at
-        world_size 1.
+        ranks), and histogram quantiles are recomputed over the MERGED
+        rank-local reservoirs (each rank contributes its most recent
+        ``_RESERVOIR`` observations — a bounded-window approximation,
+        the same caveat a single rank's snapshot quantiles already
+        carry; the point is that an aggregated p95 is computed from
+        every rank's samples instead of being silently dropped). Rides
+        distributed/fleet/metrics.py — identity at world_size 1.
 
         Every fm.* call is a collective, so ranks MUST issue the same
         sequence: the schema union above aligns rank-dependent metric
@@ -245,10 +285,16 @@ class MetricsRegistry:
                 if n:
                     s.update(count=n, sum=tot, mean=tot / n,
                              min=mn, max=mx)
-                # reservoirs are rank-local; a p99 next to fleet-wide
-                # count/min/max would read as fleet-wide when it isn't
-                for q in ("p50", "p90", "p95", "p99"):
-                    s.pop(q, None)
+                merged = self._gather_reservoir(name)
+                if merged:
+                    ss = sorted(merged)
+                    s.update(p50=percentile(ss, 50),
+                             p90=percentile(ss, 90),
+                             p95=percentile(ss, 95),
+                             p99=percentile(ss, 99))
+                else:
+                    for q in ("p50", "p90", "p95", "p99"):
+                        s.pop(q, None)
         return snap
 
 

@@ -20,7 +20,11 @@ resident decodes, as ragged rows of one
 decode row is simply ``true_len == 1``). XLA gather spelling is the
 measured default; a Pallas ragged kernel is interpret-verified and
 gated for the real-TPU follow-up; ``attention_kernel="legacy"`` keeps
-the pre-unification two-dispatch engine for benchmarking.
+the pre-unification two-dispatch engine for benchmarking. Speculative
+decoding (``ServingConfig.spec`` = ``SpecConfig(draft_model, k)``,
+``spec.py``) amortizes the target over k drafted tokens per verify
+tick with greedy acceptance — spec greedy output stays BITWISE equal
+to plain greedy (the classic invariant, tested).
 
 Quick use::
 
@@ -54,6 +58,8 @@ from __future__ import annotations
 from .engine import Request, ServingConfig, ServingEngine  # noqa: F401
 from .paged_cache import (NULL_PAGE, PageAllocator, PagePool,  # noqa: F401
                           PrefixCache)
+from .spec import DraftRunner, SpecConfig  # noqa: F401
 
-__all__ = ["ServingEngine", "ServingConfig", "Request",
-           "PagePool", "PageAllocator", "PrefixCache", "NULL_PAGE"]
+__all__ = ["ServingEngine", "ServingConfig", "Request", "SpecConfig",
+           "DraftRunner", "PagePool", "PageAllocator", "PrefixCache",
+           "NULL_PAGE"]

@@ -54,6 +54,14 @@ fill:
   as a longer prompt (and its pages stay cached, so re-prefill is a
   prefix hit). Sampling keys are folded per absolute position, so a
   preempted request's tokens do not depend on scheduling.
+- **Speculative decoding** (``ServingConfig.spec``; serving/spec.py):
+  a draft model runs ``k`` tokens ahead per slot, ONE verify/mixed
+  tick scores every slot's ``(1+k)``-token row (a verify row is a
+  chunk row whose logits are kept at every position), greedy
+  acceptance emits the target's own argmax stream — so spec greedy is
+  BITWISE plain greedy — and rejected tails rewind through the
+  refcounted ``shrink_slot`` path. Two compiled sites (draft tick +
+  verify tick), per-tick host sync instead of the deferred window.
 
 Greedy paged decode is **bitwise identical** to the dense
 ``generate()`` on the same weights whenever the slot capacity
@@ -118,8 +126,9 @@ from ..profiler import events as _events
 from ..profiler import recompile as _recompile
 from ..profiler import registry as _registry
 from .paged_cache import PagePool
+from .spec import SpecConfig
 
-__all__ = ["ServingConfig", "ServingEngine", "Request"]
+__all__ = ["ServingConfig", "ServingEngine", "Request", "SpecConfig"]
 
 #: engine ids stamped on every event (``eng`` attr) so co-resident
 #: engines' timelines don't alias in the process-global log
@@ -176,6 +185,12 @@ class ServingConfig:
     seed: int = 0
     attention_kernel: str = "ragged-xla"   # see ATTENTION_KERNELS
     attention_impl: Optional[str] = None   # deprecated alias: 'xla'|'pallas'
+    #: speculative decoding (serving/spec.py SpecConfig: draft model +
+    #: k). Greedy-only, unified tick only; the engine gains a second
+    #: compiled site (the draft tick) and syncs each verify tick —
+    #: acceptance decides the next tick's positions, so the deferred
+    #: window cannot stay open across it (max_inflight is ignored).
+    spec: Optional[SpecConfig] = None
 
 
 @dataclass
@@ -249,6 +264,20 @@ class ServingEngine:
             raise ValueError(
                 f"unknown attention kernel {kernel!r}; expected one of "
                 f"{ATTENTION_KERNELS}")
+        self._spec = cfg.spec
+        if self._spec is not None:
+            if kernel == "legacy":
+                raise ValueError(
+                    "speculative decoding needs the unified mixed-row "
+                    "tick; attention_kernel='legacy' has no verify row "
+                    "path")
+            if cfg.decode != "greedy":
+                raise NotImplementedError(
+                    "speculative decoding is greedy-only: sampling "
+                    "needs the rejection-sampling acceptance rule "
+                    "(ROADMAP residue)")
+            if self._spec.k < 1:
+                raise ValueError("spec.k must be >= 1")
         self._legacy = kernel == "legacy"
         self._impl = "pallas" if kernel.endswith("pallas") else "xla"
         self.attention_kernel = kernel
@@ -305,6 +334,33 @@ class ServingEngine:
                                  donate_argnums=(2, 3))
             self._prefill = jax.jit(self._make_prefill_chunk(),
                                     donate_argnums=(2, 3))
+        elif self._spec is not None:
+            from .spec import DraftRunner, make_spec_tick
+
+            dcfg = self._spec.draft_model.config
+            if dcfg.vocab_size != mcfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab_size {dcfg.vocab_size} != target "
+                    f"{mcfg.vocab_size}: acceptance compares token ids")
+            if dcfg.max_seq_len < mcfg.max_seq_len:
+                raise ValueError(
+                    f"draft max_seq_len {dcfg.max_seq_len} must cover "
+                    f"the target's {mcfg.max_seq_len}")
+            self._spec_k = int(self._spec.k)
+            self._draft = DraftRunner(
+                self._spec.draft_model, b_slots,
+                self.pool.slot_capacity, self._spec_k,
+                self.prefill_chunk)
+            #: per-admission-cycle lifecycle-event latches
+            self._spec_started = [False] * b_slots
+            self._spec_verifying = [False] * b_slots
+            self._zero_drafts = np.zeros(b_slots * self._spec_k,
+                                         np.int32)
+            self._tick = jax.jit(
+                make_spec_tick(mcfg, b_slots, self._spec_k,
+                               self.prefill_chunk, self._impl,
+                               self._tick_site),
+                donate_argnums=(2, 3))
         else:
             self._tick = jax.jit(self._make_unified_tick(),
                                  donate_argnums=(2, 3))
@@ -314,11 +370,14 @@ class ServingEngine:
     def compiled_sites(self) -> Tuple[str, ...]:
         """Recompile-telemetry site names of this engine's hot-path
         dispatch programs — the unified engine has exactly ONE (the
-        mixed-row tick); only the legacy mode has a second (prefill).
-        Tests assert this, so silently re-growing a dispatch site is a
-        visible regression."""
+        mixed-row tick); a spec-decoding engine has exactly TWO (the
+        draft tick + the verify/mixed tick); only the legacy mode has
+        a separate prefill program. Tests assert this, so silently
+        re-growing a dispatch site is a visible regression."""
         if self._legacy:
             return (self._tick_site, self._prefill_site)
+        if self._spec is not None:
+            return (self._tick_site, self._draft.site)
         return (self._tick_site,)
 
     def _emit(self, kind: str, rid: int, **attrs) -> None:
@@ -418,6 +477,10 @@ class ServingEngine:
             dispatched = self._prefill_chunks()
             self._grow_pages()
             dispatched = self._dispatch_legacy_tick() or dispatched
+        elif self._spec is not None:
+            chunks = self._collect_chunks()
+            self._grow_pages()
+            dispatched = self._dispatch_spec(chunks)
         else:
             chunks = self._collect_chunks()
             self._grow_pages()
@@ -517,11 +580,21 @@ class ServingEngine:
                 tokens[:n_full * self.pool.page_size],
                 [int(p) for p in self.pool.tables[slot, :n_full]])
 
+    def _spec_reset(self, slot: int) -> None:
+        """Invalidate the slot's draft state (admission, finish,
+        preemption): the next tenant's draft cache re-feeds from 0."""
+        if self._spec is None:
+            return
+        self._draft.reset_slot(slot)
+        self._spec_started[slot] = False
+        self._spec_verifying[slot] = False
+
     def _finish(self, slot: int, rid: int,
                 reason: str = "max_new") -> None:
         req = self._requests[rid]
         req.done = True
         if self._slot_rid[slot] == rid:
+            self._spec_reset(slot)
             # cache the finished sequence's pages (prompt AND generated
             # full pages) before release: an identical follow-up
             # conversation prefix becomes a prefix hit
@@ -561,6 +634,7 @@ class ServingEngine:
             self._slot_prompt[slot] = req.prompt.shape[0]
             self._slot_dispatched[slot] = 0
             self._slot_looked_up[slot] = False
+            self._spec_reset(slot)
             self._admit_seq += 1
             self._slot_admit_seq[slot] = self._admit_seq
             self._emit("admit", req.rid, slot=slot)
@@ -758,6 +832,7 @@ class ServingEngine:
         req.queue_t = time.perf_counter()
         self._insert_prefix(victim, req.prompt, int(self._slot_len[victim]))
         self._queue.appendleft(req)
+        self._spec_reset(victim)
         self.pool.release_slot(victim)
         self._slot_rid[victim] = None
         self._slot_len[victim] = 0
@@ -931,6 +1006,243 @@ class ServingEngine:
             return kpool, vpool, nxt, new_last
 
         return tick
+
+    # ------------------------------------------------------------------
+    # speculative decoding (ServingConfig.spec; serving/spec.py): the
+    # draft tick runs k tokens ahead per caught-up slot, then ONE
+    # verify/mixed tick scores every slot's (1+k)-token row through
+    # the same ragged program that carries the prefill chunks. Host
+    # syncs each verify tick (acceptance decides the next tick's
+    # positions); emitted tokens are always the TARGET's argmax
+    # stream, so greedy output is bitwise non-speculative greedy.
+    # ------------------------------------------------------------------
+    def _dispatch_spec(self, chunks: List[_Chunk]) -> bool:
+        """One spec scheduler step: (1) draft tick — parallel
+        catch-up feed for behind slots + k greedy draft steps for
+        caught-up decoding slots; (2) per-slot speculation depth
+        ``k_s`` (clamped by remaining budget and page headroom —
+        best-effort growth only, never preempting a co-resident to
+        speculate deeper); (3) the verify/mixed tick; (4) synchronous
+        absorb — append the accepted prefix + correction token, rewind
+        the frontier past the rejected tail and return its pages
+        (``PagePool.shrink_slot``)."""
+        chunks = [c for c in chunks if self._slot_rid[c[0]] == c[1]]
+        ticking = self._ticking_slots()
+        if not ticking and not chunks:
+            return False
+        ns = self.config.num_slots
+        k = self._spec_k
+        w = self.prefill_chunk
+        npf = self.config.prefill_chunks_per_tick
+        nps = self.pool.pages_per_slot
+        cap = self.pool.slot_capacity
+        dr = self._draft
+        reg = _registry()
+        ticking_set = set(ticking)
+
+        # ---- draft tick: feed + generate ----
+        feed_toks = np.zeros((ns, w), np.int32)
+        feed_pos0 = np.zeros(ns, np.int32)
+        feed_len = np.zeros(ns, np.int32)
+        gen_tok = np.zeros(ns, np.int32)
+        gen_pos = np.full(ns, cap, np.int32)   # cap = the trash column
+        last_tok = np.zeros(ns, np.int32)
+        gen_slots: List[int] = []
+        any_feed = False
+        for s, rid in enumerate(self._slot_rid):
+            if rid is None:
+                continue
+            req = self._requests[rid]
+            if s in ticking_set:
+                last_tok[s] = req.out[-1]
+            behind = int(self._slot_len[s]) - int(dr.len[s])
+            fed = 0
+            if behind > 0:
+                # catch the draft cache up toward the accepted
+                # frontier: prompt tokens (admission / prefix hits the
+                # draft never saw) and emitted tokens ride the same
+                # chunk-shaped feed
+                fed = min(behind, w)
+                seq = np.concatenate(
+                    [req.prompt, np.asarray(req.out, np.int32)])
+                lo = int(dr.len[s])
+                feed_toks[s, :fed] = seq[lo:lo + fed]
+                feed_pos0[s] = lo
+                feed_len[s] = fed
+                any_feed = True
+                if not self._spec_started[s]:
+                    self._spec_started[s] = True
+                    self._emit("draft", rid, slot=s, pos=lo)
+            if s in ticking_set and behind - fed == 0 and \
+                    req.max_new - len(req.out) >= 2:
+                gen_tok[s] = req.out[-1]
+                gen_pos[s] = int(self._slot_len[s])
+                gen_slots.append(s)
+        draft_flat = self._zero_drafts
+        if any_feed or gen_slots:
+            dargs = (dr.stacked, dr.other, dr.kc, dr.vc, feed_toks,
+                     feed_pos0, feed_len, gen_tok, gen_pos,
+                     np.bool_(any_feed), np.bool_(len(gen_slots) > 0))
+            self._note_avals(dr.site, dr.tick, dargs)
+            with _quiet_donation():
+                dr.kc, dr.vc, drafts = dr.tick(*dargs)
+            draft_flat = drafts.reshape(-1)
+            dr.len += feed_len
+            reg.counter("serving/spec_draft_ticks").add(1)
+            if any_feed:
+                reg.counter("serving/spec_feed_tokens").add(
+                    int(feed_len.sum()))
+
+        # ---- per-slot speculation depth (host-deterministic) ----
+        k_arr = np.zeros(ns, np.int32)
+        for s in gen_slots:
+            rid = self._slot_rid[s]
+            req = self._requests[rid]
+            pos0 = int(self._slot_len[s])
+            ks = min(k, req.max_new - len(req.out) - 1, cap - 1 - pos0)
+            if ks <= 0:
+                continue
+            need = self.pool.pages_for(pos0 + ks + 1) \
+                - self.pool.slot_pages(s)
+            if need > 0 and not self.pool.grow_slot(s, need):
+                # pool pressure: speculate only as deep as the pages
+                # already held reach (k_s may hit 0 = plain decode row)
+                ks = min(ks, self.pool.slot_pages(s)
+                         * self.pool.page_size - pos0 - 1)
+            if ks > 0:
+                k_arr[s] = ks
+                if not self._spec_verifying[s]:
+                    self._spec_verifying[s] = True
+                    self._emit("verify", rid, slot=s, k=ks)
+        has_drafts = bool(k_arr.any())
+
+        # ---- assemble + dispatch the verify/mixed tick ----
+        base = ns * (1 + k)
+        nt = base + npf * w
+        pf_toks = np.zeros(npf * w, np.int32)
+        tok_pos = np.zeros(nt, np.int32)
+        tok_limit = np.zeros(nt, np.int32)
+        tok_pos[:ns] = self._slot_len
+        tok_limit[:ns] = cap
+        dj = np.arange(k)[None, :]
+        tok_pos[ns:base] = (self._slot_len[:, None] + 1 + dj) \
+            .astype(np.int32).reshape(-1)
+        tok_limit[ns:base] = np.where(dj < k_arr[:, None], cap, 0) \
+            .astype(np.int32).reshape(-1)
+        row_tab = np.zeros((ns + npf, nps), np.int32)
+        row_tab[:ns] = self.pool.tables
+        row_pos0 = np.zeros(ns + npf, np.int32)
+        row_pos0[:ns] = self._slot_len
+        row_len = np.ones(ns + npf, np.int32)
+        row_len[:ns] += k_arr
+        sample = np.zeros((ns, 1 + k), np.int32)
+        sample[:, 0] = np.arange(ns)
+        sample[:, 1:] = ns + np.arange(ns)[:, None] * k \
+            + np.arange(k)[None, :]
+        finishers = []
+        for c, (s, rid, start, end, t0) in enumerate(chunks):
+            coff = base + c * w
+            req = self._requests[rid]
+            pf_toks[c * w:c * w + (end - start)] = req.prompt[start:end]
+            tok_pos[coff:coff + w] = start + np.arange(w)
+            tok_limit[coff:coff + w] = t0
+            row_tab[ns + c] = self.pool.tables[s]
+            row_pos0[ns + c] = start
+            row_len[ns + c] = end - start
+            tok_pos[s] = end
+            row_pos0[s] = end
+            if end >= t0:
+                finishers.append((s, rid))
+                sample[s, 0] = coff + (t0 - 1 - start)
+        args = (self._stacked, self._other, self.pool.k, self.pool.v,
+                last_tok, draft_flat, pf_toks, tok_pos, tok_limit,
+                row_tab, row_pos0, row_len, sample.reshape(-1), k_arr,
+                np.bool_(len(chunks) > 0), np.bool_(has_drafts))
+        self._note_avals(self._tick_site, self._tick, args)
+        with _quiet_donation():
+            self.pool.k, self.pool.v, tok_m, acc = self._tick(*args)
+
+        # ---- chunk bookkeeping (same as the unified tick) ----
+        for s, rid, start, end, t0 in chunks:
+            self._slot_len[s] = end
+            self._emit("chunk", rid, slot=s, start=start, end=end,
+                       final=bool(end >= t0))
+            if end >= t0:
+                reg.counter("serving/prefills").add(1)
+            self._insert_prefix(s, self._requests[rid].prompt, end)
+
+        # ---- synchronous absorb: acceptance, rewind, finishes ----
+        toks = np.asarray(tok_m)                       # [ns, 1+k]
+        accs = np.asarray(acc)
+        reg.counter("serving/token_syncs").add(1)
+        now = time.perf_counter()
+        eos = self.config.eos_token_id
+        for s, rid in [(t, self._slot_rid[t]) for t in ticking] \
+                + finishers:
+            req = self._requests[rid]
+            ks = int(k_arr[s])
+            a = min(int(accs[s]), ks) if ks else 0
+            pos0 = int(self._slot_len[s])
+            emitted = 0
+            finished = None
+            for j in range(a + 1):
+                tok = int(toks[s, j])
+                req.out.append(tok)
+                emitted += 1
+                reg.counter("serving/tokens_generated").add(1)
+                if req.first_token_t is None:
+                    req.first_token_t = now
+                    reg.histogram("serving/ttft_ms").observe(
+                        (now - req.submit_t) * 1000.0)
+                    self._emit("first_token", rid, slot=s)
+                if eos is not None and tok == eos:
+                    finished = "eos"
+                    break
+                if len(req.out) >= req.max_new:
+                    finished = "max_new"
+                    break
+            if s in ticking_set:
+                # the accepted prefix's KV is in the cache (written by
+                # this verify row); the rejected tail is truncated off
+                self._slot_len[s] = pos0 + emitted
+                if ks:
+                    gained = emitted - 1
+                    reg.counter("serving/spec_drafted_tokens").add(ks)
+                    reg.counter("serving/spec_accepted_tokens").add(
+                        gained)
+                    reg.histogram("serving/spec_accept_len").observe(
+                        float(gained))
+                    self._emit("accept", rid, slot=s, accepted=gained,
+                               drafted=ks)
+                if s in gen_slots:
+                    # the draft's own speculation wrote the accepted
+                    # tokens' KV — its frontier follows without repair
+                    dr.len[s] = pos0 + min(emitted, k)
+                if finished is None and ks:
+                    # rewind: return pages past the new frontier (+1
+                    # page headroom for the next tick's write) — the
+                    # refcount machinery keeps shared pages alive
+                    self.pool.shrink_slot(
+                        s, self.pool.pages_for(
+                            int(self._slot_len[s]) + 1))
+            self._slot_dispatched[s] = len(req.out)
+            if finished is not None:
+                self._finish(s, rid, reason=finished)
+        reg.counter("serving/ticks").add(1)
+        if chunks:
+            reg.counter("serving/prefill_chunks").add(len(chunks))
+        reg.gauge("serving/decode_batch").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows").set(
+            float(len(ticking) + len(chunks)))
+        reg.gauge("serving/mixed_rows_decode").set(float(len(ticking)))
+        reg.gauge("serving/mixed_rows_prefill").set(float(len(chunks)))
+        reg.gauge("serving/spec_rows").set(float(int((k_arr > 0).sum())))
+        drafted = reg.counter("serving/spec_drafted_tokens").value
+        if drafted:
+            reg.gauge("serving/spec_accept_rate").set(
+                reg.counter("serving/spec_accepted_tokens").value
+                / drafted)
+        return True
 
     # ------------------------------------------------------------------
     # legacy two-dispatch mode (attention_kernel="legacy"): the

@@ -400,6 +400,27 @@ class PagePool:
             np.asarray(pages, np.int32)
         held.extend(int(p) for p in pages)
 
+    def shrink_slot(self, slot: int, keep_pages: int) -> int:
+        """Release the slot's pages BEYOND the first ``keep_pages``
+        (position order — the speculative-rewind path: rejected draft
+        tail tokens truncate the slot's frontier, and pages past the
+        new length go back to the pool). Refcount-safe like
+        ``release_slot``: only this slot's reference is dropped, so a
+        page the prefix index (or another slot) still holds survives;
+        the zeroed table tail means a stale id can never be gathered.
+        No-op when the slot already holds ``<= keep_pages``. Returns
+        how many page references were dropped."""
+        if keep_pages < 0:
+            raise ValueError("keep_pages must be >= 0")
+        held = self._held[slot]
+        drop = held[keep_pages:]
+        if not drop:
+            return 0
+        self.allocator.free(drop)
+        del held[keep_pages:]
+        self.tables[slot, keep_pages:] = NULL_PAGE
+        return len(drop)
+
     def release_slot(self, slot: int) -> int:
         """Drop ``slot``'s reference on all of its pages (a page only
         returns to the pool at refcount 0 — the prefix index or another

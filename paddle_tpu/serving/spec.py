@@ -1,0 +1,372 @@
+"""Speculative decoding on the paged serving engine (ROADMAP item 4).
+
+Decode throughput on the unified tick is bounded by one target-model
+dispatch per emitted token. Classic speculative decoding amortizes that
+cost: a small **draft model** runs ``k`` tokens ahead per resident
+slot, then ONE target **verify tick** scores every slot's
+``k + 1``-token row through the existing mixed-row ragged program
+(``models/gpt.py::gpt_ragged_apply`` with ``spec_k`` — a verify row is
+exactly a prefill-chunk-shaped row whose logits are kept at every
+position, not just the last). Greedy acceptance takes the longest
+prefix where draft == target argmax, plus one correction token; the
+emitted stream is therefore always the TARGET's own argmax stream, so
+greedy speculative output is **bitwise identical** to non-speculative
+greedy paged decode (which is itself bitwise vs dense ``generate()``)
+— the classic invariant, and this engine's signature parity-contract
+style (tests/test_spec_decode.py pins it across admission orders,
+prefix-cache hits, COW divergence, preemption/requeue mid-speculation,
+and exact-capacity finishes).
+
+Two compiled dispatch sites, each tracing exactly once
+(``ServingEngine.compiled_sites`` == {draft tick, verify/mixed tick}):
+
+- **Draft tick** (``make_draft_tick``): the draft model keeps a DENSE
+  per-slot KV cache ``[L_d, num_slots, capacity + 1, NH_d, D_d]``
+  (builder's call per the issue — dense is the simple footprint;
+  position ``capacity`` is the trash column, the dense analogue of the
+  page pool's null page: pad/overflow writes land there, never in live
+  state). One fixed-shape program does BOTH draft duties per scheduler
+  step: a ``feed`` stage catches slots' draft caches up to the
+  target's accepted frontier (prompt tokens after admission or a
+  prefix-cache hit — the draft has no prefix cache — and the one
+  token the draft never saw after a full-acceptance round), then a
+  ``generate`` stage scans ``k`` greedy draft steps. Each stage sits
+  behind its own ``lax.cond`` — steady-state ticks (nothing to feed)
+  pay only the k-step scan, and feed-only ticks (chunked prefill in
+  flight) skip the generate scan — the engine's decode-only
+  fast-path idiom on both axes.
+- **Verify tick** (``make_spec_tick``): the unified mixed-row tick
+  widened with a draft-token section. Flat token layout
+  ``[ns last_tok | ns*k drafts | chunks]``; slot rows group as
+  ``[ns, 1+k]`` ragged rows (a non-speculating slot rides with
+  ``row_len == 1`` — its draft positions are pad queries whose writes
+  route to the null page). Four ``lax.cond`` branches in ONE
+  executable extend the decode-only fast path: with speculation idle
+  (no drafts) and/or no chunks aboard, the tick pays exactly the
+  non-speculative program's capacity — verify rows cost nothing while
+  nobody speculates. Greedy argmax and acceptance
+  (``ops/decoding.spec_accept_length``) run on device; the host
+  materializes ``(tokens [ns, 1+k], accepted [ns])`` once per tick.
+
+**Rewind** is what the PR-5 refcount/COW machinery makes safe: the
+rejected tail's KV writes land in pages only this slot holds (prefix
+pages are published strictly BEHIND the accepted frontier), so the
+engine just truncates ``pos`` and returns pages past the new length
+(``PagePool.shrink_slot``); the draft cache needs no repair either —
+its own speculation wrote the accepted tokens' KV, and the correction
+token arrives as the next round's ``gen_tok``. Preemption resets the
+slot's draft frontier to 0; the requeued prompt (with generated
+prefix) re-feeds chunk-by-chunk, so the draft state survives
+preemption/requeue by reconstruction, not by copy.
+
+**Why host sync per verify tick**: acceptance decides the next tick's
+positions and page growth, which are host scheduling state — the
+deferred-sync window of the plain engine cannot stay open across an
+acceptance decision. Spec mode trades the PR-3 overlap for a k-token
+amortization per dispatch; ``serving/spec_accept_rate`` and
+``serve_bench --spec-decode`` measure whether the trade pays.
+
+Residue (ROADMAP): greedy only — sampling needs the rejection-sampling
+acceptance rule; ``k`` is static per engine (adaptive k is a policy
+follow-up); the draft cache is dense, not paged.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..profiler import recompile as _recompile
+
+__all__ = ["SpecConfig", "DraftRunner", "make_draft_tick",
+           "make_spec_tick"]
+
+
+@dataclass
+class SpecConfig:
+    """Speculative-decoding knobs for ``ServingConfig.spec``.
+
+    ``draft_model``: a dense ``GPT`` sharing the target's vocab (and
+    ``max_seq_len >= target's``) — typically much smaller; quality only
+    affects the accept rate, never correctness (rejected drafts cost a
+    wasted verify position, accepted ones skip a target dispatch).
+    ``k``: draft tokens speculated per verify tick; each slot's actual
+    depth is clamped per tick by its remaining token budget and page
+    headroom (down to 0 = a plain decode row)."""
+
+    draft_model: object
+    k: int = 4
+
+
+class DraftRunner:
+    """Draft-model state + the ONE jitted draft tick.
+
+    Host side: ``len[s]`` is the slot's draft frontier (dense-cache
+    positions ``0..len[s]-1`` hold the accepted sequence's KV). Device
+    side: the dense caches, donated per dispatch. The engine owns
+    scheduling (what to feed, who generates) and frontier bookkeeping;
+    this class owns the state and the compiled program."""
+
+    def __init__(self, draft_model, num_slots: int, capacity: int,
+                 k: int, feed_width: int):
+        cfg = draft_model.config
+        self.config = cfg
+        self.k = int(k)
+        self.capacity = int(capacity)
+        self.feed_width = int(feed_width)
+        self.stacked, self.other = draft_model._decode_state()
+        dt = self.other["embeddings.wte.weight"].dtype
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        shape = (cfg.num_layers, num_slots, capacity + 1, nh, hd)
+        self.kc = jnp.zeros(shape, dt)
+        self.vc = jnp.zeros(shape, dt)
+        self.len = np.zeros(num_slots, np.int64)
+        self.site = _recompile.unique_site("serving.draft")
+        self.tick = jax.jit(
+            make_draft_tick(cfg, num_slots, capacity, k, feed_width,
+                            self.site),
+            donate_argnums=(2, 3))
+
+    def reset_slot(self, slot: int) -> None:
+        """Invalidate the slot's draft cache (admission / finish /
+        preemption): the next tenant re-feeds from position 0."""
+        self.len[slot] = 0
+
+
+def _head(x_last, other, wte):
+    if "lm_head.weight" in other:
+        return x_last @ other["lm_head.weight"]
+    return x_last @ wte.T
+
+
+def _greedy(logits):
+    """The repo's one greedy spelling (ops/decoding.greedy_decode /
+    engine._sample_tok): argmax of f32 log_softmax."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.argmax(lp, axis=-1).astype(jnp.int32)
+
+
+def make_draft_tick(cfg, num_slots: int, capacity: int, k: int,
+                    feed_width: int, site: str):
+    """Build the draft tick body (jitted by DraftRunner; caches
+    donated).
+
+    Args (all fixed-shape; one trace covers every scheduler state):
+      stacked/other   draft decode params
+      kc/vc           [L, ns, cap+1, NH, D] dense caches (pos ``cap``
+                      is the trash column)
+      feed_toks       [ns, F] catch-up tokens per slot
+      feed_pos0       [ns]    first feed position per slot
+      feed_len        [ns]    real feed tokens (0 = nothing to feed)
+      gen_tok         [ns]    generation seed token (the slot's last
+                              emitted/accepted token)
+      gen_pos         [ns]    its position — ``cap`` for slots not
+                              generating (their scan writes go to the
+                              trash column and their drafts are
+                              garbage the engine never offers)
+      has_feed        bool    lax.cond fast path: steady-state ticks
+                              skip the feed stage's compute entirely
+      has_gen         bool    the symmetric fast path: feed-only ticks
+                              (every chunked-prefill step) skip the
+                              k-step generate scan — nobody would read
+                              those drafts
+
+    Returns (kc, vc, drafts [ns, k] — zeros when ``has_gen`` is off).
+    """
+    nh = cfg.num_heads
+    hd = cfg.hidden_size // nh
+    eps = cfg.layer_norm_eps
+    msl = cfg.max_seq_len
+    ns = num_slots
+    cap = capacity
+    f = feed_width
+
+    from ..models.gpt import _ln, gpt_block_body
+
+    def tick(stacked, other, kc, vc, feed_toks, feed_pos0, feed_len,
+             gen_tok, gen_pos, has_feed, has_gen):
+        _recompile.mark_trace(site, kc, feed_toks, gen_tok)
+        wte = other["embeddings.wte.weight"]
+        wpe = other["embeddings.wpe.weight"]
+        rows = jnp.arange(ns)
+        key_pos = jnp.arange(cap + 1)
+
+        def feed(kc, vc):
+            # chunk-style parallel catch-up: F tokens per slot in one
+            # forward; pad positions (i >= feed_len) write to trash
+            pos = feed_pos0[:, None] + jnp.arange(f)[None, :]  # [ns, F]
+            real = jnp.arange(f)[None, :] < feed_len[:, None]
+            wr = jnp.where(real, jnp.minimum(pos, cap), cap)
+            x = wte[feed_toks] + wpe[jnp.clip(pos, 0, msl - 1)]
+
+            def block(xc, inp):
+                p, kc0, vc0 = inp
+
+                def attend(q, kk, vv):
+                    kcl = kc0.at[rows[:, None], wr].set(kk)
+                    vcl = vc0.at[rows[:, None], wr].set(vv)
+                    att = jnp.einsum("btnd,bsnd->bnts", q, kcl) / \
+                        math.sqrt(hd)
+                    mask = key_pos[None, None, None, :] <= \
+                        pos[:, None, :, None]
+                    att = jnp.where(mask, att, -1e9)
+                    w = jax.nn.softmax(att.astype(jnp.float32),
+                                       axis=-1).astype(xc.dtype)
+                    return jnp.einsum("bnts,bsnd->btnd", w, vcl), \
+                        (kcl, vcl)
+
+                return gpt_block_body(xc, p, eps, nh, hd, attend)
+
+            _, (kc, vc) = jax.lax.scan(block, x, (stacked, kc, vc))
+            return kc, vc
+
+        kc, vc = jax.lax.cond(has_feed, feed, lambda a, b: (a, b),
+                              kc, vc)
+
+        def gstep(carry, _):
+            tok, kc, vc, p = carry
+            wr = jnp.minimum(p, cap)
+            x = wte[tok[:, None]] + wpe[jnp.clip(p, 0, msl - 1)][:, None]
+
+            def block(xc, inp):
+                pp, kc0, vc0 = inp
+
+                def attend(q, kk, vv):
+                    kcl = kc0.at[rows, wr].set(kk[:, 0])
+                    vcl = vc0.at[rows, wr].set(vv[:, 0])
+                    att = jnp.einsum("btnd,bsnd->bnts", q, kcl) / \
+                        math.sqrt(hd)
+                    mask = key_pos[None, None, None, :] <= \
+                        p[:, None, None, None]
+                    att = jnp.where(mask, att, -1e9)
+                    w = jax.nn.softmax(att.astype(jnp.float32),
+                                       axis=-1).astype(xc.dtype)
+                    return jnp.einsum("bnts,bsnd->btnd", w, vcl), \
+                        (kcl, vcl)
+
+                return gpt_block_body(xc, pp, eps, nh, hd, attend)
+
+            x, (kc, vc) = jax.lax.scan(block, x, (stacked, kc, vc))
+            x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
+            nxt = _greedy(_head(x[:, -1], other, wte))
+            return (nxt, kc, vc, p + 1), nxt
+
+        def generate(kc, vc):
+            (_, kc, vc, _), drafts = jax.lax.scan(
+                gstep, (gen_tok, kc, vc, gen_pos), None, length=k)
+            return kc, vc, jnp.swapaxes(drafts, 0, 1)   # [ns, k]
+
+        return jax.lax.cond(
+            has_gen, generate,
+            lambda kc, vc: (kc, vc, jnp.zeros((ns, k), jnp.int32)),
+            kc, vc)
+
+    return tick
+
+
+def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
+                   impl: str, site: str):
+    """Build the spec engine's verify/mixed tick body (jitted by the
+    engine; pools donated). This IS the unified mixed-row tick with a
+    draft section — same site name, same single-trace contract.
+
+    Flat token layout: ``[ns last_tok | ns*k drafts | npf*w chunks]``.
+    ``sample_ix`` is ``[ns * (1+k)]`` in that layout,
+    ``reshape(ns, 1+k)``-able: column 0 is each slot's primary
+    emission position (its last_tok row — or, for a slot whose final
+    prefill chunk rides this tick, the chunk's last real position),
+    columns 1..k its draft verify positions. ``n_draft`` [ns] is the
+    per-slot speculation depth this tick (0 = plain decode row).
+
+    Four branches, ONE executable (the decode-only fast-path idiom
+    squared): with no drafts aboard the program runs the exact
+    non-speculative graph (verify-row capacity costs nothing — the
+    plain branches compute only the ns primary logits and scatter
+    them into the fixed-shape output); with no chunks aboard the
+    prefill capacity is skipped as before.
+
+    Returns (kpool, vpool, tokens [ns, 1+k] — the target's greedy
+    argmax at every verify position, accepted [ns]).
+    """
+    ns = num_slots
+    w = chunk_width
+    base = ns * (1 + k)
+
+    from ..models.gpt import gpt_ragged_apply
+    from ..ops.decoding import spec_accept_length
+
+    def tick(stacked, other, kpool, vpool, last_tok, draft_toks,
+             pf_toks, tok_pos, tok_limit, row_tab, row_pos0, row_len,
+             sample_ix, n_draft, has_chunks, has_drafts):
+        _recompile.mark_trace(site, kpool, row_tab, tok_pos, last_tok)
+        tokens = jnp.concatenate([last_tok, draft_toks, pf_toks])
+        # the no-draft branches run the exact non-speculative layout:
+        # the draft section sliced out of every metadata vector
+        tokens_plain = jnp.concatenate([last_tok, pf_toks])
+        pos_plain = jnp.concatenate([tok_pos[:ns], tok_pos[base:]])
+        lim_plain = jnp.concatenate([tok_limit[:ns], tok_limit[base:]])
+        # spec-layout sample indices remapped to the plain layout:
+        # chunk-section indices shift down by the draft section; draft
+        # indices (unused there — n_draft is all-zero whenever a plain
+        # branch runs) clamp to 0
+        is_draft = (sample_ix >= ns) & (sample_ix < base)
+        ix_plain = jnp.where(
+            sample_ix < ns, sample_ix,
+            jnp.where(is_draft, 0, sample_ix - ns * k))
+        primary_ix = ix_plain[jnp.arange(ns) * (1 + k)]
+
+        def scatter_primary(tok_ns):
+            # fixed-shape output: each slot's primary token lands at
+            # its column-0 position; draft columns stay 0 (garbage the
+            # host never reads when has_drafts is False)
+            out = jnp.zeros((base,), jnp.int32)
+            return out.at[jnp.arange(ns) * (1 + k)].set(tok_ns)
+
+        def spec_mixed(kp, vp):
+            lg, kp, vp = gpt_ragged_apply(
+                mcfg, stacked, other, kp, vp, tokens, tok_pos,
+                tok_limit, row_tab, row_pos0, row_len, sample_ix,
+                decode_rows=ns, chunk_width=w, impl=impl, spec_k=k)
+            return _greedy(lg), kp, vp
+
+        def spec_only(kp, vp):
+            lg, kp, vp = gpt_ragged_apply(
+                mcfg, stacked, other, kp, vp, tokens[:base],
+                tok_pos[:base], tok_limit[:base], row_tab[:ns],
+                row_pos0[:ns], row_len[:ns], sample_ix,
+                decode_rows=ns, chunk_width=w, impl=impl, spec_k=k)
+            return _greedy(lg), kp, vp
+
+        def plain_mixed(kp, vp):
+            lg, kp, vp = gpt_ragged_apply(
+                mcfg, stacked, other, kp, vp, tokens_plain, pos_plain,
+                lim_plain, row_tab, row_pos0, row_len, primary_ix,
+                decode_rows=ns, chunk_width=w, impl=impl)
+            return scatter_primary(_greedy(lg)), kp, vp
+
+        def plain_only(kp, vp):
+            lg, kp, vp = gpt_ragged_apply(
+                mcfg, stacked, other, kp, vp, tokens_plain[:ns],
+                pos_plain[:ns], lim_plain[:ns], row_tab[:ns],
+                row_pos0[:ns], row_len[:ns], primary_ix,
+                decode_rows=ns, chunk_width=w, impl=impl)
+            return scatter_primary(_greedy(lg)), kp, vp
+
+        toks, kpool, vpool = jax.lax.cond(
+            has_drafts,
+            lambda kp, vp: jax.lax.cond(has_chunks, spec_mixed,
+                                        spec_only, kp, vp),
+            lambda kp, vp: jax.lax.cond(has_chunks, plain_mixed,
+                                        plain_only, kp, vp),
+            kpool, vpool)
+        tok_m = toks.reshape(ns, 1 + k)
+        acc = spec_accept_length(draft_toks.reshape(ns, k),
+                                 tok_m[:, :k], n_draft)
+        return kpool, vpool, tok_m, acc
+
+    return tick

@@ -47,6 +47,7 @@ _COMPILE_HEAVY_FILES = frozenset({
     "test_stream_layers.py",     # per-layer offload streaming programs
     "test_async_pipeline.py",    # elastic/runner async pipeline
     "test_serving.py",           # serving engines: tick + bucket prefills
+    "test_spec_decode.py",       # spec engines: draft tick + verify tick
 })
 
 

@@ -131,6 +131,53 @@ class TestMetricsRegistry:
         reg.histogram("a/h").observe(1.0)
         assert reg.aggregate() == reg.snapshot()
 
+    def test_aggregate_merges_rank_local_reservoirs(self, monkeypatch):
+        """ISSUE 9 satellite (closes the 'rank-local quantiles'
+        residue): aggregated histogram snapshots carry p50/p90/p95/p99
+        computed over the MERGED rank reservoirs, not dropped. The
+        collectives are faked to simulate a 2-rank fleet: rank 1
+        reports the same schema, double counts, and a disjoint
+        reservoir — the quantiles must move to the union's."""
+        import numpy as np
+
+        from paddle_tpu.distributed import collective as coll
+        from paddle_tpu.distributed import env as denv
+        from paddle_tpu.distributed.fleet import metrics as fm
+        from paddle_tpu.framework.tensor import Tensor
+
+        reg = profiler.registry()
+        for v in (1.0, 2.0, 3.0, 4.0):
+            reg.histogram("m/h").observe(v)
+        peer = [5.0, 6.0, 7.0, 8.0]
+
+        monkeypatch.setattr(denv, "get_world_size", lambda: 2)
+        monkeypatch.setattr(fm, "get_world_size", lambda: 2)
+        monkeypatch.setattr(fm, "sum", lambda x, **kw: 2.0 * float(
+            np.asarray(x, np.float64)))
+        monkeypatch.setattr(fm, "max", lambda x, **kw: float(
+            np.asarray(x, np.float64)))
+        monkeypatch.setattr(fm, "min", lambda x, **kw: float(
+            np.asarray(x, np.float64)))
+
+        def fake_all_gather(out, tensor, group=None, **kw):
+            local = np.asarray(tensor._value)
+            out.append(Tensor(local))
+            if np.issubdtype(local.dtype, np.floating):  # reservoir
+                buf = np.full(local.shape, np.nan, np.float64)
+                n = min(len(peer), buf.shape[0])
+                buf[:n] = peer[:n]
+                out.append(Tensor(buf))
+            else:                               # schema-union gather
+                out.append(Tensor(local))
+
+        monkeypatch.setattr(coll, "all_gather", fake_all_gather)
+        agg = reg.aggregate()["m/h"]
+        assert agg["count"] == 8                # sum-reduced
+        # nearest-rank percentiles over the UNION [1..8]
+        assert agg["p50"] == 5.0
+        assert agg["p99"] == 8.0
+        assert agg["p90"] == 8.0
+
     def test_schema_union_is_sorted_name_type_pairs(self):
         # the deterministic reduction order every rank walks in
         # aggregate() — identity (local schema) at world_size 1

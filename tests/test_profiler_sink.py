@@ -571,3 +571,61 @@ def test_rollback_leaves_flight_dump(tmp_path):
     reasons = [json.loads(x)["reason"]
                for x in open(os.path.join(d, "metrics.jsonl"))]
     assert "rollback" in reasons
+
+
+# ---------------------------------------------------------------------------
+# sink-schema checker: accept-event validation (ISSUE 9 satellite —
+# negative-tested here so the CI leg's new rules are themselves pinned)
+# ---------------------------------------------------------------------------
+
+
+def _load_checker():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "check_sink_schema.py")
+    spec = importlib.util.spec_from_file_location("check_sink_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    schema = json.load(open(os.path.join(
+        os.path.dirname(path), "sink_schema.json")))
+    return mod, schema
+
+
+def _check_events(tmp_path, lines):
+    mod, schema = _load_checker()
+    p = str(tmp_path / "events.jsonl")
+    with open(p, "w") as f:
+        for ln in lines:
+            f.write(json.dumps(ln) + "\n")
+    mod._ERRORS.clear()
+    mod.check_events_jsonl(p, schema)
+    errs = list(mod._ERRORS)
+    mod._ERRORS.clear()
+    return errs
+
+
+def test_schema_checker_accepts_valid_accept_events(tmp_path):
+    ok = [{"seq": 0, "t_ns": 1, "kind": "submit", "rid": 0},
+          {"seq": 1, "t_ns": 2, "kind": "accept", "rid": 0,
+           "accepted": 2, "drafted": 3},
+          {"seq": 2, "t_ns": 3, "kind": "accept", "rid": 0,
+           "accepted": 0, "drafted": 4}]
+    assert _check_events(tmp_path, ok) == []
+
+
+def test_schema_checker_flags_bad_accept_events(tmp_path):
+    # accepted > drafted is impossible by construction — a writer bug
+    bad = [{"seq": 0, "t_ns": 1, "kind": "accept", "rid": 0,
+            "accepted": 5, "drafted": 3}]
+    assert any("outside" in e for e in _check_events(tmp_path, bad))
+    # missing the accepted-count entirely
+    missing = [{"seq": 0, "t_ns": 1, "kind": "accept", "rid": 0,
+                "drafted": 3}]
+    assert any("missing 'accepted'" in e
+               for e in _check_events(tmp_path, missing))
+    # non-integer counts
+    nonint = [{"seq": 0, "t_ns": 1, "kind": "accept", "rid": 0,
+               "accepted": "2", "drafted": 3}]
+    assert any("not ints" in e for e in _check_events(tmp_path, nonint))

@@ -11,9 +11,11 @@ CI's sink-schema leg runs::
 
 and fails the build on any violation: a torn/garbled JSONL line, a
 non-monotonic event sequence, a malformed Prometheus exposition, a
-bench block missing the p50/p90/p95/p99 TTFT/TPOT percentiles or the
-compiled-program inventory. stdlib only (the CI image installs jax +
-numpy + pytest, nothing else).
+speculative-decoding ``accept`` event whose counts are missing,
+non-integer, or impossible (accepted > drafted), a bench block missing
+the p50/p90/p95/p99 TTFT/TPOT percentiles or the compiled-program
+inventory. stdlib only (the CI image installs jax + numpy + pytest,
+nothing else).
 
 Note on events.jsonl seq monotonicity: the sink's writer is
 at-least-once under I/O errors — a partially-landed segment is re-sent
@@ -107,6 +109,21 @@ def check_events_jsonl(path: str, schema: dict) -> None:
             err(f"{path}:{i + 1}: seq {seq!r} not strictly increasing "
                 f"(prev {last}) — the exactly-once cursor is broken")
         last = seq if isinstance(seq, int) else last
+        if ev.get("kind") == "accept":
+            # speculative-decoding acceptance events (ISSUE 9): the
+            # accepted-count must be present and can never exceed the
+            # drafted-count
+            for kk in sc.get("accept_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: accept event missing {kk!r}")
+            a, d = ev.get("accepted"), ev.get("drafted")
+            if "accepted" in ev and "drafted" in ev:
+                if not isinstance(a, int) or not isinstance(d, int):
+                    err(f"{path}:{i + 1}: accept counts not ints "
+                        f"({a!r}, {d!r})")
+                elif not 0 <= a <= d:
+                    err(f"{path}:{i + 1}: accept event accepted={a} "
+                        f"outside [0, drafted={d}]")
 
 
 _SAMPLE_RE = re.compile(
