@@ -44,12 +44,19 @@ site), and the measured event-log overhead on the decode hot loop
 ``--sink-dir`` additionally streams everything to disk (metrics.jsonl
 + events.jsonl + metrics.prom — the ISSUE 8 persistent-sink artifact;
 tools/check_sink_schema.py validates it in CI).
+``--trace-window N`` (ISSUE 11) drives N extra warm ticks under a
+parsed XLA device-trace window and embeds the MEASURED per-tick
+device timeline — op-category timings, per-collective durations by
+kind next to their modeled bytes, the compute∩comm overlap fraction,
+and the goodput/MFU ledger — as ``extra.device_trace`` (plus
+``trace_summary.json`` in the sink dir when ``--sink-dir`` is on).
 
     python benchmarks/serve_bench.py                 # Poisson, 8 slots
     python benchmarks/serve_bench.py --prefix-cache  # shared-prefix TTFT
     python benchmarks/serve_bench.py --kernel-matrix # unified vs legacy
     python benchmarks/serve_bench.py --tiny [...]    # CI smoke sizes
     python benchmarks/serve_bench.py --sink-dir DIR  # + persistent sink
+    python benchmarks/serve_bench.py --trace-window 8  # + device trace
 """
 from __future__ import annotations
 
@@ -196,6 +203,30 @@ def pct(xs, p):
     return float(percentile(sorted(xs), p)) if xs else 0.0
 
 
+def traced_window_block(eng, reqs, ticks):
+    """Drive up to ``ticks`` ticks of the WARM engine under a parsed
+    device-trace window (ISSUE 11) and return the summary: measured
+    per-op-category timings, per-collective durations, the
+    compute∩comm overlap fraction and the goodput/MFU ledger, per
+    tick. Runs OFF the throughput clock (after the measured
+    comparison) so the capture overhead never pollutes the headline;
+    leftover requests finish outside the capture."""
+    eng.reset_results()
+    for prompt, max_new in reqs:
+        eng.submit(prompt, max_new)
+    with eng.trace_window() as cap:
+        for _ in range(ticks):
+            if eng.idle():
+                break
+            eng.step()
+        eng.drain(0)          # sync before the trace stops
+    while not eng.idle():     # finish residents off the trace
+        if not eng.step():
+            eng.drain(0)
+    eng.reset_results()
+    return cap.summary
+
+
 def bench_poisson(args, tiny):
     import paddle_tpu as paddle
     import paddle_tpu.profiler as profiler
@@ -256,6 +287,13 @@ def bench_poisson(args, tiny):
     inventory = eng.record_program_stats()
     summ = profiler.disable()
 
+    trace_block = None
+    if args.trace_window:
+        trace_block = traced_window_block(
+            eng, [(p, m) for _, p, m in make_trace(
+                max(2, slots), prompt_lens, max_new, 1e9, seed=3)],
+            args.trace_window)
+
     bl_tps = bl_tokens / bl_wall
     eng_tps = eng_tokens / eng_wall
     speedup = eng_tps / bl_tps if bl_tps else 0.0
@@ -264,7 +302,7 @@ def bench_poisson(args, tiny):
     snap = {k: v.get("value", v.get("count"))
             for k, v in summ["metrics"].items()
             if k.startswith("serving/")}
-    return {
+    out = {
         "metric": "serving_continuous_batching_speedup",
         "value": round(speedup, 4),
         "unit": "x tokens/s vs sequential generate()",
@@ -296,6 +334,9 @@ def bench_poisson(args, tiny):
             "latency_table": lat_rows,
             "registry": summ["metrics"],
             "xla_programs": inventory,
+            # parsed device-trace window (ISSUE 11): per-tick
+            # site/collective/MFU tables — measured, not apportioned
+            "device_trace": trace_block,
             "events_overhead_pct": round(overhead_pct, 2),
             "events_off_tokens_per_sec": round(off_tps, 2),
             "events_on_tokens_per_sec": round(on_tps, 2),
@@ -314,6 +355,9 @@ def bench_poisson(args, tiny):
                      "residual small/negative values are timer noise"),
         },
     }
+    if trace_block is None:
+        del out["extra"]["device_trace"]
+    return out
 
 
 def bench_shared_prefix(args, tiny):
@@ -363,6 +407,11 @@ def bench_shared_prefix(args, tiny):
     inventory = eng_on.record_program_stats()
     summ = profiler.disable()
 
+    trace_block = None
+    if args.trace_window:
+        trace_block = traced_window_block(eng_on, reqs,
+                                          args.trace_window)
+
     mean_off = float(np.mean(off_ttft))
     mean_on = float(np.mean(on_ttft))
     speedup = mean_off / mean_on if mean_on else 0.0
@@ -374,7 +423,7 @@ def bench_shared_prefix(args, tiny):
 
     snap = _snap(summ)
     snap_off = _snap(summ_off)
-    return {
+    out = {
         "metric": "serving_prefix_cache_ttft_speedup",
         "value": round(speedup, 4),
         "unit": "x lower mean TTFT vs prefix-cache-off engine",
@@ -413,6 +462,9 @@ def bench_shared_prefix(args, tiny):
                      "decode (outputs bitwise-equal across engines)"),
         },
     }
+    if trace_block is not None:
+        out["extra"]["device_trace"] = trace_block
+    return out
 
 
 def build_early_exit_draft(net, layers):
@@ -760,10 +812,24 @@ def main():
                     help="enable the persistent metrics sink into this "
                          "directory (metrics.jsonl + events.jsonl + "
                          "metrics.prom, final flush on exit)")
+    ap.add_argument("--trace-window", type=int, default=0,
+                    metavar="N",
+                    help="after the measured comparison, drive N warm "
+                         "engine ticks under a parsed device-trace "
+                         "window and embed the per-tick device "
+                         "timeline (op categories, per-collective "
+                         "durations, overlap fraction, goodput/MFU "
+                         "ledger) as extra.device_trace; with "
+                         "--sink-dir the summary also lands as "
+                         "trace_summary.json (Poisson and "
+                         "--prefix-cache modes)")
     args = ap.parse_args()
     if args.spec_decode and args.attention_kernel == "legacy":
         ap.error("--spec-decode needs the unified tick; "
                  "--attention-kernel legacy has no verify-row path")
+    if args.trace_window and (args.kernel_matrix or args.spec_decode):
+        ap.error("--trace-window rides the Poisson or --prefix-cache "
+                 "modes (the matrix/spec cells stay lean)")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax

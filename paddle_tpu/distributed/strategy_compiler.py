@@ -424,13 +424,17 @@ class HybridParallelTrainer:
 
     __call__ = step
 
-    def profile_step_phases(self, *batch, iters: int = 2):
+    def profile_step_phases(self, *batch, iters: int = 2,
+                            trace_window: int = 0):
         """Per-phase (fwd/bwd/optim/comm) decomposition — the
         compile_train_step counterpart of
         ``HybridPipelineTrainer.profile_step_phases`` (see its docstring
         for semantics): nested prefixes fwd / fwd+bwd / full step are
         compiled and timed, comm is modeled from collective bytes, and
-        the results land in the ``phase/*_ms`` gauges."""
+        the results land in the ``phase/*_ms`` gauges.
+        ``trace_window=k`` wraps k more real steps in a parsed
+        device-trace capture (measured per-op/per-collective timings,
+        overlap fraction, MFU ledger) returned under ``"trace"``."""
         from ..core import rng as rng_mod
 
         vs = self._shard_batch(batch)
@@ -458,11 +462,20 @@ class HybridParallelTrainer:
         from ..profiler import xla_stats as _xstats
 
         ps = _xstats.record_lowered(self._prof_site, lowered)
-        return _pinstr.record_phases(
+        out = _pinstr.record_phases(
             fwd_s=t_fwd, fwdbwd_s=t_fb, step_s=t_step,
             comm_bytes=st["total_bytes"],
             platform=self.mesh.devices.flat[0].platform,
             cost_bytes_accessed=ps.bytes_accessed)
+        if trace_window:
+            from ..profiler import device_trace as _dtrace
+
+            with _dtrace.capture(steps=int(trace_window),
+                                 label=self._prof_site) as cap:
+                for _ in range(int(trace_window)):
+                    _pinstr._first_leaf(self.step(*batch))
+            out["trace"] = cap.summary
+        return out
 
     def sync_to_layer(self):
         """Write device state back into the eager Layer (for save/eval)."""

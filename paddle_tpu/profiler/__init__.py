@@ -10,6 +10,16 @@ flushed on interval/exit/preempt/watchdog/rollback), and
 ``cost_analysis()`` FLOPs/bytes per dispatch site, the inventory that
 keys against recompile-telemetry names).
 
+Device-time truth (ISSUE 11; device_trace.py): windowed
+``jax.profiler.trace`` captures parsed with stdlib gzip+json into
+per-op-category timings, per-collective measured durations (joined
+with the byte accounting), a measured compute∩comm overlap fraction
+(``phase/comm_traced_ms`` next to the apportioned
+``phase/comm_measured_ms``), and a goodput/MFU ledger — via
+``profiler.trace_capture`` / ``profiler.TraceWindow``,
+``profile_step_phases(trace_window=k)``,
+``ServingEngine.trace_window()`` and ``serve_bench --trace-window``.
+
 Three pillars, one switch (``profiler.enable()``):
 
 1. **Tracing** (``trace.py``): ``profiler.scope("name")`` /
@@ -76,8 +86,10 @@ Quick use::
 """
 from __future__ import annotations
 
-from . import events, instrument, metrics, recompile  # noqa: F401
-from . import sink, trace, xla_stats  # noqa: F401
+from . import device_trace, events, instrument, metrics  # noqa: F401
+from . import recompile, sink, trace, xla_stats  # noqa: F401
+from .device_trace import TraceWindow, last_trace_summary  # noqa: F401
+from .device_trace import trace_capture  # noqa: F401
 from .events import (EventLog, FlightRecorder, dump_flight,  # noqa: F401
                      emit, flight_recorder, latency_breakdown,
                      latency_table, request_latency_stats)
@@ -119,6 +131,8 @@ __all__ = [
     "flush_active", "prometheus_text",
     # compiled-program accounting (xla_stats.py)
     "record_lowered", "record_compiled", "program_inventory",
+    # parsed XLA trace windows (device_trace.py)
+    "trace_capture", "TraceWindow", "last_trace_summary",
 ]
 
 
@@ -140,6 +154,7 @@ def enable(trace_dir=None, reset: bool = True) -> None:
         recompile.clear_log()
         events.log().clear()
         xla_stats.reset()
+        device_trace.reset()
     trace.enable(trace_dir=trace_dir, reset=False)
 
 
@@ -160,13 +175,19 @@ def reset() -> None:
     recompile.reset()
     events.log().clear()
     xla_stats.reset()
+    device_trace.reset()
 
 
 def summary(aggregate: bool = False) -> dict:
     """One JSON-ready dict with everything this subsystem observed:
     per-scope host spans, metric snapshot (rank-aggregated when
     ``aggregate``), derived rates (tokens/sec, steps/sec over the enabled
-    window), per-phase ms gauges, and the retrace log."""
+    window), per-phase ms gauges, and the retrace log. Also surfaces
+    IN-PROCESS what used to be visible only post-mortem in
+    metrics.jsonl: ``events_lost`` (lifecycle events aged out of the
+    bounded ring — a truncated timeline is a fact about THIS process,
+    not just the sink's file) and ``sink`` health (flush count, failed
+    flushes, last error)."""
     reg = metrics.registry()
     snap = reg.aggregate() if aggregate else reg.snapshot()
     window_s = trace.enabled_window_s()
@@ -185,4 +206,6 @@ def summary(aggregate: bool = False) -> dict:
             "rates": rates,
             "phases_ms": phases,
             "retraces": recompile.retraces(),
-            "programs": xla_stats.inventory()}
+            "programs": xla_stats.inventory(),
+            "events_lost": events.log().dropped,
+            "sink": sink.stats()}

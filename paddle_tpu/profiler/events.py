@@ -438,6 +438,17 @@ class FlightRecorder:
                                for t, s in _trace.live_spans().items()},
                 "scope_summary": _trace.scope_summary(),
             }
+            # the last parsed device-trace window (ISSUE 11): a hang
+            # or rollback dump carries the newest measured device
+            # timeline alongside the host-side evidence (None before
+            # any capture; lazy import — post-mortem paths must not
+            # pull jax state in)
+            try:
+                from . import device_trace as _dtrace
+
+                doc["trace_summary"] = _dtrace.last_summary()
+            except Exception:
+                doc["trace_summary"] = None
         except Exception as e:  # pragma: no cover - post-mortem shield
             doc = {"kind": "flight_recorder_dump", "reason": reason,
                    "error": f"{type(e).__name__}: {e}"}

@@ -66,7 +66,7 @@ from . import events as _events
 from .metrics import registry
 
 __all__ = ["MetricsSink", "enable_sink", "disable_sink", "active_sink",
-           "flush_active", "prometheus_text"]
+           "flush_active", "prometheus_text", "stats"]
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -150,6 +150,8 @@ class MetricsSink:
         self._event_log = event_log or _events.log()
         self._cursor = 0           # event-log seq already persisted
         self._flushes = 0
+        self._flush_errors = 0     # failed/timed-out flush attempts
+        self._last_error: Optional[str] = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -168,8 +170,8 @@ class MetricsSink:
         while not self._stop.wait(self.interval_s):
             try:
                 self.flush("interval")
-            except Exception:  # pragma: no cover - keep the writer alive
-                pass
+            except Exception:  # keep the writer alive; the failure is
+                pass           # already counted by flush()
 
     def close(self, reason: str = "exit",
               timeout: Optional[float] = None) -> None:
@@ -180,6 +182,8 @@ class MetricsSink:
         if not self._lock.acquire(timeout=-1 if timeout is None
                                   else timeout):
             self._closed = True       # wedged writer: give up the flush
+            self._flush_errors += 1
+            self._last_error = f"close({reason!r}): lock timeout"
             self._stop.set()
             return
         try:
@@ -218,11 +222,21 @@ class MetricsSink:
         between the watchdog and its abort ``os._exit``."""
         if not self._lock.acquire(timeout=-1 if timeout is None
                                   else timeout):
+            # a wedged writer IS a flush failure: data the caller asked
+            # to persist did not land — count it so summary() shows it
+            self._flush_errors += 1
+            self._last_error = f"flush({reason!r}): lock timeout"
             return None
         try:
             if self._closed:
                 return None
-            return self._flush_locked(reason)
+            try:
+                return self._flush_locked(reason)
+            except Exception as e:
+                self._flush_errors += 1
+                self._last_error = \
+                    f"flush({reason!r}): {type(e).__name__}: {e}"
+                raise
         finally:
             self._lock.release()
 
@@ -270,6 +284,17 @@ class MetricsSink:
     def flushes(self) -> int:
         return self._flushes
 
+    @property
+    def flush_errors(self) -> int:
+        """Flush attempts that failed (I/O error or lock timeout) —
+        surfaced in-process via ``profiler.summary()["sink"]``, not
+        just implied by holes in the on-disk artifacts."""
+        return self._flush_errors
+
+    @property
+    def last_error(self) -> Optional[str]:
+        return self._last_error
+
 
 # ---------------------------------------------------------------------------
 # process-global active sink
@@ -311,6 +336,19 @@ def disable_sink(reason: str = "disabled") -> None:
 
 def active_sink() -> Optional[MetricsSink]:
     return _active
+
+
+def stats() -> dict:
+    """In-process sink health: {active, directory, flushes,
+    flush_errors, last_error} — what ``profiler.summary()`` embeds so
+    a failing writer is visible BEFORE anyone reads metrics.jsonl."""
+    s = _active
+    if s is None:
+        return {"active": False, "flushes": 0, "flush_errors": 0,
+                "last_error": None}
+    return {"active": True, "directory": s.directory,
+            "flushes": s.flushes, "flush_errors": s.flush_errors,
+            "last_error": s.last_error}
 
 
 def flush_active(reason: str,

@@ -415,6 +415,38 @@ class ServingEngine:
                 site, fn.lower(*avals)).to_dict()
         return out
 
+    @contextmanager
+    def trace_window(self, log_dir: Optional[str] = None,
+                     peak_flops: Optional[float] = None):
+        """Capture a parsed device-trace window over the ticks driven
+        inside the block (ISSUE 11)::
+
+            with eng.trace_window() as cap:
+                for _ in range(8):
+                    eng.step()
+                eng.drain(0)          # sync before the trace stops
+            cap.summary               # per-tick device timeline
+
+        Records the hot-path programs first (``record_program_stats``
+        — registers the HLO-module -> site join keys and cost-analysis
+        FLOPs, so slices attribute to ``serving.tick#N`` and the MFU
+        ledger has its numerator), then wraps the block in a
+        ``device_trace.capture`` whose ``steps`` is set to the MEASURED
+        tick count (the ``serving/ticks`` counter delta), so the
+        summary's per-step rows read per-tick. Callers must drain
+        in-flight ticks before the block ends or the trailing device
+        work is cut off the timeline."""
+        from ..profiler import device_trace as _dtrace
+
+        self.record_program_stats()
+        t0 = _registry().counter("serving/ticks").value
+        cap = _dtrace.capture(log_dir=log_dir, peak_flops=peak_flops,
+                              label=f"serving.eng{self._eng_id}")
+        with cap:
+            yield cap
+            cap.steps = int(
+                _registry().counter("serving/ticks").value - t0) or None
+
     def latency_stats(self, window_s: Optional[float] = None) -> dict:
         """Rolling-window TTFT/TPOT p50/p90/p95/p99 over requests
         finished in the last ``window_s`` seconds (None: everything

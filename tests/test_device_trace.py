@@ -1,0 +1,508 @@
+"""Parsed XLA trace windows (ISSUE 11, profiler/device_trace.py).
+
+Every parser path runs over CHECKED-IN miniature trace fixtures
+(tests/data/*.trace.json.gz) so tier-1 never depends on a live
+capture; the live round-trips (real ``jax.profiler.trace`` on the CPU
+backend — XLA:CPU thunk slices) are slow-marked, per the saturated
+tier-1 time cap. Covered: fixture parsing (CPU thunk spelling, TPU
+device-pid spelling, hlo_module site attribution), the negative cases
+(truncated gzip / malformed JSON / wrong shape / empty window),
+overlap-fraction interval math on synthetic slices, the goodput/MFU
+ledger arithmetic, the TraceWindow scheduler, the per-op-category
+HLO breakdown (xla_stats satellite), and the summary()
+events_lost/sink-failure surfacing (bugfix satellite).
+"""
+import gzip
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import device_trace as dt
+from paddle_tpu.profiler import events as pevents
+from paddle_tpu.profiler import sink as psink
+from paddle_tpu.profiler import xla_stats
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def fix(name):
+    return os.path.join(DATA, name)
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_state():
+    profiler.reset()
+    yield
+    profiler.reset()
+
+
+def _inject_program(site, module, flops=None, collectives=None):
+    """Seed the inventory + module map the way record_compiled would,
+    without paying a compile (white-box: the join is what's under
+    test, not XLA)."""
+    xla_stats.register_module_site(module, site)
+    ps = xla_stats.ProgramStats(site, 1.0, flops, None,
+                                {"flops": flops} if flops else {},
+                                module=module,
+                                collectives=collectives)
+    with xla_stats._lock:
+        xla_stats._programs[site] = ps
+    return ps
+
+
+# ---------------------------------------------------------------------------
+# fixture parsing — positive paths
+# ---------------------------------------------------------------------------
+def test_cpu_fixture_categories_sites_and_bounds():
+    _inject_program("hybrid.step#0", "jit_step", flops=1000.0)
+    doc = dt.load_trace_events(fix("mini_cpu.trace.json.gz"))
+    s = dt.summarize(doc, label="t")
+    assert not s["empty"]
+    assert s["device_ops"] == 7
+    # window bounds exclude the 100ms python-tracer noise span: they
+    # run from the hybrid/step annotation (ts=1000us) to the last
+    # thunk end (2750us)
+    assert s["wall_ms"] == pytest.approx(1.75, abs=1e-6)
+    assert s["device_busy_ms"] == pytest.approx(1.05, abs=1e-6)
+    assert s["host_gap_ms"] == pytest.approx(0.70, abs=1e-6)
+    assert 0.0 <= s["busy_frac"] <= 1.0
+    cats = s["categories"]
+    assert cats["matmul"]["count"] == 2
+    assert cats["matmul"]["ms"] == pytest.approx(0.78, abs=1e-6)
+    assert cats["elementwise"]["count"] == 5
+    assert cats["collective"]["count"] == 0
+    # jit_step attributed to the registered site; jit_other is not
+    row = s["sites"]["hybrid.step#0"]
+    assert row["module"] == "jit_step"
+    assert row["executions"] == 2            # min per-op-name count
+    assert row["executions_source"] == "trace_min_op_count"
+    assert row["flops_per_exec"] == 1000.0
+    assert "jit_other" in s["unattributed_modules"]
+    # the profiler scope annotation survives as a host span
+    assert s["host_annotations"]["hybrid/step"]["count"] == 1
+    # comm: none in this window, overlap honestly 0
+    assert s["comm_ms"] == 0
+    assert s["comm_overlap_frac"] == 0.0
+
+
+def test_tpu_fixture_collectives_overlap_and_device_pid():
+    _inject_program(
+        "hybrid.step#1", "jit_train_step", flops=5000.0,
+        collectives={"all_reduce": {"ops": 1, "bytes": 4096},
+                     "reduce_scatter": {"ops": 1, "bytes": 512}})
+    doc = dt.load_trace_events(fix("mini_tpu.trace.json.gz"))
+    s = dt.summarize(doc, label="t")
+    # the arg-less slice under the /device: pid still parses as a
+    # device op (TPU stream spelling)
+    assert s["device_ops"] == 5
+    # scope-aware classification: the dot under the fwd/attn scope
+    # counts as attention work (TPU op names carry scope prefixes)
+    assert s["categories"]["attention"]["count"] == 2
+    assert s["categories"]["matmul"]["count"] == 0
+    assert s["categories"]["scatter-gather"]["count"] == 1
+    # per-collective measured durations by kind
+    assert s["collectives"]["all_reduce"]["ms"] == \
+        pytest.approx(0.2, abs=1e-6)
+    assert s["collectives"]["all_reduce"]["count"] == 1
+    assert s["collectives"]["reduce_scatter"]["ms"] == \
+        pytest.approx(0.04, abs=1e-6)
+    # measured overlap: all-reduce [150,350] vs compute union
+    # [100,300]+[320,420]+[430,580] -> (150+30)/240
+    assert s["comm_overlap_frac"] == pytest.approx(0.75, abs=1e-6)
+    assert s["comm_ms"] == pytest.approx(0.24, abs=1e-6)
+    # the byte join: modeled bytes sit NEXT TO traced microseconds in
+    # the same per-kind record
+    site_cols = s["sites"]["hybrid.step#1"]["collectives"]
+    assert site_cols["all_reduce"]["bytes_per_exec"] == 4096
+    assert site_cols["all_reduce"]["ms"] == pytest.approx(0.2, abs=1e-6)
+    # host-pid noise excluded from bounds: window is 100..640us
+    assert s["wall_ms"] == pytest.approx(0.54, abs=1e-6)
+
+
+def test_steps_hint_overrides_single_site_executions():
+    _inject_program("hybrid.step#2", "jit_step", flops=1000.0)
+    doc = dt.load_trace_events(fix("mini_cpu.trace.json.gz"))
+    # drop the unattributed-module slice so exactly ONE site remains
+    doc["traceEvents"] = [
+        e for e in doc["traceEvents"]
+        if (e.get("args") or {}).get("hlo_module") != "jit_other"]
+    s = dt.summarize(doc, steps=2, label="t")
+    row = s["sites"]["hybrid.step#2"]
+    assert row["executions"] == 2
+    assert row["executions_source"] == "steps_hint"
+    # ledger: model flops x executions over the window wall
+    led = s["ledger"]
+    assert led["model_flops_total"] == pytest.approx(2000.0)
+    assert led["steps"] == 2
+    assert led["wall_ms_per_step"] == pytest.approx(
+        s["wall_ms"] / 2, abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# negative paths — malformed/truncated/empty fixtures
+# ---------------------------------------------------------------------------
+def test_truncated_gzip_raises_parse_error():
+    with pytest.raises(dt.TraceParseError):
+        dt.load_trace_events(fix("truncated.trace.json.gz"))
+
+
+def test_malformed_json_raises_parse_error():
+    with pytest.raises(dt.TraceParseError):
+        dt.load_trace_events(fix("malformed.trace.json.gz"))
+
+
+def test_wrong_shape_raises_parse_error():
+    with pytest.raises(dt.TraceParseError):
+        dt.load_trace_events(fix("wrong_shape.trace.json.gz"))
+
+
+def test_empty_window_summarizes_honestly():
+    doc = dt.load_trace_events(fix("empty_window.trace.json.gz"))
+    s = dt.summarize(doc, label="t")
+    assert s["empty"]
+    assert s["device_ops"] == 0
+    assert s["device_busy_ms"] == 0.0
+    assert s["comm_overlap_frac"] == 0.0
+    assert s["sites"] == {}
+    assert s["ledger"]["model_flops_total"] is None
+
+
+def test_missing_file_raises_parse_error(tmp_path):
+    with pytest.raises(dt.TraceParseError):
+        dt.load_trace_events(str(tmp_path / "nope.trace.json.gz"))
+    assert dt.find_trace_file(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# overlap / interval math on synthetic slices
+# ---------------------------------------------------------------------------
+def test_interval_union_merges_overlaps():
+    assert dt.interval_union_ms([]) == 0.0
+    assert dt.interval_union_ms([(0, 1000)]) == pytest.approx(1.0)
+    # overlapping + contained + disjoint
+    assert dt.interval_union_ms(
+        [(0, 500), (400, 1000), (600, 800), (2000, 2500)]) == \
+        pytest.approx(1.5)
+
+
+def test_overlap_fraction_synthetic():
+    # no comm -> 0 (nothing to overlap)
+    assert dt.overlap_fraction([], [(0, 100)]) == 0.0
+    # disjoint -> 0
+    assert dt.overlap_fraction([(0, 100)], [(200, 300)]) == 0.0
+    # fully hidden -> 1
+    assert dt.overlap_fraction([(50, 150)], [(0, 200)]) == 1.0
+    # partial: comm [0,100], compute [50,75]+[90,200] -> 35/100
+    assert dt.overlap_fraction(
+        [(0, 100)], [(50, 75), (90, 200)]) == pytest.approx(0.35)
+    # fragmented comm against fragmented compute
+    assert dt.overlap_fraction(
+        [(0, 10), (20, 30)], [(5, 25)]) == pytest.approx(0.5)
+    # result always clamped to [0, 1]
+    assert 0.0 <= dt.overlap_fraction(
+        [(0, 1)], [(0, 1), (0, 1)]) <= 1.0
+
+
+def test_categorize_op():
+    assert dt.categorize_op("dot.4") == "matmul"
+    assert dt.categorize_op("convolution.2") == "matmul"
+    assert dt.categorize_op("fusion.attention_softmax") == "attention"
+    assert dt.categorize_op("gather.1") == "scatter-gather"
+    assert dt.categorize_op("dynamic-update-slice.9") == \
+        "scatter-gather"
+    assert dt.categorize_op("all-reduce-done.1") == "collective"
+    assert dt.categorize_op("broadcast_maximum_fusion") == "elementwise"
+    assert dt.collective_kind("all-gather-start.3") == "all_gather"
+    assert dt.collective_kind("collective-permute.1") == "ppermute"
+    assert dt.collective_kind("dot.4") is None
+
+
+# ---------------------------------------------------------------------------
+# goodput / MFU ledger arithmetic
+# ---------------------------------------------------------------------------
+def test_ledger_arithmetic_exact():
+    _inject_program("site.a#0", "jit_a", flops=1e6)
+    # one module, 4 identical 100us ops back to back: wall 400us,
+    # busy 400us, 2 executions (two op names x2)
+    evs = []
+    for i in range(2):
+        t0 = i * 200.0
+        evs.append({"ph": "X", "pid": 1, "tid": 1, "ts": t0,
+                    "dur": 100.0, "name": "dot.1",
+                    "args": {"hlo_module": "jit_a", "hlo_op": "dot.1"}})
+        evs.append({"ph": "X", "pid": 1, "tid": 1, "ts": t0 + 100,
+                    "dur": 100.0, "name": "add.2",
+                    "args": {"hlo_module": "jit_a", "hlo_op": "add.2"}})
+    s = dt.summarize({"traceEvents": evs}, peak_flops=1e12, label="t")
+    led = s["ledger"]
+    assert s["wall_ms"] == pytest.approx(0.4)
+    assert s["device_busy_ms"] == pytest.approx(0.4)
+    assert led["goodput_busy_frac"] == pytest.approx(1.0)
+    # 2 execs x 1e6 flops over 400us = 5e9 flop/s -> mfu 5e-3 at 1e12
+    assert led["model_flops_total"] == pytest.approx(2e6)
+    assert led["model_flops_per_s"] == pytest.approx(5e9)
+    assert led["mfu"] == pytest.approx(5e-3)
+    assert led["peak_flops_source"] == "caller"
+    row = s["sites"]["site.a#0"]
+    assert row["model_flops_per_s"] == pytest.approx(5e9)
+    assert row["mfu"] == pytest.approx(5e-3)
+
+
+def test_default_peak_flops_is_labeled():
+    peak, src = dt.default_peak_flops()
+    assert peak is None or peak > 0
+    assert isinstance(src, str) and src
+
+
+# ---------------------------------------------------------------------------
+# record_summary: gauges + sink artifact + flight attachment
+# ---------------------------------------------------------------------------
+def test_record_summary_gauges_and_flight(tmp_path):
+    doc = dt.load_trace_events(fix("mini_tpu.trace.json.gz"))
+    s = dt.summarize(doc, steps=1, label="t")
+    psink.enable_sink(str(tmp_path), interval_s=3600)
+    try:
+        dt.record_summary(s)
+        reg = profiler.registry()
+        snap = reg.snapshot()
+        assert snap["phase/comm_traced_ms"]["value"] == \
+            pytest.approx(0.24, abs=1e-6)
+        assert snap["phase/comm_overlap_frac"]["value"] == \
+            pytest.approx(0.75, abs=1e-6)
+        assert snap["trace/goodput_busy_frac"]["value"] == \
+            s["busy_frac"]
+        assert snap["trace/comm/all_reduce_ms"]["value"] == \
+            pytest.approx(0.2, abs=1e-6)
+        # the sink persisted the summary artifact atomically
+        art = json.load(open(tmp_path / "trace_summary.json"))
+        assert art["kind"] == "device_trace_summary"
+        assert art["comm_overlap_frac"] == s["comm_overlap_frac"]
+        # the flight recorder attaches the last summary
+        assert dt.last_summary() is s
+        dump = pevents.flight_recorder().dump(reason="test")
+        assert dump["trace_summary"]["kind"] == "device_trace_summary"
+    finally:
+        psink.disable_sink()
+
+
+def test_degraded_summary_not_recorded(tmp_path):
+    """A skipped/errored capture must not clobber the last good
+    summary, the gauges, or the sink artifact (whose schema it would
+    violate) — it is counted instead."""
+    doc = dt.load_trace_events(fix("mini_cpu.trace.json.gz"))
+    good = dt.summarize(doc, label="good")
+    psink.enable_sink(str(tmp_path), interval_s=3600)
+    try:
+        dt.record_summary(good)
+        dt.record_summary({"kind": "device_trace_summary",
+                           "label": "bad", "skipped": "trace busy",
+                           "empty": True})
+        assert dt.last_summary() is good
+        art = json.load(open(tmp_path / "trace_summary.json"))
+        assert art["label"] == "good"
+        reg = profiler.registry()
+        assert reg.counter("trace/windows_degraded").value == 1
+    finally:
+        psink.disable_sink()
+
+
+def test_reset_clears_module_site_maps():
+    """profiler.reset() clears the module->site join maps with the
+    inventory: a re-used module name from a NEW engine generation must
+    not inherit a stale mapping or a permanent ambiguity flag."""
+    xla_stats.register_module_site("jit_gen", "old.site#0")
+    profiler.reset()
+    assert "jit_gen" not in xla_stats.module_sites()
+    xla_stats.register_module_site("jit_gen", "new.site#0")
+    assert "jit_gen" not in xla_stats.ambiguous_modules()
+    assert xla_stats.module_sites()["jit_gen"] == "new.site#0"
+
+
+# ---------------------------------------------------------------------------
+# TraceWindow scheduler (no live capture needed)
+# ---------------------------------------------------------------------------
+def test_trace_window_schedule_logic():
+    w = dt.TraceWindow(length=2, every=5, start=3)
+    assert [i for i in range(14) if w._should_start(i)] == [3, 8, 13]
+    one_shot = dt.TraceWindow(length=2, start=4)
+    assert [i for i in range(10) if one_shot._should_start(i)] == [4]
+    capped = dt.TraceWindow(length=1, every=2, max_windows=2)
+    capped.summaries = [{}, {}]
+    assert not capped._should_start(4)
+    with pytest.raises(ValueError):
+        dt.TraceWindow(length=0)
+    with pytest.raises(ValueError):
+        dt.TraceWindow(length=4, every=2)   # overlapping windows
+
+
+# ---------------------------------------------------------------------------
+# xla_stats satellite: per-op-category FLOPs/bytes from compiled HLO
+# ---------------------------------------------------------------------------
+def test_category_breakdown_tiny_program():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x, w):
+        return jnp.take(jax.nn.relu(jnp.dot(x, w)),
+                        jnp.arange(4), axis=0).sum()
+
+    x = jnp.ones((8, 6), jnp.float32)
+    w = jnp.ones((6, 8), jnp.float32)
+    compiled = f.lower(x, w).compile()
+    bd = xla_stats.category_breakdown(compiled.as_text())
+    cats = bd["categories"]
+    # the dot's flops are exact: 2 * 8*8 * 6
+    assert cats["matmul"]["flops"] == pytest.approx(2 * 8 * 8 * 6)
+    # the categories table stays homogeneous ({ops, bytes[, flops]}
+    # entries only); the reconciliation number sits NEXT TO it
+    assert all(isinstance(c, dict) for c in cats.values())
+    assert sum(c["ops"] for c in cats.values()) > 0
+    # record_compiled folds the breakdown + module join key in
+    ps = xla_stats.record_compiled("test.cat#0", compiled)
+    assert ps.categories["matmul"]["flops"] == \
+        pytest.approx(2 * 8 * 8 * 6)
+    assert ps.module and ps.module.startswith("jit_f")
+    assert xla_stats.module_sites()[ps.module] == "test.cat#0"
+    assert ps.to_dict()["categories"] == ps.categories
+    # reconciliation: unattributed remainder is non-negative
+    if ps.flops_unattributed is not None:
+        assert ps.flops_unattributed >= 0
+
+
+def test_module_site_ambiguity_flagged():
+    xla_stats.register_module_site("jit_same", "a#0")
+    xla_stats.register_module_site("jit_same", "b#0")
+    assert "jit_same" in xla_stats.ambiguous_modules()
+    _inject_program("b#0", "jit_same")
+    evs = [{"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 10.0,
+            "name": "dot.1",
+            "args": {"hlo_module": "jit_same", "hlo_op": "dot.1"}}]
+    s = dt.summarize({"traceEvents": evs}, label="t")
+    assert s["sites"]["b#0"]["ambiguous"] is True
+
+
+# ---------------------------------------------------------------------------
+# bugfix satellite: summary() surfaces events_lost + sink failures
+# ---------------------------------------------------------------------------
+def test_summary_surfaces_events_lost():
+    old = pevents._log
+    pevents._log = pevents.EventLog(capacity=4)
+    try:
+        for i in range(10):
+            pevents.emit("submit", rid=i)
+        s = profiler.summary()
+        assert s["events_lost"] == 6
+    finally:
+        pevents._log = old
+
+
+def test_summary_surfaces_sink_flush_failures(tmp_path):
+    s = psink.enable_sink(str(tmp_path), interval_s=3600)
+    try:
+        assert profiler.summary()["sink"]["active"] is True
+        good_path = s._metrics_path
+        s._metrics_path = str(tmp_path)     # a directory: append fails
+        with pytest.raises(OSError):
+            s.flush("manual")
+        s._metrics_path = good_path
+        health = profiler.summary()["sink"]
+        assert health["flush_errors"] == 1
+        assert "manual" in health["last_error"]
+        assert s.flush("manual") is not None    # recovered
+    finally:
+        psink.disable_sink()
+    assert profiler.summary()["sink"]["active"] is False
+
+
+# ---------------------------------------------------------------------------
+# slow: live capture round-trips on the CPU backend (XLA:CPU thunks)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_live_capture_round_trip_cpu():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, w):
+        return jax.nn.relu(jnp.dot(x, w)).sum()
+
+    x = jnp.ones((64, 64))
+    w = jnp.ones((64, 64))
+    step(x, w).block_until_ready()
+    xla_stats.record_lowered("live.step#0", step.lower(x, w))
+    with dt.capture(steps=3, label="live.step#0") as cap:
+        for _ in range(3):
+            step(x, w).block_until_ready()
+    s = cap.summary
+    assert s is not None and not s.get("empty")
+    assert s["device_ops"] > 0
+    assert s["categories"]["matmul"]["count"] >= 3
+    assert "live.step#0" in s["sites"]
+    assert s["sites"]["live.step#0"]["executions"] == 3
+    assert s["ledger"]["model_flops_total"] > 0
+    assert 0.0 <= s["comm_overlap_frac"] <= 1.0
+    assert dt.last_summary() is s
+
+
+@pytest.mark.slow
+def test_live_trace_window_scheduler_cpu():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return (x * 2.0).sum()
+
+    x = jnp.ones((32, 32))
+    step(x).block_until_ready()
+    win = dt.TraceWindow(length=2, every=4, start=1, max_windows=2,
+                         label="win")
+    for _ in range(9):
+        with win.step():
+            step(x).block_until_ready()
+    assert len(win.summaries) == 2      # windows at steps 1-2 and 5-6
+    assert win.last is win.summaries[-1]
+    for s in win.summaries:
+        assert s["steps"] == 2
+        assert s["device_ops"] > 0
+
+
+@pytest.mark.slow
+def test_live_serving_trace_window_cpu():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    paddle.seed(0)
+    net = GPT(GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64))
+    net.eval()
+    eng = ServingEngine(net, ServingConfig(
+        num_slots=2, page_size=8, pages_per_slot=4))
+    prompts = [np.arange(4, dtype=np.int32) + i for i in range(2)]
+    for p in prompts:                   # warm the tick off the trace
+        eng.submit(p, 4)
+    eng.run()
+    eng.reset_results()
+    for p in prompts:
+        eng.submit(p, 6)
+    with eng.trace_window() as cap:
+        for _ in range(5):
+            if eng.idle():
+                break
+            eng.step()
+        eng.drain(0)
+    while not eng.idle():
+        if not eng.step():
+            eng.drain(0)
+    s = cap.summary
+    assert s is not None and not s.get("empty")
+    assert any(site.startswith("serving.tick") for site in s["sites"])
+    assert s["steps"] and s["steps"] >= 1
+    site = next(v for k, v in s["sites"].items()
+                if k.startswith("serving.tick"))
+    assert site["executions"] == s["steps"]

@@ -14,8 +14,13 @@ non-monotonic event sequence, a malformed Prometheus exposition, a
 speculative-decoding ``accept`` event whose counts are missing,
 non-integer, or impossible (accepted > drafted), a bench block missing
 the p50/p90/p95/p99 TTFT/TPOT percentiles or the compiled-program
-inventory. stdlib only (the CI image installs jax + numpy + pytest,
-nothing else).
+inventory, and (ISSUE 11) a device-trace summary — the sink's
+``trace_summary.json`` and/or the bench block's ``extra.device_trace``
+— whose overlap/goodput fractions leave [0, 1] or whose
+category/collective/site/ledger records drop required keys
+(``--require-trace`` makes their PRESENCE mandatory, for the
+``--trace-window`` CI leg). stdlib only (the CI image installs jax +
+numpy + pytest, nothing else).
 
 Note on events.jsonl seq monotonicity: the sink's writer is
 at-least-once under I/O errors — a partially-landed segment is re-sent
@@ -171,7 +176,68 @@ def check_prometheus(path: str, schema: dict) -> None:
         err(f"{path}: no TYPE declarations at all")
 
 
-def check_bench_json(path: str, schema: dict) -> None:
+def check_trace_summary(doc, schema: dict, where: str) -> None:
+    """Validate one device-trace summary document (the sink's
+    trace_summary.json artifact or a bench block's extra.device_trace
+    key — same schema, ISSUE 11)."""
+    sc = schema["trace_summary"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["required"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    if doc.get("kind") != sc["kind"]:
+        err(f"{where}: kind {doc.get('kind')!r} != {sc['kind']!r}")
+    for k in sc["fractions_in_unit_interval"]:
+        v = doc.get(k)
+        if not isinstance(v, (int, float)) or not 0.0 <= v <= 1.0:
+            err(f"{where}: {k} {v!r} not a number in [0, 1]")
+    cats = doc.get("categories")
+    if isinstance(cats, dict):
+        for c in sc["categories"]:
+            if c not in cats:
+                err(f"{where}: categories missing {c!r}")
+        for c, entry in cats.items():
+            for k in sc["category_entry"]:
+                if k not in (entry or {}):
+                    err(f"{where}: categories.{c} missing {k!r}")
+    for kind, entry in (doc.get("collectives") or {}).items():
+        for k in sc["collective_entry"]:
+            if k not in (entry or {}):
+                err(f"{where}: collectives.{kind} missing {k!r}")
+    for site, entry in (doc.get("sites") or {}).items():
+        for k in sc["site_entry"]:
+            if k not in (entry or {}):
+                err(f"{where}: sites.{site!r} missing {k!r}")
+    led = doc.get("ledger")
+    if isinstance(led, dict):
+        for k in sc["ledger_required"]:
+            if k not in led:
+                err(f"{where}: ledger missing {k!r}")
+        g = led.get("goodput_busy_frac")
+        if not isinstance(g, (int, float)) or not 0.0 <= g <= 1.0:
+            err(f"{where}: ledger.goodput_busy_frac {g!r} not in "
+                "[0, 1]")
+    elif led is not None:
+        err(f"{where}: ledger not an object")
+
+
+def check_trace_summary_file(path: str, schema: dict,
+                             required: bool) -> None:
+    if not os.path.exists(path):
+        if required:
+            err(f"{path}: missing (run produced no device-trace "
+                "window; --require-trace expects one)")
+        return
+    try:
+        doc = json.load(open(path))
+    except Exception as e:
+        return err(f"{path}: unreadable ({e})")
+    check_trace_summary(doc, schema, path)
+
+
+def check_bench_json(path: str, schema: dict,
+                     require_trace: bool = False) -> None:
     sc = schema["bench_extra"]
     try:
         extra = json.load(open(path))["extra"]
@@ -204,6 +270,13 @@ def check_bench_json(path: str, schema: dict) -> None:
         err(f"{path}: extra.registry (full snapshot) missing")
     if "events_overhead_pct" not in extra:
         err(f"{path}: extra.events_overhead_pct missing")
+    # device-trace block (ISSUE 11): validated whenever present; with
+    # --require-trace (the --trace-window CI leg) it must be present
+    dt = extra.get("device_trace")
+    if dt is not None:
+        check_trace_summary(dt, schema, f"{path}: extra.device_trace")
+    elif require_trace:
+        err(f"{path}: extra.device_trace missing (--require-trace)")
 
 
 def main() -> int:
@@ -211,6 +284,12 @@ def main() -> int:
     ap.add_argument("sink_dir", help="directory a MetricsSink wrote")
     ap.add_argument("--bench-json", default=None,
                     help="serve_bench stdout JSON to validate as well")
+    ap.add_argument("--require-trace", action="store_true",
+                    help="fail unless trace_summary.json exists in the "
+                         "sink dir AND the bench block carries "
+                         "extra.device_trace (the --trace-window CI "
+                         "leg; without this flag both are validated "
+                         "only when present)")
     ap.add_argument("--schema", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "sink_schema.json"))
     args = ap.parse_args()
@@ -222,8 +301,12 @@ def main() -> int:
         os.path.join(args.sink_dir, "events.jsonl"), schema)
     check_prometheus(
         os.path.join(args.sink_dir, "metrics.prom"), schema)
+    check_trace_summary_file(
+        os.path.join(args.sink_dir, "trace_summary.json"), schema,
+        required=args.require_trace)
     if args.bench_json:
-        check_bench_json(args.bench_json, schema)
+        check_bench_json(args.bench_json, schema,
+                         require_trace=args.require_trace)
 
     if _ERRORS:
         print(f"sink schema: {len(_ERRORS)} violation(s)")
