@@ -372,6 +372,85 @@ def bench_gpt(paddle, cfg, batch, seq, steps, peak, remat=False,
                 trace_window=2 if profile_phases else 0)}
 
 
+def bench_qcomm(paddle, steps=4):
+    """Quantized DP-gradient AllReduce (distributed/qcomm.py, ISSUE
+    12): the SAME tiny-GPT pure-DP step compiled twice —
+    ``dp_grad_comm='f32'`` (GSPMD's implicit f32 AllReduce) vs
+    ``'int8'`` (EQuARX-style blockwise-int8 ring) — with the
+    profiler's collective-byte accounting per config, the per-dtype
+    gauges (``comm/collective_bytes_{int8,f32}``) making the byte cut
+    readable straight off the registry, and a 2-step parsed
+    device-trace window so ``phase/comm_traced_ms`` sits before/after
+    where the backend exposes collective slices (on this CPU box the
+    parser reads host-scheduled thunks — collective slices may be
+    empty, stated honestly; the TPU capture is the pending hardware
+    run, ROADMAP). Loss trajectories of both configs ride along as the
+    in-bench parity check."""
+    import jax
+
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.strategy_compiler import (
+        build_mesh_from_strategy, compile_train_step)
+    from paddle_tpu.models import GPT, GPTConfig
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"skipped": f"needs a multi-device dp mesh (have {ndev})"}
+
+    def make(dpc):
+        paddle.seed(3)
+        net = GPT(GPTConfig(vocab_size=128, hidden_size=64,
+                            num_layers=2, num_heads=4, max_seq_len=64))
+        opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+        s = DistributedStrategy()
+        return compile_train_step(net, opt, s,
+                                  build_mesh_from_strategy(s),
+                                  dp_grad_comm=dpc)
+
+    toks = np.random.RandomState(0).randint(
+        0, 128, (max(ndev * 2, 8), 32)).astype(np.int32)
+    out = {"dp": ndev, "model": "gpt h64 L2 v128"}
+    losses = {}
+    for name in ("f32", "int8"):
+        tr = make(name)
+        profiler.enable()
+        try:
+            ph = tr.profile_step_phases(toks, trace_window=2)
+            losses[name] = [float(tr.step(toks)) for _ in range(steps)]
+            s = profiler.summary()
+
+            def gauge(n):
+                return (s["metrics"].get(n) or {}).get("value")
+
+            cell = {
+                "phases_ms": {k: v for k, v in ph.items()
+                              if k != "trace"},
+                "collective_bytes_per_step":
+                    gauge("comm/collective_bytes_per_step"),
+                "collective_bytes_int8":
+                    gauge("comm/collective_bytes_int8"),
+                "collective_bytes_f32":
+                    gauge("comm/collective_bytes_f32"),
+                "comm_traced_ms": gauge("phase/comm_traced_ms"),
+                "comm_overlap_frac": gauge("phase/comm_overlap_frac"),
+                "losses": [round(l, 6) for l in losses[name]],
+            }
+            out[name] = cell
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        finally:
+            profiler.disable()
+            profiler.reset()
+    if "error" not in out["f32"] and "error" not in out["int8"]:
+        bf = out["f32"]["collective_bytes_per_step"] or 1
+        out["collective_bytes_ratio"] = round(
+            (out["int8"]["collective_bytes_per_step"] or 0) / bf, 4)
+        out["loss_abs_delta_final"] = round(
+            abs(losses["f32"][-1] - losses["int8"][-1]), 6)
+    return out
+
+
 def bench_moe(paddle, steps, peak):
     """MoE-GPT (distributed/moe.py): tokens/sec + dense-equivalent MFU
     (active params only — top-1 routing activates 1/E of expert FLOPs;
@@ -697,6 +776,10 @@ def main():
         except Exception as e:  # one broken config must not kill the line
             configs[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
         release_hbm()
+
+    # quantized DP-grad AllReduce before/after (ISSUE 12) — cheap (two
+    # tiny-GPT compiles); self-skips on single-device boxes
+    extra("gpt_dp_qcomm_int8", lambda: bench_qcomm(paddle))
 
     if on_tpu:
         from paddle_tpu.models import (BertForPretraining,

@@ -140,13 +140,14 @@ def run_baseline(net, trace):
 
 def build_engine(net, num_slots, page_size, pages_per_slot,
                  prefill_chunk=0, prefix_cache=True,
-                 attention_kernel="ragged-xla"):
+                 attention_kernel="ragged-xla", kv_dtype=None):
     from paddle_tpu.serving import ServingConfig, ServingEngine
 
     return ServingEngine(net, ServingConfig(
         num_slots=num_slots, page_size=page_size,
         pages_per_slot=pages_per_slot, prefill_chunk=prefill_chunk,
-        prefix_cache=prefix_cache, attention_kernel=attention_kernel))
+        prefix_cache=prefix_cache, attention_kernel=attention_kernel,
+        kv_dtype=kv_dtype))
 
 
 def run_engine(eng, trace):
@@ -465,6 +466,195 @@ def bench_shared_prefix(args, tiny):
     if trace_block is not None:
         out["extra"]["device_trace"] = trace_block
     return out
+
+
+def _pool_bytes(eng):
+    """Device bytes of an engine's page pool, scale arrays included —
+    the honest denominator of the residency claim."""
+    b = eng.pool.k.nbytes + eng.pool.v.nbytes
+    if eng.pool.quantized:
+        b += eng.pool.k_scale.nbytes + eng.pool.v_scale.nbytes
+    return b
+
+
+def _continuation_nll(net, prompt, cont):
+    """Per-token NLL of ``cont`` after ``prompt`` under the (f32,
+    dense) reference model — the quality proxy's perplexity leg: how
+    plausible each engine's emitted continuation is under the model
+    that emitted it (KV quantization perturbs the sampling path, not
+    the scoring model)."""
+    import paddle_tpu as paddle
+
+    seq = np.concatenate([prompt, np.asarray(cont, np.int32)])[None]
+    logits = np.asarray(
+        net(paddle.to_tensor(seq.astype(np.int32))).numpy(),
+        np.float64)[0]
+    lp = logits - np.log(np.exp(
+        logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+        - logits.max(-1, keepdims=True)
+    pos = np.arange(len(prompt) - 1, seq.shape[1] - 1)
+    return -lp[pos, np.asarray(cont, np.int64)]
+
+
+def bench_kv_quant(args, tiny):
+    """int8 (or bf16) KV pages vs the f32 pool (ISSUE 12): a residency
+    cell at MATCHED pool bytes (the int8 pool holds 2x the slots in
+    about half the bytes — per-page scale overhead included) and a
+    quality-proxy cell (greedy token-match rate vs the f32 engine on a
+    fixed-seed workload, plus the dense-model perplexity of each
+    engine's emitted continuations, reported honestly).
+
+    Regime note: this mode uses STANDARD-init (0.02) untrained models.
+    With the serving benches' usual 0.2-scale init, untrained
+    attention logits saturate and greedy argmax sits on knife-edge
+    ties — a sub-1% cache perturbation flips ~10% of tokens/step
+    there (measured), which characterizes the regime's chaos, not the
+    quantizer. The same reasoning as the --spec-decode draft-friendly
+    regime; trained models land at or above the 0.02-init margin.
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.models import GPT, GPTConfig
+
+    kv = args.kv_dtype
+    paddle.seed(0)
+    if tiny:
+        cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                        num_heads=4, max_seq_len=128)
+        slots, n_req, max_new, plens, ps = 2, 6, 16, (8, 16), 8
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=4,
+                        num_heads=4, max_seq_len=256)
+        slots, n_req, max_new = args.slots // 2 or 4, args.requests, \
+            args.max_new
+        plens, ps = (16, 32, 64), 16
+    net = GPT(cfg)
+    net.eval()
+    pages_per_slot = -(-(max(plens) + max_new) // ps)
+    trace = make_trace(n_req, plens, max_new, args.rate)
+
+    # ---- quality proxy: same fixed-seed workload through both pools -
+    def outputs(eng):
+        eng.reset_results()
+        run_engine(eng, trace)
+        res = {rid: r for rid, r in eng._requests.items() if r.done}
+        out = [(res[rid].prompt[:res[rid].orig_prompt_len],
+                np.asarray(res[rid].out, np.int32))
+               for rid in sorted(res)]
+        eng.reset_results()
+        return out
+
+    eng_f = build_engine(net, slots, ps, pages_per_slot)
+    eng_q = build_engine(net, slots, ps, pages_per_slot, kv_dtype=kv)
+    warm = make_trace(max(2, slots), plens, max_new, 1e9, seed=1)
+    for eng in (eng_f, eng_q):
+        run_engine(eng, [(0.0, p, m) for _, p, m in warm])
+        eng.pool.drop_prefix_cache()
+        eng.reset_results()
+
+    profiler.enable()
+    outs_f = outputs(eng_f)
+    outs_q = outputs(eng_q)
+    tot = mat = 0
+    nll_f, nll_q = [], []
+    for (pf, cf), (pq, cq) in zip(outs_f, outs_q):
+        assert np.array_equal(pf, pq)
+        for x, y in zip(cf, cq):
+            tot += 1
+            mat += int(x == y)
+        nll_f.append(_continuation_nll(net, pf, cf))
+        nll_q.append(_continuation_nll(net, pq, cq))
+    ppl_f = float(np.exp(np.mean(np.concatenate(nll_f))))
+    ppl_q = float(np.exp(np.mean(np.concatenate(nll_q))))
+    quality = {
+        "kv_dtype": kv, "requests": len(outs_f),
+        "total_tokens": tot, "matched_tokens": mat,
+        "token_match_rate": round(mat / max(tot, 1), 4),
+        "ppl_f32": round(ppl_f, 4), "ppl_kv": round(ppl_q, 4),
+        "ppl_delta": round(ppl_q - ppl_f, 4),
+        "note": ("token_match_rate is positional equality of the two "
+                 "greedy streams (one flip cascades — it lower-bounds "
+                 "per-step agreement); ppl_* is the dense f32 model's "
+                 "perplexity of each engine's own emitted "
+                 "continuations on the same prompts"),
+    }
+
+    # ---- residency cell: matched pool bytes, 2x slots under int8 ----
+    # f32 pool with `slots` fully-resident slots sets the byte budget;
+    # the quantized pool fits 2x the slots (scales included) in less.
+    res_f = build_engine(net, slots, ps, pages_per_slot)
+    res_q = build_engine(net, 2 * slots, ps, pages_per_slot,
+                         kv_dtype=kv)
+    bytes_f, bytes_q = _pool_bytes(res_f), _pool_bytes(res_q)
+    res_trace = make_trace(2 * n_req, plens, max_new, args.rate,
+                           seed=13)
+    for eng in (res_f, res_q):
+        run_engine(eng, [(0.0, p, m) for _, p, m in warm])
+        eng.pool.drop_prefix_cache()
+        eng.reset_results()
+    tok_f, wall_f, _, occ_f, _ = run_engine(res_f, res_trace)
+    tok_q, wall_q, _, occ_q, _ = run_engine(res_q, res_trace)
+    residency = {
+        "f32_slots": slots, "kv_slots": 2 * slots,
+        "f32_pool_bytes": bytes_f, "kv_pool_bytes": bytes_q,
+        "pool_bytes_ratio": round(bytes_q / bytes_f, 4),
+        "slots_ratio": 2.0,
+        "f32_tokens_per_sec": round(tok_f / wall_f, 2),
+        "kv_tokens_per_sec": round(tok_q / wall_q, 2),
+        "f32_resident_mean": round(float(np.mean(occ_f)), 2),
+        "kv_resident_mean": round(float(np.mean(occ_q)), 2),
+    }
+
+    lat_stats = profiler.request_latency_stats()
+    lat_rows = profiler.latency_table()
+    inventory = eng_q.record_program_stats()
+    summ = profiler.disable()
+    snap = {k: v.get("value", v.get("count"))
+            for k, v in summ["metrics"].items()
+            if k.startswith("serving/")}
+    return {
+        "metric": "serving_kv_quant_residency",
+        # 2x slots, discounted if the quantized pool overshot the f32
+        # byte budget (it never does: int8+scales is ~half the bytes
+        # at double the slots)
+        "value": round(2.0 * min(1.0, bytes_f / bytes_q), 4),
+        "unit": f"x resident slots at matched pool bytes "
+                f"({kv} vs f32 KV pages)",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "model": {"hidden": cfg.hidden_size,
+                      "layers": cfg.num_layers,
+                      "vocab": cfg.vocab_size,
+                      "initializer_range": cfg.initializer_range},
+            "kv_dtype": kv, "page_size": ps,
+            "pages_per_slot": pages_per_slot,
+            "requests": n_req, "max_new": max_new,
+            "prompt_lens": list(plens),
+            "residency": residency,
+            "kv_quality_proxy": quality,
+            "request_latency": lat_stats,
+            "latency_table": lat_rows,
+            "registry": summ["metrics"],
+            "xla_programs": inventory,
+            "events_overhead_pct": None,
+            "profiler": snap,
+            "note": ("residency cell: the quantized pool carries 2x "
+                     "the resident slots in pool_bytes_ratio of the "
+                     "f32 bytes (int8 values + f32 per-page per-head "
+                     "scales; the byte headroom is ~4x, the cell "
+                     "claims the ISSUE's 2x with room to spare) on a "
+                     "2x-concurrency Poisson workload. quality cell: "
+                     "standard-init (0.02) untrained model — see the "
+                     "mode docstring for why 0.2-init untrained "
+                     "attention is a chaotic-regime measurement, not "
+                     "a quantizer one. Quantize-on-write pays a "
+                     "page-granular read-modify-write per token per "
+                     "layer (rescale-on-growth), so CPU tokens/s "
+                     "under int8 reads below f32 — the win this "
+                     "change buys is HBM residency, which CPU wall "
+                     "clock does not price"),
+        },
+    }
 
 
 def build_early_exit_draft(net, layers):
@@ -801,6 +991,14 @@ def main():
                     choices=["ragged-xla", "ragged-pallas", "legacy"],
                     help="engine attention/dispatch path for the "
                          "single-workload modes")
+    ap.add_argument("--kv-dtype", default="f32",
+                    choices=["f32", "bf16", "int8"],
+                    help="page-pool storage dtype. 'f32' runs the "
+                         "normal modes; 'bf16'/'int8' switch to the "
+                         "KV-quantization comparison (residency at "
+                         "matched pool bytes + greedy token-match / "
+                         "perplexity quality proxy vs the f32 engine, "
+                         "ISSUE 12)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=48)
@@ -830,6 +1028,12 @@ def main():
     if args.trace_window and (args.kernel_matrix or args.spec_decode):
         ap.error("--trace-window rides the Poisson or --prefix-cache "
                  "modes (the matrix/spec cells stay lean)")
+    if args.kv_dtype != "f32" and (args.kernel_matrix or
+                                   args.spec_decode or
+                                   args.prefix_cache or
+                                   args.trace_window):
+        ap.error("--kv-dtype bf16/int8 is its own comparison mode "
+                 "(residency + quality proxy vs the f32 engine)")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -841,7 +1045,9 @@ def main():
 
         profiler.enable_sink(args.sink_dir, interval_s=5.0)
 
-    if args.kernel_matrix:
+    if args.kv_dtype != "f32":
+        out = bench_kv_quant(args, args.tiny)
+    elif args.kernel_matrix:
         out = bench_kernel_matrix(args, args.tiny)
     elif args.spec_decode:
         out = bench_spec(args, args.tiny)

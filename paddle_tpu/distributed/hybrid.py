@@ -73,7 +73,9 @@ class HybridPipelineTrainer:
                  update_scan: bool = False,
                  unroll_layers: Optional[bool] = None,
                  free_eager: bool = False,
-                 guard_bad_steps: bool = False):
+                 guard_bad_steps: bool = False,
+                 dp_grad_comm: str = "f32",
+                 dp_grad_block: int = 2048):
         """Memory knobs for billion-param single/few-chip configs
         (reference analogue: RecomputeConfig offload + ShardingConfig,
         distributed_strategy.proto:25-35):
@@ -268,6 +270,23 @@ class HybridPipelineTrainer:
         else:
             self._host_kind, self._dev_kind = "pinned_host", "device"
         self.unroll_layers = unroll_layers
+        # quantized DP-gradient sync (distributed/qcomm.py, ROADMAP 3b):
+        # same semantics and constraints as the strategy compiler's knob
+        # — per-shard local grads inside an all-manual shard_map over
+        # 'dp', reduced by the EQuARX-style compressed ring. Pure-DP
+        # only: the pipeline/tp/sp manual regions and ZeRO's grad
+        # sharding don't compose with the wrap yet (residue).
+        from .qcomm import validate_dp_grad_comm
+
+        validate_dp_grad_comm(
+            dp_grad_comm, self.mesh, zero_stage=self.zero,
+            block=int(dp_grad_block),
+            unsupported=(("offload_params (the host-streamed update "
+                          "builders bypass the shard_map grad wrap)",
+                          offload_params),
+                         ("stream_layers", stream_layers)))
+        self.dp_grad_comm = dp_grad_comm
+        self.dp_grad_block = int(dp_grad_block)
 
         self._param_ns = lambda sp: NamedSharding(
             self.mesh, sp, memory_kind=self._host_kind) \
@@ -857,6 +876,8 @@ class HybridPipelineTrainer:
                 *core_upd(p, g, s_dev, lr, step_no, plr, wd, p.dtype, s))
 
         guard = self.guard_bad_steps
+        qcomm_dp = self.mesh.shape.get("dp", 1) \
+            if self.dp_grad_comm == "int8" else 1
 
         def step_fn(block_params, other_params, block_opt, other_opt,
                     batch, lr, step_no, key, *guard_args):
@@ -880,16 +901,41 @@ class HybridPipelineTrainer:
             else:
                 bp_c, op_c = block_params, other_params
 
-            def loss_of(bp, op):
-                l = self._forward_loss(bp, op, batch, key)
-                # fault is 1.0 in normal operation (exact IEEE noop);
-                # the chaos harness sets it to NaN for one step, which
-                # poisons the loss AND (through the cotangent) every
-                # gradient leaf — the guard below must catch all of it
-                return l * fault if guard else l
+            def grads_of(bp, op, batch_, key_, fault_):
+                def loss_of(bp_, op_):
+                    l = self._forward_loss(bp_, op_, batch_, key_)
+                    # fault is 1.0 in normal operation (exact IEEE
+                    # noop); the chaos harness sets it to NaN for one
+                    # step, which poisons the loss AND (through the
+                    # cotangent) every gradient leaf — the guard below
+                    # must catch all of it
+                    return l * fault_ if guard else l
 
-            loss, (g_blk, g_oth) = jax.value_and_grad(
-                loss_of, argnums=(0, 1))(bp_c, op_c)
+                return jax.value_and_grad(loss_of, argnums=(0, 1))(bp, op)
+
+            if qcomm_dp > 1:
+                # quantized DP-grad sync: per-shard local grads inside
+                # the ONE shared all-manual shard_map wrap (qcomm.py),
+                # reduced by the EQuARX-style compressed ring. pmean of
+                # the per-shard mean losses == the global mean loss;
+                # the quantized ring replaces the grads' pmean — the
+                # only numeric difference vs the GSPMD path.
+                from . import qcomm as _qcomm
+
+                def local(rep, key_, batch_):
+                    bp, op, ft = rep
+                    loss, grads = grads_of(bp, op, batch_, key_, ft)
+                    return loss, (), grads
+
+                ft = fault if guard else jnp.float32(1.0)
+                loss, _, (g_blk, g_oth) = \
+                    _qcomm.dp_quantized_value_and_grads(
+                        mesh, qcomm_dp, self.dp_grad_block, local,
+                        (bp_c, op_c, ft), batch,
+                        _qcomm.dp_batch_specs(batch, qcomm_dp), key)
+            else:
+                loss, (g_blk, g_oth) = grads_of(bp_c, op_c, batch, key,
+                                                fault)
             g_blk, g_oth = functional_clip(clip, (g_blk, g_oth))
 
             ok = None

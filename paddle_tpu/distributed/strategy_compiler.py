@@ -194,7 +194,8 @@ class HybridParallelTrainer:
     def __init__(self, layer, optimizer, strategy: Optional[
             DistributedStrategy] = None, mesh: Optional[Mesh] = None,
             loss_fn=None, data_spec: Optional[Tuple] = None,
-            donate: bool = True, accumulate_steps: int = 1):
+            donate: bool = True, accumulate_steps: int = 1,
+            dp_grad_comm: str = "f32", dp_grad_block: int = 2048):
         self.layer = layer
         self.optimizer = optimizer
         # gradient merge (reference: fleet gradient_merge meta-optimizer /
@@ -214,6 +215,20 @@ class HybridParallelTrainer:
             self.strategy.sharding else 0
         self.zero_stage = zero
         self.amp = self.strategy.amp
+        # quantized DP-gradient sync (distributed/qcomm.py, ROADMAP 3b):
+        # "int8" computes per-shard local gradients inside an all-manual
+        # shard_map and reduces them through the EQuARX-style compressed
+        # ring (blockwise int8 transport, f32 accumulation) instead of
+        # GSPMD's implicit f32 AllReduce. Pure-DP only: every non-dp
+        # mesh axis must be 1 and ZeRO off (the quantized
+        # reduce-scatter would compose with ZeRO's grad sharding, but
+        # that wiring is ROADMAP residue).
+        from .qcomm import validate_dp_grad_comm
+
+        validate_dp_grad_comm(dp_grad_comm, self.mesh, zero_stage=zero,
+                              block=int(dp_grad_block))
+        self.dp_grad_comm = dp_grad_comm
+        self.dp_grad_block = int(dp_grad_block)
 
         pn, pt, bn, bt = state_tensors(layer)
         self.param_names, self._param_tensors = pn, pt
@@ -310,16 +325,21 @@ class HybridParallelTrainer:
 
         k_acc = self.accumulate_steps
 
-        def step_fn(params, opt_states, buffers, batch, lr, step_no, key):
-            # trace-time side effect: reports every (re)trace of this
-            # program with the triggering batch shapes (profiler.recompile)
-            _precomp.mark_trace(self._prof_site, batch)
+        def local_loss_grads(params, buffers, batch, key):
+            """Loss + gradients over (this shard of) ``batch`` — the
+            whole logical batch on the GSPMD path, the device-local
+            shard inside the dp_grad_comm='int8' shard_map."""
             if k_acc > 1:
                 for b in jax.tree_util.tree_leaves(batch):
                     if b.shape[0] % k_acc:
                         raise ValueError(
                             f"gradient merge: batch size {b.shape[0]} is "
-                            f"not divisible by accumulate_steps={k_acc}")
+                            f"not divisible by accumulate_steps={k_acc}"
+                            + (" — the PER-SHARD batch: "
+                               "dp_grad_comm='int8' scans micro-batches "
+                               "inside each dp shard, so the global "
+                               "batch must divide dp × accumulate_steps"
+                               if qcomm_dp > 1 else ""))
                 micros = jax.tree_util.tree_map(
                     lambda b: b.reshape((k_acc, b.shape[0] // k_acc)
                                         + b.shape[1:]), batch)
@@ -351,6 +371,47 @@ class HybridParallelTrainer:
 
                 (loss, new_buf), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params)
+            return loss, new_buf, grads
+
+        qcomm_dp = self.mesh.shape.get("dp", 1) \
+            if self.dp_grad_comm == "int8" else 1
+        qcomm_block = self.dp_grad_block
+
+        def step_fn(params, opt_states, buffers, batch, lr, step_no, key):
+            # trace-time side effect: reports every (re)trace of this
+            # program with the triggering batch shapes (profiler.recompile)
+            _precomp.mark_trace(self._prof_site, batch)
+            if qcomm_dp > 1:
+                # quantized DP-grad sync: per-shard local grads inside
+                # the ONE shared all-manual shard_map wrap (qcomm.py),
+                # reduced by the EQuARX-style compressed ring. The
+                # local loss is the mean over the shard, so
+                # pmean(loss) == the global mean loss and pmean(local
+                # grads) == its gradient — the quantized ring replaces
+                # that pmean, which is the ONLY numeric difference vs
+                # the GSPMD path. An explicit data_spec is
+                # authoritative (a leaf the user replicated must NOT
+                # be split just because its dim 0 happens to divide
+                # dp — under the manual wrap that would hand each
+                # shard a slice of a non-batch array); the lead-dim
+                # heuristic covers the no-spec default.
+                from . import qcomm as _qcomm
+
+                def local(rep, key_, batch_):
+                    params_, buffers_ = rep
+                    return local_loss_grads(params_, buffers_, batch_,
+                                            key_)
+
+                bspecs = tuple(self.data_spec) \
+                    if self.data_spec is not None \
+                    else _qcomm.dp_batch_specs(batch, qcomm_dp)
+                loss, new_buf, grads = \
+                    _qcomm.dp_quantized_value_and_grads(
+                        mesh, qcomm_dp, qcomm_block, local,
+                        (params, buffers), batch, bspecs, key)
+            else:
+                loss, new_buf, grads = local_loss_grads(
+                    params, buffers, batch, key)
             grads = functional_clip(clip, grads)
             with _ptrace.annotate("optim"):
                 new_params, new_states = [], []

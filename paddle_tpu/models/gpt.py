@@ -291,7 +291,7 @@ class GPT(nn.Layer):
                  top_p: float = 1.0, temperature: float = 1.0,
                  num_beams: int = 4, length_penalty: float = 0.0,
                  eos_token_id=None, seed: int = 0, paged: bool = False,
-                 page_size: int = 0):
+                 page_size: int = 0, kv_dtype=None):
         """Autoregressive generation with a preallocated KV cache, as one
         jitted program (prefill + lax.scan decode loop).
 
@@ -309,6 +309,12 @@ class GPT(nn.Layer):
         matches); sampling draws from per-request key chains, so paged
         sampling is reproducible but not token-identical to the dense
         shared-batch rng. Beam search has no paged path.
+
+        ``kv_dtype`` (paged only): the page pool's storage dtype —
+        None keeps the model dtype (the bitwise contract above);
+        'bf16' halves and 'int8' quarters cache HBM per token, at
+        which point greedy parity becomes a measured token-match rate
+        (serving docs), not a bitwise guarantee.
         """
         import numpy as _np
 
@@ -333,7 +339,12 @@ class GPT(nn.Layer):
                     "reordering needs per-beam page aliasing (ROADMAP)")
             return self._generate_paged(
                 _np.asarray(ids_v), max_new_tokens, decode_strategy,
-                top_k, top_p, temperature, eos_token_id, seed, page_size)
+                top_k, top_p, temperature, eos_token_id, seed, page_size,
+                kv_dtype)
+        if kv_dtype is not None:
+            raise ValueError("kv_dtype is a paged-cache knob; the dense "
+                             "cache follows the model dtype (use "
+                             "paged=True)")
         stacked, other = self._decode_state()
         cfg = self.config
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
@@ -399,7 +410,7 @@ class GPT(nn.Layer):
 
     def _generate_paged(self, ids_np, max_new_tokens, decode_strategy,
                         top_k, top_p, temperature, eos_token_id, seed,
-                        page_size):
+                        page_size, kv_dtype=None):
         """generate() surface over the paged serving engine: one slot
         per batch row, slot capacity == the dense path's S_max (the
         wrapper picks the largest page size <= 16 dividing S_max, so
@@ -421,7 +432,7 @@ class GPT(nn.Layer):
                 "needs slot capacity == dense S_max)")
         strategy = "sampling" if decode_strategy == "sampling" else "greedy"
         ekey = (b, t0, max_new_tokens, ps, strategy, top_k, top_p,
-                temperature, eos_token_id)
+                temperature, eos_token_id, kv_dtype)
         if "_paged_engines" not in self.__dict__:
             from ..utils.lru import LRUCache
 
@@ -434,7 +445,8 @@ class GPT(nn.Layer):
                 num_slots=b, page_size=ps, pages_per_slot=smax // ps,
                 prefill_chunk=t0, decode=strategy,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_token_id=eos_token_id, seed=seed))
+                eos_token_id=eos_token_id, seed=seed,
+                kv_dtype=kv_dtype))
             engines[ekey] = eng
         base = _np.asarray(jax.random.PRNGKey(seed))
         rids = [eng.submit(ids_np[i], max_new_tokens,
@@ -574,7 +586,7 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
                      tokens, tok_pos, tok_limit, row_tab, row_pos0,
                      row_len, sample_ix, decode_rows: int,
                      chunk_width: int, impl: str = "xla",
-                     spec_k: int = 0):
+                     spec_k: int = 0, kscale=None, vscale=None):
     """Mixed prefill/decode forward over the PAGED cache: every token
     in flight rides one program. ``tokens`` [NT] is the flat token
     buffer of one serving tick — ``decode_rows`` resident decode
@@ -635,8 +647,20 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
     old suffix-prefill program, token for token, bit for bit; a verify
     position equals the decode row the non-speculative engine would
     have run at that position.
+
+    ``kscale``/``vscale`` [L, P, NH] (ISSUE 12): per-page per-head
+    scales of an int8 pool. When given, every token's KV write routes
+    through ``ops/paged_attention.paged_kv_scatter`` (quantize at the
+    page's running-max scale, re-quantizing resident content when it
+    grows) and the attention gather dequantizes with the same scales —
+    the whole int8 story lives in those two shared helpers, so both
+    attention impls and every delegating spelling inherit it. The
+    return grows to (logits, kpool, vpool, kscale, vscale); numerics
+    are tolerance, not bitwise, vs the unquantized pool (the engine
+    only asserts bitwise between two int8 engines).
     """
-    from ..ops.paged_attention import ragged_paged_attention
+    from ..ops.paged_attention import (paged_kv_scatter,
+                                      ragged_paged_attention)
 
     nt = tokens.shape[0]
     nd = decode_rows
@@ -668,12 +692,18 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
         0)
     off = tok_pos % ps
 
+    quantized = kscale is not None
+
     def block(xc, inp):
-        p, kpl0, vpl0 = inp
+        if quantized:
+            p, kpl0, vpl0, ksl0, vsl0 = inp
+        else:
+            p, kpl0, vpl0 = inp
+            ksl0 = vsl0 = None
 
         def attend(q, kk, vv):
-            kpl = kpl0.at[page, off].set(kk[:, 0])
-            vpl = vpl0.at[page, off].set(vv[:, 0])
+            kpl, ksl = paged_kv_scatter(kpl0, ksl0, page, off, kk[:, 0])
+            vpl, vsl = paged_kv_scatter(vpl0, vsl0, page, off, vv[:, 0])
             outs = []
             if nd and spec_k:
                 # verify grouping [nd, 1 + spec_k]: each slot's last
@@ -684,38 +714,46 @@ def gpt_ragged_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
                     axis=1)
                 ov = ragged_paged_attention(
                     qv, kpl, vpl, row_tab[:nd], row_pos0[:nd],
-                    row_len[:nd], impl=impl)
+                    row_len[:nd], impl=impl, k_scale=ksl, v_scale=vsl)
                 outs.append(ov[:, :1])
                 outs.append(ov[:, 1:].reshape(nd * spec_k, 1, nh, hd))
             elif nd:
                 outs.append(ragged_paged_attention(
                     q[:nd], kpl, vpl, row_tab[:nd], row_pos0[:nd],
-                    row_len[:nd], impl=impl))
+                    row_len[:nd], impl=impl, k_scale=ksl, v_scale=vsl))
             if nch:
                 qp = q[base:, 0].reshape(nch, chunk_width, nh, hd)
                 op = ragged_paged_attention(
                     qp, kpl, vpl, row_tab[nd:], row_pos0[nd:],
-                    row_len[nd:], impl=impl)
+                    row_len[nd:], impl=impl, k_scale=ksl, v_scale=vsl)
                 outs.append(op.reshape(nch * chunk_width, 1, nh, hd))
             o = outs[0] if len(outs) == 1 else \
                 jnp.concatenate(outs, axis=0)
-            return o, (kpl, vpl)
+            return (o, (kpl, vpl, ksl, vsl)) if quantized \
+                else (o, (kpl, vpl))
 
         return gpt_block_body(xc, p, eps, nh, hd, attend)
 
-    x, (kpool, vpool) = jax.lax.scan(block, x, (stacked, kpool, vpool))
+    if quantized:
+        x, (kpool, vpool, kscale, vscale) = jax.lax.scan(
+            block, x, (stacked, kpool, vpool, kscale, vscale))
+    else:
+        x, (kpool, vpool) = jax.lax.scan(block, x,
+                                         (stacked, kpool, vpool))
     x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
     last = x[sample_ix, 0]                              # [S, h]
     if "lm_head.weight" in other:
         logits = last @ other["lm_head.weight"]
     else:
         logits = last @ wte.T
+    if quantized:
+        return logits, kpool, vpool, kscale, vscale
     return logits, kpool, vpool
 
 
 def gpt_paged_suffix_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
                            tokens, pos0, true_len, page_row,
-                           logits_index):
+                           logits_index, kscale=None, vscale=None):
     """Suffix-prefill forward over the PAGED cache: one prompt chunk
     ``tokens`` [1, T] at positions pos0..pos0+T-1 of the slot whose
     page-table row is ``page_row`` [NPs]. Retired into the unified
@@ -735,7 +773,8 @@ def gpt_paged_suffix_apply(cfg: GPTConfig, stacked, other, kpool, vpool,
                             page_row[None],
                             jnp.asarray(pos0, jnp.int32)[None],
                             jnp.full((1,), t, jnp.int32), sample_ix,
-                            decode_rows=0, chunk_width=t)
+                            decode_rows=0, chunk_width=t,
+                            kscale=kscale, vscale=vscale)
 
 
 def _gpt_decode_state(model: "GPT"):

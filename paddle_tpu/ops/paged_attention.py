@@ -50,6 +50,24 @@ spelling is the measured default until the kernel wins on hardware):
 Layout note: pools are ``[num_pages, page_size, NH, D]`` per layer;
 page 0 is the null page (writes of inactive rows land there, gathers
 of unallocated table entries read it and are masked).
+
+Quantized pools (ISSUE 12): with ``kv_dtype="int8"`` the pools store
+int8 values plus per-page **per-head** f32 scales ``[P, NH]`` per
+layer (one outlier head costs one head's precision, not the page's —
+the per-channel idiom of ``ops/int8_matmul.py``). The write side is
+``paged_kv_scatter``: each token's per-head amax scatter-MAXes into
+its page's scale, resident page content is re-quantized when the
+scale grows (``round(q·s_old/s_new)`` — an exact no-op while the
+scale is unchanged, which is the steady state), and the new token is
+quantized at the final scale; the null page's scale contribution is
+masked so it stays 0 forever. The read side dequantizes inside
+``_gather_attend`` — so the XLA spelling, both delegating entry
+points, AND the Pallas kernel (which prefetches the scale rows
+alongside the page table and dequantizes in VMEM before the online
+softmax) all inherit it from the one shared helper. The f32 path is
+bit-for-bit untouched (no cast, no extra ops) — the engine's bitwise
+parity contract only ever applied to unquantized pools, and still
+does.
 """
 from __future__ import annotations
 
@@ -64,7 +82,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ._pallas_compat import CompilerParams as _CompilerParams
 
 __all__ = ["ragged_paged_attention", "paged_decode_attention",
-           "paged_prefill_attention"]
+           "paged_prefill_attention", "paged_kv_scatter"]
 
 _NEG_INF = -1e9     # same masking constant as gpt_cached_apply
 
@@ -75,7 +93,8 @@ def _interpret() -> bool:
     return target_platform() == "cpu"
 
 
-def _gather_attend(q, k_pool, v_pool, page_table, qpos):
+def _gather_attend(q, k_pool, v_pool, page_table, qpos,
+                   k_scale=None, v_scale=None):
     """THE dense paged-attention expression — the single spelling of
     gather + mask + f32 softmax shared by every XLA entry point in this
     module (and, transitively, the spelling ``gpt_cached_apply`` uses
@@ -88,28 +107,57 @@ def _gather_attend(q, k_pool, v_pool, page_table, qpos):
     v_pool      [P, ps, NH, D] per-layer value page pool
     page_table  [R, NPs] int32 page ids per row (0 = null page)
     qpos        [R, T] int32   last attendable cache position per query
+    k_scale     [P, NH] f32    per-page per-head dequant scales (int8
+    v_scale     [P, NH]        pools only; None leaves the math — and
+                               the f32 parity contract — untouched)
 
     Every reduction runs at the full slot capacity ``NPs * ps`` with
     exact-zero weights behind the mask, so results are independent of
     page layout and of whatever garbage sits in unattended positions.
-    Returns [R, T, NH, D].
+    Quantized pools dequantize right after the gather (value ·
+    per-page per-head scale), so everything downstream — contraction
+    order, mask constant, softmax dtype — is the one shared spelling
+    regardless of storage dtype. Returns [R, T, NH, D].
     """
     r = q.shape[0]
     nps, ps = page_table.shape[1], k_pool.shape[1]
     nh, hd = k_pool.shape[2], k_pool.shape[3]
     s_cap = nps * ps
-    k_c = k_pool[page_table].reshape(r, s_cap, nh, hd)
-    v_c = v_pool[page_table].reshape(r, s_cap, nh, hd)
+    k_c = k_pool[page_table]                # [R, NPs, ps, NH, D]
+    v_c = v_pool[page_table]
+    if k_scale is not None:
+        # int8 pools: dequant with the gathered per-page per-head
+        # scales (null pages carry scale 0, so their garbage reads as
+        # exact zeros even before the mask)
+        k_c = k_c.astype(q.dtype) * k_scale[page_table][:, :, None, :,
+                                                        None]
+        v_c = v_c.astype(q.dtype) * v_scale[page_table][:, :, None, :,
+                                                        None]
+    elif k_pool.dtype != q.dtype:
+        # mixed storage/compute dtypes: contract at the WIDER of the
+        # two — upcasting a bf16 pool under an f32 model is free, and
+        # DOWNcasting an f32 pool under a bf16 model would throw away
+        # exactly the precision kv_dtype='f32' paid double the HBM for
+        wide = jnp.promote_types(k_pool.dtype, q.dtype)
+        k_c = k_c.astype(wide)
+        v_c = v_c.astype(wide)
+    k_c = k_c.reshape(r, s_cap, nh, hd)
+    v_c = v_c.reshape(r, s_cap, nh, hd)
     key_pos = jnp.arange(s_cap)
     mask = key_pos[None, None, None, :] <= qpos[:, None, :, None]
     att = jnp.einsum("btnd,bsnd->bnts", q, k_c) / math.sqrt(hd)
     att = jnp.where(mask, att, _NEG_INF)
     w = jax.nn.softmax(att.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bnts,bsnd->btnd", w, v_c)
+    out = jnp.einsum("bnts,bsnd->btnd", w, v_c)
+    # mixed-dtype contraction may promote; hand back the query dtype
+    # (identity — same array object — on the homogeneous f32 path, so
+    # the bitwise parity contract is untouched)
+    return out if out.dtype == q.dtype else out.astype(q.dtype)
 
 
 def ragged_paged_attention(q, k_pool, v_pool, page_table, pos0, true_len,
-                           impl: str = "xla"):
+                           impl: str = "xla", k_scale=None,
+                           v_scale=None):
     """One attention call over ragged rows of the page pool.
 
     q           [R, T, NH, D]  per-row query blocks (T static)
@@ -118,6 +166,8 @@ def ragged_paged_attention(q, k_pool, v_pool, page_table, pos0, true_len,
     page_table  [R, NPs] int32 page ids per row (0 = null page)
     pos0        [R] int32      absolute position of each row's query 0
     true_len    [R] int32      real queries in the row (1 = decode row)
+    k_scale     [P, NH] f32    dequant scales for int8 pools (both
+    v_scale     [P, NH]        impls; None = unquantized pools)
 
     Query ``i`` of row ``r`` attends cache positions
     ``<= pos0[r] + i``. Rows are fixed-shape: queries at
@@ -129,15 +179,18 @@ def ragged_paged_attention(q, k_pool, v_pool, page_table, pos0, true_len,
     if impl == "xla":
         t = q.shape[1]
         qpos = pos0[:, None] + jnp.arange(t, dtype=pos0.dtype)[None, :]
-        return _gather_attend(q, k_pool, v_pool, page_table, qpos)
+        return _gather_attend(q, k_pool, v_pool, page_table, qpos,
+                              k_scale=k_scale, v_scale=v_scale)
     if impl == "pallas":
         return _ragged_attention_pallas(q, k_pool, v_pool, page_table,
-                                        pos0, true_len)
+                                        pos0, true_len,
+                                        k_scale=k_scale, v_scale=v_scale)
     raise ValueError(f"unknown paged attention impl {impl!r}")
 
 
 def paged_decode_attention(q, k_pool, v_pool, page_table, attend_pos,
-                           impl: str = "xla"):
+                           impl: str = "xla", k_scale=None,
+                           v_scale=None):
     """One decode step of attention over paged KV: a ragged call where
     every row is a single query at its slot's write position.
 
@@ -154,10 +207,12 @@ def paged_decode_attention(q, k_pool, v_pool, page_table, attend_pos,
         raise ValueError(f"unknown paged attention impl {impl!r}")
     ones = jnp.ones_like(attend_pos)
     return ragged_paged_attention(q, k_pool, v_pool, page_table,
-                                  attend_pos, ones, impl=impl)
+                                  attend_pos, ones, impl=impl,
+                                  k_scale=k_scale, v_scale=v_scale)
 
 
-def paged_prefill_attention(q, k_pool, v_pool, page_table, pos0):
+def paged_prefill_attention(q, k_pool, v_pool, page_table, pos0,
+                            k_scale=None, v_scale=None):
     """Suffix-prefill (chunked) attention over paged KV: a ragged call
     where each batch row is a T-query chunk starting at the shared
     scalar position ``pos0`` (query i attends positions <= pos0 + i).
@@ -168,15 +223,76 @@ def paged_prefill_attention(q, k_pool, v_pool, page_table, pos0):
     row_pos0 = jnp.broadcast_to(jnp.asarray(pos0, jnp.int32), (b,))
     return ragged_paged_attention(q, k_pool, v_pool, page_table,
                                   row_pos0,
-                                  jnp.full((b,), t, jnp.int32))
+                                  jnp.full((b,), t, jnp.int32),
+                                  k_scale=k_scale, v_scale=v_scale)
+
+
+def paged_kv_scatter(pool, scale, page, off, vals):
+    """Write one tick's per-token KV into the page pool — the single
+    write-side spelling shared by the unified tick, the spec verify
+    tick and the legacy suffix-prefill program (via
+    ``gpt_ragged_apply``).
+
+    pool   [P, ps, NH, D]  per-layer page pool (f32/bf16/int8)
+    scale  [P, NH] f32     per-page per-head scales (int8 pools; None
+                           otherwise)
+    page   [NT] int32      target page per token (0 = null page)
+    off    [NT] int32      offset within the page
+    vals   [NT, NH, D]     the token KV (model dtype)
+
+    Unquantized pools: one scatter (cast to the pool dtype). int8
+    pools quantize-on-write with RUNNING per-page scales:
+
+    1. each token's per-head ``amax/127`` scatter-maxes into its
+       page's scale row (null-page contributions masked to 0, so the
+       null page's scale stays 0 — its garbage dequantizes to exact
+       zeros);
+    2. pages whose scale grew have their resident int8 content
+       re-quantized ``round(q · s_old/s_new)`` — an exact no-op
+       (``round(q·1) == q``) whenever the scale is unchanged, which is
+       every steady-state decode write; a freshly-reset page
+       (``s_old == 0``) is zeroed, which also sanitizes recycled-page
+       garbage;
+    3. the token is quantized at the final scale (``|q| <= 127`` by
+       construction: the page scale is >= the token's own amax/127).
+
+    The rescale pass gathers + rewrites one page per token per layer —
+    the documented write-amplification cost of keeping ONE scale per
+    page (bounded by ``page_size`` rows per token; decode ticks touch
+    one page per slot). Duplicate page targets (a prefill chunk
+    landing several tokens in one page) are safe: every duplicate
+    computes the same rescaled page from the same pre-write content,
+    and the offset writes are disjoint.
+
+    Returns (pool, scale) — scale is None when it came in None.
+    """
+    if scale is None:
+        vals = vals if vals.dtype == pool.dtype \
+            else vals.astype(pool.dtype)
+        return pool.at[page, off].set(vals), None
+    a = jnp.max(jnp.abs(vals.astype(jnp.float32)), axis=-1) / 127.0
+    a = jnp.where((page > 0)[:, None], a, 0.0)          # [NT, NH]
+    s_old = scale[page]                                 # [NT, NH]
+    scale = scale.at[page].max(a)
+    s_new = scale[page]
+    ratio = jnp.where(s_new > 0.0,
+                      s_old / jnp.maximum(s_new, 1e-30), 0.0)
+    pg = pool[page].astype(jnp.float32)                 # [NT, ps, NH, D]
+    pg = jnp.round(pg * ratio[:, None, :, None])
+    pool = pool.at[page].set(pg.astype(jnp.int8))
+    q = jnp.round(vals.astype(jnp.float32)
+                  / jnp.maximum(s_new, 1e-30)[:, :, None])
+    q = jnp.clip(q, -127.0, 127.0)
+    pool = pool.at[page, off].set(q.astype(jnp.int8))
+    return pool, scale
 
 
 # --------------------------------------------------------------------------
 # Pallas ragged kernel
 # --------------------------------------------------------------------------
 
-def _ragged_kernel(pt_ref, pos0_ref, tl_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, page_size: int, n_pages: int):
+def _ragged_kernel(pt_ref, pos0_ref, tl_ref, q_ref, k_ref, v_ref, *rest,
+                   page_size: int, n_pages: int):
     """Grid (r, j): row r consumes its j-th page. Page table, pos0 and
     true_len are scalar-prefetched, so the BlockSpec index map DMAs
     page ``pt[r, j]`` straight from the pool — the gathered
@@ -185,7 +301,15 @@ def _ragged_kernel(pt_ref, pos0_ref, tl_ref, q_ref, k_ref, v_ref, o_ref,
     in the page is attendable by any real query of the row) to the
     null page with their compute predicated off. Running max /
     denominator / accumulator live in VMEM scratch across the page
-    axis (online softmax)."""
+    axis (online softmax). Quantized pools add two inputs — the
+    per-page per-head scale rows, DMA'd by the SAME index map as the
+    page itself — and dequantize in VMEM right after the (int8) page
+    loads, before anything touches the MXU."""
+    if len(rest) == 6:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+        ks_ref = vs_ref = None
     r = pl.program_id(0)
     j = pl.program_id(1)
 
@@ -202,6 +326,10 @@ def _ragged_kernel(pt_ref, pos0_ref, tl_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)                # [T, NH, D]
         k = k_ref[0].astype(jnp.float32)                # [ps, NH, D]
         v = v_ref[0].astype(jnp.float32)
+        if ks_ref is not None:
+            # in-VMEM dequant: page values × this page's [NH] scales
+            k = k * ks_ref[0][None, :, None]
+            v = v * vs_ref[0][None, :, None]
         hd = q.shape[-1]
         # s[n, t, p] = q[t, n] · k[p, n] / sqrt(D)
         s = jax.lax.dot_general(
@@ -236,7 +364,7 @@ def _ragged_kernel(pt_ref, pos0_ref, tl_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _ragged_attention_pallas(q, k_pool, v_pool, page_table, pos0,
-                             true_len):
+                             true_len, k_scale=None, v_scale=None):
     r, t, nh, hd = q.shape
     ps = k_pool.shape[1]
     nps = page_table.shape[1]
@@ -247,15 +375,26 @@ def _ragged_attention_pallas(q, k_pool, v_pool, page_table, pos0,
         return (jnp.where(j * ps <= p0[i] + tl[i] - 1, pt[i, j], 0),
                 0, 0, 0)
 
+    def _scale_index(i, j, pt, p0, tl):
+        # the scale row rides the same page choice as the page itself
+        return (jnp.where(j * ps <= p0[i] + tl[i] - 1, pt[i, j], 0), 0)
+
+    in_specs = [
+        pl.BlockSpec((1, t, nh, hd),
+                     lambda i, j, pt, p0, tl: (i, 0, 0, 0)),
+        pl.BlockSpec((1, ps, nh, hd), _kv_index),
+        pl.BlockSpec((1, ps, nh, hd), _kv_index),
+    ]
+    args = (page_table, pos0, true_len, q, k_pool, v_pool)
+    if k_scale is not None:
+        in_specs += [pl.BlockSpec((1, nh), _scale_index),
+                     pl.BlockSpec((1, nh), _scale_index)]
+        args += (k_scale, v_scale)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(r, nps),
-        in_specs=[
-            pl.BlockSpec((1, t, nh, hd),
-                         lambda i, j, pt, p0, tl: (i, 0, 0, 0)),
-            pl.BlockSpec((1, ps, nh, hd), _kv_index),
-            pl.BlockSpec((1, ps, nh, hd), _kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, t, nh, hd),
                                lambda i, j, pt, p0, tl: (i, 0, 0, 0)),
         scratch_shapes=[
@@ -271,4 +410,4 @@ def _ragged_attention_pallas(q, k_pool, v_pool, page_table, pos0,
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(page_table, pos0, true_len, q, k_pool, v_pool)
+    )(*args)

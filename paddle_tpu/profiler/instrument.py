@@ -44,7 +44,19 @@ _DTYPE_BYTES = {
     "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
     "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
     "i8": 1, "ui8": 1, "i1": 1, "pred": 1,
+    # compiled-HLO spellings (`compiled.as_text()` prints s8/u32/...;
+    # StableHLO prints i8/ui32/...). Without these an int8 collective's
+    # payload (quantized AllReduce, qcomm.py) would fall through to the
+    # 4-byte default and be counted as if it were still f32.
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1,
 }
+
+#: canonical spelling per dtype family, so byte breakdowns key the same
+#: whether parsed from StableHLO (i8) or compiled HLO (s8)
+_DTYPE_CANON = {"s8": "i8", "u8": "ui8", "s16": "i16", "u16": "ui16",
+                "s32": "i32", "u32": "ui32", "s64": "i64", "u64": "ui64",
+                "pred": "i1"}
 
 
 def _tensor_bytes(dims: str, dtype: str) -> int:
@@ -67,10 +79,22 @@ def collective_stats(lowered_text: str) -> dict:
     (jit + shardings, no shard_map) keeps its collectives implicit until
     XLA's SPMD partitioner runs, so its StableHLO reports 0 — pass the
     COMPILED text to count those. Returns
-    {"ops": {op_name: count}, "bytes": {op_name: bytes}, "total_bytes"}.
+    {"ops": {op_name: count}, "bytes": {op_name: bytes},
+    "bytes_by_dtype": {canonical_dtype: bytes}, "total_bytes"} — the
+    per-dtype split is what makes a quantized-collective experiment
+    (distributed/qcomm.py) readable straight off the gauges instead of
+    derived from op-level deltas.
     """
     ops: dict = {}
     byts: dict = {}
+    by_dtype: dict = {}
+
+    def _acc(op: str, dims: str, dtype: str) -> None:
+        b = _tensor_bytes(dims, dtype)
+        byts[op] = byts.get(op, 0) + b
+        canon = _DTYPE_CANON.get(dtype, dtype)
+        by_dtype[canon] = by_dtype.get(canon, 0) + b
+
     lines = lowered_text.splitlines()
     i = 0
     while i < len(lines):
@@ -81,9 +105,8 @@ def collective_stats(lowered_text: str) -> dict:
             if hm:
                 op = hm.group(2).replace("-", "_")
                 ops[op] = ops.get(op, 0) + 1
-                byts[op] = byts.get(op, 0) + sum(
-                    _tensor_bytes(dims.replace(",", "x"), dt)
-                    for dt, dims in _HLO_TYPE_RE.findall(hm.group(1)))
+                for dt, dims in _HLO_TYPE_RE.findall(hm.group(1)):
+                    _acc(op, dims.replace(",", "x"), dt)
             i += 1
             continue
         op = m.group(1)
@@ -107,27 +130,38 @@ def collective_stats(lowered_text: str) -> dict:
         if tensors:
             # after `->`: the result type(s); variadic collectives print
             # a tuple `(tensor<..>, tensor<..>)` — sum every buffer
-            byts[op] = byts.get(op, 0) + sum(
-                _tensor_bytes(d, t) for d, t in tensors)
+            for d, t in tensors:
+                _acc(op, d, t)
         else:
             # compact printer form has no arrow (`... applies stablehlo.add
             # : tensor<..>`): last tensor type on the line is the result
             tensors = _TENSOR_RE.findall(type_line)
             if tensors:
                 dims, dt = tensors[-1]
-                byts[op] = byts.get(op, 0) + _tensor_bytes(dims, dt)
+                _acc(op, dims, dt)
         i += 1
-    return {"ops": ops, "bytes": byts,
+    return {"ops": ops, "bytes": byts, "bytes_by_dtype": by_dtype,
             "total_bytes": sum(byts.values())}
 
 
 def record_collective_stats(lowered_text: str, prefix: str = "comm") -> dict:
-    """collective_stats + fold the totals into the metrics registry."""
+    """collective_stats + fold the totals into the metrics registry.
+
+    Besides the blended total, the per-dtype gauges
+    ``{prefix}/collective_bytes_int8`` / ``_f32`` make the "collective
+    bytes halved" claim of a quantized-AllReduce config (qcomm.py)
+    readable straight off the gauge: int8 counts the i8/ui8 payloads,
+    f32 the f32 ones (block scales included — they ARE f32 wire
+    bytes)."""
     st = collective_stats(lowered_text)
     reg = registry()
     reg.gauge(f"{prefix}/collective_bytes_per_step").set(st["total_bytes"])
     reg.gauge(f"{prefix}/collective_ops_per_step").set(
         sum(st["ops"].values()))
+    bd = st["bytes_by_dtype"]
+    reg.gauge(f"{prefix}/collective_bytes_int8").set(
+        bd.get("i8", 0) + bd.get("ui8", 0))
+    reg.gauge(f"{prefix}/collective_bytes_f32").set(bd.get("f32", 0))
     return st
 
 

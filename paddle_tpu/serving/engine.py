@@ -54,6 +54,21 @@ fill:
   as a longer prompt (and its pages stay cached, so re-prefill is a
   prefix hit). Sampling keys are folded per absolute position, so a
   preempted request's tokens do not depend on scheduling.
+- **Quantized KV pages** (``ServingConfig.kv_dtype``; ISSUE 12):
+  ``"int8"`` stores the pools as int8 with per-page per-head f32
+  scales — 4x tokens per pool byte (2x resident slots at matched
+  bytes with headroom to spare). Quantize-on-write rides INSIDE the
+  one tick (``ops/paged_attention.paged_kv_scatter``: running
+  scatter-max scales, rescale-on-growth, recycled pages reset via the
+  fresh-page vector folded into the tick args), dequantization rides
+  inside the one shared attention gather, and scales travel every
+  refcount edge (COW copies the donor's scales; the null page keeps
+  scale 0). ``compiled_sites`` is unchanged — int8 is a dtype of the
+  one mixed-row tick, not a new dispatch site. Greedy parity vs the
+  f32 engine becomes a measured token-match rate (``serve_bench
+  --kv-dtype``); two int8 engines still agree bitwise. ``"bf16"``
+  halves the pool with a plain cast; legacy mode keeps the model
+  dtype.
 - **Speculative decoding** (``ServingConfig.spec``; serving/spec.py):
   a draft model runs ``k`` tokens ahead per slot, ONE verify/mixed
   tick scores every slot's ``(1+k)``-token row (a verify row is a
@@ -178,6 +193,14 @@ class ServingConfig:
     prefix_cache: bool = True        # share prompt-prefix pages
     max_inflight: int = 2            # unmaterialized decode ticks in flight
     decode: str = "greedy"           # 'greedy' | 'sampling'
+    #: page-pool storage dtype (ISSUE 12): None keeps the model dtype
+    #: (the bitwise-parity default), 'f32'/'bf16' store at that dtype,
+    #: 'int8' quantizes pages on write with per-page per-head scales —
+    #: 4x tokens per pool byte vs f32, greedy parity becomes a measured
+    #: token-match rate (serve_bench --kv-dtype) instead of bitwise.
+    #: Unified tick + both ragged kernels only (legacy is the
+    #: pre-unification bench baseline and stays at the model dtype).
+    kv_dtype: Optional[str] = None   # None | 'f32' | 'bf16' | 'int8'
     temperature: float = 1.0         # sampling defaults; per-request
     top_k: int = 0                   #   overrides ride submit()
     top_p: float = 1.0
@@ -228,6 +251,17 @@ def _copy_pages(kpool, vpool, src, dst):
     layers (one compiled program, pools donated)."""
     return (kpool.at[:, dst].set(kpool[:, src]),
             vpool.at[:, dst].set(vpool[:, src]))
+
+
+def _copy_pages_q(kpool, vpool, kscale, vscale, src, dst):
+    """COW for quantized pools: the donor page's per-head scales travel
+    with its content (dequantizing the copied int8 values needs the
+    SAME scales; the engine un-lists ``dst`` from the fresh-page reset
+    so the next tick cannot zero them)."""
+    return (kpool.at[:, dst].set(kpool[:, src]),
+            vpool.at[:, dst].set(vpool[:, src]),
+            kscale.at[:, dst].set(kscale[:, src]),
+            vscale.at[:, dst].set(vscale[:, src]))
 
 
 class ServingEngine:
@@ -289,6 +323,20 @@ class ServingEngine:
         self.model_config = mcfg
         self._stacked, self._other = model._decode_state()
         self._dtype = self._other["embeddings.wte.weight"].dtype
+        # page-pool storage dtype (ISSUE 12): None follows the model
+        kv_map = {None: self._dtype, "f32": jnp.float32,
+                  "bf16": jnp.bfloat16, "int8": jnp.int8}
+        if cfg.kv_dtype not in kv_map:
+            raise ValueError(
+                f"unknown kv_dtype {cfg.kv_dtype!r}; expected one of "
+                "None (model dtype), 'f32', 'bf16', 'int8'")
+        kv_jnp = jnp.dtype(kv_map[cfg.kv_dtype])
+        if self._legacy and kv_jnp != jnp.dtype(self._dtype):
+            raise ValueError(
+                f"kv_dtype={cfg.kv_dtype!r} needs the unified tick; "
+                "attention_kernel='legacy' is the pre-unification "
+                "bench baseline and keeps the model-dtype pool")
+        self._quantized = kv_jnp == jnp.dtype(jnp.int8)
         nh = mcfg.num_heads
         hd = mcfg.hidden_size // nh
         ps = cfg.page_size
@@ -296,7 +344,7 @@ class ServingEngine:
         num_pages = cfg.num_pages or cfg.num_slots * pages_per_slot + 1
         self.pool = PagePool(mcfg.num_layers, num_pages, ps, nh, hd,
                              cfg.num_slots, pages_per_slot,
-                             dtype=self._dtype,
+                             dtype=kv_jnp,
                              prefix_cache=cfg.prefix_cache)
         self.prefill_chunk = int(cfg.prefill_chunk) or 2 * ps
         if self.prefill_chunk < 1:
@@ -359,12 +407,31 @@ class ServingEngine:
             self._tick = jax.jit(
                 make_spec_tick(mcfg, b_slots, self._spec_k,
                                self.prefill_chunk, self._impl,
-                               self._tick_site),
-                donate_argnums=(2, 3))
+                               self._tick_site,
+                               quantized=self._quantized),
+                donate_argnums=(2, 3, 4, 5) if self._quantized
+                else (2, 3))
         else:
             self._tick = jax.jit(self._make_unified_tick(),
-                                 donate_argnums=(2, 3))
-        self._copy = jax.jit(_copy_pages, donate_argnums=(0, 1))
+                                 donate_argnums=(2, 3, 4, 5)
+                                 if self._quantized else (2, 3))
+        if self._quantized:
+            self._copy = jax.jit(_copy_pages_q,
+                                 donate_argnums=(0, 1, 2, 3))
+            # fixed-size fresh-page reset vector folded into every tick
+            # (paged_cache.take_fresh): sized past the worst case one
+            # scheduler step can allocate — decode growth (<= 1 page
+            # per slot), speculation growth, and the selected chunks'
+            # pages — so the eager-reset overflow path never triggers
+            # in normal operation (it stays correct if it does).
+            spec_extra = (self._spec_k // ps + 2) \
+                if self._spec is not None else 0
+            self._fresh_cap = (
+                b_slots * (1 + spec_extra)
+                + cfg.prefill_chunks_per_tick
+                * (self.prefill_chunk // ps + 2) + 8)
+        else:
+            self._copy = jax.jit(_copy_pages, donate_argnums=(0, 1))
 
     @property
     def compiled_sites(self) -> Tuple[str, ...]:
@@ -382,6 +449,29 @@ class ServingEngine:
 
     def _emit(self, kind: str, rid: int, **attrs) -> None:
         _events.emit(kind, rid=rid, eng=self._eng_id, **attrs)
+
+    def _pool_args(self) -> tuple:
+        """The pool's device-state args for a tick dispatch (shared by
+        the unified and spec sites). Order matters in int8 mode:
+        ``take_fresh`` runs BEFORE the scale arrays are captured —
+        its overflow path eagerly rewrites them, and capturing first
+        would dispatch the stale arrays and then clobber the reset
+        with the tick's output."""
+        if not self._quantized:
+            return (self.pool.k, self.pool.v)
+        fresh = self.pool.take_fresh(self._fresh_cap)
+        return (self.pool.k, self.pool.v, self.pool.k_scale,
+                self.pool.v_scale, fresh)
+
+    def _store_pools(self, outs: tuple) -> tuple:
+        """Store a tick's donated pool outputs back on the pool;
+        returns the remaining (per-mode) outputs."""
+        if self._quantized:
+            (self.pool.k, self.pool.v, self.pool.k_scale,
+             self.pool.v_scale) = outs[:4]
+            return outs[4:]
+        self.pool.k, self.pool.v = outs[:2]
+        return outs[2:]
 
     def _note_avals(self, site: str, fn, args: tuple) -> None:
         """Remember a dispatch site's argument avals (shape/dtype only
@@ -718,9 +808,22 @@ class ServingEngine:
                     dst = self.pool.tables[slot,
                                            self.pool.slot_pages(slot) - 1]
                     with _quiet_donation():
-                        self.pool.k, self.pool.v = self._copy(
-                            self.pool.k, self.pool.v,
-                            np.int32(src), np.int32(dst))
+                        if self._quantized:
+                            # scales travel with the page; un-list dst
+                            # from the fresh reset or the next tick
+                            # would zero the copied scales
+                            (self.pool.k, self.pool.v,
+                             self.pool.k_scale, self.pool.v_scale) = \
+                                self._copy(
+                                    self.pool.k, self.pool.v,
+                                    self.pool.k_scale,
+                                    self.pool.v_scale,
+                                    np.int32(src), np.int32(dst))
+                            self.pool.claim_fresh(int(dst))
+                        else:
+                            self.pool.k, self.pool.v = self._copy(
+                                self.pool.k, self.pool.v,
+                                np.int32(src), np.int32(dst))
                     hit += lcp
                     _registry().counter("cache_share/cow_copies").add(1)
                     self._emit("cow_copy", req.rid, slot=slot, tokens=lcp)
@@ -935,18 +1038,17 @@ class ServingEngine:
                 sample_ix[s] = base + (t0 - 1 - start)
                 sample_pos[s] = t0
                 emit[s] = True
-        args = (self._stacked, self._other, self.pool.k, self.pool.v,
-                self._last_tok, pf_toks, tok_pos, tok_limit, row_tab,
+        tail = (self._last_tok, pf_toks, tok_pos, tok_limit, row_tab,
                 row_pos0, row_len, sample_ix, sample_pos, emit,
                 np.bool_(len(chunks) > 0),
                 np.ascontiguousarray(self._keys),
                 np.ascontiguousarray(self._temps),
                 np.ascontiguousarray(self._topks),
                 np.ascontiguousarray(self._topps))
+        args = (self._stacked, self._other) + self._pool_args() + tail
         self._note_avals(self._tick_site, self._tick, args)
         with _quiet_donation():
-            self.pool.k, self.pool.v, tok, self._last_tok = \
-                self._tick(*args)
+            tok, self._last_tok = self._store_pools(self._tick(*args))
         meta = [(s, s, self._slot_rid[s]) for s in ticking]
         meta += [(s, s, rid) for s, rid in finishers]
         if meta:
@@ -997,16 +1099,30 @@ class ServingEngine:
         impl = self._impl
         ns = self.config.num_slots
         w = self.prefill_chunk
+        quantized = self._quantized
 
         from ..models.gpt import gpt_ragged_apply
 
-        def tick(stacked, other, kpool, vpool, last_tok, pf_toks,
-                 tok_pos, tok_limit, row_tab, row_pos0, row_len,
-                 sample_ix, sample_pos, emit, has_chunks, keys, temps,
-                 top_ks, top_ps):
-            _recompile.mark_trace(site, kpool, row_tab, tok_pos,
-                                  last_tok)
+        def core(stacked, other, pools, last_tok, pf_toks, tok_pos,
+                 tok_limit, row_tab, row_pos0, row_len, sample_ix,
+                 has_chunks):
             tokens = jnp.concatenate([last_tok, pf_toks])
+
+            def run(pl_, toks_, pos_, lim_, tab_, p0_, len_):
+                if quantized:
+                    kp, vp, ks, vs = pl_
+                    lg, kp, vp, ks, vs = gpt_ragged_apply(
+                        mcfg, stacked, other, kp, vp, toks_, pos_,
+                        lim_, tab_, p0_, len_, sample_ix,
+                        decode_rows=ns, chunk_width=w, impl=impl,
+                        kscale=ks, vscale=vs)
+                    return lg, (kp, vp, ks, vs)
+                kp, vp = pl_
+                lg, kp, vp = gpt_ragged_apply(
+                    mcfg, stacked, other, kp, vp, toks_, pos_, lim_,
+                    tab_, p0_, len_, sample_ix, decode_rows=ns,
+                    chunk_width=w, impl=impl)
+                return lg, (kp, vp)
 
             # ONE program, data-dependent prefill piggyback: both
             # branches trace into this single executable (the site
@@ -1016,26 +1132,54 @@ class ServingEngine:
             # a fixed-shape program otherwise pays its worst-case mix
             # every tick, which on the XLA path is real FLOPs, not
             # skipped blocks.
-            def mixed(kpool, vpool):
-                return gpt_ragged_apply(
-                    mcfg, stacked, other, kpool, vpool, tokens,
+            def mixed(pl_):
+                lg, pl_ = run(pl_, tokens, tok_pos, tok_limit,
+                              row_tab, row_pos0, row_len)
+                return (lg,) + pl_
+
+            def decode_only(pl_):
+                lg, pl_ = run(pl_, tokens[:ns], tok_pos[:ns],
+                              tok_limit[:ns], row_tab[:ns],
+                              row_pos0[:ns], row_len[:ns])
+                return (lg,) + pl_
+
+            out = jax.lax.cond(has_chunks, mixed, decode_only, pools)
+            return out[0], out[1:]
+
+        if quantized:
+            def tick(stacked, other, kpool, vpool, kscale, vscale,
+                     fresh, last_tok, pf_toks, tok_pos, tok_limit,
+                     row_tab, row_pos0, row_len, sample_ix, sample_pos,
+                     emit, has_chunks, keys, temps, top_ks, top_ps):
+                _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                      last_tok)
+                # recycled pages restart their running-max scale at 0
+                # (fresh pads with the null page, whose scale is 0)
+                kscale = kscale.at[:, fresh].set(0.0)
+                vscale = vscale.at[:, fresh].set(0.0)
+                logits, (kpool, vpool, kscale, vscale) = core(
+                    stacked, other, (kpool, vpool, kscale, vscale),
+                    last_tok, pf_toks, tok_pos, tok_limit, row_tab,
+                    row_pos0, row_len, sample_ix, has_chunks)
+                nxt = self._sample_tok(logits, keys, sample_pos, temps,
+                                       top_ks, top_ps)
+                new_last = jnp.where(emit, nxt, last_tok)
+                return kpool, vpool, kscale, vscale, nxt, new_last
+        else:
+            def tick(stacked, other, kpool, vpool, last_tok, pf_toks,
+                     tok_pos, tok_limit, row_tab, row_pos0, row_len,
+                     sample_ix, sample_pos, emit, has_chunks, keys,
+                     temps, top_ks, top_ps):
+                _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                      last_tok)
+                logits, (kpool, vpool) = core(
+                    stacked, other, (kpool, vpool), last_tok, pf_toks,
                     tok_pos, tok_limit, row_tab, row_pos0, row_len,
-                    sample_ix, decode_rows=ns, chunk_width=w,
-                    impl=impl)
-
-            def decode_only(kpool, vpool):
-                return gpt_ragged_apply(
-                    mcfg, stacked, other, kpool, vpool, tokens[:ns],
-                    tok_pos[:ns], tok_limit[:ns], row_tab[:ns],
-                    row_pos0[:ns], row_len[:ns], sample_ix,
-                    decode_rows=ns, chunk_width=w, impl=impl)
-
-            logits, kpool, vpool = jax.lax.cond(
-                has_chunks, mixed, decode_only, kpool, vpool)
-            nxt = self._sample_tok(logits, keys, sample_pos, temps,
-                                   top_ks, top_ps)
-            new_last = jnp.where(emit, nxt, last_tok)
-            return kpool, vpool, nxt, new_last
+                    sample_ix, has_chunks)
+                nxt = self._sample_tok(logits, keys, sample_pos, temps,
+                                       top_ks, top_ps)
+                new_last = jnp.where(emit, nxt, last_tok)
+                return kpool, vpool, nxt, new_last
 
         return tick
 
@@ -1186,13 +1330,13 @@ class ServingEngine:
             if end >= t0:
                 finishers.append((s, rid))
                 sample[s, 0] = coff + (t0 - 1 - start)
-        args = (self._stacked, self._other, self.pool.k, self.pool.v,
-                last_tok, draft_flat, pf_toks, tok_pos, tok_limit,
+        tail = (last_tok, draft_flat, pf_toks, tok_pos, tok_limit,
                 row_tab, row_pos0, row_len, sample.reshape(-1), k_arr,
                 np.bool_(len(chunks) > 0), np.bool_(has_drafts))
+        args = (self._stacked, self._other) + self._pool_args() + tail
         self._note_avals(self._tick_site, self._tick, args)
         with _quiet_donation():
-            self.pool.k, self.pool.v, tok_m, acc = self._tick(*args)
+            tok_m, acc = self._store_pools(self._tick(*args))
 
         # ---- chunk bookkeeping (same as the unified tick) ----
         for s, rid, start, end, t0 in chunks:

@@ -339,6 +339,23 @@ class PagePool:
         shape = (num_layers, num_pages, page_size, num_heads, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
+        # int8 pools (ISSUE 12): per-page per-head dequant scales ride
+        # as device state next to the pools — quantize-on-write updates
+        # them inside the tick (ops/paged_attention.paged_kv_scatter),
+        # so they are donated/returned per dispatch exactly like k/v.
+        # Page 0 (null) keeps scale 0 forever (masked contributions).
+        # Page CONTENT is deliberately never cleared on free (LIFO
+        # dirty reuse is a feature), but a recycled page's STALE SCALE
+        # would poison the running-max of its next tenant — so fresh
+        # allocations are tracked host-side and the engine folds a
+        # scale reset for them into the next tick's arguments.
+        self.quantized = jnp.dtype(dtype) == jnp.int8
+        if self.quantized:
+            self.k_scale = jnp.zeros((num_layers, num_pages, num_heads),
+                                     jnp.float32)
+            self.v_scale = jnp.zeros((num_layers, num_pages, num_heads),
+                                     jnp.float32)
+            self._fresh: List[int] = []
         self.allocator = PageAllocator(num_pages)
         self.prefix: Optional[PrefixCache] = (
             PrefixCache(page_size, self.allocator) if prefix_cache
@@ -366,7 +383,47 @@ class PagePool:
         if got is None and self.prefix is not None:
             self.prefix.evict_for(n - self.allocator.num_free)
             got = self.allocator.alloc(n)
+        if got is not None and self.quantized:
+            self._fresh.extend(got)
         return got
+
+    # -- int8 scale lifecycle (quantized pools only) -------------------
+    def take_fresh(self, cap: int) -> np.ndarray:
+        """Drain the freshly-allocated-page list into a fixed-size
+        int32 vector (padded with the null page, whose scale is 0
+        anyway) for the next tick's in-program scale reset. Allocations
+        beyond ``cap`` — which a correctly-sized cap never produces —
+        are reset eagerly here instead of silently dropped (a dropped
+        reset would leave a stale running-max scale on a recycled
+        page)."""
+        fresh, self._fresh = self._fresh, []
+        if len(fresh) > cap:
+            self.reset_scales(fresh[cap:])
+            fresh = fresh[:cap]
+        out = np.zeros(cap, np.int32)
+        out[:len(fresh)] = fresh
+        return out
+
+    def reset_scales(self, pages) -> None:
+        """Eagerly zero the scale rows of ``pages`` (rare overflow path
+        of :meth:`take_fresh`; the hot path resets inside the tick)."""
+        idx = np.asarray(list(pages), np.int32)
+        if idx.size == 0:
+            return
+        self.k_scale = self.k_scale.at[:, idx].set(0.0)
+        self.v_scale = self.v_scale.at[:, idx].set(0.0)
+
+    def claim_fresh(self, page: int) -> None:
+        """Remove ``page`` from the pending-reset list — its scale was
+        just written by a device op (the COW copy duplicates the donor
+        page's scale; resetting it afterwards would dequantize the
+        copied content at scale 0). EVERY occurrence goes: an
+        alloc→preempt-release→realloc cycle inside one scheduler step
+        lists the same id twice, and a surviving duplicate would still
+        zero the copied scales on the next tick."""
+        if self.quantized:
+            page = int(page)
+            self._fresh = [p for p in self._fresh if p != page]
 
     def grow_slot(self, slot: int, n_pages: int) -> bool:
         """Extend ``slot`` by ``n_pages`` fresh pages; False (untouched)

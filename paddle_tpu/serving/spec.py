@@ -270,10 +270,14 @@ def make_draft_tick(cfg, num_slots: int, capacity: int, k: int,
 
 
 def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
-                   impl: str, site: str):
+                   impl: str, site: str, quantized: bool = False):
     """Build the spec engine's verify/mixed tick body (jitted by the
     engine; pools donated). This IS the unified mixed-row tick with a
     draft section — same site name, same single-trace contract.
+    ``quantized`` (int8 KV pools, ISSUE 12) widens the signature with
+    the per-page per-head scale arrays + the fresh-page reset vector,
+    exactly like the plain unified tick; the draft model's dense cache
+    stays at its own model dtype either way.
 
     Flat token layout: ``[ns last_tok | ns*k drafts | npf*w chunks]``.
     ``sample_ix`` is ``[ns * (1+k)]`` in that layout,
@@ -300,10 +304,9 @@ def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
     from ..models.gpt import gpt_ragged_apply
     from ..ops.decoding import spec_accept_length
 
-    def tick(stacked, other, kpool, vpool, last_tok, draft_toks,
+    def core(stacked, other, pools, last_tok, draft_toks,
              pf_toks, tok_pos, tok_limit, row_tab, row_pos0, row_len,
              sample_ix, n_draft, has_chunks, has_drafts):
-        _recompile.mark_trace(site, kpool, row_tab, tok_pos, last_tok)
         tokens = jnp.concatenate([last_tok, draft_toks, pf_toks])
         # the no-draft branches run the exact non-speculative layout:
         # the draft section sliced out of every metadata vector
@@ -327,46 +330,84 @@ def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
             out = jnp.zeros((base,), jnp.int32)
             return out.at[jnp.arange(ns) * (1 + k)].set(tok_ns)
 
-        def spec_mixed(kp, vp):
+        def run(pl_, toks_, pos_, lim_, tab_, p0_, len_, six_, sk):
+            if quantized:
+                kp, vp, ks, vs = pl_
+                lg, kp, vp, ks, vs = gpt_ragged_apply(
+                    mcfg, stacked, other, kp, vp, toks_, pos_, lim_,
+                    tab_, p0_, len_, six_, decode_rows=ns,
+                    chunk_width=w, impl=impl, spec_k=sk,
+                    kscale=ks, vscale=vs)
+                return lg, (kp, vp, ks, vs)
+            kp, vp = pl_
             lg, kp, vp = gpt_ragged_apply(
-                mcfg, stacked, other, kp, vp, tokens, tok_pos,
-                tok_limit, row_tab, row_pos0, row_len, sample_ix,
-                decode_rows=ns, chunk_width=w, impl=impl, spec_k=k)
-            return _greedy(lg), kp, vp
+                mcfg, stacked, other, kp, vp, toks_, pos_, lim_,
+                tab_, p0_, len_, six_, decode_rows=ns,
+                chunk_width=w, impl=impl, spec_k=sk)
+            return lg, (kp, vp)
 
-        def spec_only(kp, vp):
-            lg, kp, vp = gpt_ragged_apply(
-                mcfg, stacked, other, kp, vp, tokens[:base],
-                tok_pos[:base], tok_limit[:base], row_tab[:ns],
-                row_pos0[:ns], row_len[:ns], sample_ix,
-                decode_rows=ns, chunk_width=w, impl=impl, spec_k=k)
-            return _greedy(lg), kp, vp
+        def spec_mixed(pl_):
+            lg, pl_ = run(pl_, tokens, tok_pos, tok_limit, row_tab,
+                          row_pos0, row_len, sample_ix, k)
+            return (_greedy(lg),) + pl_
 
-        def plain_mixed(kp, vp):
-            lg, kp, vp = gpt_ragged_apply(
-                mcfg, stacked, other, kp, vp, tokens_plain, pos_plain,
-                lim_plain, row_tab, row_pos0, row_len, primary_ix,
-                decode_rows=ns, chunk_width=w, impl=impl)
-            return scatter_primary(_greedy(lg)), kp, vp
+        def spec_only(pl_):
+            lg, pl_ = run(pl_, tokens[:base], tok_pos[:base],
+                          tok_limit[:base], row_tab[:ns], row_pos0[:ns],
+                          row_len[:ns], sample_ix, k)
+            return (_greedy(lg),) + pl_
 
-        def plain_only(kp, vp):
-            lg, kp, vp = gpt_ragged_apply(
-                mcfg, stacked, other, kp, vp, tokens_plain[:ns],
-                pos_plain[:ns], lim_plain[:ns], row_tab[:ns],
-                row_pos0[:ns], row_len[:ns], primary_ix,
-                decode_rows=ns, chunk_width=w, impl=impl)
-            return scatter_primary(_greedy(lg)), kp, vp
+        def plain_mixed(pl_):
+            lg, pl_ = run(pl_, tokens_plain, pos_plain, lim_plain,
+                          row_tab, row_pos0, row_len, primary_ix, 0)
+            return (scatter_primary(_greedy(lg)),) + pl_
 
-        toks, kpool, vpool = jax.lax.cond(
+        def plain_only(pl_):
+            lg, pl_ = run(pl_, tokens_plain[:ns], pos_plain[:ns],
+                          lim_plain[:ns], row_tab[:ns], row_pos0[:ns],
+                          row_len[:ns], primary_ix, 0)
+            return (scatter_primary(_greedy(lg)),) + pl_
+
+        out = jax.lax.cond(
             has_drafts,
-            lambda kp, vp: jax.lax.cond(has_chunks, spec_mixed,
-                                        spec_only, kp, vp),
-            lambda kp, vp: jax.lax.cond(has_chunks, plain_mixed,
-                                        plain_only, kp, vp),
-            kpool, vpool)
+            lambda pl_: jax.lax.cond(has_chunks, spec_mixed,
+                                     spec_only, pl_),
+            lambda pl_: jax.lax.cond(has_chunks, plain_mixed,
+                                     plain_only, pl_),
+            pools)
+        toks, pools = out[0], out[1:]
         tok_m = toks.reshape(ns, 1 + k)
         acc = spec_accept_length(draft_toks.reshape(ns, k),
                                  tok_m[:, :k], n_draft)
-        return kpool, vpool, tok_m, acc
+        return pools, tok_m, acc
+
+    if quantized:
+        def tick(stacked, other, kpool, vpool, kscale, vscale, fresh,
+                 last_tok, draft_toks, pf_toks, tok_pos, tok_limit,
+                 row_tab, row_pos0, row_len, sample_ix, n_draft,
+                 has_chunks, has_drafts):
+            _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                  last_tok)
+            # recycled pages start their running-max scale at 0 (the
+            # engine lists pages allocated since the last dispatch)
+            kscale = kscale.at[:, fresh].set(0.0)
+            vscale = vscale.at[:, fresh].set(0.0)
+            (kpool, vpool, kscale, vscale), tok_m, acc = core(
+                stacked, other, (kpool, vpool, kscale, vscale),
+                last_tok, draft_toks, pf_toks, tok_pos, tok_limit,
+                row_tab, row_pos0, row_len, sample_ix, n_draft,
+                has_chunks, has_drafts)
+            return kpool, vpool, kscale, vscale, tok_m, acc
+    else:
+        def tick(stacked, other, kpool, vpool, last_tok, draft_toks,
+                 pf_toks, tok_pos, tok_limit, row_tab, row_pos0,
+                 row_len, sample_ix, n_draft, has_chunks, has_drafts):
+            _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                  last_tok)
+            (kpool, vpool), tok_m, acc = core(
+                stacked, other, (kpool, vpool), last_tok, draft_toks,
+                pf_toks, tok_pos, tok_limit, row_tab, row_pos0,
+                row_len, sample_ix, n_draft, has_chunks, has_drafts)
+            return kpool, vpool, tok_m, acc
 
     return tick

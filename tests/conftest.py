@@ -48,6 +48,8 @@ _COMPILE_HEAVY_FILES = frozenset({
     "test_async_pipeline.py",    # elastic/runner async pipeline
     "test_serving.py",           # serving engines: tick + bucket prefills
     "test_spec_decode.py",       # spec engines: draft tick + verify tick
+    "test_kv_quant.py",          # int8-KV engines: quantized tick pairs
+    "test_qcomm.py",             # quantized-DP trainers: 2 step compiles
 })
 
 

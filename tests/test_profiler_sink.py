@@ -629,3 +629,74 @@ def test_schema_checker_flags_bad_accept_events(tmp_path):
     nonint = [{"seq": 0, "t_ns": 1, "kind": "accept", "rid": 0,
                "accepted": "2", "drafted": 3}]
     assert any("not ints" in e for e in _check_events(tmp_path, nonint))
+
+
+# ---------------------------------------------------------------------------
+# sink-schema checker: ISSUE 12 blocks (kv-quant quality proxy /
+# residency cell / qcomm config) — negative-tested so the CI leg's new
+# rules are themselves pinned
+# ---------------------------------------------------------------------------
+
+
+def _run_check(fn_name, doc):
+    mod, schema = _load_checker()
+    mod._ERRORS.clear()
+    getattr(mod, fn_name)(doc, schema, "t")
+    errs = list(mod._ERRORS)
+    mod._ERRORS.clear()
+    return errs
+
+
+def test_schema_checker_kv_quality_proxy():
+    good = {"kv_dtype": "int8", "requests": 4, "total_tokens": 10,
+            "matched_tokens": 10, "token_match_rate": 1.0,
+            "ppl_f32": 2.5, "ppl_kv": 2.5, "ppl_delta": 0.0}
+    assert _run_check("check_kv_quality", good) == []
+    # a rate outside [0, 1] is a writer bug, not a quality result
+    bad = dict(good, token_match_rate=1.5)
+    assert any("[0, 1]" in e for e in _run_check("check_kv_quality", bad))
+    # matched > total is impossible by construction
+    impossible = dict(good, matched_tokens=11)
+    assert any("outside" in e
+               for e in _run_check("check_kv_quality", impossible))
+    missing = {k: v for k, v in good.items() if k != "ppl_kv"}
+    assert any("missing key 'ppl_kv'" in e
+               for e in _run_check("check_kv_quality", missing))
+
+
+def test_schema_checker_kv_residency():
+    good = {"f32_slots": 4, "kv_slots": 8, "f32_pool_bytes": 1000,
+            "kv_pool_bytes": 500, "pool_bytes_ratio": 0.5,
+            "f32_tokens_per_sec": 10.0, "kv_tokens_per_sec": 9.0}
+    assert _run_check("check_kv_residency", good) == []
+    assert any("positive" in e for e in _run_check(
+        "check_kv_residency", dict(good, pool_bytes_ratio=0)))
+    assert any("missing key 'kv_pool_bytes'" in e for e in _run_check(
+        "check_kv_residency",
+        {k: v for k, v in good.items() if k != "kv_pool_bytes"}))
+
+
+def test_schema_checker_qcomm_config():
+    cell = {"collective_bytes_per_step": 100,
+            "collective_bytes_int8": 0, "collective_bytes_f32": 100,
+            "losses": [1.0]}
+    i8 = dict(cell, collective_bytes_int8=90, collective_bytes_f32=10)
+    good = {"dp": 8, "f32": cell, "int8": i8}
+    assert _run_check("check_qcomm_config", good) == []
+    # a skipped config (single-device box) is not a violation
+    assert _run_check("check_qcomm_config", {"skipped": "1 device"}) == []
+    # an "int8" cell that moved no int8 bytes is the accounting bug
+    # the per-dtype gauges exist to catch
+    no_i8 = {"dp": 8, "f32": cell, "int8": dict(i8,
+                                                collective_bytes_int8=0)}
+    assert any("no int8 bytes" in e
+               for e in _run_check("check_qcomm_config", no_i8))
+    # ...and an f32 baseline that DID move int8 bytes is the converse
+    leak = {"dp": 8, "f32": dict(cell, collective_bytes_int8=5),
+            "int8": i8}
+    assert any("nonzero in the f32" in e
+               for e in _run_check("check_qcomm_config", leak))
+    missing = {"dp": 8, "f32": cell,
+               "int8": {k: v for k, v in i8.items() if k != "losses"}}
+    assert any("missing key 'losses'" in e
+               for e in _run_check("check_qcomm_config", missing))

@@ -236,6 +236,78 @@ def check_trace_summary_file(path: str, schema: dict,
     check_trace_summary(doc, schema, path)
 
 
+def check_kv_quality(doc, schema: dict, where: str) -> None:
+    """Validate a serve_bench --kv-dtype quality-proxy block (ISSUE
+    12): required keys, a token-match rate inside [0, 1], and
+    impossible token counts (matched > total) flagged as writer
+    bugs."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["kv_quality_proxy"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    r = doc.get("token_match_rate")
+    if not isinstance(r, (int, float)) or not 0.0 <= r <= 1.0:
+        err(f"{where}: token_match_rate {r!r} not a number in [0, 1]")
+    m, t = doc.get("matched_tokens"), doc.get("total_tokens")
+    if isinstance(m, int) and isinstance(t, int):
+        if not 0 <= m <= t:
+            err(f"{where}: matched_tokens={m} outside [0, "
+                f"total_tokens={t}]")
+    elif "matched_tokens" in doc and "total_tokens" in doc:
+        err(f"{where}: token counts not ints ({m!r}, {t!r})")
+
+
+def check_kv_residency(doc, schema: dict, where: str) -> None:
+    """Validate a serve_bench --kv-dtype residency cell: required
+    keys and a positive pool-bytes ratio (the matched-bytes claim is
+    meaningless without the denominator)."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["kv_residency"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    r = doc.get("pool_bytes_ratio")
+    if not isinstance(r, (int, float)) or r <= 0:
+        err(f"{where}: pool_bytes_ratio {r!r} not a positive number")
+
+
+def check_qcomm_config(doc, schema: dict, where: str) -> None:
+    """Validate a bench.py gpt_dp_qcomm_int8 config block: both cells
+    carry the collective-byte keys, the int8 cell actually moved int8
+    bytes and the f32 cell moved none (a quantized AllReduce whose
+    payload still counts as f32 is exactly the accounting bug the
+    per-dtype gauges exist to catch)."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    if "skipped" in doc or "error" in doc:
+        return
+    for cell_name in ("f32", "int8"):
+        cell = doc.get(cell_name)
+        if not isinstance(cell, dict):
+            err(f"{where}: missing {cell_name!r} cell")
+            continue
+        if "error" in cell:
+            continue
+        for k in sc["qcomm_cell"]:
+            if k not in cell:
+                err(f"{where}.{cell_name}: missing key {k!r}")
+    f32c, i8c = doc.get("f32") or {}, doc.get("int8") or {}
+    if isinstance(i8c.get("collective_bytes_int8"), (int, float)) \
+            and i8c["collective_bytes_int8"] <= 0:
+        err(f"{where}.int8: collective_bytes_int8 "
+            f"{i8c['collective_bytes_int8']!r} not positive (the "
+            "quantized payload moved no int8 bytes)")
+    if isinstance(f32c.get("collective_bytes_int8"), (int, float)) \
+            and f32c["collective_bytes_int8"] != 0:
+        err(f"{where}.f32: collective_bytes_int8 "
+            f"{f32c['collective_bytes_int8']!r} nonzero in the f32 "
+            "baseline")
+
+
 def check_bench_json(path: str, schema: dict,
                      require_trace: bool = False) -> None:
     sc = schema["bench_extra"]
@@ -277,6 +349,18 @@ def check_bench_json(path: str, schema: dict,
         check_trace_summary(dt, schema, f"{path}: extra.device_trace")
     elif require_trace:
         err(f"{path}: extra.device_trace missing (--require-trace)")
+    # ISSUE 12 blocks, validated whenever present: the --kv-dtype
+    # quality-proxy + residency cells, and the bench.py qcomm config
+    if "kv_quality_proxy" in extra:
+        check_kv_quality(extra["kv_quality_proxy"], schema,
+                         f"{path}: extra.kv_quality_proxy")
+    if "residency" in extra:
+        check_kv_residency(extra["residency"], schema,
+                           f"{path}: extra.residency")
+    qc = (extra.get("configs") or {}).get("gpt_dp_qcomm_int8")
+    if qc is not None:
+        check_qcomm_config(qc, schema,
+                           f"{path}: extra.configs.gpt_dp_qcomm_int8")
 
 
 def main() -> int:
