@@ -967,6 +967,221 @@ def bench_kernel_matrix(args, tiny):
     }
 
 
+def bench_multihost(args, tiny):
+    """Multi-host serving (ISSUE 13): aggregate tokens/s scaling from
+    1 to ``--hosts`` REAL processes on the CPU mesh, plus the
+    disaggregated-vs-symmetric p95 TTFT comparison on a
+    long-prompt-mixed workload.
+
+    HONEST CPU-MESH CAVEATS (the headline's fine print): this
+    container has ONE CPU core, so N timesharing processes cannot add
+    compute and the WALL-clock aggregate is physically pinned near
+    1.0x (reported as ``wall_scaling`` — expect ~0.9x after consensus
+    and channel overhead). The headline is therefore the
+    PARALLEL-HARDWARE PROJECTION: each rank measures its own CPU
+    seconds over the measured window (all threads), and
+    ``tokens / max(per-rank CPU)`` is the aggregate rate N actual
+    cores/hosts would realize running the same rank workloads
+    concurrently — a measured quantity (the ranks' real, sharded
+    work), not a model; only the "they run in parallel" step is
+    projected. The mesh is sharded the way the tentpole says: the
+    1-host cell runs the GLOBAL engine (all slots, the whole pool),
+    the N-host cell shards slots AND pages across ranks, so per-rank
+    ticks genuinely shrink (a fixed-shape tick pays its full
+    row-capacity FLOPs regardless of occupancy — identical per-host
+    configs would burn the savings as padding). The TTFT cell runs
+    both 2-host topologies at matched ample capacity, so its
+    comparison is pure scheduling structure, valid even on one core
+    and on wall clocks."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import tempfile
+
+    import mp_mesh
+
+    hosts = args.hosts
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "serve_worker.py")
+    # full mode uses the compute-per-token model (bench_poisson's full
+    # sizing): on tiny models Python/dispatch overhead swamps the
+    # sharded-tick FLOPs the scaling cell measures
+    model = ({"vocab": 128, "hidden": 64, "layers": 4, "heads": 4,
+              "max_seq_len": 128} if tiny else
+             {"vocab": 512, "hidden": 256, "layers": 6, "heads": 8,
+              "max_seq_len": 192})
+
+    def run_cell(name, world, cell_cfg):
+        root = tempfile.mkdtemp(prefix=f"serve_mh_{name}_")
+        cfg = dict(cell_cfg, world=world, model=model,
+                   shared_dir=os.path.join(root, "shared"))
+        cfg_path = os.path.join(root, "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        res = mp_mesh.launch(world, worker, [cfg_path, root],
+                             log_dir=os.path.join(root, "logs"),
+                             timeout=cfg.get("timeout_s", 600) + 120)
+        if not res.ok:
+            raise SystemExit(f"multihost cell {name} failed:\n"
+                             f"{res.tail()}")
+        stats = []
+        for r in range(world):
+            with open(os.path.join(root, f"bench.{r}.json")) as f:
+                stats.append(json.load(f))
+        tokens = sum(s["tokens"] for s in stats)
+        wall = max(s["end_w"] for s in stats) - \
+            min(s["start_w"] for s in stats)
+        cpus = [s["cpu_s"] for s in stats]
+        ttfts = [v for s in stats for v in s["ttft_ms"].values()]
+        served = sorted(g for s in stats for g in s["served"])
+        assert served == list(range(cfg["n_requests"])), \
+            f"cell {name}: served {len(served)}/{cfg['n_requests']}"
+        return {
+            "world": world,
+            "tokens": tokens,
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(tokens / wall, 2),
+            "cpu_s_per_rank": cpus,
+            "projected_tokens_per_sec": round(tokens / max(cpus), 2),
+            "ttft_p50_ms": round(pct(ttfts, 50), 2),
+            "ttft_p95_ms": round(pct(ttfts, 95), 2),
+            "handoffs": sum(s["handoffs_sent"] for s in stats),
+            "handoff_bytes": int(sum(s["handoff_bytes_out"]
+                                     for s in stats)),
+            "preemptions": int(sum(s["preemptions"] for s in stats)),
+            "prefill_chunks": int(sum(s["prefill_chunks"]
+                                      for s in stats)),
+            "prefix_evictions": int(sum(s["prefix_evictions"]
+                                        for s in stats)),
+        }
+
+    # ---- cell 1: mixed-Poisson scaling, global engine vs the pool
+    # SHARDED over the mesh (slots and pages split across ranks, so a
+    # rank's fixed-shape tick genuinely shrinks with its shard) ------
+    ps = 8
+    max_new = 24 if tiny else 48
+    plens = (16, 32, 48) if tiny else (32, 48, 64)
+    pps = -(-(max(plens) + max_new) // ps)
+    # global slot capacity scales with the mesh so each host's shard
+    # keeps >= 4 slots (below that the shard tick degenerates and the
+    # scaling headline would be measured on a toy); tiny vs full scale
+    # through the model + token counts instead
+    g_slots = max(8, 4 * hosts)
+    shard = g_slots // hosts
+
+    def scale_cfg(slots):
+        return {
+            "seed": 7, "rate": 500.0,
+            "n_requests": 2 * g_slots,
+            "prompt_lens": list(plens), "max_new": max_new,
+            "prefill_ranks": [],
+            "engine": {"num_slots": slots, "page_size": ps,
+                       "pages_per_slot": pps,
+                       "num_pages": slots * pps + 1,
+                       "prefill_chunk": ps},
+            "timeout_s": 900,
+        }
+
+    cells = {"scale_1host": run_cell("s1", 1, scale_cfg(g_slots))}
+    cells[f"scale_{hosts}host_symmetric"] = run_cell(
+        f"s{hosts}", hosts, scale_cfg(shard))
+    c1, cn = cells["scale_1host"], \
+        cells[f"scale_{hosts}host_symmetric"]
+    scaling = cn["projected_tokens_per_sec"] \
+        / max(c1["projected_tokens_per_sec"], 1e-9)
+    wall_scaling = cn["tokens_per_sec"] / max(c1["tokens_per_sec"],
+                                              1e-9)
+
+    # ---- cell 2: long-prompt-mixed TTFT, disagg vs symmetric -------
+    # matched AMPLE capacity on both topologies: the delta is pure
+    # scheduling structure (where long prefills run), fair on one
+    # core. Mostly-short traffic + a couple of very long prompts:
+    # chunked prefill is OLDEST-ADMISSION-FIRST, so on a symmetric
+    # host every short admitted behind a long waits for the long's
+    # ENTIRE chunk train before its own prefill starts — the
+    # disaggregated decode rank never carries those chunks at all.
+    # p95 (nearest-rank) over n requests must land on the SHORT
+    # population (the protected one), so n >> #longs.
+    # slots sized ABOVE the short concurrency so shorts admit
+    # instantly and their TTFT measures chunk-queue structure, not
+    # slot starvation (which would hit both topologies identically)
+    n_ttft = 20 if tiny else 40
+    long_len = 64 if tiny else 128
+    t_max_new = 8 if tiny else 16
+    long_lens = [8] * n_ttft
+    long_lens[2] = long_len
+    if not tiny:
+        long_lens[n_ttft // 2] = 96
+    lpps = -(-(max(long_lens) + t_max_new) // ps)
+    ttft_cfg = {
+        # arrivals the decode mesh can keep up with: short TTFT then
+        # measures chunk-queue structure, not saturation backlog
+        "seed": 11, "rate": 100.0 if tiny else 25.0,
+        "n_requests": n_ttft,
+        "prompt_lens": list(long_lens), "max_new": t_max_new,
+        "prefill_ranks": [],
+        "engine": {"num_slots": 8 if tiny else 16, "page_size": ps,
+                   "pages_per_slot": lpps,
+                   "prefill_chunk": ps},
+        "long_prompt_threshold": 4 * ps,
+        "timeout_s": 900,
+    }
+    cells["ttft_symmetric"] = run_cell("tsym", 2, ttft_cfg)
+    disagg_cfg = dict(ttft_cfg, prefill_ranks=[1])
+    cells["ttft_disagg"] = run_cell("tdis", 2, disagg_cfg)
+    ttft_ratio = cells["ttft_disagg"]["ttft_p95_ms"] / \
+        max(cells["ttft_symmetric"]["ttft_p95_ms"], 1e-9)
+
+    return {
+        "metric": "serving_multihost_scaling",
+        "value": round(scaling, 4),
+        "unit": f"x aggregate tokens/s, 1 -> {hosts} real processes "
+                "(mixed Poisson; parallel-hardware projection from "
+                "measured per-rank CPU seconds — see note)",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "hosts": hosts, "model": model,
+            "cells": cells,
+            "wall_scaling": round(wall_scaling, 4),
+            "ttft_p95_disagg_over_symmetric": round(ttft_ratio, 4),
+            "scale_workload": {
+                k: scale_cfg(g_slots)[k] for k in
+                ("n_requests", "prompt_lens", "max_new", "engine")},
+            "shard_slots": shard,
+            "ttft_workload": {
+                k: ttft_cfg[k] for k in
+                ("n_requests", "prompt_lens", "max_new", "engine",
+                 "long_prompt_threshold")},
+            "note": ("ONE-CORE CPU container: N timesharing "
+                     "processes cannot add compute, so the honest "
+                     "WALL aggregate (extra.wall_scaling) is pinned "
+                     "near 1.0x minus consensus/channel overhead — "
+                     "that is container physics, not the runtime. "
+                     "The headline divides total served tokens by "
+                     "the MAX of the measured per-rank CPU seconds "
+                     "(all threads, measured-window delta): the "
+                     "rank workloads and their costs are fully "
+                     "measured and genuinely sharded (slots AND "
+                     "pages split per rank, so each rank's "
+                     "fixed-shape tick is proportionally smaller); "
+                     "only the final 'ranks run concurrently' step "
+                     "is projected, which is what separate hosts "
+                     "do by construction. Consensus admission, the "
+                     "done-agreement rounds, and KV-handoff bytes "
+                     "all ride the measured window. The TTFT cell "
+                     "is pure wall clock and needs no projection: "
+                     "2-host disaggregated (rank 1 absorbs long "
+                     "prompts' chunk trains; rank 0 keeps the "
+                     "decode-only fast path + short prefills — "
+                     "chunk selection is oldest-admission-first, so "
+                     "a symmetric host parks every short behind a "
+                     "long's whole chunk train) vs 2-host symmetric "
+                     "at matched ample capacity."),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
@@ -999,6 +1214,13 @@ def main():
                          "matched pool bytes + greedy token-match / "
                          "perplexity quality proxy vs the f32 engine, "
                          "ISSUE 12)")
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="run the multi-host serving comparison on N "
+                         "REAL processes (tools/mp_mesh.py): 1-host "
+                         "vs N-host aggregate tokens/s at fixed "
+                         "per-host pool capacity, plus the 2-host "
+                         "disaggregated-vs-symmetric p95 TTFT cell "
+                         "(ISSUE 13; BENCH_SERVE_r13.json)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=48)
@@ -1045,7 +1267,12 @@ def main():
 
         profiler.enable_sink(args.sink_dir, interval_s=5.0)
 
-    if args.kv_dtype != "f32":
+    if args.hosts > 1:
+        if args.kernel_matrix or args.spec_decode or \
+                args.prefix_cache or args.kv_dtype != "f32":
+            ap.error("--hosts N is its own comparison mode")
+        out = bench_multihost(args, args.tiny)
+    elif args.kv_dtype != "f32":
         out = bench_kv_quant(args, args.tiny)
     elif args.kernel_matrix:
         out = bench_kernel_matrix(args, args.tiny)

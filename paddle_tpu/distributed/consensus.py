@@ -1,0 +1,474 @@
+"""Cross-host agreement: a deterministic all-gather vote with
+epoch/lease semantics over the process mesh (ISSUE 13 tentpole piece 2).
+
+Everything multi-host in this repo needs the SAME small primitive, so
+it is built once here and used twice:
+
+- **serving admission / handoff routing** (serving/disagg.py): the
+  ranks of a serving mesh vote their load each admission round; the
+  agreed decision assigns every pending request to exactly one rank,
+  deterministically, so no two hosts ever admit the same request;
+- **resilience rollback/abort** (resilience/runner.py): ONE rank's
+  K-consecutive-bad verdict becomes a mesh-wide agreed rollback (or
+  abort) instead of per-rank divergence — the cross-host agreement the
+  resilience layer has listed as residue since PR 2.
+
+Why a shared-directory board and not a jax collective: an agreement
+protocol must reach a decision precisely when the mesh is UNHEALTHY —
+a dead or hung peer is the input, not an error. Compiled collectives
+hang (by design) when a participant dies, and this container's jax
+0.4.37 cannot run multiprocess computations on the CPU backend at all,
+so the control plane rides the same substrate the checkpoint/resume
+machinery already trusts: a shared filesystem. (On a real TPU fleet the
+board directory is the job's existing shared checkpoint/artifact store;
+the data plane — grads, KV pages — stays on ICI.) The jax coordination
+service still does process bring-up (tools/mp_mesh.py) — the board does
+membership and votes, where liveness timeouts are required semantics.
+
+Protocol (per topic *family*, e.g. ``"admit"`` or ``"rollback"``):
+
+- every rank keeps a **lease** alive (``lease.<rank>`` mtime,
+  refreshed by ``heartbeat()``; every vote/poll refreshes it). A rank
+  whose lease is older than ``lease_s`` is *suspect* — votes are no
+  longer awaited from it.
+- decisions happen in dense **epochs** 0, 1, 2, ... per family. Each
+  rank casts at most one immutable vote per epoch
+  (``<family>/e<epoch>/vote.<rank>``).
+- the **leader** — the lowest-ranked live rank — publishes the
+  decision once every live rank has voted, or once the epoch's vote
+  window (``window_s``, anchored at the epoch's first vote) expires
+  with at least one vote. Publication is an atomic exclusive link of an
+  immutable ``decision.json``; if two ranks race to lead (lease flap),
+  exactly one file wins and the loser adopts it. Leader death hands
+  leadership to the next live rank by lease expiry — no election
+  round.
+- every rank — voter or not, live or late — adopts the decision by
+  reading that one immutable file, then advances its epoch cursor.
+  A rank that slept through epochs catches up by reading the dense
+  decision history in order; this is what makes the vote an
+  *all-gather*: the decision carries every vote it was reduced from.
+
+The decision VALUE is computed by the leader from the votes (sorted by
+rank — deterministic) with the caller's reducer; followers take the
+published value, so agreement never depends on every rank re-deriving
+it. Reducers: ``any``/``all`` (bools), ``majority`` (most common
+value, lowest-rank tie-break), ``min``/``max``, ``union`` (sorted
+union of list votes), ``first`` (lowest-ranked vote), or a callable
+``f(votes: {rank: value}) -> value`` (must be the same on all ranks).
+
+Single-process meshes (world == 1) decide immediately and touch the
+disk only for the decision record, so the primitive costs nothing to
+leave wired in single-host code paths.
+
+Honest limits: liveness is mtime-based, so multi-NODE boards need a
+shared filesystem with coherent timestamps (the CPU test mesh runs on
+one node; a real fleet would back the board with its coordination
+service's KV store — the transport is three small functions). A rank
+that dies AFTER voting still counts: its vote is a fact on the board.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from collections import Counter as _Counter
+from typing import Any, Callable, Dict, List, Optional, Union
+
+__all__ = ["Consensus", "Decision", "ConsensusTimeout", "REDUCERS"]
+
+#: adopted epochs kept on disk behind every live rank's cursor — the
+#: replay window a transiently-slow rank can still read; everything
+#: older is pruned (a long-lived mesh must not leak one directory per
+#: agreement round forever)
+KEEP_EPOCHS = 8
+
+
+class ConsensusTimeout(RuntimeError):
+    """decide() ran out of time before a decision was published."""
+
+
+class Decision:
+    """One published, immutable agreement.
+
+    epoch:        dense per-family decision index.
+    value:        the reduced (agreed) value — what callers act on.
+    votes:        {rank: vote} actually received (sorted by rank).
+    participants: ranks whose votes were reduced.
+    missing:      ranks alive at epoch start that never voted inside
+                  the window, plus suspects — the fault evidence.
+    leader:       rank that published.
+    """
+
+    __slots__ = ("family", "epoch", "value", "votes", "participants",
+                 "missing", "leader")
+
+    def __init__(self, family: str, epoch: int, value, votes: Dict[int, Any],
+                 participants: List[int], missing: List[int], leader: int):
+        self.family = family
+        self.epoch = epoch
+        self.value = value
+        self.votes = votes
+        self.participants = participants
+        self.missing = missing
+        self.leader = leader
+
+    def to_dict(self) -> dict:
+        return {"family": self.family, "epoch": self.epoch,
+                "value": self.value,
+                "votes": {str(r): v for r, v in self.votes.items()},
+                "participants": self.participants,
+                "missing": self.missing, "leader": self.leader}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Decision":
+        return cls(d["family"], int(d["epoch"]), d["value"],
+                   {int(r): v for r, v in d["votes"].items()},
+                   [int(r) for r in d["participants"]],
+                   [int(r) for r in d["missing"]], int(d["leader"]))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"Decision({self.family}#{self.epoch} -> {self.value!r} "
+                f"votes={self.votes!r} missing={self.missing!r})")
+
+
+def _majority(votes: Dict[int, Any]):
+    """Most common vote value; ties break toward the value held by the
+    lowest-ranked voter (deterministic without value ordering)."""
+    counts = _Counter(json.dumps(v, sort_keys=True)
+                      for v in votes.values())
+    best = max(counts.values())
+    for r in sorted(votes):
+        if counts[json.dumps(votes[r], sort_keys=True)] == best:
+            return votes[r]
+    raise ValueError("majority of zero votes")  # pragma: no cover
+
+
+REDUCERS: Dict[str, Callable[[Dict[int, Any]], Any]] = {
+    "any": lambda v: any(bool(x) for x in v.values()),
+    "all": lambda v: all(bool(x) for x in v.values()),
+    "majority": _majority,
+    "min": lambda v: min(v[r] for r in sorted(v)),
+    "max": lambda v: max(v[r] for r in sorted(v)),
+    "union": lambda v: sorted({x for vv in v.values() for x in vv}),
+    "first": lambda v: v[min(v)],
+}
+
+
+class Consensus:
+    """See module docstring. One instance per rank per board."""
+
+    def __init__(self, board_dir: str, rank: int, world: int, *,
+                 lease_s: float = 5.0, window_s: Optional[float] = None,
+                 poll_s: float = 0.02, timeout_s: float = 60.0):
+        if world < 1 or not 0 <= rank < world:
+            raise ValueError(f"bad rank/world {rank}/{world}")
+        if lease_s <= 0:
+            raise ValueError("lease_s must be > 0")
+        self.dir = board_dir
+        self.rank = int(rank)
+        self.world = int(world)
+        self.lease_s = float(lease_s)
+        #: a live-but-slow rank gets this long from the epoch's FIRST
+        #: vote before the leader decides without it (a dead rank is
+        #: dropped sooner, at lease expiry)
+        self.window_s = float(window_s) if window_s is not None \
+            else 4.0 * float(lease_s)
+        self.poll_s = float(poll_s)
+        self.timeout_s = float(timeout_s)
+        self._epochs: Dict[str, int] = {}
+        self._hb_stop: Optional[threading.Event] = None
+        self._hb_thread: Optional[threading.Thread] = None
+        os.makedirs(board_dir, exist_ok=True)
+        self.heartbeat()
+
+    @classmethod
+    def for_mesh(cls, board_dir: str, **kw) -> "Consensus":
+        """Build from the ambient jax process mesh (rank 0 of 1 when
+        jax.distributed was never initialized). Uses the ONE guarded
+        rank/world detection helper (profiler.sink), which avoids
+        forcing backend bring-up as a side effect."""
+        from ..profiler.sink import _detect_rank, _detect_world
+
+        return cls(board_dir, _detect_rank(), _detect_world(), **kw)
+
+    # -- leases ------------------------------------------------------------
+    def _lease_path(self, rank: int) -> str:
+        return os.path.join(self.dir, f"lease.{rank}")
+
+    def heartbeat(self) -> None:
+        """Refresh this rank's lease. Called implicitly by every vote
+        and poll; loops that can stall (compile, checkpoint I/O) should
+        call it at their own boundaries."""
+        p = self._lease_path(self.rank)
+        try:
+            os.utime(p)
+        except OSError:
+            with open(p, "w") as f:
+                f.write(str(os.getpid()))
+
+    def start_heartbeat(self, interval_s: Optional[float] = None
+                        ) -> "Consensus":
+        """Refresh the lease from a daemon thread (default every
+        ``lease_s / 3``). Use whenever the calling loop can stall
+        longer than the lease — a rank COMPILING its first program for
+        a minute is alive, and its lease must say so. A killed process
+        stops heartbeating (threads die with it), which is exactly the
+        signal the board wants; a HUNG process keeps its lease — that
+        is the vote window's job, not the lease's."""
+        if self._hb_thread is not None:
+            return self
+        beat = max((self.lease_s / 3.0) if interval_s is None
+                   else float(interval_s), 0.02)
+        self._hb_stop = threading.Event()
+
+        def loop():
+            while not self._hb_stop.wait(beat):
+                try:
+                    self.heartbeat()
+                except OSError:  # pragma: no cover - board removed
+                    pass
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="consensus-heartbeat", daemon=True)
+        self._hb_thread.start()
+        return self
+
+    def stop_heartbeat(self) -> None:
+        if self._hb_thread is None:
+            return
+        self._hb_stop.set()
+        self._hb_thread.join(timeout=2)
+        self._hb_thread = None
+        self._hb_stop = None
+
+    def alive(self) -> List[int]:
+        """Ranks with a fresh lease (self always counts)."""
+        now = time.time()
+        out = []
+        for r in range(self.world):
+            if r == self.rank:
+                out.append(r)
+                continue
+            try:
+                if now - os.path.getmtime(self._lease_path(r)) \
+                        < self.lease_s:
+                    out.append(r)
+            except OSError:
+                pass
+        return out
+
+    # -- epochs ------------------------------------------------------------
+    def _family_dir(self, family: str) -> str:
+        if "/" in family or family.startswith("lease."):
+            raise ValueError(f"bad family name {family!r}")
+        return os.path.join(self.dir, family)
+
+    def _epoch_dir(self, family: str, epoch: int) -> str:
+        return os.path.join(self._family_dir(family), f"e{epoch:06d}")
+
+    def epoch(self, family: str) -> int:
+        """This rank's current (next unadopted) epoch for ``family``.
+        Always starts at 0: a rank that slept through epochs (or a
+        restarted one) adopts the dense published history IN ORDER —
+        every decision carries assignments/verdicts the rank must act
+        on, so skipping ahead would silently drop agreements."""
+        if family not in self._epochs:
+            self._epochs[family] = 0
+            os.makedirs(self._family_dir(family), exist_ok=True)
+        return self._epochs[family]
+
+    # -- voting ------------------------------------------------------------
+    def vote(self, family: str, value) -> None:
+        """Cast this rank's (immutable, idempotent) vote in the current
+        epoch. A second vote in the same epoch is ignored — re-voting a
+        DIFFERENT value in one epoch is a caller bug, not a protocol
+        feature."""
+        self.heartbeat()
+        ed = self._epoch_dir(family, self.epoch(family))
+        os.makedirs(ed, exist_ok=True)
+        path = os.path.join(ed, f"vote.{self.rank}")
+        if os.path.exists(path):
+            return
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "value": value,
+                       "t": time.time()}, f)
+        try:
+            os.link(tmp, path)      # exclusive: first vote wins
+        except FileExistsError:
+            pass
+        finally:
+            os.unlink(tmp)
+
+    def pending(self, family: str) -> bool:
+        """True when the current epoch already has activity (a vote or
+        a decision) — how a healthy rank notices, at its own step
+        boundary, that a peer opened a proposal it should join."""
+        ed = self._epoch_dir(family, self.epoch(family))
+        try:
+            return bool(os.listdir(ed))
+        except OSError:
+            return False
+
+    def _read_votes(self, ed: str) -> Dict[int, Any]:
+        votes: Dict[int, Any] = {}
+        try:
+            names = os.listdir(ed)
+        except OSError:
+            return votes
+        for n in names:
+            if not n.startswith("vote.") or ".tmp" in n:
+                continue
+            try:
+                with open(os.path.join(ed, n)) as f:
+                    d = json.load(f)
+                votes[int(d["rank"])] = d["value"]
+            except (OSError, ValueError, KeyError):
+                continue            # torn concurrent write: next poll
+        return votes
+
+    def _first_vote_t(self, ed: str) -> Optional[float]:
+        ts = []
+        try:
+            names = os.listdir(ed)
+        except OSError:
+            return None
+        for n in names:
+            if n.startswith("vote.") and ".tmp" not in n:
+                try:
+                    ts.append(os.path.getmtime(os.path.join(ed, n)))
+                except OSError:
+                    pass
+        return min(ts) if ts else None
+
+    def outcome(self, family: str,
+                reducer: Union[str, Callable] = "majority"
+                ) -> Optional[Decision]:
+        """Non-blocking: the current epoch's decision if one can be
+        adopted or published right now, else None. Adopting a decision
+        advances the epoch cursor, so the next vote opens the next
+        epoch."""
+        self.heartbeat()
+        e = self.epoch(family)
+        ed = self._epoch_dir(family, e)
+        dpath = os.path.join(ed, "decision.json")
+        dec = self._try_read_decision(dpath)
+        if dec is None and self._should_publish(family, ed):
+            dec = self._publish(family, e, ed, dpath, reducer)
+        if dec is not None:
+            self._epochs[family] = e + 1
+            self._note_adopted(family, e)
+        return dec
+
+    def decide(self, family: str, value, *,
+               reducer: Union[str, Callable] = "majority",
+               timeout_s: Optional[float] = None) -> Decision:
+        """Blocking all-gather vote: cast ``value``, poll until the
+        epoch's decision exists (publishing it if this rank becomes the
+        leader), adopt it. Raises ConsensusTimeout past ``timeout_s``."""
+        self.vote(family, value)
+        deadline = time.monotonic() + (self.timeout_s if timeout_s is None
+                                       else float(timeout_s))
+        while True:
+            dec = self.outcome(family, reducer)
+            if dec is not None:
+                return dec
+            if time.monotonic() > deadline:
+                raise ConsensusTimeout(
+                    f"{family}#{self.epoch(family)}: no decision within "
+                    f"timeout (alive={self.alive()})")
+            time.sleep(self.poll_s)
+
+    # -- history bounds ----------------------------------------------------
+    def _note_adopted(self, family: str, epoch: int) -> None:
+        """Publish this rank's adopted-epoch cursor and periodically
+        prune history every live rank is past: decisions are immutable
+        facts, but an agreement board that grows one directory per
+        round forever is a filesystem leak on a long-lived mesh.
+        Epochs newer than ``min(live cursors) - KEEP_EPOCHS`` survive
+        so a transiently-slow rank still catches up in order; a rank
+        dead past its lease that later revives may find its history
+        pruned — it was not a member anymore (documented residue)."""
+        fam = self._family_dir(family)
+        path = os.path.join(fam, f"cursor.{self.rank}")
+        tmp = path + f".tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                f.write(str(epoch))
+            os.replace(tmp, path)
+        except OSError:  # pragma: no cover - board dir vanished
+            return
+        if (epoch + 1) % KEEP_EPOCHS != 0:
+            return
+        cursors = []
+        for r in self.alive():
+            try:
+                with open(os.path.join(fam, f"cursor.{r}")) as f:
+                    cursors.append(int(f.read()))
+            except (OSError, ValueError):
+                return          # a live rank with no cursor: no prune
+        cut = min(cursors) - KEEP_EPOCHS + 1
+        try:
+            names = os.listdir(fam)
+        except OSError:  # pragma: no cover
+            return
+        for n in names:
+            if n.startswith("e") and len(n) == 7 and n[1:].isdigit() \
+                    and int(n[1:]) < cut:
+                shutil.rmtree(os.path.join(fam, n), ignore_errors=True)
+
+    # -- leader path -------------------------------------------------------
+    def _try_read_decision(self, dpath: str) -> Optional[Decision]:
+        try:
+            with open(dpath) as f:
+                return Decision.from_dict(json.load(f))
+        except OSError:
+            return None
+        except ValueError:          # pragma: no cover - torn mid-link
+            return None             # read (impossible: link is atomic)
+
+    def _should_publish(self, family: str, ed: str) -> bool:
+        live = self.alive()
+        if self.rank != min(live):
+            return False            # not the leader
+        votes = self._read_votes(ed)
+        if not votes:
+            return False            # nothing to decide from
+        if all(r in votes for r in live):
+            return True             # every live rank voted
+        t0 = self._first_vote_t(ed)
+        return t0 is not None and time.time() - t0 > self.window_s
+
+    def _publish(self, family: str, epoch: int, ed: str, dpath: str,
+                 reducer: Union[str, Callable]) -> Optional[Decision]:
+        votes = self._read_votes(ed)
+        red = REDUCERS[reducer] if isinstance(reducer, str) else reducer
+        live = self.alive()
+        missing = sorted(set(range(self.world)) - set(votes))
+        dec = Decision(family, epoch, red(dict(sorted(votes.items()))),
+                       dict(sorted(votes.items())), sorted(votes),
+                       missing, self.rank)
+        tmp = dpath + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(dec.to_dict(), f)
+        try:
+            os.link(tmp, dpath)     # exclusive publish: one winner
+        except FileExistsError:
+            dec = self._try_read_decision(dpath)   # adopt the winner's
+        finally:
+            os.unlink(tmp)
+        _note_decision(family, live)
+        return dec
+
+
+def _note_decision(family: str, live: List[int]) -> None:
+    """Profiler breadcrumbs — decisions are rare, counters are cheap."""
+    try:
+        from ..profiler.metrics import registry
+
+        registry().counter(f"consensus/decisions_{family}").add(1)
+        registry().gauge("consensus/live_ranks").set(float(len(live)))
+    except Exception:               # pragma: no cover - metrics must
+        pass                        # never break agreement

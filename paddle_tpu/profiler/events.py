@@ -62,9 +62,14 @@ __all__ = [
 #: bounded by verify ticks, i.e. at most one event per ~k emitted
 #: tokens, and only ever emitted by a spec-enabled engine — plain
 #: engines keep the strict O(1)-per-residency lifecycle rate.
+#: Disaggregated serving (ISSUE 13) adds two lifecycle-edge kinds:
+#: ``handoff_out`` (a prefill-group engine exported a held request's
+#: KV pages — attrs ``tokens``/``pages``/``bytes``) and ``handoff_in``
+#: (a decode-group engine imported them). Both are O(1) per request.
 EVENT_KINDS = (
     "submit", "admit", "prefix_hit", "cow_copy", "chunk",
     "first_token", "draft", "verify", "accept",
+    "handoff_out", "handoff_in",
     "preempt", "requeue", "finish", "rollback",
 )
 

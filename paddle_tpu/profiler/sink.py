@@ -51,6 +51,19 @@ so reusing a ``--sink-dir`` across runs keeps every file individually
 schema-valid and old post-mortems readable. The writer thread holds no
 jax state and issues no collectives — pure host I/O, safe next to XLA
 (SaveHandle rule).
+
+Multi-process safety (ISSUE 13): every metrics line and every event
+line carries the writing process's ``rank`` (the jax process index; 0
+single-process), and on a multi-process mesh ``enable_sink`` redirects
+each rank into its own ``rank<K>/`` subdirectory of the requested path
+— N processes NEVER append to one file, so there are no torn
+interleaved lines by construction (POSIX O_APPEND would interleave
+whole lines at best, and the per-file strictly-increasing seq contract
+cannot survive two writers at all). A mesh-level consumer globs
+``<dir>/rank*/events.jsonl`` and has the rank field on every line to
+group by; tools/check_sink_schema.py validates the field and flags a
+file whose rank stamps disagree (two writers sharing a file IS the
+bug the field exists to catch).
 """
 from __future__ import annotations
 
@@ -135,9 +148,13 @@ class MetricsSink:
                  metrics_file: str = "metrics.jsonl",
                  events_file: str = "events.jsonl",
                  prom_file: str = "metrics.prom",
-                 event_log: Optional[_events.EventLog] = None):
+                 event_log: Optional[_events.EventLog] = None,
+                 rank: Optional[int] = None):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
+        self.rank = _detect_rank() if rank is None else int(rank)
+        if self.rank < 0:
+            raise ValueError("rank must be >= 0")
         os.makedirs(directory, exist_ok=True)
         self.directory = directory
         self.interval_s = float(interval_s)
@@ -256,8 +273,11 @@ class MetricsSink:
             first = evs[0].seq if evs else cursor
             lost = max(0, first - self._cursor)
             if evs:
-                seg = "".join(json.dumps(ev.to_dict()) + "\n"
-                              for ev in evs)
+                # rank-stamped at write: events are process-local, so
+                # the writer's rank IS the event's rank
+                seg = "".join(
+                    json.dumps({**ev.to_dict(), "rank": self.rank})
+                    + "\n" for ev in evs)
                 with open(self._events_path, "a") as f:
                     f.write(seg)
             elif not os.path.exists(self._events_path):
@@ -268,8 +288,8 @@ class MetricsSink:
             # an I/O error above re-sends it on the next flush
             self._cursor = cursor
             line = {"ts": round(time.time(), 6), "reason": reason,
-                    "flush_seq": seq, "events_lost": lost,
-                    "metrics": snap}
+                    "rank": self.rank, "flush_seq": seq,
+                    "events_lost": lost, "metrics": snap}
             with open(self._metrics_path, "a") as f:
                 f.write(json.dumps(line) + "\n")
             tmp = self._prom_path + ".tmp"
@@ -314,12 +334,55 @@ def _atexit_close() -> None:  # pragma: no cover - interpreter teardown
             pass
 
 
-def enable_sink(directory: str, **kwargs) -> MetricsSink:
+def _detect_rank() -> int:
+    """The jax process index, without forcing backend bring-up when
+    jax.distributed was never initialized (0 then)."""
+    try:
+        from jax._src import distributed
+
+        if distributed.global_state.client is None:
+            return 0
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # pragma: no cover - exotic bring-up failure
+        return 0
+
+
+def _detect_world() -> int:
+    try:
+        from jax._src import distributed
+
+        if distributed.global_state.client is None:
+            return 1
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # pragma: no cover
+        return 1
+
+
+def enable_sink(directory: str, per_rank_subdir: Optional[bool] = None,
+                **kwargs) -> MetricsSink:
     """Create + start the process's active sink (closing any prior
-    one) and register the exit flush. kwargs ride to MetricsSink."""
+    one) and register the exit flush. kwargs ride to MetricsSink.
+
+    On a multi-process mesh each rank's artifacts land in
+    ``<directory>/rank<K>/`` (``per_rank_subdir``: None = auto, on
+    exactly when the jax world has more than one process) — N
+    processes never share a JSONL file, so no interleaved/torn lines
+    and the per-file seq contract survives."""
     global _active, _atexit_registered
     if _active is not None:
         _active.close("replaced")
+    rank = kwargs.get("rank")
+    if rank is None:
+        rank = _detect_rank()
+        kwargs["rank"] = rank
+    if per_rank_subdir is None:
+        per_rank_subdir = _detect_world() > 1
+    if per_rank_subdir:
+        directory = os.path.join(directory, f"rank{rank}")
     _active = MetricsSink(directory, **kwargs).start()
     if not _atexit_registered:
         atexit.register(_atexit_close)

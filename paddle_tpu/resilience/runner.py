@@ -32,11 +32,26 @@ Determinism contract: with a fixed ``ChaosPlan``, a run that is
 preempted, corrupted, and restarted produces the SAME per-step losses
 as an uninterrupted run (the chaos e2e test asserts this bitwise).
 
-Known limit (ROADMAP): rollback decisions are host-local. On a
-multi-host mesh every process computes the same verdict from the same
-replicated loss/grads, so they agree in lockstep — but there is no
-explicit cross-host agreement protocol yet for faults only one host
-sees (a local data-loader giving up, a local watchdog fire).
+Cross-host agreement (ISSUE 13 — retires the PR 2 residue): with
+``ResilienceConfig(consensus=...)`` set (a
+``distributed.consensus.Consensus`` over the job's shared board), the
+K-streak verdict becomes a MESH-WIDE agreement instead of a per-rank
+decision. The rank that hits the streak opens a ``resil`` vote (verdict
+``rollback`` — or ``abort`` when it has nothing restorable and no
+guard); healthy ranks notice the open round at their next step
+boundary (one directory poll per step — free next to a train step),
+drain their window, and join with verdict ``healthy`` plus their own
+partial bad-cursor streak. The published decision carries the UNION of
+every rank's poisoned cursors, so all ranks re-seed identically and
+the data timeline stays in lockstep; an agreed abort raises on every
+rank instead of leaving N-1 processes training into a dead mesh. A
+rank whose fault only IT can see (a local NaN injection, a local
+loader giving up) therefore takes the whole mesh back to the same
+committed step — chaos-tested on the real process mesh
+(tests/multihost/test_resilience_mesh.py). Leases ride a heartbeat
+thread for the duration of ``run`` (compile stalls must not mark the
+rank dead); checkpoints land at the same steps on every rank by the
+shared schedule, so the restored step agrees without being voted on.
 """
 from __future__ import annotations
 
@@ -52,6 +67,19 @@ from .preemption import PREEMPT_EXIT_CODE, PreemptionHandler
 from .watchdog import StepWatchdog
 
 __all__ = ["ResilienceConfig", "ResilientRunner", "RunResult"]
+
+
+def _resilience_reducer(votes):
+    """The ``resil`` vote's deterministic reduce: the mesh verdict is
+    the most severe any rank reported (abort > rollback > healthy) and
+    the poisoned-cursor set is the union — every rank must blocklist
+    every rank's bad batches or the data timelines diverge."""
+    verdicts = [v["verdict"] for v in votes.values()]
+    verdict = "abort" if "abort" in verdicts else (
+        "rollback" if "rollback" in verdicts else "healthy")
+    cursors = sorted({int(c) for v in votes.values()
+                      for c in v["bad_cursors"]})
+    return {"verdict": verdict, "bad_cursors": cursors}
 
 
 class ResilienceConfig:
@@ -71,6 +99,11 @@ class ResilienceConfig:
     raise_on_preempt:       raise PreemptedError after the preemption
                             checkpoint commits, instead of returning a
                             RunResult with preempted=True (default).
+    consensus:              a distributed.consensus.Consensus over the
+                            job's shared board — K-streak rollback and
+                            abort become mesh-wide agreements (module
+                            docstring). None (default) keeps the
+                            host-local single-process behavior.
 
     Async step pipeline (distributed/elastic.py docstring; README
     "Async step pipeline" has the guard/rollback interaction table):
@@ -115,9 +148,11 @@ class ResilienceConfig:
                  max_inflight: int = 2,
                  prefetch_depth: int = 0,
                  snapshot_async: bool = False,
-                 snapshot_chunk_bytes: Optional[int] = None):
+                 snapshot_chunk_bytes: Optional[int] = None,
+                 consensus=None):
         if bad_step_limit < 1:
             raise ValueError("bad_step_limit must be >= 1")
+        self.consensus = consensus
         self.bad_step_limit = int(bad_step_limit)
         self.watchdog_timeout_s = watchdog_timeout_s
         self.watchdog_first_grace_s = watchdog_first_grace_s if \
@@ -226,6 +261,38 @@ class ResilientRunner:
                      seed=cursor,          # deterministic per batch
                      on_retry=_note)
 
+    def _mesh_agree(self, verdict: str, cursors) -> dict:
+        """One ``resil`` agreement round (module docstring): cast this
+        rank's verdict + poisoned cursors, adopt the published
+        decision. Raises on an agreed abort — EVERY rank raises, which
+        is the point (no survivor trains into a dead mesh)."""
+        cons = self.config.consensus
+        reg = _registry()
+        dec = cons.decide(
+            "resil",
+            {"verdict": verdict,
+             "bad_cursors": sorted(int(c) for c in cursors)},
+            reducer=_resilience_reducer)
+        reg.counter("resilience/mesh_agreements").add(1)
+        if dec.value["verdict"] == "abort":
+            reg.counter("resilience/mesh_aborts").add(1)
+            from ..profiler import events as _pevents
+            from ..profiler import sink as _psink
+
+            _pevents.emit("rollback", mesh_abort=True,
+                          participants=dec.participants,
+                          missing=dec.missing)
+            _pevents.dump_flight("mesh-abort")
+            _psink.flush_active("rollback")
+            raise RuntimeError(
+                f"mesh-wide abort agreed (resil#{dec.epoch}): a rank "
+                f"hit its bad-step limit with no restorable checkpoint "
+                f"and no guard; participants={dec.participants} "
+                f"missing={dec.missing}")
+        if dec.value["verdict"] == "rollback":
+            reg.counter("resilience/mesh_rollbacks").add(1)
+        return dec.value
+
     def _rollback(self, bad_cursors, guarded: bool) -> int:
         """K consecutive bad steps: restore the newest readable
         committed checkpoint and blocklist the poisoned cursors.
@@ -287,6 +354,7 @@ class ResilientRunner:
         el = self.elastic
         tr = self.trainer
         chaos = self.chaos
+        cons = cfg.consensus
         reg = _registry()
         guarded = bool(getattr(tr, "guard_bad_steps", False))
         # deferred verdicts need the PER-STEP device scalar; a guarded
@@ -317,6 +385,10 @@ class ResilientRunner:
         preempted = False
         prefetcher = None
         prev_profiled_sync = getattr(tr, "profiled_step_sync", True)
+        if cons is not None:
+            # lease upkeep off-thread: a step that compiles for a
+            # minute must not read as a dead rank to the mesh
+            cons.start_heartbeat()
         try:
             start = el.resume()
             self._merge_resumed_skips()
@@ -369,10 +441,23 @@ class ResilientRunner:
                             if wd is not None:
                                 # the rollback's checkpoint restore is
                                 # as slow as the startup one — same
-                                # grace
+                                # grace (it also covers the consensus
+                                # wait for the other ranks to join)
                                 wd.pet(s,
                                        grace_s=cfg.watchdog_first_grace_s)
-                            back = self._rollback(bad_cursors, guarded)
+                            roll_cursors = bad_cursors
+                            if cons is not None:
+                                # THIS rank's verdict becomes the
+                                # mesh's: propose, wait for the ranks
+                                # that saw nothing wrong, adopt the
+                                # union cursor set (or the abort)
+                                verdict = "abort" if (
+                                    el.manager.latest_step() is None
+                                    and not guarded) else "rollback"
+                                dec = self._mesh_agree(verdict,
+                                                       bad_cursors)
+                                roll_cursors = dec["bad_cursors"]
+                            back = self._rollback(roll_cursors, guarded)
                             rollbacks += 1
                             consecutive_bad = 0
                             bad_cursors = []
@@ -423,7 +508,42 @@ class ResilientRunner:
                     first = True       # restored state may retrace
                 rolled[0] = None
 
+            def join_mesh_round() -> bool:
+                """A peer opened a ``resil`` round: drain the window
+                (our own streak may complete inside — that path joins
+                the SAME round as proposer), then join as healthy and
+                execute whatever the mesh agreed. Returns False when
+                the drain's own rollback already handled everything."""
+                nonlocal step, first, rollbacks, consecutive_bad, \
+                    bad_cursors
+                if not drain(0):
+                    return False
+                dec = self._mesh_agree("healthy", bad_cursors)
+                if dec["verdict"] != "rollback":
+                    return True
+                if wd is not None:
+                    wd.pet(step, grace_s=cfg.watchdog_first_grace_s)
+                back = self._rollback(dec["bad_cursors"], guarded)
+                rollbacks += 1
+                consecutive_bad = 0
+                bad_cursors = []
+                if prefetcher is not None:
+                    prefetcher.invalidate(el.data_cursor)
+                if back >= 0:
+                    for s2 in [s2 for s2 in losses if s2 >= back]:
+                        del losses[s2]
+                    step = back
+                    first = True
+                # back < 0: guarded with nothing committed — continue
+                # in place; the union cursors are blocklisted, so the
+                # next fetch skips them exactly like the proposer's
+                return True
+
             while True:
+                if cons is not None and cons.pending("resil"):
+                    if not join_mesh_round():
+                        resume_after_rollback()
+                    continue
                 if step >= total_steps:
                     if not drain(0):
                         resume_after_rollback()
@@ -532,6 +652,8 @@ class ResilientRunner:
                              preempted=preempted, rollbacks=rollbacks)
         finally:
             tr.profiled_step_sync = prev_profiled_sync
+            if cons is not None:
+                cons.stop_heartbeat()
             if prefetcher is not None:
                 prefetcher.stop()
             if wd is not None:

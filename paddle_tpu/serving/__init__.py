@@ -55,6 +55,8 @@ regression assertable (tests do).
 """
 from __future__ import annotations
 
+from .disagg import (DisaggServer, HandoffChannel, MeshSpec,  # noqa: F401
+                     route_requests)
 from .engine import Request, ServingConfig, ServingEngine  # noqa: F401
 from .paged_cache import (NULL_PAGE, PageAllocator, PagePool,  # noqa: F401
                           PrefixCache)
@@ -62,4 +64,5 @@ from .spec import DraftRunner, SpecConfig  # noqa: F401
 
 __all__ = ["ServingEngine", "ServingConfig", "Request", "SpecConfig",
            "DraftRunner", "PagePool", "PageAllocator", "PrefixCache",
-           "NULL_PAGE"]
+           "NULL_PAGE", "DisaggServer", "MeshSpec", "HandoffChannel",
+           "route_requests"]

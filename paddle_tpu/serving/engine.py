@@ -147,7 +147,20 @@ __all__ = ["ServingConfig", "ServingEngine", "Request", "SpecConfig"]
 
 #: engine ids stamped on every event (``eng`` attr) so co-resident
 #: engines' timelines don't alias in the process-global log
-_ENGINE_SEQ = iter(range(1 << 30))
+_ENGINE_SEQ = iter(range(1 << 20))
+
+
+def _proc_index() -> int:
+    """The jax process index (0 when jax.distributed never came up) —
+    folded into engine ids so co-resident engines ACROSS processes of a
+    multi-host mesh stop colliding in merged ``latency_table()`` views
+    (PR 8 noted the per-process sequence already reuses ids across
+    processes; rank-merged sinks made that visible). ONE detection
+    helper: the sink's, which guards against forcing backend bring-up
+    when jax.distributed was never initialized."""
+    from ..profiler.sink import _detect_rank
+
+    return _detect_rank()
 
 #: attention_kernel values: the unified mixed-row tick on the XLA
 #: gather spelling (measured default), the unified tick on the Pallas
@@ -224,6 +237,12 @@ class Request:
     key: np.ndarray                  # uint32[2] sampling key (absolute-pos folds)
     out: List[int] = field(default_factory=list)
     done: bool = False
+    #: prefill-group mode (ISSUE 13): stop after the prompt is fully
+    #: prefilled and the FIRST token sampled — the request's KV pages
+    #: are then exported to a decode-group engine instead of decoding
+    #: here. Survives preemption (the requeued victim re-prefills and
+    #: holds again).
+    hold: bool = False
     submit_t: float = 0.0
     queue_t: float = 0.0             # (re)queue anchor: submit, or requeue
     preempts: int = 0                # times this request was preempted
@@ -315,7 +334,9 @@ class ServingEngine:
         self._legacy = kernel == "legacy"
         self._impl = "pallas" if kernel.endswith("pallas") else "xla"
         self.attention_kernel = kernel
-        self._eng_id = next(_ENGINE_SEQ)
+        # process index folded in: ids stay unique when rank-tagged
+        # event streams from N processes are merged (ISSUE 13)
+        self._eng_id = (_proc_index() << 20) | next(_ENGINE_SEQ)
         # {site: (jitted fn, arg avals)} captured at first dispatch —
         # record_program_stats() re-lowers from these for cost analysis
         self._program_args: Dict[str, tuple] = {}
@@ -362,6 +383,10 @@ class ServingEngine:
         self._requests: Dict[int, Request] = {}
         self._next_rid = 0
         self._inflight: deque[_Inflight] = deque()
+        #: held requests whose first token has materialized — ready for
+        #: export_held() (disaggregated prefill group, ISSUE 13)
+        self._held_ready: set = set()
+        self._import_fn = None       # lazy jitted KV-import scatter
         self.max_inflight_seen = 0
         # device state
         self._last_tok = jnp.zeros((b_slots,), jnp.int32)
@@ -553,10 +578,20 @@ class ServingEngine:
                key: Optional[np.ndarray] = None,
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               top_p: Optional[float] = None) -> int:
+               top_p: Optional[float] = None,
+               hold_after_prefill: bool = False) -> int:
         """Queue one request. ``temperature``/``top_k``/``top_p``
         override the engine-global sampling params for this request
-        only (ignored under greedy decode). Returns its request id."""
+        only (ignored under greedy decode). Returns its request id.
+
+        ``hold_after_prefill`` puts the request in prefill-group mode
+        (ISSUE 13): the engine prefills the prompt (chunked, prefix-
+        cached, preemptible — all the normal machinery) and samples the
+        FIRST token, then parks the slot instead of decoding; the
+        coordinator exports the KV pages (``export_held``) to a decode
+        engine and releases the slot (``release_exported``). Held slots
+        never ride decode ticks, so a prefill-group engine's tick only
+        ever carries chunk rows."""
         p = np.asarray(prompt_ids, np.int32).reshape(-1)
         t0 = p.shape[0]
         cap = self.pool.slot_capacity
@@ -579,7 +614,8 @@ class ServingEngine:
         req = Request(rid=rid, prompt=p, max_new=int(max_new_tokens),
                       key=np.asarray(key, np.uint32),
                       submit_t=now, queue_t=now, orig_prompt_len=t0,
-                      temperature=temperature, top_k=top_k, top_p=top_p)
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      hold=bool(hold_after_prefill))
         self._requests[rid] = req
         self._queue.append(req)
         self._emit("submit", rid, prompt_tokens=t0,
@@ -655,6 +691,228 @@ class ServingEngine:
         self._requests = {rid: r for rid, r in self._requests.items()
                           if not r.done}
 
+    # ------------------------------------------------------------------
+    # KV handoff (ISSUE 13, serving/disagg.py): a prefill-group engine
+    # exports a held request's pages; a decode-group engine imports
+    # them. Pages move as raw pool bytes — int8 pools hand off int8
+    # values + their per-page scales, so the PR 12 byte cut applies to
+    # the transfer for free. The import writer is a jitted fixed-shape
+    # maintenance op like the COW copy (self._copy): it is NOT a
+    # hot-path dispatch site, so ``compiled_sites`` is unchanged and
+    # the decode group's tick keeps its decode-only fast path.
+    # ------------------------------------------------------------------
+    def held_ready(self) -> Tuple[int, ...]:
+        """rids submitted with ``hold_after_prefill`` whose prompt is
+        fully prefilled and first token materialized — exportable."""
+        return tuple(sorted(self._held_ready))
+
+    def export_held(self, rid: int) -> dict:
+        """The KV-handoff payload of a held-ready request: its current
+        prompt, remaining budget, sampling state, first token, and the
+        raw page content (+ scales when quantized) for the
+        ``ceil(t0 / page_size)`` pages holding the prompt's KV. The
+        slot stays resident until ``release_exported`` — export is
+        read-only, so a failed send can simply retry."""
+        if rid not in self._held_ready:
+            raise ValueError(f"request {rid} is not held-ready")
+        req = self._requests[rid]
+        slot = self._slot_rid.index(rid)
+        pages = list(self.pool._held[slot])
+        idx = np.asarray(pages, np.int32)
+        t0 = int(self._slot_len[slot])
+        assert t0 == req.prompt.shape[0], "held slot frontier != prompt"
+        payload = {
+            "prompt": np.asarray(req.prompt, np.int32),
+            "orig_prompt_len": int(req.orig_prompt_len),
+            "max_new": int(req.max_new),
+            "first_token": int(req.out[0]),
+            "key": np.asarray(req.key, np.uint32),
+            "n_tokens": t0,
+            "preempts": int(req.preempts),
+            # the receiving pool must store the SAME representation —
+            # int8 bytes dequantize only with their scales, and f32
+            # bytes are garbage reinterpreted as int8
+            "kv_dtype": str(np.dtype(self.pool.k.dtype)),
+            "k": np.asarray(self.pool.k[:, idx]),
+            "v": np.asarray(self.pool.v[:, idx]),
+        }
+        # per-request sampling overrides travel with the request (only
+        # when set — absent keys mean "decode rank's engine defaults",
+        # exactly like a local submit with None overrides)
+        if req.temperature is not None:
+            payload["temperature"] = float(req.temperature)
+        if req.top_k is not None:
+            payload["top_k"] = int(req.top_k)
+        if req.top_p is not None:
+            payload["top_p"] = float(req.top_p)
+        if self._quantized:
+            payload["k_scale"] = np.asarray(self.pool.k_scale[:, idx])
+            payload["v_scale"] = np.asarray(self.pool.v_scale[:, idx])
+        nbytes = sum(payload[k].nbytes for k in
+                     ("k", "v") + (("k_scale", "v_scale")
+                                   if self._quantized else ()))
+        reg = _registry()
+        reg.counter("serving/handoffs_out").add(1)
+        reg.counter("serving/handoff_bytes_out").add(nbytes)
+        self._emit("handoff_out", rid, slot=slot, tokens=t0,
+                   pages=len(pages), bytes=nbytes)
+        return payload
+
+    def release_exported(self, rid: int) -> None:
+        """Drop a held request after its payload shipped: publish the
+        fully-written prompt pages into the local prefix index (an
+        identical later prompt re-prefills for free — rank-local by
+        design), release the slot, and mark the request done HERE (the
+        decode group owns the visible finish)."""
+        if rid not in self._held_ready:
+            raise ValueError(f"request {rid} is not held-ready")
+        req = self._requests[rid]
+        slot = self._slot_rid.index(rid)
+        self._insert_prefix(slot, req.prompt, int(self._slot_len[slot]))
+        self.pool.release_slot(slot)
+        self._slot_rid[slot] = None
+        self._slot_len[slot] = 0
+        self._held_ready.discard(rid)
+        req.done = True
+
+    def admit_prefilled(self, payload: dict) -> Optional[int]:
+        """Decode-group admission of an exported payload: bind a free
+        slot, allocate the prompt's pages, write the transferred KV
+        (+ scales) into them, and seed the decode state exactly where a
+        local prefill finisher would have left it (frontier at the
+        prompt length, one token dispatched, ``last_tok`` = the first
+        token) — so the next unified tick is an ordinary decode row and
+        greedy output stays bitwise the single-host stream. Returns the
+        local rid, or None when no slot/pages are free right now (the
+        caller retries; imports never preempt residents — a transfer
+        must not evict committed decode work)."""
+        p = np.asarray(payload["prompt"], np.int32).reshape(-1)
+        t0 = p.shape[0]
+        max_new = int(payload["max_new"])
+        first_tok = int(payload["first_token"])
+        src_dtype = payload.get("kv_dtype")
+        if src_dtype is not None and \
+                str(np.dtype(str(src_dtype))) != \
+                str(np.dtype(self.pool.k.dtype)):
+            raise ValueError(
+                f"handoff payload carries {str(src_dtype)!r} KV pages "
+                f"but this pool stores {np.dtype(self.pool.k.dtype)!s} "
+                "— prefill and decode groups must serve the same "
+                "kv_dtype (silently casting would corrupt the cache)")
+        cap = self.pool.slot_capacity
+        if t0 + max_new - 1 > cap:
+            raise ValueError(
+                f"handoff needs {t0 + max_new - 1} cache positions; "
+                f"slot capacity is {cap}")
+        free = [s for s, r in enumerate(self._slot_rid) if r is None]
+        if not free:
+            return None
+        slot = free.pop()
+        n_pages = self.pool.pages_for(t0)
+        if n_pages != payload["k"].shape[1]:
+            raise ValueError(
+                f"payload carries {payload['k'].shape[1]} pages for a "
+                f"{t0}-token prompt; expected {n_pages}")
+        if not self.pool.grow_slot(slot, n_pages):
+            return None
+        rid = self._next_rid
+        self._next_rid += 1
+        now = time.perf_counter()
+        req = Request(rid=rid, prompt=p, max_new=max_new,
+                      key=np.asarray(payload["key"], np.uint32),
+                      out=[first_tok], submit_t=now, queue_t=now,
+                      orig_prompt_len=int(payload["orig_prompt_len"]),
+                      preempts=int(payload.get("preempts", 0)))
+        req.first_token_t = now
+        self._requests[rid] = req
+        self._slot_rid[slot] = rid
+        self._slot_len[slot] = t0
+        self._slot_prompt[slot] = t0
+        self._slot_dispatched[slot] = 1
+        self._slot_looked_up[slot] = True     # no prefill owed here
+        self._admit_seq += 1
+        self._slot_admit_seq[slot] = self._admit_seq
+        self._spec_reset(slot)
+        self._keys[slot] = req.key
+        c = self.config
+        self._temps[slot] = c.temperature if \
+            payload.get("temperature") is None else payload["temperature"]
+        self._topks[slot] = c.top_k if payload.get("top_k") is None \
+            else payload["top_k"]
+        self._topps[slot] = c.top_p if payload.get("top_p") is None \
+            else payload["top_p"]
+        self._write_imported_pages(slot, payload)
+        self._last_tok = self._last_tok.at[slot].set(first_tok)
+        nbytes = sum(payload[k].nbytes for k in
+                     ("k", "v") + (("k_scale", "v_scale")
+                                   if self._quantized else ()))
+        reg = _registry()
+        reg.counter("serving/handoffs_in").add(1)
+        reg.counter("serving/handoff_bytes_in").add(nbytes)
+        self._emit("handoff_in", rid, slot=slot, tokens=t0,
+                   pages=n_pages, bytes=nbytes)
+        # the transferred first token may already satisfy the stop
+        # conditions — finish without ever decoding
+        eos = self.config.eos_token_id
+        if eos is not None and first_tok == eos:
+            self._finish(slot, rid, reason="eos")
+        elif len(req.out) >= req.max_new:
+            self._finish(slot, rid, reason="max_new")
+        return rid
+
+    def _write_imported_pages(self, slot: int, payload: dict) -> None:
+        """One fixed-shape jitted scatter (padded to ``pages_per_slot``
+        with the null page, whose content is always masked and whose
+        scale pad is 0 — the null-scale pin survives) so imports of any
+        page count share one compiled program."""
+        pool = self.pool
+        pps = pool.pages_per_slot
+        pages = pool._held[slot]
+        n = len(pages)
+        dst = np.zeros(pps, np.int32)
+        dst[:n] = pages
+        shape = (pool.num_layers, pps, pool.page_size, pool.num_heads,
+                 pool.head_dim)
+        kbuf = np.zeros(shape, pool.k.dtype)
+        vbuf = np.zeros(shape, pool.v.dtype)
+        kbuf[:, :n] = payload["k"]
+        vbuf[:, :n] = payload["v"]
+        if self._import_fn is None:
+            if self._quantized:
+                def imp(kpool, vpool, kscale, vscale, kp, vp, ks, vs,
+                        d):
+                    return (kpool.at[:, d].set(kp),
+                            vpool.at[:, d].set(vp),
+                            kscale.at[:, d].set(ks),
+                            vscale.at[:, d].set(vs))
+
+                self._import_fn = jax.jit(imp,
+                                          donate_argnums=(0, 1, 2, 3))
+            else:
+                def imp(kpool, vpool, kp, vp, d):
+                    return (kpool.at[:, d].set(kp),
+                            vpool.at[:, d].set(vp))
+
+                self._import_fn = jax.jit(imp, donate_argnums=(0, 1))
+        with _quiet_donation():
+            if self._quantized:
+                sshape = (pool.num_layers, pps, pool.num_heads)
+                ksbuf = np.zeros(sshape, np.float32)
+                vsbuf = np.zeros(sshape, np.float32)
+                ksbuf[:, :n] = payload["k_scale"]
+                vsbuf[:, :n] = payload["v_scale"]
+                (pool.k, pool.v, pool.k_scale, pool.v_scale) = \
+                    self._import_fn(pool.k, pool.v, pool.k_scale,
+                                    pool.v_scale, kbuf, vbuf, ksbuf,
+                                    vsbuf, dst)
+                # the scale rows were just written by the import — the
+                # next tick's fresh-page reset must not zero them
+                for pg in pages:
+                    pool.claim_fresh(int(pg))
+            else:
+                pool.k, pool.v = self._import_fn(pool.k, pool.v, kbuf,
+                                                 vbuf, dst)
+
     def _tokens_done(self) -> int:
         return sum(len(r.out) for r in self._requests.values())
 
@@ -681,6 +939,13 @@ class ServingEngine:
                     _registry().histogram("serving/ttft_ms").observe(
                         (now - req.submit_t) * 1000.0)
                     self._emit("first_token", rid, slot=slot)
+                if req.hold:
+                    # prefill-group mode: the first token is the LAST
+                    # thing this engine computes for the request — park
+                    # it for export; eos/max_new stops are the decode
+                    # group's business (export_held ships the token)
+                    self._held_ready.add(rid)
+                    continue
                 eos = self.config.eos_token_id
                 # max_new counts tokens wanted since the LAST (re)queue —
                 # preemption moved earlier output into the prompt and
@@ -715,6 +980,7 @@ class ServingEngine:
                 reason: str = "max_new") -> None:
         req = self._requests[rid]
         req.done = True
+        self._held_ready.discard(rid)
         if self._slot_rid[slot] == rid:
             self._spec_reset(slot)
             # cache the finished sequence's pages (prompt AND generated
@@ -928,6 +1194,8 @@ class ServingEngine:
             if rid is None:
                 continue
             req = self._requests[rid]
+            if req.hold:
+                continue    # held slots stop at the prefill finisher's
             if not req.done and \
                     1 <= self._slot_dispatched[s] < req.max_new:
                 out.append(s)
@@ -964,6 +1232,10 @@ class ServingEngine:
         req.max_new -= len(req.out)
         req.out = []
         req.preempts += 1
+        # a held-ready victim loses its parked first token with the
+        # preemption (it moved into the prompt); the requeued cycle
+        # re-prefills and parks again
+        self._held_ready.discard(rid)
         req.queue_t = time.perf_counter()
         self._insert_prefix(victim, req.prompt, int(self._slot_len[victim]))
         self._queue.appendleft(req)

@@ -305,6 +305,16 @@ class PrefixCache:
             _registry().counter("cache_share/prefix_evictions").add(freed)
         return freed
 
+    def pages(self) -> List[int]:
+        """Every page id the index currently holds a refcount on (one
+        per node) — the prefix leg of ``PagePool.check_consistency``."""
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            out.append(node.page)
+            stack.extend(node.children.values())
+        return out
+
     def clear(self) -> int:
         """Drop every index entry (still-shared pages lose only the
         index's refcount and survive in their slots). Returns the
@@ -498,3 +508,50 @@ class PagePool:
         """Flush the prefix index (frees every unshared cached page);
         no-op without a prefix cache. Returns entries dropped."""
         return self.prefix.clear() if self.prefix is not None else 0
+
+    def check_consistency(self) -> List[str]:
+        """Audit the host-side invariants that every refcount edge —
+        grow/share/COW/shrink/release, prefix insert/evict, and the
+        ISSUE 13 export/import handoff path — must preserve. Returns a
+        list of violation strings (empty = consistent); the multihost
+        chaos tests assert a SURVIVOR's pool passes this after a peer
+        died mid-handoff."""
+        out = []
+        holds: Dict[int, int] = {}
+        for slot, held in enumerate(self._held):
+            row = self.tables[slot]
+            for i, pg in enumerate(held):
+                holds[pg] = holds.get(pg, 0) + 1
+                if int(row[i]) != pg:
+                    out.append(f"slot {slot} table[{i}]={int(row[i])} "
+                               f"!= held page {pg}")
+            for i in range(len(held), self.pages_per_slot):
+                if int(row[i]) != NULL_PAGE:
+                    out.append(f"slot {slot} table[{i}]="
+                               f"{int(row[i])} past the held prefix")
+            if NULL_PAGE in held:
+                out.append(f"slot {slot} holds the null page")
+        if self.prefix is not None:
+            for pg in self.prefix.pages():
+                holds[pg] = holds.get(pg, 0) + 1
+        alloc = self.allocator
+        for pg, want in holds.items():
+            have = alloc.refcount(pg)
+            if have != want:
+                out.append(f"page {pg} refcount {have} != {want} "
+                           "(table rows + prefix index)")
+            if pg in alloc._free_set:
+                out.append(f"page {pg} is held AND on the free list")
+        for pg in alloc._ref:
+            if pg not in holds:
+                out.append(f"page {pg} allocated (refcount "
+                           f"{alloc._ref[pg]}) but held by no slot or "
+                           "index entry")
+        n_booked = len(alloc._free) + len(alloc._ref)
+        if n_booked != alloc.num_pages - 1:
+            out.append(f"free ({len(alloc._free)}) + allocated "
+                       f"({len(alloc._ref)}) != allocatable "
+                       f"({alloc.num_pages - 1})")
+        if set(alloc._free) != alloc._free_set:
+            out.append("free list and free set disagree")
+        return out

@@ -1,0 +1,57 @@
+"""distributed.consensus on REAL processes: agreement byte-equality
+across ranks, multi-round epochs, and the kill-one decision (the
+board's lease-based liveness doing the job the coordination service's
+collectives cannot — a dead peer is an input here, not a hang)."""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+import mp_mesh  # noqa: E402
+
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "worker_consensus.py")
+
+
+def _decisions(tmp_path, rank):
+    with open(tmp_path / f"decisions.{rank}") as f:
+        return json.load(f)
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_all_ranks_adopt_identical_decisions(tmp_path, nprocs):
+    res = mp_mesh.launch(nprocs, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=240)
+    assert res.ok, res.tail()
+    docs = [_decisions(tmp_path, r) for r in range(nprocs)]
+    for d in docs[1:]:
+        assert d == docs[0]          # byte-identical adopted decisions
+    pick = docs[0]["pick"]
+    assert pick["participants"] == list(range(nprocs))
+    assert pick["missing"] == []
+    merge = docs[0]["merge"]
+    assert merge["value"] == sorted(
+        [r for r in range(nprocs)] + [100 + r for r in range(nprocs)])
+
+
+def test_kill_one_rank_before_voting_survivors_decide(tmp_path):
+    """Rank 1 is killed BEFORE casting any vote: the survivors' leader
+    publishes once the corpse's lease expires, the decision names it
+    missing, and every survivor adopts the same record."""
+    res = mp_mesh.launch(3, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=240,
+                         chaos="kill:1:pre_vote",
+                         expect_fail_ranks=(1,))
+    assert res.ok, res.tail()
+    d0 = _decisions(tmp_path, 0)
+    d2 = _decisions(tmp_path, 2)
+    assert d0 == d2
+    assert d0["pick"]["missing"] == [1]
+    assert d0["pick"]["participants"] == [0, 2]
+    assert d0["merge"]["value"] == [0, 2, 100, 102]
+    assert not (tmp_path / "decisions.1").exists()

@@ -1,0 +1,54 @@
+"""The mesh harness itself: N real processes with
+``jax.distributed.initialize`` on CPU, KV-store exchange, and the
+kill-one chaos hook (ISSUE 13 acceptance: N=2 and N=4 real processes +
+the kill-one chaos test, under the ``multihost`` marker)."""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+import mp_mesh  # noqa: E402
+
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "worker_mesh.py")
+
+
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_mesh_comes_up_with_real_processes(tmp_path, nprocs):
+    res = mp_mesh.launch(nprocs, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=240)
+    assert res.ok, res.tail()
+    for r in range(nprocs):
+        assert (tmp_path / f"ok.{r}").exists(), res.tail()
+
+
+def test_kill_one_process_survivors_finish(tmp_path):
+    """Chaos: rank 1 of 2 dies (``os._exit(137)``, no cleanup) right
+    after bring-up; the survivor completes its KV-store work and exits
+    cleanly. This is the harness-level guarantee every kill-one test
+    above it builds on."""
+    res = mp_mesh.launch(2, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=240,
+                         chaos="kill:1:after_up",
+                         expect_fail_ranks=(1,))
+    assert res.ok, res.tail()
+    assert res.returncodes[1] == mp_mesh.KILL_EXIT
+    assert (tmp_path / "ok.0").exists()
+    assert not (tmp_path / "ok.1").exists()
+    assert "chaos-killed" in res.log(1)
+
+
+def test_hang_one_process_does_not_block_peers_forever(tmp_path):
+    """Chaos hang: rank 1 wedges for longer than the test window; the
+    launcher's timeout reaps the mesh and reports honestly (a hang is
+    a FAILURE unless the workload routes around it — serving's
+    lease-based paths do; the raw mesh worker does not)."""
+    res = mp_mesh.launch(2, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=20,
+                         chaos="hang:1:after_up:600")
+    assert not res.ok
+    assert res.timed_out

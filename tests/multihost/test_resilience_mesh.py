@@ -1,0 +1,45 @@
+"""Cross-host rollback agreement on REAL processes (ISSUE 13
+satellite — retires the PR 2 "no cross-host agreement on
+rollback/abort" residue): a NaN streak only rank 1 can see takes BOTH
+ranks back to the same committed step with the union cursor blocklist,
+and the replicated runs finish with bitwise-identical loss curves."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+import mp_mesh  # noqa: E402
+
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "worker_resilience.py")
+
+
+def test_one_rank_nan_triggers_agreed_mesh_rollback(tmp_path):
+    res = mp_mesh.launch(2, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=600,
+                         host_devices=2)     # dp=2 trainer per rank
+    assert res.ok, res.tail()
+    runs = []
+    for r in range(2):
+        with open(tmp_path / f"run.{r}.json") as f:
+            runs.append(json.load(f))
+    # BOTH ranks rolled back exactly once — the healthy rank because
+    # the mesh agreed, not because it saw anything wrong itself
+    assert [d["rollbacks"] for d in runs] == [1, 1]
+    # the union cursor blocklist is identical (rank 0 contributed none)
+    assert runs[0]["skips"] == runs[1]["skips"] == [3, 4]
+    # replicated trainers + agreed rollback target + union re-seed =>
+    # bitwise loss lockstep, no NaN anywhere
+    l0 = [runs[0]["losses"][k] for k in sorted(runs[0]["losses"],
+                                               key=int)]
+    l1 = [runs[1]["losses"][k] for k in sorted(runs[1]["losses"],
+                                               key=int)]
+    assert len(l0) == len(l1) > 0
+    assert np.isfinite(l0).all() and np.isfinite(l1).all()
+    np.testing.assert_array_equal(l0, l1)

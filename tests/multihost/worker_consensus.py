@@ -1,0 +1,44 @@
+"""Consensus worker: real processes vote on the shared board; each
+rank writes its adopted decision so the test can assert mesh-wide
+agreement byte-for-byte. The ``pre_vote`` chaos point kills one rank
+BEFORE it ever votes — survivors must still decide (lease expiry) and
+name the corpse missing.
+
+argv: out_dir
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir, "tools"))
+import mp_mesh  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    rank, world = mp_mesh.init()
+    from paddle_tpu.distributed.consensus import Consensus
+
+    cons = Consensus(os.path.join(out_dir, "board"), rank, world,
+                     lease_s=1.5, timeout_s=120.0)
+    mp_mesh.barrier("up")
+    mp_mesh.chaos_point("pre_vote")
+    # round 0: a majority vote over rank-dependent values
+    d0 = cons.decide("pick", {"weight": rank % 2}, reducer="majority")
+    # round 1: a union over rank-local "bad cursor" style lists
+    d1 = cons.decide("merge", [rank, 100 + rank], reducer="union")
+    with open(os.path.join(out_dir, f"decisions.{rank}"), "w") as f:
+        json.dump({"pick": d0.to_dict(), "merge": d1.to_dict()}, f)
+    ok = os.path.join(out_dir, f"ok.{rank}")
+    if rank == 0:
+        spec = mp_mesh.chaos_spec()
+        dead = {spec[1]} if spec and spec[0] == "kill" else set()
+        peers = [os.path.join(out_dir, f"ok.{r}")
+                 for r in range(1, world) if r not in dead]
+        mp_mesh.finish_last(ok, peers)
+    mp_mesh.finish(ok)
+
+
+if __name__ == "__main__":
+    main()

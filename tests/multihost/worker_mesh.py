@@ -1,0 +1,46 @@
+"""Mesh bring-up worker: N real processes initialize the jax
+coordination service, prove rank identity, exchange values through the
+KV store (the 0.4.37-safe cross-process data path — compiled CPU
+collectives are unimplemented on this jax, see tools/mp_mesh.py), and
+optionally die at the ``after_up`` chaos point.
+
+argv: out_dir
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir, "tools"))
+import mp_mesh  # noqa: E402
+
+
+def main():
+    out_dir = sys.argv[1]
+    rank, world = mp_mesh.init()
+    import jax
+
+    assert jax.process_index() == rank
+    assert jax.process_count() == world
+    assert int(os.environ["PADDLE_TRAINER_ID"]) == rank
+    mp_mesh.kv_set(f"mesh/{rank}", f"v{rank * rank}")
+    mp_mesh.barrier("up")
+    mp_mesh.chaos_point("after_up")
+    # all-gather through the KV store: every surviving rank must see
+    # every value that was set BEFORE the barrier
+    for r in range(world):
+        spec = mp_mesh.chaos_spec()
+        if spec and spec[0] == "kill" and spec[1] == r:
+            continue                  # the corpse may not have set it
+        assert mp_mesh.kv_get(f"mesh/{r}") == f"v{r * r}", r
+    ok = os.path.join(out_dir, f"ok.{rank}")
+    if rank == 0:
+        spec = mp_mesh.chaos_spec()
+        dead = {spec[1]} if spec and spec[0] == "kill" else set()
+        peers = [os.path.join(out_dir, f"ok.{r}")
+                 for r in range(1, world) if r not in dead]
+        mp_mesh.finish_last(ok, peers)
+    mp_mesh.finish(ok)
+
+
+if __name__ == "__main__":
+    main()

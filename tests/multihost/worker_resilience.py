@@ -1,0 +1,99 @@
+"""Resilience mesh worker: 2 real processes run ResilientRunner with
+the consensus board wired in; rank 1's chaos plan injects NaNs only IT
+can see. The agreed outcome must be a MESH-WIDE rollback: both ranks
+restore the same committed step, blocklist the union cursor set, and
+finish with bitwise-identical loss curves (the trainers are replicated
+— same seed, same data; pacing stands in for the per-step DP allreduce
+barrier this jax cannot run across CPU processes).
+
+argv: out_dir
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir, "tools"))
+import mp_mesh  # noqa: E402
+
+TOTAL_STEPS = 7
+NAN_CURSORS = {3, 4}
+
+
+def main():
+    out_dir = sys.argv[1]
+    # env-only ranks: this worker's device compute is rank-LOCAL
+    # (replicated trainers) and 0.4.37's distributed runtime would
+    # route even local sharded device_put / checkpoint barriers into
+    # unimplemented CPU collectives — see mp_mesh.init_env_only
+    rank, world = mp_mesh.init_env_only()
+    assert world == 2
+    import numpy as np
+    import paddle_tpu as paddle
+    import jax
+    from paddle_tpu.distributed.consensus import Consensus
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.hybrid import HybridPipelineTrainer
+    from paddle_tpu.distributed.mesh import create_mesh
+    from paddle_tpu.models import GPT, GPTConfig
+    from paddle_tpu.resilience import (ResilienceConfig,
+                                       ResilientRunner, chaos)
+
+    paddle.seed(11)                  # REPLICATED weights across ranks
+    net = GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=16))
+    opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+    mesh = create_mesh({"dp": 2}, jax.devices()[:2])
+    tr = HybridPipelineTrainer(net, opt, DistributedStrategy(), mesh,
+                               n_micro=1, guard_bad_steps=True)
+    cons = Consensus(os.path.join(out_dir, "board"), rank, world,
+                     lease_s=3.0, timeout_s=240.0)
+
+    def batch(cursor):
+        rng = np.random.RandomState(1000 + cursor)
+        return (rng.randint(0, 128, (2, 16)).astype(np.int32),)
+
+    prog = os.path.join(out_dir, "prog")
+    os.makedirs(prog, exist_ok=True)
+
+    def gated(cursor):
+        """Replicated-data pacing: never run more than 2 cursors ahead
+        of the peer (what the per-step DP allreduce would enforce);
+        bail out on an open resil round — the imminent agreed rollback
+        makes pacing moot."""
+        with open(os.path.join(prog, f"p.{rank}"), "w") as f:
+            f.write(str(cursor))
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                peer = int(open(os.path.join(
+                    prog, f"p.{1 - rank}")).read())
+            except (OSError, ValueError):
+                peer = -1
+            if peer >= cursor - 2 or cons.pending("resil"):
+                break
+            time.sleep(0.01)
+        return batch(cursor)
+
+    plan = chaos.ChaosPlan(nan_cursors=NAN_CURSORS) if rank == 1 \
+        else None
+    runner = ResilientRunner(
+        tr, os.path.join(out_dir, f"ckpt{rank}"), save_interval=3,
+        config=ResilienceConfig(bad_step_limit=2, consensus=cons),
+        chaos=plan)
+    res = runner.run(gated, TOTAL_STEPS)
+    assert res.completed
+    with open(os.path.join(out_dir, f"run.{rank}.json"), "w") as f:
+        json.dump({"rank": rank, "rollbacks": res.rollbacks,
+                   "skips": sorted(runner._skips),
+                   "losses": {str(s): res.losses[s]
+                              for s in sorted(res.losses)}}, f)
+    ok = os.path.join(out_dir, f"ok.{rank}")
+    if rank == 0:
+        mp_mesh.finish_last(ok, [os.path.join(out_dir, "ok.1")])
+    mp_mesh.finish(ok)
+
+
+if __name__ == "__main__":
+    main()

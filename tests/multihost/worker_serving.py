@@ -1,0 +1,130 @@
+"""Disaggregated-serving worker: a real 2-process mesh with rank 1 as
+the prefill group and rank 0 as the decode group (rank 0 hosts the jax
+coordination service, and the chaos target must be a non-coordinator
+rank — tools/mp_mesh.py docstring).
+
+Modes (argv: out_dir mode):
+  run    — full mesh: both ranks drive DisaggServer.run; the decode
+           rank asserts every output is BITWISE its own single-host
+           reference engine's stream; both audit their pool shard.
+  chaos  — kill-one-mid-handoff: the mesh is launched with
+           ``kill:1:pre_handoff_commit``; rank 1 dies BETWEEN writing
+           its first payload's bytes and the atomic rename. Rank 0
+           (survivor) must: import NOTHING torn (zero handoffs
+           received), finish its directly-routed requests bitwise,
+           and pass the refcount-consistency audit.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir, "tools"))
+import mp_mesh  # noqa: E402
+
+PROMPT_LENS = (8, 16, 12, 20)
+MAX_NEW = 6
+CFG = dict(num_slots=2, page_size=8, pages_per_slot=4,
+           prefill_chunk=8)
+
+
+def build():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(0)
+    net = gpt_tiny(initializer_range=0.2)
+    net.eval()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 128, (t,)).astype(np.int32)
+               for t in PROMPT_LENS]
+    return net, prompts
+
+
+def reference(net, prompts):
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+
+    ref = ServingEngine(net, ServingConfig(**CFG))
+    rids = [ref.submit(p, MAX_NEW) for p in prompts]
+    out = ref.run()
+    return {i: out[r] for i, r in enumerate(rids)}
+
+
+def main():
+    out_dir, mode = sys.argv[1], sys.argv[2]
+    rank, world = mp_mesh.init()
+    assert world == 2
+    import numpy as np
+    from paddle_tpu.serving import (DisaggServer, HandoffChannel,
+                                    MeshSpec, ServingConfig)
+
+    net, prompts = build()
+    if mode == "chaos" and rank == 1:
+        # die between the payload bytes landing and the atomic rename
+        HandoffChannel.pre_commit = staticmethod(
+            lambda: mp_mesh.chaos_point("pre_handoff_commit"))
+    srv = DisaggServer(net, ServingConfig(**CFG),
+                       MeshSpec(rank, world, prefill_ranks=(1,)),
+                       os.path.join(out_dir, "shared"), lease_s=2.0)
+    for p in prompts:
+        srv.submit(p, MAX_NEW)
+    mp_mesh.barrier("engines-up")
+
+    ok = os.path.join(out_dir, f"ok.{rank}")
+    if mode == "run":
+        srv.run(timeout_s=240.0)
+        if rank == 0:                 # the decode rank owns results
+            want = reference(net, prompts)
+            got = srv.results()
+            assert sorted(got) == sorted(want), (sorted(got),
+                                                 sorted(want))
+            for gid in want:
+                np.testing.assert_array_equal(got[gid], want[gid])
+            assert srv.handoffs_recv > 0
+        else:
+            assert srv.handoffs_sent > 0
+        assert srv.check_consistency() == []
+        srv.write_results(os.path.join(out_dir, f"results.{rank}.json"))
+        if rank == 0:
+            mp_mesh.finish_last(ok, [os.path.join(out_dir, "ok.1")])
+        mp_mesh.finish(ok)
+
+    # ---- chaos mode ----
+    if rank == 1:
+        # drive until the chaos point fires inside the first export
+        import time as _t
+
+        deadline = _t.monotonic() + 120
+        while _t.monotonic() < deadline:
+            srv.step()
+        raise SystemExit("chaos kill never fired on rank 1")
+    # rank 0, the survivor: its direct (short) requests must finish
+    # bitwise; nothing torn may arrive from the corpse
+    import time
+
+    direct = [i for i, p in enumerate(prompts)
+              if len(p) <= srv.engine.prefill_chunk]
+    deadline = time.monotonic() + 75     # inside the jax fatal-poll
+    while time.monotonic() < deadline:   # window (mp_mesh docstring)
+        srv.step()
+        if all(g in srv.results() for g in direct):
+            break
+        time.sleep(0.01)
+    got = srv.results()
+    want = reference(net, prompts)
+    assert sorted(got) == sorted(direct), (sorted(got), direct)
+    for gid in direct:
+        np.testing.assert_array_equal(got[gid], want[gid])
+    assert srv.handoffs_recv == 0        # no torn/partial import
+    assert srv.check_consistency() == [], srv.check_consistency()
+    # the corpse's half-written payload is an ignorable .tmp, never a
+    # consumable .npz addressed to us
+    hdir = os.path.join(out_dir, "shared", "handoff")
+    leftovers = [n for n in os.listdir(hdir)
+                 if n.endswith("-to0.npz")]
+    assert leftovers == [], leftovers
+    mp_mesh.finish(ok)
+
+
+if __name__ == "__main__":
+    main()

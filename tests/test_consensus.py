@@ -1,0 +1,260 @@
+"""distributed.consensus: the shared-board all-gather vote with
+epoch/lease semantics (ISSUE 13). Pure host-side — these tests run N
+logical ranks inside one process (threads where concurrency matters),
+which exercises every protocol edge the real N-process mesh tests
+(tests/multihost/) then re-pin with actual killed processes."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.consensus import (Consensus, ConsensusTimeout,
+                                              Decision, REDUCERS)
+
+
+def _ranks(tmp_path, world, **kw):
+    kw.setdefault("lease_s", 0.4)
+    kw.setdefault("poll_s", 0.005)
+    kw.setdefault("timeout_s", 10.0)
+    return [Consensus(str(tmp_path), r, world, **kw)
+            for r in range(world)]
+
+
+def _decide_all(cs, family, values, reducer="majority"):
+    """Drive every rank's decide() concurrently; return the decisions
+    in rank order."""
+    out = [None] * len(cs)
+    errs = []
+
+    def run(i):
+        try:
+            out[i] = cs[i].decide(family, values[i], reducer=reducer)
+        except Exception as e:       # pragma: no cover - failure detail
+            errs.append((i, e))
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(len(cs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    return out
+
+
+class TestSingleRank:
+    def test_world1_decides_immediately(self, tmp_path):
+        c = Consensus(str(tmp_path), 0, 1)
+        d = c.decide("admit", {"load": 3}, reducer="first")
+        assert d.value == {"load": 3}
+        assert d.epoch == 0 and d.participants == [0] and not d.missing
+        d2 = c.decide("admit", {"load": 4}, reducer="first")
+        assert d2.epoch == 1 and d2.value == {"load": 4}
+
+    def test_epochs_are_per_family(self, tmp_path):
+        c = Consensus(str(tmp_path), 0, 1)
+        assert c.decide("a", 1, reducer="first").epoch == 0
+        assert c.decide("b", 2, reducer="first").epoch == 0
+        assert c.decide("a", 3, reducer="first").epoch == 1
+
+    def test_bad_args_raise(self, tmp_path):
+        with pytest.raises(ValueError):
+            Consensus(str(tmp_path), 2, 2)
+        with pytest.raises(ValueError):
+            Consensus(str(tmp_path), 0, 0)
+        c = Consensus(str(tmp_path), 0, 1)
+        with pytest.raises(ValueError):
+            c.vote("../escape", 1)
+
+
+class TestReducers:
+    def test_builtin_reducers(self):
+        votes = {0: 3, 1: 1, 2: 3}
+        assert REDUCERS["min"](votes) == 1
+        assert REDUCERS["max"](votes) == 3
+        assert REDUCERS["majority"](votes) == 3
+        assert REDUCERS["first"](votes) == 3
+        assert REDUCERS["any"]({0: False, 1: True}) is True
+        assert REDUCERS["all"]({0: False, 1: True}) is False
+        assert REDUCERS["union"]({0: [3, 1], 1: [1, 7]}) == [1, 3, 7]
+
+    def test_majority_tie_breaks_to_lowest_rank(self):
+        # 2-2 tie: rank 0's value wins deterministically
+        votes = {0: "a", 1: "b", 2: "b", 3: "a"}
+        assert REDUCERS["majority"](votes) == "a"
+
+
+class TestAgreement:
+    def test_three_ranks_agree_and_carry_all_votes(self, tmp_path):
+        cs = _ranks(tmp_path, 3)
+        decs = _decide_all(cs, "admit", [10, 20, 10])
+        for d in decs:
+            assert d.value == 10 and d.epoch == 0
+            assert d.votes == {0: 10, 1: 20, 2: 10}
+            assert d.participants == [0, 1, 2] and d.missing == []
+        # the published record is one immutable file all ranks read
+        assert decs[0].to_dict() == decs[1].to_dict() == decs[2].to_dict()
+
+    def test_epoch_advances_in_lockstep(self, tmp_path):
+        cs = _ranks(tmp_path, 2)
+        for e in range(3):
+            decs = _decide_all(cs, "admit", [e, e + 100], reducer="min")
+            assert all(d.epoch == e and d.value == e for d in decs)
+        assert all(c.epoch("admit") == 3 for c in cs)
+
+    def test_callable_reducer(self, tmp_path):
+        cs = _ranks(tmp_path, 2)
+
+        def spread(votes):
+            return max(votes.values()) - min(votes.values())
+
+        decs = _decide_all(cs, "x", [3, 10], reducer=spread)
+        assert all(d.value == 7 for d in decs)
+
+    def test_late_rank_adopts_published_decision(self, tmp_path):
+        """A rank that slept through the vote window still converges:
+        it reads the immutable decision (and its vote goes unmissed in
+        the record)."""
+        cs = _ranks(tmp_path, 2, lease_s=0.2, window_s=0.3)
+        # rank 1 heartbeats (alive) but never votes: leader publishes
+        # at window expiry with rank 1 missing
+        stop = threading.Event()
+
+        def beat():
+            while not stop.is_set():
+                cs[1].heartbeat()
+                time.sleep(0.05)
+
+        t = threading.Thread(target=beat)
+        t.start()
+        try:
+            d0 = cs[0].decide("admit", 5)
+        finally:
+            stop.set()
+            t.join()
+        assert d0.value == 5 and d0.missing == [1]
+        # the latecomer now adopts the same epoch-0 decision
+        d1 = cs[1].decide("admit", 99)
+        assert d1.epoch == 0 and d1.value == 5
+        assert d1.to_dict() == d0.to_dict()
+
+    def test_vote_is_idempotent_first_wins(self, tmp_path):
+        c = Consensus(str(tmp_path), 0, 1)
+        c.vote("t", "first")
+        c.vote("t", "second")        # ignored: immutable per epoch
+        d = c.outcome("t", reducer="first")
+        assert d is not None and d.value == "first"
+
+
+class TestLiveness:
+    def test_dead_rank_is_dropped_after_lease_expiry(self, tmp_path):
+        """Kill-one semantics: rank 1 votes never; its lease (created
+        at init) expires; the survivors decide without it and name it
+        missing."""
+        cs = _ranks(tmp_path, 3, lease_s=0.25)
+        t0 = time.monotonic()
+        decs = _decide_all(cs[:2], "admit", [[1], [2]], reducer="union")
+        assert time.monotonic() - t0 < 5.0
+        for d in decs:
+            assert d.missing == [2]
+            assert d.participants == [0, 1]
+
+    def test_leader_death_hands_publication_to_next_rank(self, tmp_path):
+        """Rank 0 votes then dies (stops heartbeating): once its lease
+        goes stale rank 1 becomes leader, publishes with rank 0's vote
+        included, and the decision is still the deterministic reduce
+        over BOTH votes."""
+        cs = _ranks(tmp_path, 2, lease_s=0.25)
+        cs[0].vote("admit", 7)       # then silence: never polls again
+        time.sleep(0.35)             # rank 0's lease expires
+        d = cs[1].decide("admit", 9, reducer="min")
+        assert d.value == 7          # the dead rank's vote still counts
+        assert d.leader == 1 and d.participants == [0, 1]
+
+    def test_follower_times_out_when_leader_never_decides(self, tmp_path):
+        """The honest timeout: the FOLLOWER cannot publish while the
+        leader's lease stays fresh, and the leader never votes or
+        publishes (wedged, not dead) with a vote window far out — the
+        follower surfaces ConsensusTimeout instead of fabricating an
+        agreement."""
+        cs = _ranks(tmp_path, 2, lease_s=30.0, window_s=60.0,
+                    timeout_s=0.5)
+        with pytest.raises(ConsensusTimeout):
+            cs[1].decide("x", 1)
+
+    def test_provably_dead_sole_peer_does_not_block(self, tmp_path):
+        """A dead peer is an INPUT: once its lease is gone the
+        survivor decides alone (kill-one-of-2 semantics — agreement
+        must be reachable exactly when the mesh is unhealthy)."""
+        cs = _ranks(tmp_path, 2, lease_s=0.2, window_s=60.0)
+        os.unlink(os.path.join(str(tmp_path), "lease.1"))
+        d = cs[0].decide("x", 1, reducer="first")
+        assert d.value == 1 and d.missing == [1]
+
+    def test_window_expiry_decides_without_silent_live_rank(self, tmp_path):
+        """An alive-but-not-participating rank bounds the wait: the
+        leader publishes at window expiry, names it missing."""
+        cs = _ranks(tmp_path, 2, lease_s=10.0, window_s=0.2)
+        # rank 1's lease stays fresh (init just touched it; lease_s is
+        # long) but it never votes
+        d = cs[0].decide("x", 4)
+        assert d.value == 4 and d.missing == [1]
+
+
+class TestPendingAndOutcome:
+    def test_pending_signals_open_proposal(self, tmp_path):
+        cs = _ranks(tmp_path, 2)
+        assert not cs[1].pending("rollback")
+        cs[0].vote("rollback", {"verdict": "rollback", "step": 4})
+        assert cs[1].pending("rollback")
+        # joining completes the round; afterwards nothing is pending
+        d = cs[1].decide("rollback", {"verdict": "healthy"},
+                         reducer="first")
+        assert d.value["verdict"] == "rollback"
+        assert cs[0].decide("rollback", None).epoch == 0  # adopts too
+        assert not cs[1].pending("rollback")
+
+    def test_outcome_is_nonblocking(self, tmp_path):
+        cs = _ranks(tmp_path, 2)
+        cs[0].vote("x", 1)
+        t0 = time.monotonic()
+        assert cs[0].outcome("x") is None     # rank 1 still owes a vote
+        assert time.monotonic() - t0 < 0.2
+
+    def test_publish_race_single_winner(self, tmp_path):
+        """Both ranks believe they lead (pathological lease flap): the
+        exclusive link means one decision file wins and both adopt it."""
+        cs = _ranks(tmp_path, 2)
+        cs[0].vote("x", "zero")
+        cs[1].vote("x", "one")
+        d0 = cs[0].outcome("x", reducer="first")
+        d1 = cs[1].outcome("x", reducer="first")
+        assert d0 is not None and d1 is not None
+        assert d0.to_dict() == d1.to_dict()
+
+    def test_decision_roundtrip(self):
+        d = Decision("f", 3, [1, 2], {0: [1], 1: [2]}, [0, 1], [2], 0)
+        assert Decision.from_dict(
+            json.loads(json.dumps(d.to_dict()))).to_dict() == d.to_dict()
+
+
+class TestHistoryBounds:
+    def test_adopted_epochs_are_pruned(self, tmp_path):
+        """A long-lived mesh must not leak one directory per round:
+        once every live rank's cursor is past an epoch (+ the
+        KEEP_EPOCHS replay window), it is pruned."""
+        from paddle_tpu.distributed import consensus as C
+
+        cs = _ranks(tmp_path, 2)
+        rounds = 4 * C.KEEP_EPOCHS
+        for e in range(rounds):
+            _decide_all(cs, "admit", [e, e], reducer="first")
+        fam = tmp_path / "admit"
+        dirs = [n for n in os.listdir(fam) if n.startswith("e")]
+        assert len(dirs) < rounds            # pruning happened
+        # the replay window behind the slowest cursor survives
+        assert f"e{rounds - 1:06d}" in dirs
+        # and the next round still works on the pruned board
+        decs = _decide_all(cs, "admit", [1, 2], reducer="min")
+        assert all(d.value == 1 and d.epoch == rounds for d in decs)

@@ -607,12 +607,95 @@ def _check_events(tmp_path, lines):
 
 
 def test_schema_checker_accepts_valid_accept_events(tmp_path):
-    ok = [{"seq": 0, "t_ns": 1, "kind": "submit", "rid": 0},
-          {"seq": 1, "t_ns": 2, "kind": "accept", "rid": 0,
+    ok = [{"seq": 0, "t_ns": 1, "kind": "submit", "rid": 0, "rank": 0},
+          {"seq": 1, "t_ns": 2, "kind": "accept", "rid": 0, "rank": 0,
            "accepted": 2, "drafted": 3},
-          {"seq": 2, "t_ns": 3, "kind": "accept", "rid": 0,
+          {"seq": 2, "t_ns": 3, "kind": "accept", "rid": 0, "rank": 0,
            "accepted": 0, "drafted": 4}]
     assert _check_events(tmp_path, ok) == []
+
+
+# ---------------------------------------------------------------------------
+# sink-schema checker: ISSUE 13 rules (rank tagging + handoff events) —
+# negative-tested so the multihost CI leg's new rules are themselves
+# pinned
+# ---------------------------------------------------------------------------
+
+
+def test_schema_checker_requires_rank_on_events(tmp_path):
+    missing = [{"seq": 0, "t_ns": 1, "kind": "submit", "rid": 0}]
+    assert any("missing key 'rank'" in e
+               for e in _check_events(tmp_path, missing))
+    bad_type = [{"seq": 0, "t_ns": 1, "kind": "submit", "rid": 0,
+                 "rank": -1}]
+    assert any("non-negative" in e
+               for e in _check_events(tmp_path, bad_type))
+
+
+def test_schema_checker_flags_mixed_ranks_in_one_file(tmp_path):
+    # two processes appending to ONE events file is the torn-write
+    # hazard the per-rank sink subdirs exist to prevent
+    mixed = [{"seq": 0, "t_ns": 1, "kind": "submit", "rid": 0,
+              "rank": 0},
+             {"seq": 1, "t_ns": 2, "kind": "submit", "rid": 1,
+              "rank": 1}]
+    assert any("multiple writers" in e
+               for e in _check_events(tmp_path, mixed))
+
+
+def test_schema_checker_handoff_events(tmp_path):
+    ok = [{"seq": 0, "t_ns": 1, "kind": "handoff_out", "rid": 3,
+           "rank": 0, "tokens": 16, "pages": 2, "bytes": 4096},
+          {"seq": 1, "t_ns": 2, "kind": "handoff_in", "rid": 7,
+           "rank": 0, "tokens": 16, "pages": 2, "bytes": 4096}]
+    assert _check_events(tmp_path, ok) == []
+    missing = [{"seq": 0, "t_ns": 1, "kind": "handoff_out", "rid": 3,
+                "rank": 0, "tokens": 16, "pages": 2}]
+    assert any("missing 'bytes'" in e
+               for e in _check_events(tmp_path, missing))
+    nonpos = [{"seq": 0, "t_ns": 1, "kind": "handoff_in", "rid": 3,
+               "rank": 0, "tokens": 16, "pages": 0, "bytes": 0}]
+    assert any("non-positive" in e
+               for e in _check_events(tmp_path, nonpos))
+
+
+def test_schema_checker_requires_rank_on_metrics_lines(tmp_path):
+    mod, schema = _load_checker()
+    p = str(tmp_path / "metrics.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"ts": 1.0, "reason": "manual",
+                            "flush_seq": 0, "events_lost": 0,
+                            "metrics": {}}) + "\n")
+    mod._ERRORS.clear()
+    mod.check_metrics_jsonl(p, schema)
+    errs = list(mod._ERRORS)
+    mod._ERRORS.clear()
+    assert any("missing key 'rank'" in e for e in errs)
+
+
+def test_sink_lines_carry_rank_and_validate(tmp_path):
+    """The writer side of the contract: a real sink session's
+    artifacts carry rank on every line and pass the checker."""
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.profiler import sink as psink
+
+    profiler.enable(reset=True)
+    s = psink.MetricsSink(str(tmp_path), interval_s=60.0, rank=3)
+    s.start()
+    pevents.emit("submit", rid=0, eng=1)
+    s.flush("manual")
+    s.close()
+    for fname in ("metrics.jsonl", "events.jsonl"):
+        for ln in open(tmp_path / fname):
+            assert json.loads(ln)["rank"] == 3, fname
+    mod, schema = _load_checker()
+    mod._ERRORS.clear()
+    mod.check_metrics_jsonl(str(tmp_path / "metrics.jsonl"), schema)
+    mod.check_events_jsonl(str(tmp_path / "events.jsonl"), schema)
+    errs = list(mod._ERRORS)
+    mod._ERRORS.clear()
+    assert errs == [], errs
+    profiler.disable()
 
 
 def test_schema_checker_flags_bad_accept_events(tmp_path):

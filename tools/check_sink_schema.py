@@ -49,12 +49,35 @@ def err(msg: str) -> None:
     _ERRORS.append(msg)
 
 
+def _check_rank(path: str, lineno: int, row: dict,
+                state: dict) -> None:
+    """Rank field (ISSUE 13): present, a non-negative int, and the
+    SAME on every line of the file — two processes interleaving one
+    file is exactly the failure mode the per-rank sink layout exists
+    to prevent, so a file with mixed ranks is flagged, not grouped.
+    Reported ONCE per file, at the first line that diverges from the
+    file's first-seen rank (a thousand repeats of one defect would
+    bury every other finding)."""
+    r = row.get("rank")
+    if not isinstance(r, int) or r < 0:
+        err(f"{path}:{lineno}: rank {r!r} not a non-negative int")
+        return
+    ranks = state.setdefault("ranks", set())
+    ranks.add(r)
+    if len(ranks) > 1 and not state.get("reported"):
+        state["reported"] = True
+        err(f"{path}:{lineno}: rank {r} differs from earlier lines "
+            f"({sorted(ranks - {r})}) — multiple writers shared "
+            "this file")
+
+
 def check_metrics_jsonl(path: str, schema: dict) -> None:
     sc = schema["metrics_jsonl"]
     if not os.path.exists(path):
         return err(f"{path}: missing")
     last_seq = -1
     n = 0
+    rank_state: dict = {}
     for i, line in enumerate(open(path)):
         try:
             row = json.loads(line)
@@ -68,6 +91,7 @@ def check_metrics_jsonl(path: str, schema: dict) -> None:
             err(f"{path}:{i + 1}: unknown reason {row.get('reason')!r}")
         if not isinstance(row.get("ts"), (int, float)):
             err(f"{path}:{i + 1}: ts not a number")
+        _check_rank(path, i + 1, row, rank_state)
         el = row.get("events_lost")
         if not isinstance(el, int) or el < 0:
             err(f"{path}:{i + 1}: events_lost {el!r} not a "
@@ -97,6 +121,7 @@ def check_events_jsonl(path: str, schema: dict) -> None:
         return err(f"{path}: missing (the sink writes it even before "
                    "the first event)")
     last = -1
+    rank_state: dict = {}
     for i, line in enumerate(open(path)):
         try:
             ev = json.loads(line)
@@ -109,6 +134,19 @@ def check_events_jsonl(path: str, schema: dict) -> None:
             err(f"{path}:{i + 1}: kind not a non-empty string")
         if not isinstance(ev.get("t_ns"), int):
             err(f"{path}:{i + 1}: t_ns not an int")
+        _check_rank(path, i + 1, ev, rank_state)
+        if ev.get("kind") in ("handoff_out", "handoff_in"):
+            # disaggregated-serving handoffs (ISSUE 13): the byte
+            # accounting must be present and physically possible
+            for kk in sc.get("handoff_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: {ev['kind']} event missing "
+                        f"{kk!r}")
+            b, pg = ev.get("bytes"), ev.get("pages")
+            if isinstance(b, int) and isinstance(pg, int) and \
+                    (b <= 0 or pg <= 0):
+                err(f"{path}:{i + 1}: {ev['kind']} with non-positive "
+                    f"bytes={b} / pages={pg}")
         seq = ev.get("seq")
         if not isinstance(seq, int) or seq <= last:
             err(f"{path}:{i + 1}: seq {seq!r} not strictly increasing "
