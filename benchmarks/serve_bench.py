@@ -1012,10 +1012,12 @@ def bench_multihost(args, tiny):
              {"vocab": 512, "hidden": 256, "layers": 6, "heads": 8,
               "max_seq_len": 192})
 
-    def run_cell(name, world, cell_cfg):
+    def run_cell(name, world, cell_cfg, sink_root=None):
         root = tempfile.mkdtemp(prefix=f"serve_mh_{name}_")
         cfg = dict(cell_cfg, world=world, model=model,
                    shared_dir=os.path.join(root, "shared"))
+        if sink_root:
+            cfg["sink_dir"] = sink_root
         cfg_path = os.path.join(root, "config.json")
         with open(cfg_path, "w") as f:
             json.dump(cfg, f)
@@ -1034,10 +1036,18 @@ def bench_multihost(args, tiny):
             min(s["start_w"] for s in stats)
         cpus = [s["cpu_s"] for s in stats]
         ttfts = [v for s in stats for v in s["ttft_ms"].values()]
+        uncs = [v for s in stats
+                for v in s.get("ttft_unc_ms", {}).values()]
         served = sorted(g for s in stats for g in s["served"])
         assert served == list(range(cfg["n_requests"])), \
             f"cell {name}: served {len(served)}/{cfg['n_requests']}"
+        extra_keys = {}
+        if sink_root:
+            extra_keys["sink_root"] = sink_root
+        if uncs:
+            extra_keys["ttft_unc_p95_ms"] = round(pct(uncs, 95), 3)
         return {
+            **extra_keys,
             "world": world,
             "tokens": tokens,
             "wall_s": round(wall, 3),
@@ -1129,9 +1139,39 @@ def bench_multihost(args, tiny):
     }
     cells["ttft_symmetric"] = run_cell("tsym", 2, ttft_cfg)
     disagg_cfg = dict(ttft_cfg, prefill_ranks=[1])
-    cells["ttft_disagg"] = run_cell("tdis", 2, disagg_cfg)
+    # the disagg cell's per-rank sinks feed the cross-host trace
+    # merger (ISSUE 14); with --sink-dir the rank dirs land at a
+    # stable path so CI can re-run tools/merge_traces.py over them
+    tdis_sink = os.path.join(args.sink_dir, "mh_tdis") \
+        if args.sink_dir else tempfile.mkdtemp(prefix="serve_mh_sink_")
+    cells["ttft_disagg"] = run_cell("tdis", 2, disagg_cfg,
+                                    sink_root=tdis_sink)
     ttft_ratio = cells["ttft_disagg"]["ttft_p95_ms"] / \
         max(cells["ttft_symmetric"]["ttft_p95_ms"], 1e-9)
+
+    # ---- merged cross-host trace (ISSUE 14): stitch the disagg
+    # cell's per-rank sinks into ONE clock-aligned timeline per
+    # request — the true end-to-end TTFT (with its uncertainty) and
+    # the handoff breakdown the PR 13 caveat said were unmeasurable --
+    import merge_traces
+
+    mdoc = merge_traces.merge(tdis_sink)
+    mpath = os.path.join(tdis_sink, "merged_trace.json")
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(mdoc, f)
+    os.replace(mpath + ".tmp", mpath)
+    merged_block = {
+        "artifact": mpath,
+        "partial": mdoc["partial"],
+        "requests_total": mdoc["requests_total"],
+        "requests_complete": mdoc["requests_complete"],
+        "handoffs": mdoc["handoffs"],
+        "monotonic_violations": mdoc["monotonic_violations"],
+        "ranks": mdoc["ranks"],
+        "e2e_ttft_ms": mdoc["latency"]["ttft_ms"],
+        "e2e_ttft_unc_ms": mdoc["latency"]["ttft_unc_ms"],
+        "handoff_breakdown_ms": mdoc["handoff_breakdown_ms"],
+    }
 
     return {
         "metric": "serving_multihost_scaling",
@@ -1145,6 +1185,7 @@ def bench_multihost(args, tiny):
             "cells": cells,
             "wall_scaling": round(wall_scaling, 4),
             "ttft_p95_disagg_over_symmetric": round(ttft_ratio, 4),
+            "merged_trace": merged_block,
             "scale_workload": {
                 k: scale_cfg(g_slots)[k] for k in
                 ("n_requests", "prompt_lens", "max_new", "engine")},
@@ -1177,7 +1218,20 @@ def bench_multihost(args, tiny):
                      "chunk selection is oldest-admission-first, so "
                      "a symmetric host parks every short behind a "
                      "long's whole chunk train) vs 2-host symmetric "
-                     "at matched ample capacity."),
+                     "at matched ample capacity. Since ISSUE 14, a "
+                     "handed-off request's TTFT is the TRUE "
+                     "end-to-end number — prefill-rank submit to "
+                     "decode-rank first token, clock-offset-"
+                     "corrected with a stated uncertainty (cell "
+                     "ttft_unc_p95_ms; per-request bounds in "
+                     "extra.merged_trace) — replacing PR 13's "
+                     "prefill-side same-host pairs, which priced "
+                     "the handoff at zero by construction. "
+                     "extra.merged_trace is derived by "
+                     "tools/merge_traces.py from the disagg cell's "
+                     "per-rank sinks: export / channel-wait / "
+                     "import ms are measured spans of the same "
+                     "stitched timelines."),
         },
     }
 
@@ -1220,7 +1274,10 @@ def main():
                          "vs N-host aggregate tokens/s at fixed "
                          "per-host pool capacity, plus the 2-host "
                          "disaggregated-vs-symmetric p95 TTFT cell "
-                         "(ISSUE 13; BENCH_SERVE_r13.json)")
+                         "(ISSUE 13) and the merged cross-host trace "
+                         "block — true e2e disagg TTFT with clock "
+                         "uncertainty + handoff breakdown (ISSUE 14; "
+                         "BENCH_SERVE_r14.json)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=48)

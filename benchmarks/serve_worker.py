@@ -90,8 +90,17 @@ def main():
 
     # the warm round-trip moved real counters (handoff bytes, chunks,
     # ticks) — zero the registry so the reported stats cover ONLY the
-    # measured window (no sink is active in the bench workers)
+    # measured window; same for the event ring (the per-rank sink
+    # below must stream measured-window events only)
     _reg().reset()
+    import paddle_tpu.profiler as _profiler
+
+    _profiler.event_log().clear()
+    # per-rank sink (ISSUE 14): the driver merges
+    # <sink_dir>/rank<K>/ with tools/merge_traces.py into the
+    # mesh-wide clock-aligned latency block
+    if cfg.get("sink_dir"):
+        _profiler.enable_sink(cfg["sink_dir"], interval_s=10.0)
 
     if world > 1:
         mp_mesh.barrier("warm")
@@ -141,6 +150,10 @@ def main():
         "served": sorted(res),
         "ttft_ms": {str(g): round(v, 3)
                     for g, v in srv.ttfts().items()},
+        # handed-off requests' TTFTs are true end-to-end cross-host
+        # deltas (ISSUE 14): each carries its clock-alignment bound
+        "ttft_unc_ms": {str(g): round(u, 3)
+                        for g, u in srv.ttft_uncs().items()},
         "handoffs_sent": srv.handoffs_sent,
         "handoffs_recv": srv.handoffs_recv,
         "handoff_bytes_out": registry().counter(
@@ -157,6 +170,8 @@ def main():
     with open(path + ".tmp", "w") as f:
         json.dump(stats, f)
     os.replace(path + ".tmp", path)
+    if cfg.get("sink_dir"):
+        _profiler.disable_sink()    # final flush BEFORE the hard exit
     srv.close()
     ok = os.path.join(out_dir, f"ok.{rank}")
     if world > 1:

@@ -60,6 +60,18 @@ Single-process meshes (world == 1) decide immediately and touch the
 disk only for the decision record, so the primitive costs nothing to
 leave wired in single-host code paths.
 
+Observability (ISSUE 14): every adoption increments
+``consensus/epochs_adopted``, measures the vote round trip
+(``consensus/vote_rtt_ms`` histogram — cast to adopted, when this rank
+voted) and emits a ``consensus_decision`` event; lease expiries
+(``consensus/lease_expiries`` + ``lease_expiry`` events) and
+vote-window expiries (``consensus/vote_window_expiries`` +
+``vote_window_expiry`` events, naming the ranks published-without) are
+counted at the transition — all flushed through the normal metrics
+sink, all shielded so telemetry can never break agreement.
+:func:`adopted_epochs` is the process-global {family: last epoch} the
+flight recorder stamps into post-mortem dumps.
+
 Honest limits: liveness is mtime-based, so multi-NODE boards need a
 shared filesystem with coherent timestamps (the CPU test mesh runs on
 one node; a real fleet would back the board with its coordination
@@ -76,7 +88,8 @@ import time
 from collections import Counter as _Counter
 from typing import Any, Callable, Dict, List, Optional, Union
 
-__all__ = ["Consensus", "Decision", "ConsensusTimeout", "REDUCERS"]
+__all__ = ["Consensus", "Decision", "ConsensusTimeout", "REDUCERS",
+           "adopted_epochs"]
 
 #: adopted epochs kept on disk behind every live rank's cursor — the
 #: replay window a transiently-slow rank can still read; everything
@@ -87,6 +100,21 @@ KEEP_EPOCHS = 8
 
 class ConsensusTimeout(RuntimeError):
     """decide() ran out of time before a decision was published."""
+
+
+#: last adopted epoch per family, process-global (ISSUE 14): the
+#: flight recorder stamps this into post-mortem dumps so dumps from
+#: different ranks can be ordered by agreement history, not just wall
+#: clocks. Written on every adoption; a process driving several
+#: Consensus instances (in-process mesh tests) sees the newest.
+_ADOPTED: Dict[str, int] = {}
+_ADOPTED_LOCK = threading.Lock()
+
+
+def adopted_epochs() -> Dict[str, int]:
+    """{family: last adopted epoch} for this process."""
+    with _ADOPTED_LOCK:
+        return dict(_ADOPTED)
 
 
 class Decision:
@@ -178,6 +206,13 @@ class Consensus:
         self.poll_s = float(poll_s)
         self.timeout_s = float(timeout_s)
         self._epochs: Dict[str, int] = {}
+        #: when THIS rank voted in (family, epoch) — the anchor of the
+        #: vote round-trip measurement (vote cast -> decision adopted)
+        self._vote_t: Dict[tuple, float] = {}
+        #: previously-observed live set; None until the first alive()
+        #: call so mesh bring-up (peers' leases not written yet) does
+        #: not read as a storm of expiries
+        self._last_alive: Optional[set] = None
         self._hb_stop: Optional[threading.Event] = None
         self._hb_thread: Optional[threading.Thread] = None
         os.makedirs(board_dir, exist_ok=True)
@@ -257,6 +292,11 @@ class Consensus:
                     out.append(r)
             except OSError:
                 pass
+        cur = set(out)
+        if self._last_alive is not None and cur != self._last_alive:
+            for r in sorted(self._last_alive - cur):
+                _note_lease_expiry(r)
+        self._last_alive = cur
         return out
 
     # -- epochs ------------------------------------------------------------
@@ -289,6 +329,8 @@ class Consensus:
         ed = self._epoch_dir(family, self.epoch(family))
         os.makedirs(ed, exist_ok=True)
         path = os.path.join(ed, f"vote.{self.rank}")
+        self._vote_t.setdefault((family, self.epoch(family)),
+                                time.monotonic())
         if os.path.exists(path):
             return
         tmp = path + f".tmp{os.getpid()}"
@@ -355,10 +397,16 @@ class Consensus:
         ed = self._epoch_dir(family, e)
         dpath = os.path.join(ed, "decision.json")
         dec = self._try_read_decision(dpath)
-        if dec is None and self._should_publish(family, ed):
-            dec = self._publish(family, e, ed, dpath, reducer)
+        if dec is None:
+            snap = self._should_publish(family, ed)
+            if snap is not None:
+                dec = self._publish(family, e, ed, dpath, reducer,
+                                    *snap)
         if dec is not None:
             self._epochs[family] = e + 1
+            rtt = self._vote_t.pop((family, e), None)
+            _note_adoption(dec, None if rtt is None
+                           else (time.monotonic() - rtt) * 1e3)
             self._note_adopted(family, e)
         return dec
 
@@ -429,24 +477,34 @@ class Consensus:
         except ValueError:          # pragma: no cover - torn mid-link
             return None             # read (impossible: link is atomic)
 
-    def _should_publish(self, family: str, ed: str) -> bool:
+    def _should_publish(self, family: str, ed: str):
+        """The publish decision AND its evidence: (votes, live) when
+        this rank should publish right now, else None. The snapshot is
+        handed to _publish verbatim — recomputing liveness there could
+        see a lease flap and blame a rank that was never waited out."""
         live = self.alive()
         if self.rank != min(live):
-            return False            # not the leader
+            return None             # not the leader
         votes = self._read_votes(ed)
         if not votes:
-            return False            # nothing to decide from
+            return None             # nothing to decide from
         if all(r in votes for r in live):
-            return True             # every live rank voted
+            return votes, live      # every live rank voted
         t0 = self._first_vote_t(ed)
-        return t0 is not None and time.time() - t0 > self.window_s
+        if t0 is not None and time.time() - t0 > self.window_s:
+            return votes, live
+        return None
 
     def _publish(self, family: str, epoch: int, ed: str, dpath: str,
-                 reducer: Union[str, Callable]) -> Optional[Decision]:
-        votes = self._read_votes(ed)
+                 reducer: Union[str, Callable], votes: Dict[int, Any],
+                 live: List[int]) -> Optional[Decision]:
         red = REDUCERS[reducer] if isinstance(reducer, str) else reducer
-        live = self.alive()
         missing = sorted(set(range(self.world)) - set(votes))
+        waited_out = sorted(set(live) - set(votes))
+        if waited_out:
+            # publishing WITHOUT every live vote: the epoch's window
+            # expired on someone — fault evidence worth an event
+            _note_window_expiry(family, epoch, waited_out)
         dec = Decision(family, epoch, red(dict(sorted(votes.items()))),
                        dict(sorted(votes.items())), sorted(votes),
                        missing, self.rank)
@@ -472,3 +530,51 @@ def _note_decision(family: str, live: List[int]) -> None:
         registry().gauge("consensus/live_ranks").set(float(len(live)))
     except Exception:               # pragma: no cover - metrics must
         pass                        # never break agreement
+
+
+def _note_adoption(dec: Decision, rtt_ms: Optional[float]) -> None:
+    """ISSUE 14 consensus observability: every adoption counts an
+    epoch, times the vote round trip (cast -> adopted, only when this
+    rank voted in the epoch) and leaves a ``consensus_decision`` event
+    — all flushed through the normal sink, all guarded (telemetry must
+    never break agreement)."""
+    with _ADOPTED_LOCK:
+        _ADOPTED[dec.family] = dec.epoch
+    try:
+        from ..profiler import events as _events
+        from ..profiler.metrics import registry
+
+        registry().counter("consensus/epochs_adopted").add(1)
+        attrs = {"family": dec.family, "epoch": dec.epoch,
+                 "leader": dec.leader, "missing": len(dec.missing)}
+        if rtt_ms is not None:
+            registry().histogram("consensus/vote_rtt_ms").observe(rtt_ms)
+            attrs["rtt_ms"] = round(rtt_ms, 3)
+        _events.emit("consensus_decision", **attrs)
+    except Exception:               # pragma: no cover
+        pass
+
+
+def _note_lease_expiry(peer: int) -> None:
+    try:
+        from ..profiler import events as _events
+        from ..profiler.metrics import registry
+
+        registry().counter("consensus/lease_expiries").add(1)
+        _events.emit("lease_expiry", peer=int(peer))
+    except Exception:               # pragma: no cover
+        pass
+
+
+def _note_window_expiry(family: str, epoch: int,
+                        waiting_on: List[int]) -> None:
+    try:
+        from ..profiler import events as _events
+        from ..profiler.metrics import registry
+
+        registry().counter("consensus/vote_window_expiries").add(1)
+        _events.emit("vote_window_expiry", family=family,
+                     epoch=int(epoch),
+                     waiting_on=[int(r) for r in waiting_on])
+    except Exception:               # pragma: no cover
+        pass

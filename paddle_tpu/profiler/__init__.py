@@ -88,6 +88,9 @@ from __future__ import annotations
 
 from . import device_trace, events, instrument, metrics  # noqa: F401
 from . import recompile, sink, trace, xla_stats  # noqa: F401
+from . import disttrace  # noqa: F401
+from .disttrace import ClockSync, clock_state  # noqa: F401
+from .disttrace import set_clock_state, trace_id  # noqa: F401
 from .device_trace import TraceWindow, last_trace_summary  # noqa: F401
 from .device_trace import trace_capture  # noqa: F401
 from .events import (EventLog, FlightRecorder, dump_flight,  # noqa: F401
@@ -133,6 +136,8 @@ __all__ = [
     "record_lowered", "record_compiled", "program_inventory",
     # parsed XLA trace windows (device_trace.py)
     "trace_capture", "TraceWindow", "last_trace_summary",
+    # cross-host request tracing (disttrace.py, ISSUE 14)
+    "trace_id", "clock_state", "set_clock_state", "ClockSync",
 ]
 
 

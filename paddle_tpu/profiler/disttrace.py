@@ -1,0 +1,297 @@
+"""Cross-host request tracing primitives (ISSUE 14 tentpole).
+
+The observability stack before this module was strictly rank-local: a
+request that prefills on rank 0 and decodes on rank 1 had its
+lifecycle torn across two event rings, and ``serving/disagg.py``
+refused cross-host clock deltas outright (the decode-side TTFT was
+suppressed as a bogus ~0 ms same-host pair). This module supplies the
+three pieces that make a cross-host delta *meaningful*:
+
+- **Deterministic trace ids** (:func:`trace_id`): every request of a
+  disaggregated mesh carries ``g<gid>`` derived from its global
+  submission sequence — identical on every rank by the SPMD driver
+  contract, so the prefill rank's events and the decode rank's events
+  join one trace without any coordination. The serving engine stamps
+  the id as a ``trace`` attr on every lifecycle event it emits for the
+  request (``profiler/events.py``), and the handoff payload carries it
+  across the channel.
+
+- **A wall-clock anchor per process** (:func:`walltime`): events are
+  timestamped with ``perf_counter_ns`` (process-monotonic — the right
+  clock for same-host math, meaningless across hosts). Each sink flush
+  stamps an ``(wall_s, t_ns)`` pair read back-to-back, so an offline
+  consumer can place any event on this rank's wall clock. ``walltime``
+  also honors an injected per-rank test skew (``PADDLE_CLOCK_SKEW`` =
+  ``"<rank>:<seconds>[,<rank>:<seconds>]"``) so the chaos/mesh tests
+  can *prove* the offset correction recovers a known skew instead of
+  asserting 0 == 0 on a single-node mesh.
+
+- **Clock alignment with an honest error bar** (:class:`ClockSync`): a
+  Cristian-style ping exchange over a shared directory (the same
+  substrate as the consensus board and the handoff channel). A
+  non-reference rank stamps ``t0`` (its clock), pings, the reference
+  rank replies with its own wall time ``t_ref``, the client stamps
+  ``t1``; the sample estimates ``offset = (t0 + t1) / 2 - t_ref`` with
+  uncertainty ``(t1 - t0) / 2`` — the reply is *somewhere* inside the
+  round trip, and half the round trip is the tightest bound that
+  requires no symmetry assumption. The best (min-uncertainty) of
+  ``n_samples`` wins. Merged cross-host deltas carry that uncertainty
+  instead of pretending to nanosecond truth: a TTFT measured across
+  the handoff is reported as ``value ± (unc_src + unc_dst)``.
+
+The agreed mesh-wide offset table (every rank's ``offset_s``/``unc_s``
+relative to the reference rank) is published on the consensus board by
+``serving/disagg.py`` and mirrored into this module's process-global
+**clock state** (:func:`set_clock_state` / :func:`clock_state`), which
+the metrics sink stamps into every flush line and the flight recorder
+stamps into every post-mortem dump — so the offline merger
+(``tools/merge_traces.py``) finds everything it needs inside the
+per-rank sink artifacts alone.
+
+Sign convention (used everywhere): ``offset_s`` of rank K is K's wall
+clock MINUS the reference rank's; converting a K-stamped wall time
+into reference time is ``w_ref = w_k - offset_s``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "trace_id", "walltime", "local_skew_s",
+    "clock_state", "set_clock_state", "reset_clock_state",
+    "ClockSync",
+]
+
+#: injected test skew: "<rank>:<seconds>[,<rank>:<seconds>]" or a bare
+#: float applied to every rank (single-process tests)
+SKEW_ENV = "PADDLE_CLOCK_SKEW"
+
+
+def trace_id(gid: int) -> str:
+    """Deterministic trace id of global request ``gid`` — the same
+    string on every rank of the mesh, with no coordination."""
+    return f"g{int(gid):08d}"
+
+
+def _env_rank() -> int:
+    """This process's mesh rank from the PADDLE_* env protocol (the
+    one tools/mp_mesh.py workers always carry), without touching jax —
+    skew parsing must be import-safe anywhere."""
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def local_skew_s(rank: Optional[int] = None) -> float:
+    """The injected wall-clock skew of ``rank`` (default: this
+    process), parsed from ``PADDLE_CLOCK_SKEW``. 0.0 when unset — the
+    production value; the env knob exists so mesh tests can give one
+    rank a known-wrong clock and assert the sync recovers it."""
+    raw = os.environ.get(SKEW_ENV)
+    if not raw:
+        return 0.0
+    r = _env_rank() if rank is None else int(rank)
+    try:
+        if ":" not in raw:
+            return float(raw)
+        for part in raw.split(","):
+            rr, ss = part.split(":")
+            if int(rr) == r:
+                return float(ss)
+        return 0.0
+    except ValueError:
+        return 0.0
+
+
+def walltime(skew_s: Optional[float] = None) -> float:
+    """This rank's wall clock: ``time.time()`` plus the injected test
+    skew. EVERY wall stamp that participates in cross-host math (sink
+    anchors, handoff trace contexts, TTFT endpoints) must come from
+    here, so an injected skew is consistent — and therefore
+    correctable — across all of them."""
+    return time.time() + (local_skew_s() if skew_s is None else skew_s)
+
+
+# ---------------------------------------------------------------------------
+# process-global clock state (what the sink + flight recorder stamp)
+# ---------------------------------------------------------------------------
+_lock = threading.Lock()
+_state: Dict[str, object] = {
+    "offset_s": None,   # this rank's wall clock minus the reference's
+    "unc_s": None,      # +- bound on offset_s (half best round trip)
+    "ref": 0,           # reference rank the offsets are relative to
+    "synced": False,    # True once an agreed estimate was adopted
+}
+
+
+def set_clock_state(offset_s: Optional[float], unc_s: Optional[float],
+                    ref: int = 0, synced: bool = True) -> None:
+    """Adopt this rank's agreed clock offset (serving/disagg.py calls
+    this when the mesh's ``clock`` consensus round publishes). The sink
+    stamps the state into every subsequent flush line."""
+    with _lock:
+        _state["offset_s"] = None if offset_s is None else float(offset_s)
+        _state["unc_s"] = None if unc_s is None else float(unc_s)
+        _state["ref"] = int(ref)
+        _state["synced"] = bool(synced)
+
+
+def clock_state() -> dict:
+    """A copy of the current clock state ({offset_s, unc_s, ref,
+    synced}). ``offset_s is None`` means this rank never synced —
+    consumers must treat its cross-host deltas as unbounded, not as
+    exact."""
+    with _lock:
+        return dict(_state)
+
+
+def reset_clock_state() -> None:
+    set_clock_state(None, None, ref=0, synced=False)
+
+
+# ---------------------------------------------------------------------------
+# Cristian-style clock sync over a shared directory
+# ---------------------------------------------------------------------------
+class ClockSync:
+    """One rank's half of the ping exchange (module docstring).
+
+    The reference rank answers pings (``step()`` is its serve loop and
+    returns True immediately — its own offset is 0 ± 0 by definition);
+    every other rank issues ``n_samples`` pings, one at a time, and
+    keeps the minimum-uncertainty sample. ``step()`` is non-blocking
+    and cheap (one listdir / one stat), built to ride a scheduler
+    heartbeat; ``estimate()`` returns ``(offset_s, unc_s)`` once ready.
+
+    Files (all atomic tmp+rename; a rank killed mid-write leaves only
+    an ignorable ``.tmp``): ``ping.<rank>.<seq>`` client -> reference,
+    ``pong.<rank>.<seq>`` reference -> client (JSON ``{"t_ref": ...}``).
+    Consumed files are unlinked by their reader, so the directory
+    stays O(in-flight), not O(history).
+    """
+
+    def __init__(self, directory: str, rank: int, world: int, *,
+                 ref: int = 0, n_samples: int = 5,
+                 skew_s: Optional[float] = None):
+        if world < 1 or not 0 <= rank < world:
+            raise ValueError(f"bad rank/world {rank}/{world}")
+        if not 0 <= ref < world:
+            raise ValueError(f"reference rank {ref} outside the mesh")
+        if n_samples < 1:
+            raise ValueError("n_samples must be >= 1")
+        self.dir = directory
+        self.rank = int(rank)
+        self.world = int(world)
+        self.ref = int(ref)
+        self.n_samples = int(n_samples)
+        #: injected test skew (None -> the PADDLE_CLOCK_SKEW default);
+        #: must match the skew of every other wall stamp this rank
+        #: makes, or the "correction" would un-correct real stamps
+        self.skew_s = local_skew_s(rank) if skew_s is None \
+            else float(skew_s)
+        self._seq = 0
+        self._t0: Optional[float] = None      # outstanding ping stamp
+        self._samples: list = []              # (unc_s, offset_s)
+        os.makedirs(directory, exist_ok=True)
+        # purge THIS rank's leftovers from a previous incarnation
+        # (restart after a mid-sync crash): seq restarts at 0, and a
+        # stale pong.<rank>.0 answered minutes ago would pair with a
+        # fresh ping into a wildly-wrong offset whose tiny claimed
+        # uncertainty WINS the min-unc selection. Peers' files are
+        # not ours to touch.
+        try:
+            for n in os.listdir(directory):
+                if n.startswith((f"ping.{self.rank}.",
+                                 f"pong.{self.rank}.")):
+                    try:
+                        os.unlink(os.path.join(directory, n))
+                    except OSError:  # pragma: no cover
+                        pass
+        except OSError:  # pragma: no cover - dir vanished
+            pass
+
+    # -- clock under test ---------------------------------------------------
+    def _now(self) -> float:
+        return walltime(self.skew_s)
+
+    # -- protocol -----------------------------------------------------------
+    def _write_atomic(self, path: str, doc: dict) -> None:
+        tmp = path + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+
+    def _serve(self) -> None:
+        """Reference side: answer every outstanding ping."""
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return
+        for n in names:
+            if not n.startswith("ping.") or ".tmp" in n:
+                continue
+            pong = os.path.join(self.dir, "pong." + n[len("ping."):])
+            self._write_atomic(pong, {"t_ref": self._now()})
+            try:
+                os.unlink(os.path.join(self.dir, n))
+            except OSError:  # pragma: no cover - racing second server
+                pass
+
+    def step(self) -> bool:
+        """Pump the exchange; True once :meth:`estimate` is ready. The
+        reference rank serves pongs and is always ready. Call from the
+        scheduler heartbeat until ready (the reference keeps calling —
+        peers may still be sampling)."""
+        if self.rank == self.ref:
+            self._serve()
+            return True
+        if self._t0 is not None:
+            pong = os.path.join(self.dir,
+                                f"pong.{self.rank}.{self._seq}")
+            try:
+                with open(pong) as f:
+                    t_ref = float(json.load(f)["t_ref"])
+            except (OSError, ValueError, KeyError):
+                return self.ready          # reply not landed yet
+            t1 = self._now()
+            t0 = self._t0
+            self._t0 = None
+            self._seq += 1
+            try:
+                os.unlink(pong)
+            except OSError:  # pragma: no cover
+                pass
+            self._samples.append(((t1 - t0) / 2.0,
+                                  (t0 + t1) / 2.0 - t_ref))
+            return self.ready
+        if len(self._samples) < self.n_samples:
+            ping = os.path.join(self.dir,
+                                f"ping.{self.rank}.{self._seq}")
+            # t0 BEFORE the write becomes visible: the reference may
+            # reply the instant the rename lands, and a t_ref outside
+            # [t0, t1] would break the "reply is inside the round
+            # trip" premise the ± bound rests on. Stamping early only
+            # WIDENS the bound — conservative by construction.
+            self._t0 = self._now()
+            self._write_atomic(ping, {"rank": self.rank})
+        return self.ready
+
+    @property
+    def ready(self) -> bool:
+        return self.rank == self.ref or \
+            len(self._samples) >= self.n_samples
+
+    def estimate(self) -> Optional[Tuple[float, float]]:
+        """(offset_s, unc_s) — this rank's clock minus the reference's
+        with its ± bound — or None while still sampling. The reference
+        rank is exactly (0, 0): offsets are *defined* relative to it."""
+        if self.rank == self.ref:
+            return (0.0, 0.0)
+        if not self._samples:
+            return None
+        unc, off = min(self._samples)
+        return (off, unc)

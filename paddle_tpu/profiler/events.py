@@ -64,12 +64,28 @@ __all__ = [
 #: engines keep the strict O(1)-per-residency lifecycle rate.
 #: Disaggregated serving (ISSUE 13) adds two lifecycle-edge kinds:
 #: ``handoff_out`` (a prefill-group engine exported a held request's
-#: KV pages — attrs ``tokens``/``pages``/``bytes``) and ``handoff_in``
-#: (a decode-group engine imported them). Both are O(1) per request.
+#: KV pages — attrs ``tokens``/``pages``/``bytes``/``ms``, the span
+#: duration of the export work) and ``handoff_in`` (a decode-group
+#: engine imported them — same attrs). Both are O(1) per request.
+#: Cross-host tracing (ISSUE 14) adds control-plane kinds, all rare
+#: (per agreement round / per routed request, never per token):
+#: ``route`` (an admission assignment was adopted — attrs ``gid``,
+#: ``prefill``, ``decode``, ``trace``), ``clock_sync`` (the mesh's
+#: clock-offset agreement published — attrs ``offset_s``/``unc_s``/
+#: ``ref``), ``consensus_decision`` (this rank adopted an epoch —
+#: attrs ``family``/``epoch``/``leader``/``missing``, plus ``rtt_ms``
+#: when this rank voted in it), ``lease_expiry`` (a peer's lease went
+#: stale — attr ``peer``) and ``vote_window_expiry`` (the leader
+#: published without every live vote — attrs ``family``/``epoch``/
+#: ``waiting_on``). Any event of a request that carries a trace id
+#: additionally bears a ``trace`` attr — the cross-host join key
+#: tools/merge_traces.py stitches on.
 EVENT_KINDS = (
     "submit", "admit", "prefix_hit", "cow_copy", "chunk",
     "first_token", "draft", "verify", "accept",
     "handoff_out", "handoff_in",
+    "route", "clock_sync", "consensus_decision", "lease_expiry",
+    "vote_window_expiry",
     "preempt", "requeue", "finish", "rollback",
 )
 
@@ -429,12 +445,28 @@ class FlightRecorder:
             deltas = {k: round(v - base.get(k, 0.0), 6)
                       for k, v in cur.items()
                       if v != base.get(k, 0.0)}
+            # mesh-ordering tags (ISSUE 14): dumps from different
+            # ranks of a disaggregated mesh must be orderable — the
+            # writer's rank, its agreed clock offset (± uncertainty)
+            # and the last consensus epoch it adopted per family say
+            # WHERE and WHEN this post-mortem sits in mesh history
+            from . import disttrace as _disttrace
+            from .sink import _detect_rank
+
+            try:
+                from ..distributed.consensus import adopted_epochs
+                epochs = adopted_epochs()
+            except Exception:  # pragma: no cover - import cycle guard
+                epochs = {}
             doc = {
                 "kind": "flight_recorder_dump",
                 "reason": reason,
                 "unix_time": time.time(),
                 "t_ns": time.perf_counter_ns(),
                 "baseline_t_ns": base_t,
+                "rank": _detect_rank(),
+                "clock": _disttrace.clock_state(),
+                "consensus_epochs": epochs,
                 "events": [e.to_dict() for e in _log.tail(self.tail_events)],
                 "events_dropped": _log.dropped,
                 "metrics": snap,

@@ -75,6 +75,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from . import disttrace as _disttrace
 from . import events as _events
 from .metrics import registry
 
@@ -287,8 +288,31 @@ class MetricsSink:
             # the cursor advances only once the segment hit the file —
             # an I/O error above re-sends it on the next flush
             self._cursor = cursor
+            # cross-host tracing metadata (ISSUE 14): (clock.wall_s,
+            # t_ns) is this rank's wall-clock anchor — the pair is
+            # read back-to-back, so an offline consumer can place any
+            # event's perf_counter t_ns on this rank's wall clock;
+            # offset_s/unc_s are the agreed clock alignment (relative
+            # to clock.ref) tools/merge_traces.py corrects with.
+            # clock.wall_s deliberately comes from disttrace.walltime
+            # (ts below stays the process's REAL time): an injected
+            # test skew must reach the anchor, or the mesh tests could
+            # not prove the offset correction recovers it.
+            # the wall read is BRACKETED by two monotonic reads: the
+            # midpoint pairs the clocks to first order even if the
+            # thread is preempted between the reads, and the half-gap
+            # is stamped as anchor_unc_s so the merger can widen its
+            # slack instead of flagging a phantom ordering violation
+            t_a = time.perf_counter_ns()
+            wall = _disttrace.walltime()
+            t_b = time.perf_counter_ns()
+            t_ns = (t_a + t_b) // 2
+            clock = dict(_disttrace.clock_state(),
+                         wall_s=round(wall, 6),
+                         anchor_unc_s=round((t_b - t_a) / 2e9, 9))
             line = {"ts": round(time.time(), 6), "reason": reason,
                     "rank": self.rank, "flush_seq": seq,
+                    "t_ns": t_ns, "clock": clock,
                     "events_lost": lost, "metrics": snap}
             with open(self._metrics_path, "a") as f:
                 f.write(json.dumps(line) + "\n")

@@ -55,6 +55,25 @@ rank killed mid-handoff leaves only an ignorable ``.tmp`` — the
 receiver's pool never sees a torn payload (chaos-tested in
 tests/multihost/).
 
+Cross-host tracing (ISSUE 14)
+-----------------------------
+Every request carries the deterministic trace id
+``profiler.disttrace.trace_id(gid)`` — identical on every rank by the
+SPMD driver contract — stamped as a ``trace`` attr on all of its
+engine events and carried across the handoff, so the prefill rank's
+and decode rank's event rings stitch into ONE timeline offline
+(tools/merge_traces.py). The handoff payload gains a ``trace_ctx``
+record (submit wall stamp, prefill-rank TTFT, export wall stamp), the
+coordinator runs a Cristian-style clock sync against rank 0 on server
+bring-up (``profiler.disttrace.ClockSync`` over ``<shared>/clock``;
+the agreed offset table is published on the consensus board, family
+``clock``, and mirrored into every rank's sink metadata), and a
+handed-off request's TTFT is the TRUE end-to-end delta — prefill-rank
+submit wall -> decode-rank first token, offset-corrected, ± the two
+ranks' summed clock uncertainty (:meth:`DisaggServer.ttft_bounds`).
+The old behavior (decode-side TTFT suppressed as a bogus ~0 ms pair,
+``ttft_ms=None`` for every handed-off request) is gone.
+
 Determinism: greedy disaggregated output is BITWISE the single-host
 paged greedy stream (itself bitwise dense ``generate()``): the decode
 rank attends over transferred page bytes identical to what its own
@@ -74,6 +93,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..distributed.consensus import Consensus
+from ..profiler import disttrace as _disttrace
+from ..profiler import events as _pevents
+from ..profiler.metrics import registry as _registry
 from .engine import ServingConfig, ServingEngine
 
 __all__ = ["MeshSpec", "HandoffChannel", "DisaggServer",
@@ -230,16 +252,35 @@ def route_requests(votes: Dict[int, dict]) -> dict:
     return {"assign": assign, "routed": routed + len(assign)}
 
 
+def _clock_reducer(votes: Dict[int, dict]) -> dict:
+    """The ``clock`` round's reducer: every rank's (offset, unc) vote,
+    gathered into one table keyed by rank — pure and deterministic
+    (votes arrive rank-sorted). The reference rank is taken from the
+    lowest voter (every vote carries the same ``ref`` by
+    construction)."""
+    ref = int(votes[min(votes)].get("ref", 0))
+    return {"ref": ref,
+            "offsets": {str(r): {"offset_s": v.get("offset_s"),
+                                 "unc_s": v.get("unc_s")}
+                        for r, v in sorted(votes.items())}}
+
+
 @dataclass
 class _GlobalReq:
     gid: int
     prompt: np.ndarray
     max_new: int
-    submit_w: float                  # wall clock (time.time)
+    submit_w: float                  # wall clock (disttrace.walltime)
+    trace: str = ""                  # deterministic cross-host trace id
     prefill_rank: int = -1
     decode_rank: int = -1
     routed: bool = False
     ttft_ms: Optional[float] = None
+    #: ± clock-alignment uncertainty on ttft_ms — present exactly when
+    #: ttft_ms is a CROSS-host delta corrected by a synced offset pair
+    #: (same-host pairs have no cross-clock term; an unsynced mesh
+    #: reports the delta with unc None = unbounded, never a fake 0)
+    ttft_unc_ms: Optional[float] = None
     out: Optional[np.ndarray] = None
     meta: dict = field(default_factory=dict)
 
@@ -267,7 +308,8 @@ class DisaggServer:
                  shared_dir: str, *,
                  long_prompt_threshold: Optional[int] = None,
                  consensus: Optional[Consensus] = None,
-                 lease_s: float = 5.0):
+                 lease_s: float = 5.0,
+                 clock_skew_s: Optional[float] = None):
         self.mesh = mesh
         self.engine = ServingEngine(model, config)
         self.consensus = consensus if consensus is not None else \
@@ -300,6 +342,28 @@ class DisaggServer:
         self.handoffs_recv = 0
         self._done_verdict: Optional[bool] = None
         self._done_open_t = 0.0
+        # -- cross-host tracing (ISSUE 14) ------------------------------
+        #: injected test skew applied to EVERY wall stamp this server
+        #: makes (submit/export/import) AND to its clock-sync samples —
+        #: one consistent wrong clock, exactly what a skewed host is.
+        #: NOTE: the explicit ``clock_skew_s`` parameter skews only
+        #: THIS server (in-process multi-server protocol tests, where
+        #: a per-process sink could not represent two logical clocks
+        #: anyway); a run whose per-rank sinks will be MERGED must
+        #: inject skew via PADDLE_CLOCK_SKEW instead, which also
+        #: reaches the sink's wall-clock anchor (disttrace.walltime)
+        self._skew_s = _disttrace.local_skew_s(mesh.rank) \
+            if clock_skew_s is None else float(clock_skew_s)
+        self.clock = _disttrace.ClockSync(
+            os.path.join(shared_dir, "clock"), mesh.rank, mesh.world,
+            skew_s=self._skew_s)
+        self._clock_voted = False
+        #: the agreed offset table {str(rank): {offset_s, unc_s}}, or
+        #: None until the ``clock`` consensus round publishes
+        self._clock_table: Optional[Dict[str, dict]] = None
+        #: per-gid handoff trace context of IMPORTED requests:
+        #: {gid: (ctx dict from the payload, import wall stamp)}
+        self._handoff_ctx: Dict[int, Tuple[dict, float]] = {}
         # lease upkeep on a daemon thread: a rank COMPILING its first
         # tick (tens of seconds on a small box) is alive, and its lease
         # must say so or a fast peer transiently "survives" it and
@@ -322,7 +386,8 @@ class DisaggServer:
         gid = self._next_gid
         self._next_gid += 1
         self._reqs[gid] = _GlobalReq(gid, p, int(max_new_tokens),
-                                     time.time())
+                                     self._walltime(),
+                                     trace=_disttrace.trace_id(gid))
         # an open-ended driver (Poisson arrivals) may submit AFTER an
         # idle period already voted the mesh done — new work reopens
         # the question (the next done round sees served < seen)
@@ -333,6 +398,99 @@ class DisaggServer:
             # published assignment now instead of orphaning it
             self._apply_assignment(gid)
         return gid
+
+    # -- clock alignment (ISSUE 14) ----------------------------------------
+    def _walltime(self) -> float:
+        return _disttrace.walltime(self._skew_s)
+
+    def _clock_round(self) -> None:
+        """Non-blocking Cristian sync + consensus rounds: pump the
+        ping exchange until this rank's estimate is ready, vote it
+        (family ``clock``), adopt the published mesh-wide offset
+        table. The reference rank keeps serving pongs forever (a
+        cheap listdir on the heartbeat) so late peers can still
+        sample. A rank the vote window expired OUT of the published
+        table keeps sampling, self-heals its own entry the moment its
+        estimate lands (its local stamps must not stay uncorrected),
+        and re-votes — opening the NEXT clock epoch, which every peer
+        joins via ``pending`` so the straggler's offset reaches the
+        whole mesh; tables merge across epochs."""
+        cons = self.consensus
+        me = str(self.mesh.rank)
+        healed = self._clock_table is not None and \
+            me in self._clock_table
+        if self.mesh.rank == self.clock.ref or not healed:
+            self.clock.step()
+        if self._clock_table is not None and not healed and \
+                self.clock.ready and not self._clock_voted:
+            # window-expired straggler: heal locally NOW (peers may
+            # already be draining), then gossip via the next epoch
+            est = self.clock.estimate()
+            self._clock_table[me] = {"offset_s": est[0],
+                                     "unc_s": est[1]}
+            _disttrace.set_clock_state(est[0], est[1],
+                                       ref=self.clock.ref)
+            _pevents.emit("clock_sync", offset_s=est[0], unc_s=est[1],
+                          ref=self.clock.ref)
+            self._refresh_ttfts()
+            self._vote_clock()
+        if self._clock_table is None:
+            self._vote_clock()
+        if self._clock_voted or cons.pending("clock"):
+            # a pending round a peer opened (first sync OR a healed
+            # straggler's re-round) is joined with our best estimate
+            self._vote_clock()
+            dec = cons.outcome("clock", reducer=_clock_reducer)
+            if dec is not None:
+                self._clock_voted = False
+                self._adopt_clock(dec.value)
+
+    def _vote_clock(self) -> None:
+        """Cast this rank's clock vote in the current epoch, once,
+        when its estimate exists (no-op otherwise)."""
+        if self._clock_voted or not self.clock.ready:
+            return
+        est = self.clock.estimate()
+        self.consensus.vote("clock", {"offset_s": est[0],
+                                      "unc_s": est[1],
+                                      "ref": self.clock.ref})
+        self._clock_voted = True
+
+    def _adopt_clock(self, value: dict) -> None:
+        # MERGE across epochs: a straggler's re-round carries only
+        # that epoch's voters — it must extend the table, not erase
+        # the first round's entries
+        table = dict(self._clock_table or {})
+        table.update(value.get("offsets") or {})
+        me = str(self.mesh.rank)
+        if me not in table and self.clock.ready:
+            # published without our vote (window expiry): our local
+            # estimate still anchors our OWN sink metadata honestly
+            est = self.clock.estimate()
+            if est is not None:
+                table[me] = {"offset_s": est[0], "unc_s": est[1]}
+        self._clock_table = table
+        mine = table.get(me)
+        ref = int(value.get("ref", 0))
+        off = None if mine is None else mine.get("offset_s")
+        unc = None if mine is None else mine.get("unc_s")
+        _disttrace.set_clock_state(off, unc, ref=ref,
+                                   synced=mine is not None)
+        if unc is not None:
+            _registry().gauge("consensus/clock_unc_ms").set(unc * 1e3)
+        _pevents.emit("clock_sync", offset_s=off, unc_s=unc, ref=ref)
+        self._refresh_ttfts()
+
+    def _offset_of(self, rank: int) -> Tuple[float, Optional[float]]:
+        """(offset_s, unc_s) of ``rank`` from the agreed table; an
+        unsynced rank reads as offset 0 with unc None — uncorrected
+        and explicitly unbounded, never silently exact."""
+        e = (self._clock_table or {}).get(str(int(rank)))
+        if e is None or e.get("offset_s") is None:
+            return 0.0, None
+        unc = e.get("unc_s")
+        return float(e["offset_s"]), (None if unc is None
+                                      else float(unc))
 
     # -- scheduling --------------------------------------------------------
     def _unrouted(self) -> List[int]:
@@ -369,10 +527,21 @@ class DisaggServer:
         if dec is None:
             return
         self._voted_admit = False
-        for g_str, (p_rank, d_rank) in sorted(dec.value["assign"].items(),
+        assign = dec.value["assign"]
+        if assign:
+            _registry().counter("consensus/requests_routed") \
+                .add(len(assign))
+        for g_str, (p_rank, d_rank) in sorted(assign.items(),
                                               key=lambda kv: int(kv[0])):
             gid = int(g_str)
             self._assignments[gid] = (int(p_rank), int(d_rank))
+            if int(d_rank) == self.mesh.rank:
+                # the routing decision, as an event on the rank that
+                # will OWN the visible result (one event per request
+                # mesh-wide, not one per rank)
+                _pevents.emit("route", gid=gid,
+                              trace=_disttrace.trace_id(gid),
+                              prefill=int(p_rank), decode=int(d_rank))
             if gid in self._reqs:
                 self._apply_assignment(gid)
             # else: routed before our driver submitted it — submit()
@@ -389,10 +558,12 @@ class DisaggServer:
         me = self.mesh.rank
         if req.prefill_rank == me:
             lr = self.engine.submit(req.prompt, req.max_new,
-                                    hold_after_prefill=True)
+                                    hold_after_prefill=True,
+                                    trace_id=req.trace)
             self._local[lr] = gid
         elif req.decode_rank == me and req.prefill_rank < 0:
-            lr = self.engine.submit(req.prompt, req.max_new)
+            lr = self.engine.submit(req.prompt, req.max_new,
+                                    trace_id=req.trace)
             self._local[lr] = gid
 
     def _export_held(self) -> None:
@@ -403,12 +574,25 @@ class DisaggServer:
                 continue
             req = self._reqs[gid]
             payload = eng.export_held(rid)
-            # the first token materialized HERE: TTFT is a same-host
-            # clock pair (engine perf_counter), wall-stamped for the
-            # mesh-level aggregate
+            # the prefill-rank leg of the trace rides the payload: the
+            # decode rank (and the offline merger) need the submit
+            # wall stamp to report a TRUE end-to-end TTFT instead of
+            # the old suppressed decode-side ~0 ms pair. The engine's
+            # same-host prefill TTFT (submit -> first token on THIS
+            # rank) travels too — it is a clean clock pair and bounds
+            # the handoff breakdown from the left.
             er = eng._requests[rid]
+            prefill_ttft = None
             if er.first_token_t is not None:
-                req.ttft_ms = (er.first_token_t - er.submit_t) * 1e3
+                prefill_ttft = (er.first_token_t - er.submit_t) * 1e3
+                req.meta["prefill_ttft_ms"] = prefill_ttft
+            payload["trace_ctx"] = json.dumps({
+                "trace": req.trace, "gid": gid,
+                "prefill_rank": self.mesh.rank,
+                "submit_w": req.submit_w,
+                "export_w": self._walltime(),
+                "prefill_ttft_ms": prefill_ttft,
+            })
             self.channel.send(req.decode_rank, gid, payload)
             eng.release_exported(rid)
             self.handoffs_sent += 1
@@ -423,6 +607,24 @@ class DisaggServer:
                 continue
             self._local[lr] = gid
             self.handoffs_recv += 1
+            # stamp the import wall moment + keep the payload's trace
+            # context: together with the agreed clock offsets they make
+            # the handed-off request's end-to-end TTFT computable HERE
+            # (keyed by gid, not _reqs — the import can land before our
+            # driver submitted the gid)
+            raw = payload.get("trace_ctx")
+            if raw is not None:
+                try:
+                    ctx = json.loads(str(raw))
+                except ValueError:   # pragma: no cover - torn context
+                    ctx = None
+                if ctx is not None:
+                    self._handoff_ctx[gid] = (ctx, self._walltime())
+                    # the channel-wait histogram sample is recorded in
+                    # _stamp_e2e_ttft once the offsets are SYNCED — a
+                    # histogram cannot retract a pre-adoption
+                    # skew-corrupted observation the way ttft_ms can
+                    # be re-derived
         self._pending_imports = still
 
     def _collect_finished(self) -> None:
@@ -443,20 +645,71 @@ class DisaggServer:
             self._collected.add(gid)
             self._served_total += 1
             req.out = np.asarray(er.out, np.int32)
-            # TTFT belongs to the rank that EMITTED the first token: a
-            # handed-off request's decode-side clock pair starts at
-            # import (first_token_t == submit_t there — a bogus ~0ms
-            # sample that would corrupt the mesh aggregate); its real
-            # TTFT was stamped at export on the prefill rank
-            if req.ttft_ms is None and er.first_token_t is not None \
-                    and req.prefill_rank in (-1, self.mesh.rank):
-                req.ttft_ms = (er.first_token_t - er.submit_t) * 1e3
-            req.meta["finish_w"] = time.time()
+            # TTFT (ISSUE 14): a locally-served request keeps the
+            # same-host engine clock pair; a handed-off one reports
+            # the TRUE end-to-end delta — prefill-rank submit wall ->
+            # this rank's import (its first-token moment), corrected
+            # by the agreed clock offsets and carrying their summed
+            # uncertainty. The old path suppressed the decode-side
+            # pair entirely (first_token_t == submit_t at import — a
+            # bogus ~0 ms) and left ttft_ms=None for every handed-off
+            # request: the mesh's headline latency was unmeasurable by
+            # construction.
+            if req.ttft_ms is None and er.first_token_t is not None:
+                if req.prefill_rank in (-1, self.mesh.rank):
+                    req.ttft_ms = \
+                        (er.first_token_t - er.submit_t) * 1e3
+                else:
+                    self._stamp_e2e_ttft(req)
+            req.meta["finish_w"] = self._walltime()
+
+    def _stamp_e2e_ttft(self, req: _GlobalReq) -> None:
+        """End-to-end TTFT of a request handed off TO this rank:
+        (import wall - our offset) - (prefill-rank submit wall - its
+        offset), in the reference rank's clock, ± the two offsets'
+        summed uncertainty. A payload without a trace context (a
+        pre-ISSUE-14 sender) leaves ttft_ms None — honestly absent,
+        never the old bogus ~0 ms."""
+        ctx, import_w = self._handoff_ctx.get(req.gid, (None, None))
+        if ctx is None:
+            return
+        o_me, u_me = self._offset_of(self.mesh.rank)
+        o_p, u_p = self._offset_of(int(ctx.get("prefill_rank", -1)))
+        req.ttft_ms = ((import_w - o_me)
+                       - (float(ctx["submit_w"]) - o_p)) * 1e3
+        if u_me is not None and u_p is not None:
+            first_stamp = req.ttft_unc_ms is None
+            req.ttft_unc_ms = (u_me + u_p) * 1e3
+            if first_stamp:
+                # exactly one synced observation per handed-off
+                # request (unc transitions None -> value once)
+                _registry().histogram(
+                    "serving/handoff_channel_wait_ms").observe(
+                    ((import_w - o_me)
+                     - (float(ctx["export_w"]) - o_p)) * 1e3)
+
+    def _refresh_ttfts(self) -> None:
+        """Re-derive handed-off TTFTs from their retained trace
+        contexts under the CURRENT offset table: a request collected
+        while the clock round was still converging (the mesh's first
+        steps are compile-heavy — imports can beat adoption) was
+        stamped uncorrected with unc None; once the table exists, the
+        corrected value with its bound replaces it. Idempotent; called
+        on every read surface (ttfts/ttft_bounds/write_results) and at
+        table adoption."""
+        if self._clock_table is None:
+            return
+        for gid in self._handoff_ctx:
+            req = self._reqs.get(gid)
+            if req is not None and req.ttft_ms is not None \
+                    and req.ttft_unc_ms is None:
+                self._stamp_e2e_ttft(req)
 
     def step(self) -> bool:
         """One coordinator heartbeat. Returns whether the local engine
         dispatched device work (the driver's idle signal)."""
         self.consensus.heartbeat()
+        self._clock_round()
         self._admission_round()
         self._import_arrivals()
         progressed = self.engine.step()
@@ -467,11 +720,27 @@ class DisaggServer:
         self._done_round()
         return progressed
 
+    def _clock_settled(self) -> bool:
+        """The clock round is adopted — or can never be: a dead
+        reference rank answers no pings and leads no round, so waiting
+        on it would hold the whole drain hostage (TTFTs then ship
+        uncorrected with unc None, which is the honest degraded
+        outcome, not a hang)."""
+        return self._clock_table is not None or \
+            self.clock.ref not in self.consensus.alive()
+
     def quiescent(self) -> bool:
         """Locally drained: nothing unrouted, engine idle, no parked
-        imports, no unexported holds."""
+        imports, no unexported holds — and the clock round settled (a
+        short workload must not declare the mesh done while offsets
+        are still converging: collected TTFTs would ship uncorrected.
+        The round terminates on any live mesh: every stepping rank
+        votes, a dead non-reference rank is window-expired by the
+        leader, and a dead REFERENCE releases the gate outright —
+        see :meth:`_clock_settled`)."""
         eng = self.engine
-        return (not self._unrouted()
+        return (self._clock_settled()
+                and not self._unrouted()
                 and not self._pending_imports
                 and not eng._held_ready
                 and not eng._queue and not eng._inflight
@@ -554,17 +823,52 @@ class DisaggServer:
             gid = self._local.pop(rid)
             self._reqs.pop(gid, None)
             self._collected.discard(gid)
+            self._handoff_ctx.pop(gid, None)
         self.engine.reset_results()
 
     def ttfts(self) -> Dict[int, float]:
-        """{gid: ttft_ms} measured on whichever rank emitted the first
-        token (a same-host clock pair — never cross-host deltas)."""
+        """{gid: ttft_ms} owned by the rank that served the request's
+        visible result: a same-host clock pair for locally-served
+        requests, the offset-corrected END-TO-END delta (prefill-rank
+        submit -> this rank's first token) for handed-off ones — see
+        :meth:`ttft_bounds` for the uncertainty that delta carries."""
+        self._refresh_ttfts()
         return {g: r.ttft_ms for g, r in self._reqs.items()
                 if r.ttft_ms is not None}
+
+    def ttft_uncs(self) -> Dict[int, float]:
+        """{gid: ± clock-uncertainty ms} for the TTFTs that are
+        cross-host deltas (the handed-off requests this rank decoded);
+        same-host pairs and unsynced deltas are absent."""
+        self._refresh_ttfts()
+        return {g: r.ttft_unc_ms for g, r in self._reqs.items()
+                if r.ttft_unc_ms is not None}
+
+    def ttft_bounds(self) -> Dict[int, Tuple[float, float, float]]:
+        """{gid: (lo_ms, ttft_ms, hi_ms)} — the TTFT with its clock-
+        alignment error bar. Same-host pairs have no cross-clock term
+        (lo == ttft == hi); a cross-host delta widens by the two
+        ranks' summed offset uncertainty; a cross-host delta measured
+        WITHOUT a synced clock table is excluded (its bounds would be
+        fiction)."""
+        self._refresh_ttfts()
+        out = {}
+        for g, r in self._reqs.items():
+            if r.ttft_ms is None:
+                continue
+            handed = r.prefill_rank not in (-1, self.mesh.rank) and \
+                r.decode_rank == self.mesh.rank
+            if not handed:
+                out[g] = (r.ttft_ms, r.ttft_ms, r.ttft_ms)
+            elif r.ttft_unc_ms is not None:
+                out[g] = (r.ttft_ms - r.ttft_unc_ms, r.ttft_ms,
+                          r.ttft_ms + r.ttft_unc_ms)
+        return out
 
     def write_results(self, path: str) -> None:
         """Atomic per-rank results artifact (the test/bench drivers
         merge these instead of adding a gather collective)."""
+        self._refresh_ttfts()
         doc = {
             "rank": self.mesh.rank,
             "results": {str(g): r.out.tolist()
@@ -572,6 +876,9 @@ class DisaggServer:
                         if r.out is not None},
             "ttft_ms": {str(g): round(t, 3)
                         for g, t in self.ttfts().items()},
+            "ttft_unc_ms": {str(g): round(u, 3)
+                            for g, u in self.ttft_uncs().items()},
+            "clock": _disttrace.clock_state(),
             "handoffs_sent": self.handoffs_sent,
             "handoffs_recv": self.handoffs_recv,
         }

@@ -251,6 +251,11 @@ class Request:
     temperature: Optional[float] = None   # per-request sampling overrides
     top_k: Optional[int] = None           #   (None -> engine config default)
     top_p: Optional[float] = None
+    #: cross-host trace id (ISSUE 14): stamped as a ``trace`` attr on
+    #: every lifecycle event this engine emits for the request, and
+    #: carried across the KV handoff so the decode rank's events join
+    #: the same trace. None (local-only request) emits no attr.
+    trace_id: Optional[str] = None
 
 
 class _Inflight:
@@ -473,6 +478,9 @@ class ServingEngine:
         return (self._tick_site,)
 
     def _emit(self, kind: str, rid: int, **attrs) -> None:
+        req = self._requests.get(rid)
+        if req is not None and req.trace_id is not None:
+            attrs.setdefault("trace", req.trace_id)
         _events.emit(kind, rid=rid, eng=self._eng_id, **attrs)
 
     def _pool_args(self) -> tuple:
@@ -579,10 +587,13 @@ class ServingEngine:
                temperature: Optional[float] = None,
                top_k: Optional[int] = None,
                top_p: Optional[float] = None,
-               hold_after_prefill: bool = False) -> int:
+               hold_after_prefill: bool = False,
+               trace_id: Optional[str] = None) -> int:
         """Queue one request. ``temperature``/``top_k``/``top_p``
         override the engine-global sampling params for this request
         only (ignored under greedy decode). Returns its request id.
+        ``trace_id`` (ISSUE 14) tags every event of this request with
+        a cross-host ``trace`` attr and rides any KV handoff.
 
         ``hold_after_prefill`` puts the request in prefill-group mode
         (ISSUE 13): the engine prefills the prompt (chunked, prefix-
@@ -615,7 +626,8 @@ class ServingEngine:
                       key=np.asarray(key, np.uint32),
                       submit_t=now, queue_t=now, orig_prompt_len=t0,
                       temperature=temperature, top_k=top_k, top_p=top_p,
-                      hold=bool(hold_after_prefill))
+                      hold=bool(hold_after_prefill),
+                      trace_id=trace_id)
         self._requests[rid] = req
         self._queue.append(req)
         self._emit("submit", rid, prompt_tokens=t0,
@@ -715,6 +727,7 @@ class ServingEngine:
         read-only, so a failed send can simply retry."""
         if rid not in self._held_ready:
             raise ValueError(f"request {rid} is not held-ready")
+        t_span = time.perf_counter()
         req = self._requests[rid]
         slot = self._slot_rid.index(rid)
         pages = list(self.pool._held[slot])
@@ -748,6 +761,10 @@ class ServingEngine:
         if self._quantized:
             payload["k_scale"] = np.asarray(self.pool.k_scale[:, idx])
             payload["v_scale"] = np.asarray(self.pool.v_scale[:, idx])
+        if req.trace_id is not None:
+            # the cross-host join key rides the payload: the decode
+            # rank's request (and all its events) joins this trace
+            payload["trace_id"] = req.trace_id
         nbytes = sum(payload[k].nbytes for k in
                      ("k", "v") + (("k_scale", "v_scale")
                                    if self._quantized else ()))
@@ -755,7 +772,8 @@ class ServingEngine:
         reg.counter("serving/handoffs_out").add(1)
         reg.counter("serving/handoff_bytes_out").add(nbytes)
         self._emit("handoff_out", rid, slot=slot, tokens=t0,
-                   pages=len(pages), bytes=nbytes)
+                   pages=len(pages), bytes=nbytes,
+                   ms=round((time.perf_counter() - t_span) * 1e3, 3))
         return payload
 
     def release_exported(self, rid: int) -> None:
@@ -786,10 +804,13 @@ class ServingEngine:
         local rid, or None when no slot/pages are free right now (the
         caller retries; imports never preempt residents — a transfer
         must not evict committed decode work)."""
+        t_span = time.perf_counter()
         p = np.asarray(payload["prompt"], np.int32).reshape(-1)
         t0 = p.shape[0]
         max_new = int(payload["max_new"])
         first_tok = int(payload["first_token"])
+        tid = payload.get("trace_id")
+        tid = str(tid) if tid is not None else None
         src_dtype = payload.get("kv_dtype")
         if src_dtype is not None and \
                 str(np.dtype(str(src_dtype))) != \
@@ -822,7 +843,8 @@ class ServingEngine:
                       key=np.asarray(payload["key"], np.uint32),
                       out=[first_tok], submit_t=now, queue_t=now,
                       orig_prompt_len=int(payload["orig_prompt_len"]),
-                      preempts=int(payload.get("preempts", 0)))
+                      preempts=int(payload.get("preempts", 0)),
+                      trace_id=tid)
         req.first_token_t = now
         self._requests[rid] = req
         self._slot_rid[slot] = rid
@@ -850,7 +872,8 @@ class ServingEngine:
         reg.counter("serving/handoffs_in").add(1)
         reg.counter("serving/handoff_bytes_in").add(nbytes)
         self._emit("handoff_in", rid, slot=slot, tokens=t0,
-                   pages=n_pages, bytes=nbytes)
+                   pages=n_pages, bytes=nbytes,
+                   ms=round((time.perf_counter() - t_span) * 1e3, 3))
         # the transferred first token may already satisfy the stop
         # conditions — finish without ever decoding
         eos = self.config.eos_token_id

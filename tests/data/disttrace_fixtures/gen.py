@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Regenerate the checked-in two-rank merge fixtures (ISSUE 14).
+
+Run from the repo root::
+
+    python tests/data/disttrace_fixtures/gen.py
+
+Two scenarios, both hand-scripted against ONE true reference timeline
+so the expected merged numbers are exact by construction:
+
+- ``clean/``: rank 0 prefills + exports request g00000000, rank 1
+  (whose wall clock runs +2.5 s fast, synced at ±2 ms) imports +
+  decodes it; rank 1 also serves g00000001 locally. Every milestone's
+  true reference wall time is a round number, so tests can assert the
+  merger's offset-corrected spans exactly (within the stated
+  uncertainty).
+- ``partial/``: the same handoff, but rank 1 was chaos-killed — its
+  directory never appeared — and rank 0's events.jsonl has a torn
+  tail line (killed writer). The merge must degrade to a well-formed
+  PARTIAL document.
+
+tests/test_disttrace.py additionally derives skewed variants (incl.
+negative skew) from ``clean/`` in-memory; only these two trees are
+checked in.
+"""
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+#: true reference wall times (s) of every milestone of g00000000
+T = {
+    "submit": 100.000,
+    "admit": 100.010,
+    "chunk": 100.020,
+    "first_token": 100.050,
+    "handoff_out": 100.060,
+    "handoff_in": 100.100,
+    "finish": 100.200,
+}
+#: rank 1's wall clock = true + SKEW (recovered by the sync at ±UNC)
+SKEW = 2.5
+UNC = 0.002
+
+#: each rank's arbitrary perf_counter origin: true wall 100.0 maps to
+#: these t_ns values (different per rank — monotonic clocks share no
+#: epoch, which is the whole point of the anchors)
+ORIGIN_NS = {0: 1_000_000_000, 1: 500_000_000}
+
+
+def t_ns(rank, true_wall):
+    return ORIGIN_NS[rank] + int(round((true_wall - 100.0) * 1e9))
+
+
+def wall(rank, true_wall):
+    """What rank's skewed clock SAYS at the true moment."""
+    return true_wall + (SKEW if rank == 1 else 0.0)
+
+
+def metrics_line(rank, flush_seq, true_wall, synced=True):
+    return {
+        "ts": round(true_wall, 6),       # real time (never skewed)
+        "reason": "interval" if flush_seq else "manual",
+        "rank": rank, "flush_seq": flush_seq,
+        "t_ns": t_ns(rank, true_wall),
+        "clock": {
+            "wall_s": round(wall(rank, true_wall), 6),
+            "offset_s": (SKEW if rank == 1 else 0.0) if synced
+            else None,
+            "unc_s": (UNC if rank == 1 else 0.0) if synced else None,
+            "ref": 0, "synced": synced, "anchor_unc_s": 0.0,
+        },
+        "events_lost": 0,
+        "metrics": {"serving/ticks": {"type": "counter", "value": 5}},
+    }
+
+
+def ev(rank, seq, kind, true_wall, **attrs):
+    return {"seq": seq, "t_ns": t_ns(rank, true_wall), "kind": kind,
+            "rank": rank, **attrs}
+
+
+G0 = "g00000000"
+G1 = "g00000001"
+
+
+def rank0_events():
+    s = iter(range(100))
+    return [
+        ev(0, next(s), "submit", T["submit"], rid=0, eng=0, trace=G0,
+           prompt_tokens=16, max_new=6),
+        ev(0, next(s), "consensus_decision", T["submit"] + 0.002,
+           family="admit", epoch=0, leader=0, missing=0, rtt_ms=1.5),
+        ev(0, next(s), "admit", T["admit"], rid=0, eng=0, trace=G0,
+           slot=0),
+        ev(0, next(s), "chunk", T["chunk"], rid=0, eng=0, trace=G0,
+           slot=0, start=0, end=16, final=True),
+        ev(0, next(s), "first_token", T["first_token"], rid=0, eng=0,
+           trace=G0, slot=0),
+        ev(0, next(s), "handoff_out", T["handoff_out"], rid=0, eng=0,
+           trace=G0, slot=0, tokens=16, pages=2, bytes=8192, ms=4.0),
+        # NOTE: no finish event here — release_exported marks the
+        # request done on the prefill rank without one; the decode
+        # rank owns the visible finish (mirrors the real engine)
+    ]
+
+
+def rank1_events():
+    s = iter(range(100))
+    out = [
+        ev(1, next(s), "clock_sync", T["submit"] - 0.050,
+           offset_s=SKEW, unc_s=UNC, ref=0),
+        ev(1, next(s), "route", T["submit"] + 0.003, gid=0,
+           trace=G0, prefill=0, decode=1),
+        ev(1, next(s), "route", T["submit"] + 0.003, gid=1,
+           trace=G1, prefill=-1, decode=1),
+        # the locally-served request (no handoff): a same-host pair
+        ev(1, next(s), "submit", T["submit"], rid=0, eng=1, trace=G1,
+           prompt_tokens=8, max_new=6),
+        ev(1, next(s), "admit", T["admit"], rid=0, eng=1, trace=G1,
+           slot=0),
+        ev(1, next(s), "first_token", T["first_token"], rid=0, eng=1,
+           trace=G1, slot=0),
+        ev(1, next(s), "handoff_in", T["handoff_in"], rid=1, eng=1,
+           trace=G0, slot=1, tokens=16, pages=2, bytes=8192, ms=6.0),
+        ev(1, next(s), "finish", T["finish"] - 0.020, rid=0, eng=1,
+           trace=G1, tokens=6, reason="max_new", ttft_ms=50.0,
+           tpot_ms=8.0),
+        ev(1, next(s), "finish", T["finish"], rid=1, eng=1, trace=G0,
+           tokens=6, reason="max_new", ttft_ms=None, tpot_ms=10.0),
+    ]
+    return out
+
+
+def write(path, rows, torn_tail=False):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        if torn_tail:
+            f.write('{"seq": 99, "t_ns": 1234, "ki')  # killed writer
+
+
+def main():
+    # ---- clean ----
+    for rank, evs in ((0, rank0_events()), (1, rank1_events())):
+        d = os.path.join(HERE, "clean", f"rank{rank}")
+        write(os.path.join(d, "events.jsonl"), evs)
+        write(os.path.join(d, "metrics.jsonl"),
+              [metrics_line(rank, 0, 99.5, synced=False),
+               metrics_line(rank, 1, 100.5)])
+    # ---- partial: rank 1 never flushed (chaos kill), rank 0 torn ----
+    d = os.path.join(HERE, "partial", "rank0")
+    write(os.path.join(d, "events.jsonl"), rank0_events(),
+          torn_tail=True)
+    write(os.path.join(d, "metrics.jsonl"),
+          [metrics_line(0, 0, 100.5)])
+    print("fixtures regenerated under", HERE)
+
+
+if __name__ == "__main__":
+    main()

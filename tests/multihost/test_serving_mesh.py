@@ -1,10 +1,16 @@
-"""Disaggregated serving on REAL processes (ISSUE 13 acceptance): the
-prefill rank ships finished KV to the decode rank through the
-atomic-rename channel, decode output is bitwise the single-host
-stream, and a rank killed MID-HANDOFF leaves the survivor's pool-shard
-refcounts consistent with zero torn imports."""
+"""Disaggregated serving on REAL processes (ISSUE 13 acceptance +
+ISSUE 14 cross-host tracing): the prefill rank ships finished KV to
+the decode rank through the atomic-rename channel, decode output is
+bitwise the single-host stream, the decode rank reports true
+offset-corrected end-to-end TTFTs (the prefill rank's clock is
+deliberately skewed +0.4 s to prove the correction), the per-rank
+sinks merge into ONE monotonic clock-aligned timeline per request —
+and a rank killed MID-HANDOFF leaves the survivor's pool-shard
+refcounts consistent, zero torn imports, and a partial but
+schema-valid merge."""
 import json
 import os
+import subprocess
 import sys
 
 import pytest
@@ -16,16 +22,43 @@ import mp_mesh  # noqa: E402
 pytestmark = [pytest.mark.multihost, pytest.mark.slow]
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
 WORKER = os.path.join(HERE, "worker_serving.py")
+MERGER = os.path.join(REPO, "tools", "merge_traces.py")
+CHECKER = os.path.join(REPO, "tools", "check_sink_schema.py")
+
+#: the prefill rank's injected wall-clock skew (seconds): big next to
+#: the loopback sync uncertainty (~ms), small next to the run length
+SKEW = 0.4
 
 
-def test_two_process_disagg_handoff_bitwise(tmp_path):
+def _merge(sink_root, out):
+    res = subprocess.run(
+        [sys.executable, MERGER, str(sink_root), "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr + res.stdout
+    return json.load(open(out))
+
+
+def _schema_check(rank_dir, merged_json):
+    res = subprocess.run(
+        [sys.executable, CHECKER, str(rank_dir),
+         "--merged-json", str(merged_json)],
+        capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_two_process_disagg_handoff_bitwise_and_merged(tmp_path):
     """The serving-handoff smoke the CI leg runs: 2 real processes,
-    rank 1 prefills + exports, rank 0 imports + decodes; rank 0
-    asserts bitwise parity against its own single-host reference
-    in-process, and both audit their shard."""
+    rank 1 prefills + exports (on a +0.4 s skewed clock), rank 0
+    imports + decodes; rank 0 asserts bitwise parity against its own
+    single-host reference in-process and owns every TTFT (true e2e
+    with uncertainty for the handed-off ones); merging the two ranks'
+    sinks yields one offset-corrected MONOTONIC timeline per request
+    with ordered TTFT bounds."""
     res = mp_mesh.launch(2, WORKER, [str(tmp_path), "run"],
-                         log_dir=str(tmp_path / "logs"), timeout=480)
+                         log_dir=str(tmp_path / "logs"), timeout=480,
+                         env_extra={"PADDLE_CLOCK_SKEW": f"1:{SKEW}"})
     assert res.ok, res.tail()
     with open(tmp_path / "results.0.json") as f:
         r0 = json.load(f)
@@ -34,9 +67,47 @@ def test_two_process_disagg_handoff_bitwise(tmp_path):
     assert r1["handoffs_sent"] == r0["handoffs_recv"] > 0
     assert r0["results"]                 # the decode rank owns outputs
     assert not r1["results"]             # the prefill rank owns none
-    # TTFTs were measured on whichever host emitted the first token:
-    # handed-off requests' on rank 1, direct ones' on rank 0
-    assert r1["ttft_ms"] and r0["ttft_ms"]
+    # ISSUE 14: ONE rank (the decode side) owns EVERY ttft; the
+    # handed-off ones carry a clock-uncertainty bound and, despite
+    # the 0.4 s skew, land inside the run's physical envelope
+    assert r0["ttft_ms"] and not r1["ttft_ms"]
+    assert r0["ttft_unc_ms"]
+    for g, unc in r0["ttft_unc_ms"].items():
+        assert r0["ttft_ms"][g] > 0
+        assert unc < SKEW * 1e3 / 2      # sync beat the skew
+    # rank 1's sink metadata recovered its own skew
+    assert r1["clock"]["synced"]
+    assert abs(r1["clock"]["offset_s"] - SKEW) <= \
+        r1["clock"]["unc_s"] + 0.05
+
+    # ---- the merged mesh trace (tentpole acceptance) ----
+    merged_path = tmp_path / "merged_trace.json"
+    doc = _merge(tmp_path / "sink", merged_path)
+    _schema_check(tmp_path / "sink" / "rank0", merged_path)
+    assert not doc["partial"]
+    assert doc["handoffs"] == r0["handoffs_recv"]
+    assert abs(doc["ranks"]["1"]["offset_s"] - SKEW) <= \
+        doc["ranks"]["1"]["unc_s"] + 0.05
+    assert doc["monotonic_violations"] == 0
+    by_trace = {r["trace"]: r for r in doc["requests"]}
+    handed = [r for r in doc["requests"] if r["handed_off"]]
+    assert len(handed) == doc["handoffs"]
+    for req in doc["requests"]:
+        assert req["complete"] and req["monotonic"], req
+    for req in handed:
+        assert req["ranks"] == [0, 1]
+        s = req["spans_ms"]
+        for k in ("queue_wait_ms", "prefill_ms", "export_ms",
+                  "channel_wait_ms", "import_ms", "decode_ms"):
+            assert s[k] is not None, (req["trace"], k, s)
+        assert req["ttft_lo_ms"] <= req["ttft_ms"] <= req["ttft_hi_ms"]
+        # the merged e2e TTFT agrees with the rank-level one within
+        # the combined uncertainty (+ driver-vs-event stamp slack)
+        gid = str(int(req["trace"][1:]))
+        if gid in r0["ttft_ms"]:
+            assert abs(req["ttft_ms"] - r0["ttft_ms"][gid]) <= \
+                req["ttft_unc_ms"] + r0["ttft_unc_ms"][gid] + 150.0
+    assert doc["latency"]["ttft_ms"]["count"] == len(by_trace)
 
 
 def test_kill_prefill_rank_mid_handoff_survivor_consistent(tmp_path):
@@ -44,7 +115,9 @@ def test_kill_prefill_rank_mid_handoff_survivor_consistent(tmp_path):
     payload write and atomic rename. The survivor must see zero
     handoffs (the .tmp is invisible), keep serving its direct
     requests bitwise, and pass the refcount audit — asserted inside
-    the surviving worker; a failed assert fails its exit code here."""
+    the surviving worker; a failed assert fails its exit code here.
+    ISSUE 14: merging what the mesh left behind still yields a
+    PARTIAL but schema-valid trace."""
     res = mp_mesh.launch(2, WORKER, [str(tmp_path), "chaos"],
                          log_dir=str(tmp_path / "logs"), timeout=480,
                          chaos="kill:1:pre_handoff_commit",
@@ -57,3 +130,13 @@ def test_kill_prefill_rank_mid_handoff_survivor_consistent(tmp_path):
     names = os.listdir(hdir)
     assert any(".tmp" in n for n in names), names
     assert not any(n.endswith(".npz") for n in names), names
+    # kill-one chaos leaves a partial but well-formed merge: the
+    # victim's requests are torn traces, the survivor's direct ones
+    # are whole — and the artifact still validates
+    merged_path = tmp_path / "merged_trace.json"
+    doc = _merge(tmp_path / "sink", merged_path)
+    _schema_check(tmp_path / "sink" / "rank0", merged_path)
+    assert doc["partial"]
+    assert doc["requests_total"] > 0
+    assert any(not r["complete"] for r in doc["requests"])
+    assert any(r["complete"] for r in doc["requests"])

@@ -55,10 +55,18 @@ def main():
     rank, world = mp_mesh.init()
     assert world == 2
     import numpy as np
+    import paddle_tpu.profiler as profiler
     from paddle_tpu.serving import (DisaggServer, HandoffChannel,
                                     MeshSpec, ServingConfig)
 
     net, prompts = build()
+    # per-rank sink (ISSUE 14): every rank's events + clock metadata
+    # land under <out_dir>/sink/rank<K>/ — the driver-side test merges
+    # them with tools/merge_traces.py and asserts the stitched
+    # cross-host timelines (the launcher may inject a known clock
+    # skew via PADDLE_CLOCK_SKEW to prove the offset correction)
+    profiler.enable_sink(os.path.join(out_dir, "sink"),
+                         interval_s=30.0)
     if mode == "chaos" and rank == 1:
         # die between the payload bytes landing and the atomic rename
         HandoffChannel.pre_commit = staticmethod(
@@ -69,6 +77,10 @@ def main():
     for p in prompts:
         srv.submit(p, MAX_NEW)
     mp_mesh.barrier("engines-up")
+    # a flush BEFORE the chaos point: the victim's sink dir must hold
+    # an anchor line + its pre-kill events, or the kill-one merge
+    # would have nothing to degrade over
+    profiler.flush_active("manual")
 
     ok = os.path.join(out_dir, f"ok.{rank}")
     if mode == "run":
@@ -81,10 +93,23 @@ def main():
             for gid in want:
                 np.testing.assert_array_equal(got[gid], want[gid])
             assert srv.handoffs_recv > 0
+            # the retired hole (ISSUE 14): every handed-off request
+            # has a non-None end-to-end TTFT with an uncertainty
+            handed = [g for g, r in srv._reqs.items()
+                      if r.prefill_rank == 1]
+            ttfts = srv.ttfts()
+            uncs = srv.ttft_uncs()
+            assert handed and all(ttfts.get(g) is not None
+                                  for g in handed), (handed, ttfts)
+            assert all(g in uncs for g in handed), (handed, uncs)
         else:
             assert srv.handoffs_sent > 0
+            # the prefill rank reports NO ttft for exported requests
+            # — exactly one rank owns each gid's number
+            assert srv.ttfts() == {}
         assert srv.check_consistency() == []
         srv.write_results(os.path.join(out_dir, f"results.{rank}.json"))
+        profiler.disable_sink()       # os._exit skips atexit: flush NOW
         if rank == 0:
             mp_mesh.finish_last(ok, [os.path.join(out_dir, "ok.1")])
         mp_mesh.finish(ok)
@@ -123,6 +148,7 @@ def main():
     leftovers = [n for n in os.listdir(hdir)
                  if n.endswith("-to0.npz")]
     assert leftovers == [], leftovers
+    profiler.disable_sink()              # persist the survivor's half
     mp_mesh.finish(ok)
 
 

@@ -645,3 +645,135 @@ class TestDisaggServerParity:
             "serving/prefill_chunks").value == chunks0
         assert de.compiled_sites == (de._tick_site,)
         assert recompile.trace_counts()[de._tick_site] == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-host tracing (ISSUE 14): true end-to-end TTFT over the handoff
+# ---------------------------------------------------------------------------
+class TestCrossHostTTFT:
+    def test_handed_off_request_reports_offset_corrected_e2e_ttft(
+            self, tmp_path):
+        """THE regression for the retired hole: a handed-off request
+        used to finish with ttft_ms=None (the decode-side clock pair
+        was a bogus ~0 ms and was suppressed). Now the decode rank
+        reports the TRUE end-to-end TTFT — prefill-rank submit wall ->
+        decode-rank first token — corrected by the agreed clock
+        offsets and carrying their summed uncertainty, proven here by
+        giving the decode rank a clock that runs 5 s SLOW: an
+        uncorrected delta would come out ~ -5000 ms."""
+        import time as _time
+
+        from paddle_tpu.profiler import disttrace
+        from paddle_tpu.profiler import events as pevents
+
+        net = _net()
+        prompts = _prompts((8, 16, 12))    # gid 0 direct, 1+2 handed
+        max_new = 4
+        servers = [DisaggServer(net, ServingConfig(**CFG),
+                                MeshSpec(r, 2, prefill_ranks=(0,)),
+                                str(tmp_path), lease_s=2.0,
+                                clock_skew_s=-5.0 if r == 1 else 0.0)
+                   for r in range(2)]
+        seq0 = pevents.log().next_seq
+        for srv in servers:
+            for p in prompts:
+                srv.submit(p, max_new)
+        t0 = _time.perf_counter()
+        merged = _drive_two(servers)
+        wall_ms = (_time.perf_counter() - t0) * 1e3
+        assert sorted(merged) == [0, 1, 2]
+
+        decode = servers[1]
+        handed = [g for g, r in decode._reqs.items()
+                  if r.prefill_rank == 0]
+        assert sorted(handed) == [1, 2]
+        ttfts = decode.ttfts()
+        bounds = decode.ttft_bounds()
+        for g in handed:
+            # non-None (the retired hole), positive and physically
+            # sane (inside the run's wall clock — a +-5 s skew leak
+            # would blow far outside it), with ordered bounds
+            assert ttfts.get(g) is not None
+            assert 0.0 < ttfts[g] < wall_ms + 1000.0
+            lo, mid, hi = bounds[g]
+            assert lo <= mid <= hi
+            assert hi - lo < 1000.0      # loopback sync is tight
+            assert decode._reqs[g].ttft_unc_ms is not None
+        # exactly one rank owns each gid's TTFT: the prefill rank
+        # reports none for requests it exported
+        assert all(g not in servers[0].ttfts() for g in handed)
+
+        # the agreed table recovered the injected skew
+        off = decode._clock_table["1"]["offset_s"]
+        unc = decode._clock_table["1"]["unc_s"]
+        assert abs(off - (-5.0)) <= unc + 0.05
+
+        # trace-context propagation: both halves of a handed-off
+        # request's lifecycle carry the SAME deterministic trace id,
+        # and the routing decision left its event
+        evs = pevents.log().events(since_seq=seq0)
+        for g in handed:
+            tid = disttrace.trace_id(g)
+            kinds = {e.kind for e in evs
+                     if e.attrs.get("trace") == tid}
+            assert {"submit", "admit", "handoff_out", "handoff_in",
+                    "finish"} <= kinds, (g, kinds)
+        assert any(e.kind == "route" for e in evs)
+        assert any(e.kind == "clock_sync" for e in evs)
+        ho = [e for e in evs if e.kind in ("handoff_out",
+                                           "handoff_in")]
+        assert all("ms" in e.attrs for e in ho)
+        for srv in servers:
+            srv.close()
+
+    def test_window_expired_rank_self_heals_and_reaches_the_mesh(
+            self, tmp_path):
+        """A rank whose clock samples weren't ready when the vote
+        window expired is published OUT of the first offset table. It
+        must not stay unsynced forever: it keeps sampling against the
+        still-serving reference, heals its own entry the moment its
+        estimate lands, and re-votes — opening the next clock epoch,
+        which the peers join, so the straggler's offset reaches the
+        WHOLE mesh (tables merge across epochs)."""
+        from paddle_tpu.distributed.consensus import Consensus
+
+        net = _net()
+        conss = [Consensus(str(tmp_path / "board"), r, 2,
+                           lease_s=30.0, window_s=0.3)
+                 for r in range(2)]
+        servers = [DisaggServer(net, ServingConfig(**CFG),
+                                MeshSpec(r, 2, prefill_ranks=(0,)),
+                                str(tmp_path), consensus=conss[r],
+                                clock_skew_s=0.75 if r == 1 else 0.0)
+                   for r in range(2)]
+        try:
+            # rank 0 alone: votes, the window expires on rank 1, the
+            # leader publishes a table WITHOUT it
+            deadline = time.time() + 10
+            while servers[0]._clock_table is None and \
+                    time.time() < deadline:
+                servers[0]._clock_round()
+                time.sleep(0.02)
+            assert servers[0]._clock_table is not None
+            assert "1" not in servers[0]._clock_table
+            # rank 1 joins late: samples, self-heals, re-rounds; rank
+            # 0 joins the new epoch and adopts the merged table
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                servers[0]._clock_round()
+                servers[1]._clock_round()
+                t0, t1 = servers[0]._clock_table, \
+                    servers[1]._clock_table
+                if t0 and t1 and "1" in t0 and "1" in t1:
+                    break
+                time.sleep(0.005)
+            for srv in servers:
+                assert set(srv._clock_table) == {"0", "1"}, \
+                    srv._clock_table
+            e1 = servers[1]._clock_table["1"]
+            assert abs(e1["offset_s"] - 0.75) <= e1["unc_s"] + 0.05
+            # both sides agree on the straggler's offset
+            assert servers[0]._clock_table["1"] == e1
+        finally:
+            for srv in servers:
+                srv.close()

@@ -1,0 +1,478 @@
+"""Cross-host request tracing (ISSUE 14): the Cristian clock sync,
+the offline trace merger over CHECKED-IN two-rank fixtures (clean
+handoff, kill-one partial, clock offsets incl. negative skew,
+uncertainty propagation into TTFT bounds), the sink's clock metadata,
+the flight recorder's mesh-ordering tags, and the schema validators
+for all of it — pure host tests, no jit."""
+import importlib.util
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import disttrace
+from paddle_tpu.profiler.events import (EventLog, FlightRecorder,
+                                        breakdown_from_events)
+from paddle_tpu.profiler.sink import MetricsSink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "disttrace_fixtures")
+
+
+def _load_tool(name):
+    path = os.path.join(REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+merge_traces = _load_tool("merge_traces")
+check_sink_schema = _load_tool("check_sink_schema")
+SCHEMA = json.load(open(os.path.join(REPO, "tools",
+                                     "sink_schema.json")))
+
+
+def _check_errors(fn, *args):
+    """Run one checker function and return the violations it found."""
+    check_sink_schema._ERRORS.clear()
+    fn(*args)
+    errs = list(check_sink_schema._ERRORS)
+    check_sink_schema._ERRORS.clear()
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# trace ids + skew parsing
+# ---------------------------------------------------------------------------
+def test_trace_id_deterministic():
+    assert disttrace.trace_id(7) == "g00000007"
+    assert disttrace.trace_id(7) == disttrace.trace_id(7)
+    assert disttrace.trace_id(7) != disttrace.trace_id(8)
+
+
+def test_skew_env_parsing(monkeypatch):
+    monkeypatch.setenv(disttrace.SKEW_ENV, "1:0.5,3:-0.25")
+    assert disttrace.local_skew_s(0) == 0.0
+    assert disttrace.local_skew_s(1) == 0.5
+    assert disttrace.local_skew_s(3) == -0.25
+    monkeypatch.setenv(disttrace.SKEW_ENV, "0.125")
+    assert disttrace.local_skew_s(2) == 0.125
+    monkeypatch.delenv(disttrace.SKEW_ENV)
+    assert disttrace.local_skew_s(1) == 0.0
+    assert disttrace.walltime(0.0) <= disttrace.walltime(1.0)
+
+
+# ---------------------------------------------------------------------------
+# ClockSync
+# ---------------------------------------------------------------------------
+class TestClockSync:
+    def _sync(self, tmp_path, skew, n=4):
+        ref = disttrace.ClockSync(str(tmp_path), 0, 2, skew_s=0.0,
+                                  n_samples=n)
+        cli = disttrace.ClockSync(str(tmp_path), 1, 2, skew_s=skew,
+                                  n_samples=n)
+        for _ in range(200):
+            ref.step()
+            if cli.step():
+                break
+        assert cli.ready
+        return ref, cli
+
+    @pytest.mark.parametrize("skew", [0.75, -0.75, 0.0])
+    def test_recovers_injected_skew_within_uncertainty(self, tmp_path,
+                                                       skew):
+        ref, cli = self._sync(tmp_path / f"s{skew}", skew)
+        off, unc = cli.estimate()
+        assert unc >= 0.0
+        # the estimate must bracket the injected truth — the whole
+        # point of the stated uncertainty (loopback round trips are
+        # well under a millisecond; allow scheduler-noise headroom)
+        assert abs(off - skew) <= unc + 0.05
+        assert ref.estimate() == (0.0, 0.0)
+
+    def test_reference_is_ready_immediately_and_serves(self, tmp_path):
+        ref = disttrace.ClockSync(str(tmp_path), 0, 2, skew_s=0.0)
+        assert ref.step() and ref.ready
+        cli = disttrace.ClockSync(str(tmp_path), 1, 2, skew_s=0.0,
+                                  n_samples=1)
+        assert not cli.ready
+        for _ in range(20):
+            cli.step()
+            ref.step()
+            if cli.ready:
+                break
+        assert cli.ready
+        # consumed protocol files are cleaned up
+        assert [n for n in os.listdir(tmp_path)
+                if n.startswith(("ping.", "pong."))] == []
+
+    def test_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            disttrace.ClockSync(str(tmp_path), 2, 2)
+        with pytest.raises(ValueError):
+            disttrace.ClockSync(str(tmp_path), 0, 1, n_samples=0)
+
+
+# ---------------------------------------------------------------------------
+# the merger over the checked-in fixtures
+# ---------------------------------------------------------------------------
+class TestMergeClean:
+    @pytest.fixture()
+    def doc(self):
+        return merge_traces.merge(os.path.join(FIXTURES, "clean"))
+
+    def test_offsets_read_from_sink_metadata(self, doc):
+        assert doc["ranks"]["0"]["offset_s"] == 0.0
+        assert doc["ranks"]["1"]["offset_s"] == 2.5
+        assert doc["ranks"]["1"]["unc_s"] == 0.002
+        assert not doc["partial"]
+
+    def test_handed_off_request_stitches_offset_corrected(self, doc):
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        assert req["handed_off"] and req["complete"]
+        assert req["monotonic"]
+        assert req["ranks"] == [0, 1]
+        s = req["spans_ms"]
+        # the fixture's true timeline is round numbers by construction
+        # — the +2.5 s skew on rank 1 must vanish entirely
+        assert s["queue_wait_ms"] == pytest.approx(10.0, abs=1e-3)
+        assert s["prefill_ms"] == pytest.approx(40.0, abs=1e-3)
+        assert s["export_ms"] == 4.0
+        assert s["channel_wait_ms"] == pytest.approx(40.0, abs=1e-3)
+        assert s["import_ms"] == 6.0
+        assert s["decode_ms"] == pytest.approx(100.0, abs=1e-3)
+        assert s["total_ms"] == pytest.approx(200.0, abs=1e-3)
+
+    def test_ttft_bounds_propagate_uncertainty(self, doc):
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        # e2e TTFT = submit (rank 0) -> handoff_in (rank 1): a
+        # cross-host delta carrying both ranks' summed uncertainty
+        assert req["ttft_ms"] == pytest.approx(100.0, abs=1e-3)
+        assert req["ttft_unc_ms"] == pytest.approx(2.0, abs=1e-6)
+        assert req["ttft_lo_ms"] <= req["ttft_ms"] <= req["ttft_hi_ms"]
+        assert req["ttft_hi_ms"] - req["ttft_lo_ms"] == \
+            pytest.approx(4.0, abs=1e-6)
+        assert req["spans_ms"]["channel_wait_unc_ms"] == \
+            pytest.approx(2.0, abs=1e-6)
+        # the local request is a same-host pair: zero cross-clock term
+        loc = {r["trace"]: r for r in doc["requests"]}["g00000001"]
+        assert loc["ttft_unc_ms"] == 0.0
+        assert loc["ttft_lo_ms"] == loc["ttft_ms"] == loc["ttft_hi_ms"]
+
+    def test_latency_block_and_schema(self, doc):
+        assert doc["latency"]["ttft_ms"]["count"] == 2
+        assert doc["latency"]["tpot_ms"]["count"] == 2
+        assert doc["handoff_breakdown_ms"]["export"]["count"] == 1
+        assert doc["handoff_breakdown_ms"]["channel_wait"]["p50"] == \
+            pytest.approx(40.0, abs=1e-3)
+        assert _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc") == []
+
+    def test_negative_skew_variant(self, tmp_path):
+        """Rewrite the checked-in fixture with rank 1 running SLOW
+        (negative offset): the corrected timeline must be identical."""
+        src = os.path.join(FIXTURES, "clean")
+        dst = tmp_path / "neg"
+        shutil.copytree(src, dst)
+        mpath = dst / "rank1" / "metrics.jsonl"
+        rows = [json.loads(x) for x in open(mpath)]
+        for row in rows:
+            c = row["clock"]
+            if c["offset_s"] is not None:
+                # the rank's clock reads 2.5 s fast in the fixture;
+                # flip it to 3.5 s slow: wall stamps AND the agreed
+                # offset move together, exactly like a real slow clock
+                c["wall_s"] = round(c["wall_s"] - 2.5 - 3.5, 6)
+                c["offset_s"] = -3.5
+        with open(mpath, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        doc = merge_traces.merge(str(dst))
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        assert req["monotonic"]
+        assert req["ttft_ms"] == pytest.approx(100.0, abs=1e-3)
+        assert req["spans_ms"]["channel_wait_ms"] == \
+            pytest.approx(40.0, abs=1e-3)
+
+
+class TestMergeDegraded:
+    def test_partial_fixture_is_well_formed(self):
+        """Kill-one chaos shape: rank 1's dir never appeared, rank 0's
+        events.jsonl has a torn tail. The merge is PARTIAL but
+        schema-valid, and the surviving half of the trace is there."""
+        doc = merge_traces.merge(os.path.join(FIXTURES, "partial"))
+        assert doc["partial"]
+        assert doc["ranks"]["0"]["truncated_lines"] == 1
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        assert not req["complete"]        # no finish ever observed
+        assert not req["handed_off"]      # the import never happened
+        assert req["spans_ms"]["prefill_ms"] == \
+            pytest.approx(40.0, abs=1e-3)
+        assert _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc") == []
+
+    def test_missing_rank_dir_listed_as_missing(self, tmp_path):
+        src = os.path.join(FIXTURES, "clean")
+        dst = tmp_path / "half"
+        shutil.copytree(src, dst)
+        shutil.rmtree(dst / "rank1")
+        doc = merge_traces.merge(str(dst))
+        # rank 0's own artifacts are healthy; the evidence of the
+        # vanished peer is the TORN trace (export, no import/finish)
+        # — which must flag the merge partial all the same
+        assert doc["partial"]
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        assert not req["complete"]
+        assert _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc") == []
+
+    def test_route_event_names_the_vanished_rank(self, tmp_path):
+        """A surviving rank's route events carry the assignment's
+        prefill/decode ranks — the ONE cross-reference that lets the
+        merger list a rank whose dir never appeared as missing:true
+        (a rank's own files only ever name their writer)."""
+        src = os.path.join(FIXTURES, "clean")
+        dst = tmp_path / "named"
+        shutil.copytree(src, dst)
+        shutil.rmtree(dst / "rank1")
+        with open(dst / "rank0" / "events.jsonl", "a") as f:
+            f.write(json.dumps({"seq": 50, "t_ns": 1_000_000_000,
+                                "kind": "route", "rank": 0, "gid": 0,
+                                "trace": "g00000000", "prefill": 0,
+                                "decode": 1}) + "\n")
+        doc = merge_traces.merge(str(dst))
+        assert doc["ranks"]["1"]["missing"] is True
+        assert doc["partial"]
+        assert _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc") == []
+
+    def test_unanchored_rank_events_are_counted_not_merged(self,
+                                                           tmp_path):
+        """A rank whose sink never flushed an anchor line cannot be
+        placed on any wall clock: its events are excluded from
+        stitching and counted as unplaced, never silently mis-timed."""
+        src = os.path.join(FIXTURES, "clean")
+        dst = tmp_path / "noanchor"
+        shutil.copytree(src, dst)
+        os.unlink(dst / "rank1" / "metrics.jsonl")
+        doc = merge_traces.merge(str(dst))
+        assert doc["partial"]
+        assert doc["unplaced_events"] > 0
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        assert not req["handed_off"]
+
+    def test_monotonicity_violation_beyond_uncertainty_flagged(
+            self, tmp_path):
+        """An import that lands BEFORE its export by more than the
+        stated clock uncertainty is a real ordering violation — the
+        merger must say so instead of absorbing it."""
+        src = os.path.join(FIXTURES, "clean")
+        dst = tmp_path / "bad"
+        shutil.copytree(src, dst)
+        epath = dst / "rank1" / "events.jsonl"
+        rows = [json.loads(x) for x in open(epath)]
+        for row in rows:
+            if row["kind"] == "handoff_in":
+                row["t_ns"] -= int(0.1e9)   # 100 ms early, unc is 2 ms
+        with open(epath, "w") as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+        doc = merge_traces.merge(str(dst))
+        req = {r["trace"]: r for r in doc["requests"]}["g00000000"]
+        assert not req["monotonic"]
+        assert doc["monotonic_violations"] == 1
+
+
+class TestChromeTrace:
+    def test_one_track_per_rank_spans_linked_by_flow(self):
+        doc = merge_traces.merge(os.path.join(FIXTURES, "clean"))
+        ct = merge_traces.chrome_trace(doc)
+        evs = ct["traceEvents"]
+        names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+        assert names == {"rank 0", "rank 1"}
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert {e["pid"] for e in xs} == {0, 1}
+        assert any(e["name"].endswith(":channel_wait") for e in xs)
+        flows = [e for e in evs if e["ph"] in ("s", "f")]
+        assert {e["ph"] for e in flows} == {"s", "f"}
+        assert all(e["id"] == "g00000000" for e in flows)
+        for e in xs:
+            assert e["dur"] >= 0 and e["ts"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# sink metadata + flight recorder tags
+# ---------------------------------------------------------------------------
+class TestSinkClockMetadata:
+    def test_flush_line_carries_anchor_and_clock(self, tmp_path):
+        lg = EventLog()
+        disttrace.set_clock_state(0.25, 0.001, ref=0)
+        try:
+            s = MetricsSink(str(tmp_path), interval_s=60,
+                            event_log=lg, rank=0)
+            line = s._flush_locked("manual")
+            s.close()
+        finally:
+            disttrace.reset_clock_state()
+        assert isinstance(line["t_ns"], int)
+        c = line["clock"]
+        assert c["offset_s"] == 0.25 and c["unc_s"] == 0.001
+        assert c["synced"] and c["ref"] == 0
+        assert isinstance(c["wall_s"], float)
+        # the on-disk line round-trips through the schema checker
+        errs = _check_errors(check_sink_schema.check_metrics_jsonl,
+                             str(tmp_path / "metrics.jsonl"), SCHEMA)
+        assert errs == []
+
+    def test_unsynced_state_stamps_nulls_not_zeros(self, tmp_path):
+        lg = EventLog()
+        disttrace.reset_clock_state()
+        s = MetricsSink(str(tmp_path), interval_s=60,
+                        event_log=lg, rank=0)
+        line = s._flush_locked("manual")
+        s.close()
+        assert line["clock"]["offset_s"] is None
+        assert line["clock"]["unc_s"] is None
+        assert not line["clock"]["synced"]
+
+    def test_anchor_wall_honors_injected_skew(self, tmp_path,
+                                              monkeypatch):
+        import time as _time
+
+        monkeypatch.setenv(disttrace.SKEW_ENV, "0:2.0")
+        lg = EventLog()
+        s = MetricsSink(str(tmp_path), interval_s=60,
+                        event_log=lg, rank=0)
+        line = s._flush_locked("manual")
+        s.close()
+        assert line["clock"]["wall_s"] - _time.time() > 1.5
+        # ts (the human-facing stamp) stays REAL time
+        assert abs(line["ts"] - _time.time()) < 1.0
+
+
+class TestFlightRecorderTags:
+    def test_dump_carries_rank_clock_and_epochs(self, tmp_path):
+        from paddle_tpu.distributed.consensus import Consensus
+
+        c = Consensus(str(tmp_path / "board"), 0, 1)
+        c.decide("ordering", 1, reducer="max")
+        disttrace.set_clock_state(0.5, 0.002, ref=0)
+        try:
+            doc = FlightRecorder(tail_events=4).dump(reason="test")
+        finally:
+            disttrace.reset_clock_state()
+        assert doc["rank"] == 0
+        assert doc["clock"]["offset_s"] == 0.5
+        assert doc["consensus_epochs"].get("ordering") == 0
+
+
+# ---------------------------------------------------------------------------
+# breakdown coexistence + schema negatives
+# ---------------------------------------------------------------------------
+def test_new_kinds_do_not_move_the_breakdown_state_machine():
+    lg = EventLog()
+    lg.emit("submit", rid=1)
+    lg.emit("route", gid=1, trace="g1", prefill=0, decode=1)
+    lg.emit("admit", rid=1)
+    lg.emit("clock_sync", offset_s=0.0, unc_s=0.0, ref=0)
+    lg.emit("first_token", rid=1)
+    lg.emit("consensus_decision", family="admit", epoch=0, leader=0,
+            missing=0)
+    lg.emit("finish", rid=1, tokens=3, reason="max_new", ttft_ms=1.0,
+            tpot_ms=1.0)
+    b = breakdown_from_events(lg.events(rid=1))
+    assert b["complete"]
+    total = b["queue_wait_ms"] + b["prefill_ms"] + b["decode_ms"] \
+        + b["preempted_ms"]
+    assert b["total_ms"] == pytest.approx(total, abs=0.01)
+
+
+class TestSchemaNegatives:
+    def _merged(self):
+        return merge_traces.merge(os.path.join(FIXTURES, "clean"))
+
+    def test_unordered_ttft_bounds_flagged(self):
+        doc = self._merged()
+        req = doc["requests"][0]
+        req["ttft_lo_ms"], req["ttft_hi_ms"] = 1e9, -1e9
+        errs = _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc")
+        assert any("bounds not ordered" in e for e in errs)
+
+    def test_missing_offset_field_flagged(self):
+        doc = self._merged()
+        del doc["ranks"]["1"]["offset_s"]
+        errs = _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc")
+        assert any("missing 'offset_s'" in e for e in errs)
+
+    def test_null_request_entry_reported_not_crashed(self):
+        doc = self._merged()
+        doc["requests"] = [None]
+        errs = _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc")
+        assert any("requests[0]: not an object" in e for e in errs)
+
+    def test_lone_bound_flagged(self):
+        doc = self._merged()
+        req = doc["requests"][0]
+        req.pop("ttft_hi_ms", None)
+        req["ttft_lo_ms"] = 0.0
+        errs = _check_errors(check_sink_schema.check_merged_trace,
+                             doc, SCHEMA, "doc")
+        assert any("bounds must come as a pair" in e for e in errs)
+
+    def test_metrics_line_without_clock_flagged(self, tmp_path):
+        p = tmp_path / "metrics.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps({
+                "ts": 1.0, "reason": "manual", "rank": 0,
+                "flush_seq": 0, "events_lost": 0, "metrics": {}}) + "\n")
+        errs = _check_errors(check_sink_schema.check_metrics_jsonl,
+                             str(p), SCHEMA)
+        assert any("clock" in e for e in errs)
+        assert any("t_ns" in e for e in errs)
+
+    def test_synced_clock_with_null_offset_flagged(self, tmp_path):
+        p = tmp_path / "metrics.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps({
+                "ts": 1.0, "reason": "manual", "rank": 0,
+                "flush_seq": 0, "t_ns": 1, "events_lost": 0,
+                "clock": {"wall_s": 1.0, "offset_s": None,
+                          "unc_s": None, "ref": 0, "synced": True},
+                "metrics": {}}) + "\n")
+        errs = _check_errors(check_sink_schema.check_metrics_jsonl,
+                             str(p), SCHEMA)
+        assert any("synced but offset_s" in e for e in errs)
+
+    @pytest.mark.parametrize("kind,row,frag", [
+        ("route", {"gid": 1, "prefill": 0}, "route event missing"),
+        ("consensus_decision", {"family": "x"},
+         "consensus_decision event missing"),
+        ("clock_sync", {"offset_s": 0.0}, "clock_sync event missing"),
+        ("handoff_out", {"tokens": 1, "pages": 1, "bytes": 8},
+         "missing 'ms'"),
+    ])
+    def test_event_kind_validators(self, tmp_path, kind, row, frag):
+        p = tmp_path / "events.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps({"seq": 0, "t_ns": 1, "kind": kind,
+                                "rank": 0, **row}) + "\n")
+        errs = _check_errors(check_sink_schema.check_events_jsonl,
+                             str(p), SCHEMA)
+        assert any(frag in e for e in errs), errs
+
+    def test_empty_trace_attr_flagged(self, tmp_path):
+        p = tmp_path / "events.jsonl"
+        with open(p, "w") as f:
+            f.write(json.dumps({"seq": 0, "t_ns": 1, "kind": "submit",
+                                "rank": 0, "trace": ""}) + "\n")
+        errs = _check_errors(check_sink_schema.check_events_jsonl,
+                             str(p), SCHEMA)
+        assert any("trace" in e for e in errs)

@@ -645,12 +645,14 @@ def test_schema_checker_flags_mixed_ranks_in_one_file(tmp_path):
 
 def test_schema_checker_handoff_events(tmp_path):
     ok = [{"seq": 0, "t_ns": 1, "kind": "handoff_out", "rid": 3,
-           "rank": 0, "tokens": 16, "pages": 2, "bytes": 4096},
+           "rank": 0, "tokens": 16, "pages": 2, "bytes": 4096,
+           "ms": 2.5},
           {"seq": 1, "t_ns": 2, "kind": "handoff_in", "rid": 7,
-           "rank": 0, "tokens": 16, "pages": 2, "bytes": 4096}]
+           "rank": 0, "tokens": 16, "pages": 2, "bytes": 4096,
+           "ms": 1.5}]
     assert _check_events(tmp_path, ok) == []
     missing = [{"seq": 0, "t_ns": 1, "kind": "handoff_out", "rid": 3,
-                "rank": 0, "tokens": 16, "pages": 2}]
+                "rank": 0, "tokens": 16, "pages": 2, "ms": 2.5}]
     assert any("missing 'bytes'" in e
                for e in _check_events(tmp_path, missing))
     nonpos = [{"seq": 0, "t_ns": 1, "kind": "handoff_in", "rid": 3,

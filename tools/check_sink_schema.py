@@ -19,7 +19,14 @@ inventory, and (ISSUE 11) a device-trace summary — the sink's
 — whose overlap/goodput fractions leave [0, 1] or whose
 category/collective/site/ledger records drop required keys
 (``--require-trace`` makes their PRESENCE mandatory, for the
-``--trace-window`` CI leg). stdlib only (the CI image installs jax +
+``--trace-window`` CI leg), and (ISSUE 14) the cross-host tracing
+metadata: every metrics line's wall-clock anchor (``t_ns`` +
+``clock.wall_s``) and clock-alignment stamp (offset/uncertainty
+present, null only when honestly unsynced), the
+route/consensus_decision/clock_sync event kinds, and — via
+``--merged-json`` — the tools/merge_traces.py artifact (per-rank
+offset + uncertainty fields required, per-request TTFT bounds
+ordered lo <= ttft <= hi). stdlib only (the CI image installs jax +
 numpy + pytest, nothing else).
 
 Note on events.jsonl seq monotonicity: the sink's writer is
@@ -92,6 +99,37 @@ def check_metrics_jsonl(path: str, schema: dict) -> None:
         if not isinstance(row.get("ts"), (int, float)):
             err(f"{path}:{i + 1}: ts not a number")
         _check_rank(path, i + 1, row, rank_state)
+        # cross-host tracing metadata (ISSUE 14): the wall-clock
+        # anchor pair and the clock-alignment stamp the offline
+        # merger corrects with — offset/uncertainty may be null
+        # (never synced) but must be PRESENT, and a synced rank must
+        # carry a numeric offset
+        if not isinstance(row.get("t_ns"), int):
+            err(f"{path}:{i + 1}: t_ns not an int")
+        clock = row.get("clock")
+        if not isinstance(clock, dict):
+            err(f"{path}:{i + 1}: clock not an object")
+        else:
+            for k in sc["clock_required"]:
+                if k not in clock:
+                    err(f"{path}:{i + 1}: clock missing {k!r}")
+            if not isinstance(clock.get("wall_s"), (int, float)):
+                err(f"{path}:{i + 1}: clock.wall_s not a number")
+            au = clock.get("anchor_unc_s")
+            if "anchor_unc_s" in clock and (
+                    not isinstance(au, (int, float)) or au < 0):
+                err(f"{path}:{i + 1}: clock.anchor_unc_s {au!r} not "
+                    "a non-negative number")
+            for k in ("offset_s", "unc_s"):
+                v = clock.get(k)
+                if v is not None and not isinstance(v, (int, float)):
+                    err(f"{path}:{i + 1}: clock.{k} {v!r} neither "
+                        "null nor a number")
+            if clock.get("synced") and \
+                    not isinstance(clock.get("offset_s"),
+                                   (int, float)):
+                err(f"{path}:{i + 1}: clock synced but offset_s "
+                    f"{clock.get('offset_s')!r} is not a number")
         el = row.get("events_lost")
         if not isinstance(el, int) or el < 0:
             err(f"{path}:{i + 1}: events_lost {el!r} not a "
@@ -147,6 +185,30 @@ def check_events_jsonl(path: str, schema: dict) -> None:
                     (b <= 0 or pg <= 0):
                 err(f"{path}:{i + 1}: {ev['kind']} with non-positive "
                     f"bytes={b} / pages={pg}")
+        if "trace" in ev and (not isinstance(ev["trace"], str)
+                              or not ev["trace"]):
+            err(f"{path}:{i + 1}: trace {ev['trace']!r} not a "
+                "non-empty string")
+        if ev.get("kind") == "route":
+            # consensus admission routing (ISSUE 14): the decision
+            # must say WHO got the request and under which trace
+            for kk in sc.get("route_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: route event missing {kk!r}")
+        if ev.get("kind") == "consensus_decision":
+            for kk in sc.get("consensus_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: consensus_decision event "
+                        f"missing {kk!r}")
+            if "epoch" in ev and (not isinstance(ev["epoch"], int)
+                                  or ev["epoch"] < 0):
+                err(f"{path}:{i + 1}: consensus_decision epoch "
+                    f"{ev['epoch']!r} not a non-negative int")
+        if ev.get("kind") == "clock_sync":
+            for kk in sc.get("clock_sync_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: clock_sync event missing "
+                        f"{kk!r}")
         seq = ev.get("seq")
         if not isinstance(seq, int) or seq <= last:
             err(f"{path}:{i + 1}: seq {seq!r} not strictly increasing "
@@ -272,6 +334,92 @@ def check_trace_summary_file(path: str, schema: dict,
     except Exception as e:
         return err(f"{path}: unreadable ({e})")
     check_trace_summary(doc, schema, path)
+
+
+def check_merged_trace(doc, schema: dict, where: str) -> None:
+    """Validate a tools/merge_traces.py artifact (ISSUE 14): required
+    top-level keys, per-rank clock records (offset + uncertainty
+    fields must be PRESENT — null means honestly-unsynced, absent
+    means a writer bug), per-request records with the full span
+    breakdown, and TTFT bounds that actually bracket the estimate
+    (lo <= ttft <= hi)."""
+    sc = schema["merged_trace"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["required"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    if doc.get("kind") != sc["kind"]:
+        err(f"{where}: kind {doc.get('kind')!r} != {sc['kind']!r}")
+    ranks = doc.get("ranks")
+    if not isinstance(ranks, dict) or not ranks:
+        err(f"{where}: ranks missing or empty")
+        ranks = {}
+    for r, entry in ranks.items():
+        for k in sc["rank_entry"]:
+            if k not in (entry or {}):
+                err(f"{where}: ranks.{r} missing {k!r}")
+        for k in ("offset_s", "unc_s"):
+            v = (entry or {}).get(k)
+            if v is not None and not isinstance(v, (int, float)):
+                err(f"{where}: ranks.{r}.{k} {v!r} neither null nor "
+                    "a number")
+    reqs = doc.get("requests")
+    if not isinstance(reqs, list):
+        err(f"{where}: requests not a list")
+        reqs = []
+    for i, req in enumerate(reqs):
+        rw = f"{where}: requests[{i}]"
+        if not isinstance(req, dict):
+            err(f"{rw}: not an object")
+            continue
+        for k in sc["request_entry"]:
+            if k not in req:
+                err(f"{rw}: missing {k!r}")
+        spans = req.get("spans_ms")
+        if isinstance(spans, dict):
+            for k in sc["span_keys"]:
+                if k not in spans:
+                    err(f"{rw}: spans_ms missing {k!r}")
+        elif spans is not None:
+            err(f"{rw}: spans_ms not an object")
+        if not isinstance(req.get("monotonic"), bool):
+            err(f"{rw}: monotonic not a bool")
+        ttft = req.get("ttft_ms")
+        lo, hi = req.get("ttft_lo_ms"), req.get("ttft_hi_ms")
+        if (lo is None) != (hi is None):
+            err(f"{rw}: ttft bounds must come as a pair "
+                f"(lo={lo!r}, hi={hi!r})")
+        if lo is not None and hi is not None:
+            if not isinstance(ttft, (int, float)):
+                err(f"{rw}: ttft bounds without ttft_ms")
+            elif not lo <= ttft <= hi:
+                err(f"{rw}: ttft bounds not ordered "
+                    f"({lo} <= {ttft} <= {hi} fails)")
+    lat = doc.get("latency")
+    if isinstance(lat, dict):
+        for k in sc["latency_keys"]:
+            if k not in lat:
+                err(f"{where}: latency missing {k!r}")
+    elif lat is not None:
+        err(f"{where}: latency not an object")
+    hb = doc.get("handoff_breakdown_ms")
+    if isinstance(hb, dict):
+        for k in sc["handoff_breakdown_keys"]:
+            if k not in hb:
+                err(f"{where}: handoff_breakdown_ms missing {k!r}")
+    elif hb is not None:
+        err(f"{where}: handoff_breakdown_ms not an object")
+    if not isinstance(doc.get("partial"), bool):
+        err(f"{where}: partial not a bool")
+
+
+def check_merged_trace_file(path: str, schema: dict) -> None:
+    try:
+        doc = json.load(open(path))
+    except Exception as e:
+        return err(f"{path}: unreadable merged trace ({e})")
+    check_merged_trace(doc, schema, path)
 
 
 def check_kv_quality(doc, schema: dict, where: str) -> None:
@@ -406,6 +554,10 @@ def main() -> int:
     ap.add_argument("sink_dir", help="directory a MetricsSink wrote")
     ap.add_argument("--bench-json", default=None,
                     help="serve_bench stdout JSON to validate as well")
+    ap.add_argument("--merged-json", default=None,
+                    help="tools/merge_traces.py artifact to validate "
+                         "as well (ISSUE 14: offset/uncertainty "
+                         "fields required, TTFT bounds ordered)")
     ap.add_argument("--require-trace", action="store_true",
                     help="fail unless trace_summary.json exists in the "
                          "sink dir AND the bench block carries "
@@ -429,6 +581,8 @@ def main() -> int:
     if args.bench_json:
         check_bench_json(args.bench_json, schema,
                          require_trace=args.require_trace)
+    if args.merged_json:
+        check_merged_trace_file(args.merged_json, schema)
 
     if _ERRORS:
         print(f"sink schema: {len(_ERRORS)} violation(s)")

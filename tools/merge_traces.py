@@ -1,0 +1,522 @@
+#!/usr/bin/env python
+"""Stitch per-rank sink artifacts into ONE mesh-wide request trace
+(ISSUE 14 tentpole piece 3).
+
+A disaggregated serving mesh writes rank-local observability: each
+rank's ``rank<K>/events.jsonl`` holds that rank's half of every
+handed-off request's lifecycle, timestamped with a process-monotonic
+clock that means nothing on any other host. This offline merger makes
+the mesh-level story:
+
+1. **Anchor**: every ``metrics.jsonl`` flush line carries a
+   back-to-back ``(clock.wall_s, t_ns)`` pair — the rank's wall-clock
+   anchor — plus the agreed clock alignment (``clock.offset_s`` ±
+   ``clock.unc_s`` relative to ``clock.ref``, estimated by the
+   Cristian exchange in ``profiler/disttrace.py``). An event's
+   reference-clock wall time is
+   ``anchor.wall_s + (event.t_ns - anchor.t_ns)/1e9 - offset_s``.
+2. **Stitch**: events sharing a ``trace`` attr (the deterministic
+   per-request id that rides the KV handoff) group into one global
+   timeline: submit -> admit -> chunks -> prefill first token ->
+   export (``handoff_out``) -> channel wait -> import (``handoff_in``,
+   the decode rank's first-token moment) -> finish.
+3. **Judge honestly**: every cross-host delta carries the two ranks'
+   summed offset uncertainty; the per-request ``monotonic`` flag
+   allows exactly that much slack at cross-host edges and none
+   (beyond float fuzz) at same-host edges. A truncated events file
+   (torn tail line), a rank that never flushed, or a rank directory
+   missing entirely (kill-one chaos) degrade the merge to a PARTIAL
+   but well-formed document — never an exception.
+
+Outputs: the merged-trace JSON (schema-checked by
+``tools/check_sink_schema.py --merged-json``) with per-request span
+breakdowns, mesh-wide end-to-end TTFT/TPOT percentiles (TTFT with its
+uncertainty) and the handoff breakdown (export / channel-wait /
+import ms); optionally a Chrome-trace view (``--chrome``): one
+process track per rank, request spans as complete events, handoffs
+linked by flow arrows keyed on the trace id — load in
+chrome://tracing or Perfetto.
+
+Stdlib only (json/os/math/argparse): the merger must run anywhere the
+artifacts land, with no jax on the path.
+
+Usage::
+
+    python tools/merge_traces.py <sink_root> \
+        [--out merged_trace.json] [--chrome chrome_trace.json]
+
+``<sink_root>`` is the directory holding ``rank<K>/`` sink subdirs (a
+single-rank sink dir — events.jsonl directly inside — also works).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["load_rank_dir", "merge", "chrome_trace", "percentile",
+           "stats"]
+
+_RANK_DIR_RE = re.compile(r"^rank(\d+)$")
+
+#: same-host adjacent milestones may disagree by float conversion fuzz
+#: only; cross-host edges get the measured clock slack instead
+EPS_S = 1e-6
+
+#: milestone order a stitched request must respect (present subset)
+MILESTONES = ("submit", "admit", "chunk", "first_token",
+              "handoff_out", "handoff_in", "finish")
+
+
+def percentile(vals: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (the repo-wide convention —
+    profiler.metrics.percentile, reimplemented here because the merger
+    is stdlib-only by contract)."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    k = max(0, min(len(s) - 1,
+                   int(math.ceil(q / 100.0 * len(s))) - 1))
+    return s[k]
+
+
+def stats(vals: List[float]) -> dict:
+    """{p50, p95, mean, count} over ms samples (empty -> count 0)."""
+    if not vals:
+        return {"count": 0}
+    return {"count": len(vals),
+            "mean": round(sum(vals) / len(vals), 3),
+            "p50": round(percentile(vals, 50), 3),
+            "p95": round(percentile(vals, 95), 3)}
+
+
+def _read_jsonl(path: str) -> Tuple[List[dict], int]:
+    """(parsed rows, unparseable line count). A torn tail — the
+    signature of a killed writer — costs its lines, never the file."""
+    rows: List[dict] = []
+    bad = 0
+    try:
+        f = open(path)
+    except OSError:
+        return rows, bad
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                bad += 1
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+            else:
+                bad += 1
+    return rows, bad
+
+
+def load_rank_dir(path: str, rank: Optional[int] = None) -> dict:
+    """One rank's artifacts -> {rank, events, anchor, offset_s, unc_s,
+    ref, synced, truncated_lines, anchored, missing}. Never raises:
+    a missing/empty/torn dir yields a record that SAYS so."""
+    events, bad_e = _read_jsonl(os.path.join(path, "events.jsonl"))
+    metrics, bad_m = _read_jsonl(os.path.join(path, "metrics.jsonl"))
+    anchor = None
+    offset_s: Optional[float] = None
+    unc_s: Optional[float] = None
+    anchor_unc_s = 0.0
+    ref = 0
+    synced = False
+    # the LAST flush line carrying an anchor wins: newest offset state
+    for row in metrics:
+        clock = row.get("clock")
+        if not isinstance(clock, dict):
+            continue
+        w, t = clock.get("wall_s"), row.get("t_ns")
+        if isinstance(w, (int, float)) and isinstance(t, int):
+            anchor = (float(w), t)
+            # the anchor pair's own read-gap half-width (a preempted
+            # flush thread shifts every event it places) — folded
+            # into the rank's event uncertainty below
+            au = clock.get("anchor_unc_s")
+            anchor_unc_s = float(au) if isinstance(au, (int, float)) \
+                else 0.0
+        if clock.get("offset_s") is not None:
+            offset_s = float(clock["offset_s"])
+            unc_s = None if clock.get("unc_s") is None \
+                else float(clock["unc_s"])
+            synced = bool(clock.get("synced"))
+        ref = int(clock.get("ref", 0) or 0)
+    if rank is None:
+        for src in (events, metrics):
+            for row in src:
+                if isinstance(row.get("rank"), int):
+                    rank = row["rank"]
+                    break
+            if rank is not None:
+                break
+    return {
+        "rank": rank, "events": events, "anchor": anchor,
+        "offset_s": offset_s, "unc_s": unc_s, "ref": ref,
+        "synced": synced, "anchor_unc_s": anchor_unc_s,
+        "truncated_lines": bad_e + bad_m,
+        "anchored": anchor is not None,
+        "missing": not events and not metrics,
+    }
+
+
+def _discover(root: str) -> Dict[int, str]:
+    """{rank: dir} — rank<K> subdirs, else the root itself as rank 0
+    when it IS a sink dir (single-process layout)."""
+    out: Dict[int, str] = {}
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for n in sorted(names):
+        m = _RANK_DIR_RE.match(n)
+        p = os.path.join(root, n)
+        if m and os.path.isdir(p):
+            out[int(m.group(1))] = p
+    if not out and os.path.exists(os.path.join(root, "events.jsonl")):
+        out[0] = root
+    return out
+
+
+def _wall(rank_rec: dict, t_ns: int) -> Optional[float]:
+    """Event t_ns -> reference-rank wall seconds (None: no anchor)."""
+    if rank_rec["anchor"] is None:
+        return None
+    w0, t0 = rank_rec["anchor"]
+    off = rank_rec["offset_s"] or 0.0
+    return w0 + (t_ns - t0) / 1e9 - off
+
+
+def _pair_slack(a: dict, b: dict) -> float:
+    """Allowed reordering between two placed events: their clock
+    uncertainties when they live on different ranks (unknown unc =
+    unbounded), float fuzz otherwise."""
+    if a["rank"] == b["rank"]:
+        return EPS_S
+    ua, ub = a.get("unc_s"), b.get("unc_s")
+    if ua is None or ub is None:
+        return float("inf")
+    return ua + ub + EPS_S
+
+
+def _stitch(trace: str, evs: List[dict]) -> dict:
+    """One trace group (already wall-placed, wall-sorted) -> the
+    merged per-request record."""
+    first: Dict[str, dict] = {}
+    finish = None
+    for e in evs:
+        k = e["kind"]
+        if k == "finish":
+            finish = e                 # last finish wins (requeues)
+        elif k not in first:
+            first[k] = e
+    if finish is not None:
+        first["finish"] = finish
+    milestones = [first[k] for k in MILESTONES if k in first]
+    monotonic = True
+    for a, b in zip(milestones, milestones[1:]):
+        if b["wall"] - a["wall"] < -_pair_slack(a, b):
+            monotonic = False
+    handed = "handoff_in" in first
+
+    def delta_ms(k0: str, k1: str) -> Optional[float]:
+        if k0 not in first or k1 not in first:
+            return None
+        return round((first[k1]["wall"] - first[k0]["wall"]) * 1e3, 3)
+
+    def pair_unc_ms(k0: str, k1: str) -> Optional[float]:
+        a, b = first.get(k0), first.get(k1)
+        if a is None or b is None or a["rank"] == b["rank"]:
+            return 0.0 if a is not None and b is not None else None
+        if a.get("unc_s") is None or b.get("unc_s") is None:
+            return None
+        return round((a["unc_s"] + b["unc_s"]) * 1e3, 3)
+
+    spans = {
+        "queue_wait_ms": delta_ms("submit", "admit"),
+        "prefill_ms": delta_ms("admit", "first_token"),
+        # export span: the engine's measured export work (payload
+        # assembly + page reads), stamped on the event itself
+        "export_ms": (first.get("handoff_out") or {}).get("ms"),
+        "channel_wait_ms": delta_ms("handoff_out", "handoff_in"),
+        "channel_wait_unc_ms": pair_unc_ms("handoff_out",
+                                           "handoff_in"),
+        "import_ms": (first.get("handoff_in") or {}).get("ms"),
+        "decode_ms": (delta_ms("handoff_in", "finish") if handed
+                      else delta_ms("first_token", "finish")),
+        "total_ms": delta_ms("submit", "finish"),
+    }
+    rec = {
+        "trace": trace,
+        "ranks": sorted({e["rank"] for e in evs}),
+        "handed_off": handed,
+        "complete": "submit" in first and "finish" in first,
+        "monotonic": monotonic,
+        "spans_ms": spans,
+        "events": [{k: e[k] for k in
+                    ("kind", "rank", "wall", "unc_s") if k in e}
+                   for e in evs],
+    }
+    # end-to-end TTFT: submit -> the first-token moment the DECODE
+    # side owns (handoff_in for handed-off requests — the import seeds
+    # the slot at its first token — first_token otherwise)
+    tip = first.get("handoff_in" if handed else "first_token")
+    sub = first.get("submit")
+    if tip is not None and sub is not None:
+        ttft = (tip["wall"] - sub["wall"]) * 1e3
+        rec["ttft_ms"] = round(ttft, 3)
+        unc = pair_unc_ms("submit",
+                          "handoff_in" if handed else "first_token")
+        rec["ttft_unc_ms"] = unc
+        if unc is not None:
+            rec["ttft_lo_ms"] = round(ttft - unc, 3)
+            rec["ttft_hi_ms"] = round(ttft + unc, 3)
+    if finish is not None and finish.get("tpot_ms") is not None:
+        rec["tpot_ms"] = finish["tpot_ms"]
+    return rec
+
+
+def merge(root: str) -> dict:
+    """See module docstring. Returns the merged-trace document."""
+    dirs = _discover(root)
+    ranks: Dict[int, dict] = {r: load_rank_dir(p, rank=r)
+                              for r, p in dirs.items()}
+    # a rank another rank's artifacts NAME but whose dir is absent on
+    # disk died without flushing — record the hole explicitly. Route
+    # events are the cross-reference: they carry the assignment's
+    # prefill/decode ranks (per-file 'rank' fields can't help — every
+    # file only ever names its own writer)
+    known = set(dirs)
+    for rec in ranks.values():
+        for row in rec["events"]:
+            if row.get("kind") != "route":
+                continue
+            for k in ("prefill", "decode"):
+                v = row.get(k)
+                if isinstance(v, int) and v >= 0:
+                    known.add(v)
+    for r in sorted(known - set(ranks)):
+        ranks[r] = {"rank": r, "events": [], "anchor": None,
+                    "offset_s": None, "unc_s": None, "ref": 0,
+                    "synced": False, "anchor_unc_s": 0.0,
+                    "truncated_lines": 0,
+                    "anchored": False, "missing": True}
+
+    groups: Dict[str, List[dict]] = {}
+    unplaced = 0
+    for r, rec in sorted(ranks.items()):
+        for row in rec["events"]:
+            trace = row.get("trace")
+            if not isinstance(trace, str) or \
+                    not isinstance(row.get("t_ns"), int):
+                continue
+            wall = _wall(rec, row["t_ns"])
+            if wall is None:
+                unplaced += 1          # no anchor: cannot be merged
+                continue
+            ev = {"kind": row.get("kind"), "rank": r, "wall": wall,
+                  "unc_s": (rec["unc_s"] + rec["anchor_unc_s"])
+                  if rec["synced"] and rec["unc_s"] is not None
+                  else None,
+                  "seq": row.get("seq", 0)}
+            for k in ("ms", "tpot_ms", "ttft_ms", "tokens", "final"):
+                if row.get(k) is not None:
+                    ev[k] = row[k]
+            groups.setdefault(trace, []).append(ev)
+
+    requests = []
+    for trace in sorted(groups):
+        evs = sorted(groups[trace], key=lambda e: (e["wall"], e["seq"]))
+        requests.append(_stitch(trace, evs))
+
+    complete = [r for r in requests if r["complete"]]
+    ttfts = [r["ttft_ms"] for r in complete if "ttft_ms" in r]
+    uncs = [r["ttft_unc_ms"] for r in complete
+            if r.get("ttft_unc_ms") is not None]
+    tpots = [r["tpot_ms"] for r in complete if "tpot_ms" in r]
+    totals = [r["spans_ms"]["total_ms"] for r in complete
+              if r["spans_ms"]["total_ms"] is not None]
+    handed = [r for r in requests if r["handed_off"]]
+    rank_out = {}
+    partial = False
+    for r, rec in sorted(ranks.items()):
+        rank_out[str(r)] = {
+            "offset_s": rec["offset_s"], "unc_s": rec["unc_s"],
+            "synced": rec["synced"], "anchored": rec["anchored"],
+            "events": len(rec["events"]),
+            "truncated_lines": rec["truncated_lines"],
+            "missing": rec["missing"],
+        }
+        if rec["missing"] or rec["truncated_lines"] or \
+                not rec["anchored"]:
+            partial = True
+    # a torn trace (an export whose import/finish never appears) is
+    # the fingerprint of a rank dir that vanished entirely — the
+    # corpse left no artifacts of its own to flag
+    if any(not r["complete"] for r in requests):
+        partial = True
+    return {
+        "kind": "merged_trace",
+        "root": root,
+        "ref_rank": max((rec["ref"] for rec in ranks.values()),
+                        default=0),
+        "ranks": rank_out,
+        "requests": requests,
+        "requests_total": len(requests),
+        "requests_complete": len(complete),
+        "handoffs": len(handed),
+        "monotonic_violations": sum(not r["monotonic"]
+                                    for r in requests),
+        "unplaced_events": unplaced,
+        "latency": {
+            "ttft_ms": stats(ttfts),
+            "ttft_unc_ms": stats(uncs),
+            "tpot_ms": stats(tpots),
+            "total_ms": stats(totals),
+        },
+        "handoff_breakdown_ms": {
+            "export": stats([r["spans_ms"]["export_ms"]
+                             for r in handed
+                             if r["spans_ms"]["export_ms"] is not None]),
+            "channel_wait": stats(
+                [r["spans_ms"]["channel_wait_ms"] for r in handed
+                 if r["spans_ms"]["channel_wait_ms"] is not None]),
+            "import": stats([r["spans_ms"]["import_ms"]
+                             for r in handed
+                             if r["spans_ms"]["import_ms"] is not None]),
+        },
+        "partial": partial,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace view
+# ---------------------------------------------------------------------------
+def chrome_trace(doc: dict) -> dict:
+    """Merged doc -> chrome://tracing JSON: one process (pid) per
+    rank, one thread (tid) per request on that rank, span phases as
+    complete ('X') events, each handoff linked by a flow arrow ('s' ->
+    'f') keyed on the trace id."""
+    evs: List[dict] = []
+    for r, rec in sorted(doc.get("ranks", {}).items()):
+        evs.append({"ph": "M", "pid": int(r), "tid": 0,
+                    "name": "process_name",
+                    "args": {"name": f"rank {r}"
+                             + (" (missing)" if rec.get("missing")
+                                else "")}})
+    t0: Optional[float] = None
+    for req in doc.get("requests", []):
+        for e in req["events"]:
+            t0 = e["wall"] if t0 is None else min(t0, e["wall"])
+    t0 = t0 or 0.0
+
+    def us(w: float) -> float:
+        return round((w - t0) * 1e6, 1)
+
+    for req in doc.get("requests", []):
+        trace = req["trace"]
+        tid = int(re.sub(r"\D", "", trace) or 0)
+        first: Dict[str, dict] = {}
+        for e in req["events"]:
+            if e["kind"] == "finish":
+                first["finish"] = e
+            else:
+                first.setdefault(e["kind"], e)
+
+        def span(name, k0, k1):
+            a, b = first.get(k0), first.get(k1)
+            if a is None or b is None or b["wall"] < a["wall"]:
+                return
+            evs.append({"ph": "X", "name": f"{trace}:{name}",
+                        "cat": "request", "pid": a["rank"],
+                        "tid": tid, "ts": us(a["wall"]),
+                        "dur": round((b["wall"] - a["wall"]) * 1e6, 1),
+                        "args": {"trace": trace}})
+
+        span("queue_wait", "submit", "admit")
+        span("prefill", "admit", "first_token")
+        span("export", "first_token", "handoff_out")
+        span("decode", "handoff_in" if req["handed_off"]
+             else "first_token", "finish")
+        out, inn = first.get("handoff_out"), first.get("handoff_in")
+        if out is not None and inn is not None:
+            # the channel wait, drawn on the RECEIVING rank's track,
+            # plus a flow arrow linking the two halves of the trace
+            if inn["wall"] >= out["wall"]:
+                evs.append({"ph": "X", "name": f"{trace}:channel_wait",
+                            "cat": "handoff", "pid": inn["rank"],
+                            "tid": tid, "ts": us(out["wall"]),
+                            "dur": round((inn["wall"] - out["wall"])
+                                         * 1e6, 1),
+                            "args": {"trace": trace,
+                                     "unc_ms": req["spans_ms"].get(
+                                         "channel_wait_unc_ms")}})
+            evs.append({"ph": "s", "id": trace, "name": "handoff",
+                        "cat": "handoff", "pid": out["rank"],
+                        "tid": tid, "ts": us(out["wall"])})
+            evs.append({"ph": "f", "bp": "e", "id": trace,
+                        "name": "handoff", "cat": "handoff",
+                        "pid": inn["rank"], "tid": tid,
+                        "ts": us(inn["wall"])})
+    return {"traceEvents": evs, "displayTimeUnit": "ms"}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="tools/merge_traces.py",
+        description="merge per-rank sink artifacts into one "
+                    "clock-aligned mesh trace")
+    ap.add_argument("sink_root",
+                    help="directory holding rank<K>/ sink subdirs "
+                         "(or a single sink dir)")
+    ap.add_argument("--out", default=None,
+                    help="write the merged-trace JSON here "
+                         "(default: <sink_root>/merged_trace.json)")
+    ap.add_argument("--chrome", default=None,
+                    help="also write a chrome://tracing view here")
+    ap.add_argument("--pretty", action="store_true")
+    args = ap.parse_args()
+
+    if not _discover(args.sink_root):
+        print(f"merge_traces: no rank dirs under {args.sink_root}",
+              file=sys.stderr)
+        return 2
+    doc = merge(args.sink_root)
+    out = args.out or os.path.join(args.sink_root,
+                                   "merged_trace.json")
+    tmp = out + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2 if args.pretty else None)
+    os.replace(tmp, out)
+    if args.chrome:
+        tmp = args.chrome + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(chrome_trace(doc), f)
+        os.replace(tmp, args.chrome)
+    lat = doc["latency"]["ttft_ms"]
+    print(f"merged {doc['requests_total']} request(s) "
+          f"({doc['requests_complete']} complete, "
+          f"{doc['handoffs']} handed off) across "
+          f"{len(doc['ranks'])} rank(s)"
+          + (" [PARTIAL]" if doc["partial"] else "")
+          + (f"; e2e ttft p50={lat.get('p50')}ms "
+             f"p95={lat.get('p95')}ms" if lat.get("count") else ""))
+    if doc["monotonic_violations"]:
+        print(f"WARNING: {doc['monotonic_violations']} request(s) "
+              "violate milestone order beyond clock uncertainty",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
