@@ -203,12 +203,18 @@ class ClockSync:
         # fresh ping into a wildly-wrong offset whose tiny claimed
         # uncertainty WINS the min-unc selection. Peers' files are
         # not ours to touch.
+        self._purge_own_files()
+
+    def _purge_own_files(self) -> None:
+        """Unlink THIS rank's ping/pong files (init and resync both
+        need it — a stale pong pairing with a fresh ping is the
+        hazard in both lifecycles)."""
         try:
-            for n in os.listdir(directory):
+            for n in os.listdir(self.dir):
                 if n.startswith((f"ping.{self.rank}.",
                                  f"pong.{self.rank}.")):
                     try:
-                        os.unlink(os.path.join(directory, n))
+                        os.unlink(os.path.join(self.dir, n))
                     except OSError:  # pragma: no cover
                         pass
         except OSError:  # pragma: no cover - dir vanished
@@ -279,6 +285,24 @@ class ClockSync:
             self._t0 = self._now()
             self._write_atomic(ping, {"rank": self.rank})
         return self.ready
+
+    def resync(self) -> None:
+        """Begin a FRESH sampling round (periodic drift tracking,
+        ISSUE 15): drop the previous round's samples, advance the
+        sequence past any in-flight exchange and purge this rank's
+        leftover ping/pong files — the same stale-pong hazard the
+        ``__init__`` purge guards against, now mid-life (a pong
+        answered before the resync pairing with a post-resync ping
+        would claim a tiny uncertainty for a stale offset and WIN the
+        min-unc selection). ``ready`` goes False until ``n_samples``
+        new round trips land; the reference rank has nothing to
+        resample (its offset is 0 by definition) and no-ops."""
+        if self.rank == self.ref:
+            return
+        self._t0 = None
+        self._samples = []
+        self._seq += 1
+        self._purge_own_files()
 
     @property
     def ready(self) -> bool:

@@ -24,7 +24,14 @@ the pre-unification two-dispatch engine for benchmarking. Speculative
 decoding (``ServingConfig.spec`` = ``SpecConfig(draft_model, k)``,
 ``spec.py``) amortizes the target over k drafted tokens per verify
 tick with greedy acceptance — spec greedy output stays BITWISE equal
-to plain greedy (the classic invariant, tested).
+to plain greedy (the classic invariant, tested). Every POLICY
+decision is pluggable and host-side (``sched.py``, ISSUE 15):
+``ServingConfig.scheduler`` picks the chunk-selection order (fifo /
+sjf / aged-sjf with a provable starvation bound), non-fifo policies
+shape the per-tick prefill budget from decode-stall telemetry,
+``SpecConfig.adaptive`` drives per-slot draft depth from an
+accept-rate EWMA, and disagg routing balances on estimated
+time-to-first-chunk — all without touching a compiled program.
 
 Quick use::
 
@@ -48,7 +55,11 @@ Profiler integration (``paddle_tpu.profiler``): gauges
 ``serving/token_syncs``, ``serving/prefix_lookups``,
 ``serving/prefix_hit_tokens``, ``cache_share/*`` (refcount traffic:
 shares, releases, cow_copies, prefix_evictions); histograms
-``serving/ttft_ms``, ``serving/prefill_queue_wait_ms``. The ONE
+``serving/ttft_ms``, ``serving/prefill_queue_wait_ms``,
+``serving/chunk_wait_ms`` (admission -> first chunk open); scheduler
+policy (ISSUE 15, ``sched.py``) counters
+``serving/aged_promotions``/``serving/budget_cuts`` and the
+``serving/spec_k_effective`` gauge. The ONE
 compiled hot-path site (``serving.tick#N``) must stay at ONE trace —
 ``ServingEngine.compiled_sites`` + the recompile registry make any
 regression assertable (tests do).
@@ -60,9 +71,12 @@ from .disagg import (DisaggServer, HandoffChannel, MeshSpec,  # noqa: F401
 from .engine import Request, ServingConfig, ServingEngine  # noqa: F401
 from .paged_cache import (NULL_PAGE, PageAllocator, PagePool,  # noqa: F401
                           PrefixCache)
+from .sched import (SCHED_POLICIES, ChunkScheduler,  # noqa: F401
+                    SpecKController)
 from .spec import DraftRunner, SpecConfig  # noqa: F401
 
 __all__ = ["ServingEngine", "ServingConfig", "Request", "SpecConfig",
            "DraftRunner", "PagePool", "PageAllocator", "PrefixCache",
            "NULL_PAGE", "DisaggServer", "MeshSpec", "HandoffChannel",
-           "route_requests"]
+           "route_requests", "SCHED_POLICIES", "ChunkScheduler",
+           "SpecKController"]

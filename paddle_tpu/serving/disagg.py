@@ -97,6 +97,7 @@ from ..profiler import disttrace as _disttrace
 from ..profiler import events as _pevents
 from ..profiler.metrics import registry as _registry
 from .engine import ServingConfig, ServingEngine
+from .sched import ttfc_key
 
 __all__ = ["MeshSpec", "HandoffChannel", "DisaggServer",
            "route_requests"]
@@ -201,15 +202,24 @@ def route_requests(votes: Dict[int, dict]) -> dict:
 
     Each vote:  ``{"seen": hwm, "routed": n, "pending": {gid: plen},
     "free_pages": int, "free_slots": int, "queued": int,
+    "prefill_backlog": tokens, "ttft_p95_ms": float, "chunk": int,
     "topology": {"prefill": [...], "decode": [...], "threshold": T}}``
 
     Routes every gid in ``[routed, min(seen over voters))``: a long
-    prompt (``plen >= threshold``) goes to the least-loaded prefill
-    rank (when a prefill group exists) and is decoded by the
-    least-loaded decode rank; anything else is prefilled AND decoded by
-    the least-loaded decode rank. Load = queued requests minus free
-    capacity, plus what this round already assigned — deterministic
-    tie-break toward the lower rank.
+    prompt (``plen >= threshold``) goes to the best prefill rank (when
+    a prefill group exists) and is decoded by the best decode rank;
+    anything else is prefilled AND decoded by the best decode rank.
+    "Best" is load-shaped (ISSUE 15; :func:`sched.ttfc_key`): the
+    rank with the smallest estimated TIME-TO-FIRST-CHUNK — its
+    queued-prefill-token backlog plus what this round already assigned
+    it, in chunk-train units, a slot-overflow penalty, and the rank's
+    rolling p95 TTFT as the measured tie-break — rather than free
+    pages alone (free pages say nothing about how long a chunk train
+    the new arrival queues behind, which is exactly the parked-shorts
+    pathology BENCH_SERVE_r13 measured). Pre-ISSUE-15 votes (no
+    backlog/p95 keys) degrade to a queue-depth estimate, so a
+    mixed-version mesh still orders sanely. Deterministic tie-break
+    toward the lower rank; same consensus round as before.
     """
     topo = votes[min(votes)]["topology"]
     prefill = list(topo["prefill"])
@@ -222,20 +232,16 @@ def route_requests(votes: Dict[int, dict]) -> dict:
         for g, ln in v["pending"].items():
             lens[int(g)] = int(ln)
 
-    def load(rank):
-        v = votes.get(rank)
-        if v is None:               # vote missed this round: assume
-            return 1 << 20          # busy — don't route blind
-        return (int(v["queued"]) * 64
-                - int(v["free_pages"]) - int(v["free_slots"]) * 8)
-
     # keyed by the TOPOLOGY's ranks, not the voters': a dead peer's
-    # vote is missing but its rank is still routable (load() already
-    # prices it as busy — indexing it must not crash the leader)
-    extra = {r: 0 for r in set(prefill) | set(decode)}
+    # vote is missing but its rank is still routable (ttfc_key prices
+    # it as busy — indexing it must not crash the leader)
+    ranks_all = set(prefill) | set(decode)
+    extra_tokens = {r: 0 for r in ranks_all}
+    extra_reqs = {r: 0 for r in ranks_all}
 
     def pick(ranks):
-        return min(ranks, key=lambda r: (load(r) + extra[r] * 64, r))
+        return min(ranks, key=lambda r: ttfc_key(
+            votes, r, extra_tokens, extra_reqs))
 
     assign = {}
     for gid in range(routed, upto):
@@ -243,12 +249,15 @@ def route_requests(votes: Dict[int, dict]) -> dict:
         if plen is None:            # no voter carried it: leave queued
             break
         d = pick(decode)
-        extra[d] += 1
+        extra_reqs[d] += 1
         p = -1
         if prefill and plen >= threshold:
             p = pick(prefill)
-            extra[p] += 1
-        assign[str(gid)] = [p, d]
+            extra_reqs[p] += 1
+            extra_tokens[p] += plen   # the chunk train runs HERE
+        else:
+            extra_tokens[d] += plen   # short prompts prefill where
+        assign[str(gid)] = [p, d]     # they decode
     return {"assign": assign, "routed": routed + len(assign)}
 
 
@@ -309,7 +318,8 @@ class DisaggServer:
                  long_prompt_threshold: Optional[int] = None,
                  consensus: Optional[Consensus] = None,
                  lease_s: float = 5.0,
-                 clock_skew_s: Optional[float] = None):
+                 clock_skew_s: Optional[float] = None,
+                 clock_resync_s: float = 0.0):
         self.mesh = mesh
         self.engine = ServingEngine(model, config)
         self.consensus = consensus if consensus is not None else \
@@ -361,6 +371,17 @@ class DisaggServer:
         #: the agreed offset table {str(rank): {offset_s, unc_s}}, or
         #: None until the ``clock`` consensus round publishes
         self._clock_table: Optional[Dict[str, dict]] = None
+        #: periodic clock re-sync (ISSUE 15): every ``clock_resync_s``
+        #: seconds after adoption, re-run the Cristian exchange on the
+        #: heartbeat; when the fresh offset moved by MORE than its
+        #: uncertainty, adopt it locally and re-vote the consensus
+        #: ``clock`` round (a new epoch peers join via ``pending``, the
+        #: straggler-heal machinery). 0 = one-shot sync (the PR 14
+        #: behavior); the reference rank never resamples (its offset
+        #: is 0 by definition) but keeps serving pongs either way.
+        self.clock_resync_s = float(clock_resync_s)
+        self._resyncing = False
+        self._resync_at = float("inf")
         #: per-gid handoff trace context of IMPORTED requests:
         #: {gid: (ctx dict from the payload, import wall stamp)}
         self._handoff_ctx: Dict[int, Tuple[dict, float]] = {}
@@ -419,20 +440,15 @@ class DisaggServer:
         me = str(self.mesh.rank)
         healed = self._clock_table is not None and \
             me in self._clock_table
-        if self.mesh.rank == self.clock.ref or not healed:
+        if self.mesh.rank == self.clock.ref or not healed or \
+                self._resyncing:
             self.clock.step()
+        self._resync_round(me)
         if self._clock_table is not None and not healed and \
                 self.clock.ready and not self._clock_voted:
             # window-expired straggler: heal locally NOW (peers may
             # already be draining), then gossip via the next epoch
-            est = self.clock.estimate()
-            self._clock_table[me] = {"offset_s": est[0],
-                                     "unc_s": est[1]}
-            _disttrace.set_clock_state(est[0], est[1],
-                                       ref=self.clock.ref)
-            _pevents.emit("clock_sync", offset_s=est[0], unc_s=est[1],
-                          ref=self.clock.ref)
-            self._refresh_ttfts()
+            self._heal_local(self.clock.estimate())
             self._vote_clock()
         if self._clock_table is None:
             self._vote_clock()
@@ -444,6 +460,60 @@ class DisaggServer:
             if dec is not None:
                 self._clock_voted = False
                 self._adopt_clock(dec.value)
+
+    def _heal_local(self, est: Tuple[float, float]) -> None:
+        """Adopt a fresh LOCAL estimate into the table + the
+        process clock state + the sink/event surfaces and re-derive
+        collected TTFTs — the shared step of the straggler-heal and
+        periodic-resync paths (a change to one must not silently miss
+        the other; the caller follows with its own vote logic)."""
+        self._clock_table[str(self.mesh.rank)] = {
+            "offset_s": est[0], "unc_s": est[1]}
+        _disttrace.set_clock_state(est[0], est[1], ref=self.clock.ref)
+        _registry().gauge("consensus/clock_unc_ms").set(est[1] * 1e3)
+        _pevents.emit("clock_sync", offset_s=est[0], unc_s=est[1],
+                      ref=self.clock.ref)
+        self._refresh_ttfts()
+
+    def _resync_round(self, me: str) -> None:
+        """Periodic drift tracking (ISSUE 15; retires the PR 14
+        "one-shot sync, no drift tracking" residue): once the resync
+        interval elapses, restart the ping exchange
+        (``ClockSync.resync``) and pump it on the heartbeat; when the
+        fresh estimate lands, compare it to the adopted entry — an
+        offset that moved by MORE than the SUM of the two
+        uncertainties is a real drift/step (two estimates each within
+        ±unc of the truth can legitimately differ by up to
+        unc_old + unc_new, so anything inside the summed bound is
+        indistinguishable from measurement noise and must not churn
+        epochs), so adopt it locally right away (our own stamps must
+        not stay wrong while the round converges) and re-vote the
+        ``clock`` family, opening a new epoch every peer joins via
+        ``pending`` and adopts MERGED (the straggler-heal path's
+        machinery, reused)."""
+        if self.clock_resync_s <= 0 or self.mesh.rank == self.clock.ref:
+            return
+        if not self._resyncing:
+            if self._clock_table is not None and me in \
+                    self._clock_table and \
+                    time.monotonic() >= self._resync_at:
+                self.clock.resync()
+                self._resyncing = True
+            return
+        if not self.clock.ready:
+            return                    # still resampling
+        self._resyncing = False
+        self._resync_at = time.monotonic() + self.clock_resync_s
+        est = self.clock.estimate()
+        old = (self._clock_table or {}).get(me) or {}
+        old_off = old.get("offset_s")
+        bound = est[1] + float(old.get("unc_s") or 0.0)
+        if old_off is not None and abs(est[0] - old_off) <= bound:
+            return                    # within the stated uncertainty
+        _registry().counter("consensus/clock_resyncs").add(1)
+        self._heal_local(est)
+        self._clock_voted = False
+        self._vote_clock()
 
     def _vote_clock(self) -> None:
         """Cast this rank's clock vote in the current epoch, once,
@@ -480,6 +550,9 @@ class DisaggServer:
             _registry().gauge("consensus/clock_unc_ms").set(unc * 1e3)
         _pevents.emit("clock_sync", offset_s=off, unc_s=unc, ref=ref)
         self._refresh_ttfts()
+        if self.clock_resync_s > 0 and self._resync_at == float("inf"):
+            # first adoption arms the periodic re-sync timer
+            self._resync_at = time.monotonic() + self.clock_resync_s
 
     def _offset_of(self, rank: int) -> Tuple[float, Optional[float]]:
         """(offset_s, unc_s) of ``rank`` from the agreed table; an
@@ -507,6 +580,20 @@ class DisaggServer:
         if not self._voted_admit:
             eng = self.engine
             free_slots = sum(r is None for r in eng._slot_rid)
+            # load-shaped vote (ISSUE 15): queued-prefill-token
+            # backlog (every token a new arrival's first chunk waits
+            # behind — queued prompts in full, residents' remaining
+            # prefill) and the rank's rolling p95 TTFT, next to the
+            # free-capacity counts the old reducer used alone
+            backlog = sum(int(r.prompt.shape[0]) for r in eng._queue)
+            for s, rid in enumerate(eng._slot_rid):
+                if rid is not None:
+                    backlog += max(0, int(eng._slot_prompt[s])
+                                   - int(eng._slot_len[s]))
+            # rolling p95 from the scheduler's bounded finish window
+            # (O(64) — walking the profiler event ring here would put
+            # an O(ring) scan on every admission round)
+            p95 = eng._sched.ttft_p95()
             vote = {
                 "seen": self._next_gid,
                 "routed": self._routed_hwm,
@@ -515,6 +602,10 @@ class DisaggServer:
                 "free_pages": int(eng.pool.allocator.num_free),
                 "free_slots": int(free_slots),
                 "queued": int(len(eng._queue)) + len(eng._held_ready),
+                "prefill_backlog": int(backlog),
+                "ttft_p95_ms": round(float(p95), 3),
+                "chunk": int(eng.prefill_chunk),
+                "page_size": int(eng.pool.page_size),
                 "topology": {
                     "prefill": list(self.mesh.prefill_ranks),
                     "decode": list(self.mesh.decode_ranks),

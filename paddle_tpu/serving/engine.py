@@ -106,6 +106,13 @@ cost), ``serving/tokens_per_sec``, ``serving/tokens_generated``,
 tick — a dispatch-site regression shows up here and in the
 ``serving.tick`` single-trace assertion); refcount traffic under
 ``cache_share/*`` (shares, releases, cow_copies, prefix_evictions).
+Scheduler-policy signals (ISSUE 15): ``serving/chunk_wait_ms``
+(histogram: admission -> first chunk open per admission cycle),
+``serving/aged_promotions`` (aged-sjf picks pure SJF would have
+ordered differently), ``serving/budget_cuts`` (ticks whose shaped
+prefill budget came in under the compiled worst case),
+``serving/spec_k_effective`` (mean offered draft depth per spec
+tick under adaptive k).
 
 Event timeline (ISSUE 8; profiler/events.py): every request lifecycle
 edge emits a typed event into the profiler's bounded event log —
@@ -141,6 +148,7 @@ from ..profiler import events as _events
 from ..profiler import recompile as _recompile
 from ..profiler import registry as _registry
 from .paged_cache import PagePool
+from .sched import SCHED_POLICIES, ChunkScheduler, SpecKController
 from .spec import SpecConfig
 
 __all__ = ["ServingConfig", "ServingEngine", "Request", "SpecConfig"]
@@ -203,6 +211,17 @@ class ServingConfig:
     num_pages: int = 0               # default: full residency + null page
     prefill_chunk: int = 0           # tokens per prefill chunk (0: 2 pages)
     prefill_chunks_per_tick: int = 1  # prefill rows per unified tick
+    #: chunk-selection policy (ISSUE 15; serving/sched.py): 'fifo'
+    #: (oldest admission first — the default, scheduling bit-for-bit
+    #: the pre-policy engine's so every bitwise parity pin is
+    #: undisturbed), 'sjf' (shortest-remaining-prefill first) or
+    #: 'aged-sjf' (SJF + deadline aging with a provable starvation
+    #: bound). Non-fifo policies also shape the per-tick prefill
+    #: budget from decode-stall telemetry, capped at the compiled
+    #: ``prefill_chunks_per_tick`` worst case — the tick shape never
+    #: retraces. Host-side only: per-request outputs stay bitwise
+    #: identical under every policy; only the interleaving moves.
+    scheduler: str = "fifo"
     prefix_cache: bool = True        # share prompt-prefix pages
     max_inflight: int = 2            # unmaterialized decode ticks in flight
     decode: str = "greedy"           # 'greedy' | 'sampling'
@@ -305,6 +324,10 @@ class ServingEngine:
             raise ValueError(f"unknown decode mode {cfg.decode!r}")
         if cfg.prefill_chunks_per_tick < 1:
             raise ValueError("prefill_chunks_per_tick must be >= 1")
+        if cfg.scheduler not in SCHED_POLICIES:
+            raise ValueError(
+                f"unknown scheduler {cfg.scheduler!r}; expected one of "
+                f"{SCHED_POLICIES}")
         kernel = cfg.attention_kernel
         if cfg.attention_impl is not None:
             if kernel != "ragged-xla":
@@ -337,6 +360,11 @@ class ServingEngine:
             if self._spec.k < 1:
                 raise ValueError("spec.k must be >= 1")
         self._legacy = kernel == "legacy"
+        if self._legacy and cfg.scheduler != "fifo":
+            raise ValueError(
+                "scheduler policies need the unified tick; "
+                "attention_kernel='legacy' is the pre-unification "
+                "bench baseline and keeps fifo chunk selection")
         self._impl = "pallas" if kernel.endswith("pallas") else "xla"
         self.attention_kernel = kernel
         # process index folded in: ids stay unique when rank-tagged
@@ -376,12 +404,31 @@ class ServingEngine:
         if self.prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         b_slots = cfg.num_slots
+        # chunk-selection + budget policy (ISSUE 15; serving/sched.py)
+        # — host-side only: picks which slot opens the next prefill
+        # chunk and how many chunks this tick selects, never what any
+        # compiled program looks like
+        self._sched = ChunkScheduler(
+            cfg.scheduler, b_slots, self.pool.slot_capacity,
+            self.prefill_chunk, cfg.prefill_chunks_per_tick)
         # host scheduling state (never reads device data)
         self._slot_rid: List[Optional[int]] = [None] * b_slots
         self._slot_len = np.zeros(b_slots, np.int32)      # tokens in cache
         self._slot_prompt = np.zeros(b_slots, np.int32)   # current prompt len
         self._slot_dispatched = np.zeros(b_slots, np.int64)  # tokens emitted
         self._slot_admit_seq = np.zeros(b_slots, np.int64)
+        self._slot_admit_t = np.zeros(b_slots, np.float64)
+        #: latch: this admission cycle still owes its chunk-wait
+        #: sample (recorded at the first chunk that actually OPENS —
+        #: a selection whose page acquisition freed the slot opened
+        #: nothing and must not count as service)
+        self._slot_wait_due = [False] * b_slots
+        #: per-ENGINE admission->first-chunk waits (bounded recent
+        #: window) next to the registry-global serving/chunk_wait_ms
+        #: histogram — co-resident engines (e.g. a policy matrix)
+        #: share the registry, so per-engine evidence needs its own
+        #: samples
+        self.chunk_waits_ms: deque = deque(maxlen=1024)
         self._slot_looked_up = [False] * b_slots
         self._admit_seq = 0
         self._queue: deque[Request] = deque()
@@ -425,6 +472,14 @@ class ServingEngine:
                     f"draft max_seq_len {dcfg.max_seq_len} must cover "
                     f"the target's {mcfg.max_seq_len}")
             self._spec_k = int(self._spec.k)
+            #: adaptive per-slot draft depth (ISSUE 15; sched.py):
+            #: accept-rate EWMA -> depth in the compiled [0, k] range
+            #: the verify tick already supports via row_len. None =
+            #: static k (the PR 9 behavior).
+            self._spec_ctl = (
+                SpecKController(b_slots, self._spec_k,
+                                self._spec.ewma_alpha)
+                if self._spec.adaptive else None)
             self._draft = DraftRunner(
                 self._spec.draft_model, b_slots,
                 self.pool.slot_capacity, self._spec_k,
@@ -641,6 +696,7 @@ class ServingEngine:
         ONE unified tick carrying the selected chunks plus every
         resident decode (legacy mode: the old chunk-then-tick dispatch
         pair). Returns whether any device work was dispatched."""
+        self._sched.on_tick()
         self._drain(self.config.max_inflight)
         self._admit()
         if self._legacy:
@@ -787,6 +843,7 @@ class ServingEngine:
         req = self._requests[rid]
         slot = self._slot_rid.index(rid)
         self._insert_prefix(slot, req.prompt, int(self._slot_len[slot]))
+        self._sched.note_release(slot)
         self.pool.release_slot(slot)
         self._slot_rid[slot] = None
         self._slot_len[slot] = 0
@@ -854,6 +911,8 @@ class ServingEngine:
         self._slot_looked_up[slot] = True     # no prefill owed here
         self._admit_seq += 1
         self._slot_admit_seq[slot] = self._admit_seq
+        self._slot_admit_t[slot] = now
+        self._slot_wait_due[slot] = False    # no chunk ever opens here
         self._spec_reset(slot)
         self._keys[slot] = req.key
         c = self.config
@@ -996,6 +1055,8 @@ class ServingEngine:
         if self._spec is None:
             return
         self._draft.reset_slot(slot)
+        if self._spec_ctl is not None:
+            self._spec_ctl.reset(slot)
         self._spec_started[slot] = False
         self._spec_verifying[slot] = False
 
@@ -1006,6 +1067,7 @@ class ServingEngine:
         self._held_ready.discard(rid)
         if self._slot_rid[slot] == rid:
             self._spec_reset(slot)
+            self._sched.note_release(slot)
             # cache the finished sequence's pages (prompt AND generated
             # full pages) before release: an identical follow-up
             # conversation prefix becomes a prefix hit
@@ -1026,6 +1088,8 @@ class ServingEngine:
         if req.first_token_t is not None:
             ttft = (req.first_token_t - req.submit_t) * 1000.0
             tpot = (now - req.first_token_t) * 1000.0 / max(tokens - 1, 1)
+            # budget-shaping telemetry (sched.py): O(1) per finish
+            self._sched.note_finish(ttft, tpot)
         self._emit("finish", rid, tokens=tokens, reason=reason,
                    preempts=req.preempts,
                    ttft_ms=None if ttft is None else round(ttft, 3),
@@ -1048,6 +1112,9 @@ class ServingEngine:
             self._spec_reset(slot)
             self._admit_seq += 1
             self._slot_admit_seq[slot] = self._admit_seq
+            self._slot_admit_t[slot] = time.perf_counter()
+            self._slot_wait_due[slot] = True
+            self._sched.note_admit(slot)
             self._emit("admit", req.rid, slot=slot)
             self._keys[slot] = req.key
             c = self.config
@@ -1060,18 +1127,24 @@ class ServingEngine:
     # chunk selection + prefix cache (shared by both engine modes)
     # ------------------------------------------------------------------
     def _next_prefill_slot(self, pend: Dict[int, int]) -> Optional[int]:
-        """Oldest-admitted slot with prompt tokens still unscheduled
-        (completing one request's prefill start-to-finish both
+        """The slot that opens the next prefill chunk, per the
+        configured policy (``ServingConfig.scheduler``; sched.py).
+        Under the default ``fifo`` this is the oldest-admitted pending
+        slot — completing one request's prefill start-to-finish both
         minimizes its TTFT and publishes its pages before the next
-        identical prompt looks them up). ``pend`` overlays chunk ends
-        selected earlier in the same tick."""
-        pending = [s for s, rid in enumerate(self._slot_rid)
-                   if rid is not None
-                   and pend.get(s, int(self._slot_len[s]))
-                   < self._slot_prompt[s]]
-        if not pending:
-            return None
-        return min(pending, key=lambda x: self._slot_admit_seq[x])
+        identical prompt looks them up; ``sjf``/``aged-sjf`` order by
+        remaining prefill tokens (with deadline aging). ``pend``
+        overlays chunk ends selected earlier in the same tick."""
+        cands = []
+        for s, rid in enumerate(self._slot_rid):
+            if rid is None:
+                continue
+            frontier = pend.get(s, int(self._slot_len[s]))
+            remaining = int(self._slot_prompt[s]) - frontier
+            if remaining > 0:
+                cands.append((s, int(self._slot_admit_seq[s]),
+                              remaining))
+        return self._sched.pick(cands)
 
     def _lookup_prefix(self, slot: int, req: Request) -> None:
         """Alias the longest cached page-aligned prefix of the prompt
@@ -1155,25 +1228,60 @@ class ServingEngine:
         need = self.pool.pages_for(end) - self.pool.slot_pages(s)
         if not self._acquire_pages(s, need):
             return None
+        if self._slot_wait_due[s]:
+            # admission -> FIRST chunk open, per admission cycle:
+            # recorded only once the chunk actually opened (pages
+            # acquired) — the direct evidence of what the selection
+            # policy did to start-of-service latency (ISSUE 15);
+            # cycles preempted before ever opening contribute none
+            self._slot_wait_due[s] = False
+            wait_ms = (time.perf_counter()
+                       - self._slot_admit_t[s]) * 1000.0
+            _registry().histogram("serving/chunk_wait_ms").observe(
+                wait_ms)
+            self.chunk_waits_ms.append(wait_ms)
+        self._sched.note_open(s)
         return (s, rid, start, end, t0)
 
     def _collect_chunks(self) -> List[_Chunk]:
-        """Select up to ``prefill_chunks_per_tick`` prompt chunks and
-        acquire their pages WITHOUT dispatching — the unified tick
-        carries them as prefill rows. ``_slot_len`` commits only at
-        dispatch: page acquisition can preempt a slot whose chunk was
-        already selected (the chunk is then dropped), and publishing a
-        frontier the dropped chunk never wrote would poison the prefix
-        index."""
+        """Select up to the policy's per-tick budget of prompt chunks
+        and acquire their pages WITHOUT dispatching — the unified tick
+        carries them as prefill rows. The budget is shaped by
+        decode-stall telemetry (sched.py ``chunk_budget``) but capped
+        at the compiled ``prefill_chunks_per_tick`` worst case, so the
+        tick shape never retraces; fifo keeps the constant budget.
+        ``_slot_len`` commits only at dispatch: page acquisition can
+        preempt a slot whose chunk was already selected (the chunk is
+        then dropped), and publishing a frontier the dropped chunk
+        never wrote would poison the prefix index."""
         chunks: List[_Chunk] = []
         pend: Dict[int, int] = {}
-        for _ in range(self.config.prefill_chunks_per_tick):
+        npf = self.config.prefill_chunks_per_tick
+        budget = npf
+        if self._sched.shape_budget:
+            pending = sum(
+                1 for s, rid in enumerate(self._slot_rid)
+                if rid is not None
+                and int(self._slot_len[s]) < self._slot_prompt[s])
+            budget = min(npf, self._sched.chunk_budget(
+                pending, len(self._ticking_slots()),
+                len(self._queue)))
+            if budget < npf and pending:
+                _registry().counter("serving/budget_cuts").add(1)
+        for _ in range(budget):
             s = self._next_prefill_slot(pend)
             if s is None:
                 break
             chunk = self._open_chunk(s, pend)
             if chunk is None:
-                break
+                # the selected slot was freed during page acquisition
+                # (finished in the drain, or became its own preemption
+                # victim) — it is no longer a candidate, so spend the
+                # remaining budget on the next pick instead of
+                # abandoning the tick's chunk service (the aged-sjf
+                # starvation bound rests on pending slots getting at
+                # least one open per tick whenever one CAN open)
+                continue
             pend[s] = chunk[3]
             chunks.append(chunk)
         return chunks          # _dispatch_unified drops stale entries
@@ -1263,6 +1371,7 @@ class ServingEngine:
         self._insert_prefix(victim, req.prompt, int(self._slot_len[victim]))
         self._queue.appendleft(req)
         self._spec_reset(victim)
+        self._sched.note_release(victim)
         self.pool.release_slot(victim)
         self._slot_rid[victim] = None
         self._slot_len[victim] = 0
@@ -1526,6 +1635,17 @@ class ServingEngine:
             req = self._requests[rid]
             if s in ticking_set:
                 last_tok[s] = req.out[-1]
+            if self._spec_ctl is not None and \
+                    self._spec_ctl.depth(s) == 0:
+                # adaptive depth decayed to 0 (ISSUE 15): the slot
+                # rides as a plain decode row — feeding/drafting a
+                # cache nobody will verify is pure draft-tick cost,
+                # so the slot drops out of the draft tick entirely
+                # (a tick with nothing to feed and nobody generating
+                # skips the draft dispatch altogether, converging the
+                # engine to plain-engine cost structure). Reset on
+                # the next admission cycle re-enables it.
+                continue
             behind = int(self._slot_len[s]) - int(dr.len[s])
             fed = 0
             if behind > 0:
@@ -1571,6 +1691,11 @@ class ServingEngine:
             req = self._requests[rid]
             pos0 = int(self._slot_len[s])
             ks = min(k, req.max_new - len(req.out) - 1, cap - 1 - pos0)
+            if self._spec_ctl is not None:
+                # adaptive depth (ISSUE 15): the slot's accept-rate
+                # EWMA picks a depth in the compiled [0, k] range —
+                # a decayed slot rides as a plain decode row
+                ks = min(ks, self._spec_ctl.depth(s))
             if ks <= 0:
                 continue
             need = self.pool.pages_for(pos0 + ks + 1) \
@@ -1683,6 +1808,8 @@ class ServingEngine:
                         gained)
                     reg.histogram("serving/spec_accept_len").observe(
                         float(gained))
+                    if self._spec_ctl is not None:
+                        self._spec_ctl.observe(s, gained, ks)
                     self._emit("accept", rid, slot=s, accepted=gained,
                                drafted=ks)
                 if s in gen_slots:
@@ -1708,6 +1835,13 @@ class ServingEngine:
         reg.gauge("serving/mixed_rows_decode").set(float(len(ticking)))
         reg.gauge("serving/mixed_rows_prefill").set(float(len(chunks)))
         reg.gauge("serving/spec_rows").set(float(int((k_arr > 0).sum())))
+        # mean OFFERED draft depth across speculating slots this tick
+        # (0.0 when nobody speculated): under adaptive k this is the
+        # live evidence of convergence — full depth at high accept,
+        # decaying toward 0 as drafts keep getting rejected
+        reg.gauge("serving/spec_k_effective").set(
+            float(k_arr[k_arr > 0].mean()) if (k_arr > 0).any()
+            else 0.0)
         drafted = reg.counter("serving/spec_drafted_tokens").value
         if drafted:
             reg.gauge("serving/spec_accept_rate").set(

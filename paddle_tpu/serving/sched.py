@@ -1,0 +1,414 @@
+"""SLO-aware serving scheduler policies (ISSUE 15 tentpole).
+
+Every *data-plane* decision of the serving engine is compiled and
+fixed-shape (ONE mixed-row tick; the spec engine adds one draft tick),
+but every *policy* decision used to be the naive default: chunk
+selection strictly oldest-admission-first, a constant per-tick prefill
+budget, one static speculation depth per engine, and page-count-only
+routing votes on the disaggregated mesh. BENCH_SERVE_r13 measured the
+cost of the first one directly — on a long-prompt-mixed workload the
+symmetric topology's p95 TTFT loses to disagg 0.83x because every
+short prompt admitted behind a long waits for the long's ENTIRE chunk
+train. This module is the host-side policy layer that exploits the
+sub-request granularity the chunked-prefill + ragged-tick design
+already paid for:
+
+- :class:`ChunkScheduler` — pluggable chunk-selection order
+  (``ServingConfig.scheduler``):
+
+  * ``"fifo"``  — oldest admission first, the pre-ISSUE-15 behavior and
+    the default (every bitwise parity pin rides on unchanged
+    scheduling, so the default must not move);
+  * ``"sjf"``   — shortest-remaining-prefill first: a short prompt
+    never parks behind a long chunk train. Starves long prompts under
+    a continuous short flood (classic SJF pathology);
+  * ``"aged-sjf"`` — SJF with deadline aging: a pending slot's
+    effective priority is ``max(remaining - age_rate * waited_ticks,
+    0)`` with FIFO tie-break, so every admitted request's priority
+    decays to the global minimum in bounded time and
+    :meth:`ChunkScheduler.starvation_bound_ticks` is a PROVABLE
+    first-chunk bound (tested against a hostile flood).
+
+  The scheduler also owns **budget shaping**: the per-tick prefill
+  budget becomes a decision in ``[1, prefill_chunks_per_tick]``
+  informed by decode-stall telemetry (resident decode count, queue
+  depth, rolling TTFT/TPOT p95 from the event timelines). The
+  compiled tick shape is UNTOUCHED — ``prefill_chunks_per_tick``
+  stays the worst case the program was traced for; the policy only
+  selects fewer chunks, which the fixed-shape pad rows absorb.
+
+- :class:`SpecKController` — adaptive per-slot speculation depth
+  (``SpecConfig.adaptive``): an accept-rate EWMA per slot maps to a
+  draft depth in the compiled ``[0, k]`` range the verify tick already
+  supports via ``row_len``. High-accept slots run full depth;
+  low-accept slots decay toward ``k = 0`` — a plain decode row, so a
+  hopeless draft stops costing verify width. Closes the PR 9 residue
+  ("adaptive k is a scheduler policy follow-up") without touching
+  either compiled site.
+
+- :func:`ttfc_key` — the load-shaped routing score used by
+  ``serving/disagg.py::route_requests``: estimated time-to-first-chunk
+  (queued-prefill-token backlog in chunk-train units + slot-overflow
+  penalty, rolling p95 TTFT as the tie-break) instead of free pages
+  alone. Pure, rank-deterministic, same consensus round.
+
+Nothing here dispatches device work or changes a compiled program:
+every policy only reorders/limits HOST-side selection, so
+``compiled_sites`` and the single-trace contract are untouched under
+every policy (asserted in tests/test_sched.py).
+
+Profiler signals: ``serving/aged_promotions`` (aging changed a pick
+pure SJF would have made differently), ``serving/budget_cuts`` (ticks
+whose shaped budget < the compiled worst case; counted by the engine),
+``serving/chunk_wait_ms`` (admission -> first chunk open, engine-side),
+``serving/spec_k_effective`` (mean offered draft depth per spec tick,
+engine-side).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..profiler.metrics import registry as _registry
+
+__all__ = ["SCHED_POLICIES", "ChunkScheduler", "SpecKController",
+           "ttfc_key"]
+
+#: ServingConfig.scheduler values, in documentation order
+SCHED_POLICIES = ("fifo", "sjf", "aged-sjf")
+
+
+class ChunkScheduler:
+    """Host-side chunk-selection + prefill-budget policy.
+
+    The engine calls, per scheduler step:
+
+    - :meth:`on_tick` once (advances the aging clock);
+    - :meth:`chunk_budget` once (how many chunks to select this tick);
+    - :meth:`pick` once per selected chunk (which pending slot opens
+      the next chunk), with candidates ``(slot, admit_seq,
+      remaining_prefill_tokens)``;
+    - :meth:`note_admit` / :meth:`note_open` / :meth:`note_release` at
+      the matching slot-lifecycle edges (aging bookkeeping).
+
+    ``fifo`` reproduces the pre-ISSUE-15 behavior EXACTLY (min
+    admit_seq, constant budget) — the default configuration's
+    scheduling is bit-for-bit the old engine's, which is what keeps
+    every existing bitwise parity pin undisturbed by construction.
+    """
+
+    def __init__(self, policy: str, num_slots: int,
+                 slot_capacity: int, prefill_chunk: int,
+                 chunks_per_tick: int, *,
+                 age_rate_tokens: Optional[int] = None,
+                 stats_every: int = 16):
+        if policy not in SCHED_POLICIES:
+            raise ValueError(
+                f"unknown scheduler policy {policy!r}; expected one of "
+                f"{SCHED_POLICIES}")
+        self.policy = policy
+        self.num_slots = int(num_slots)
+        self.slot_capacity = int(slot_capacity)
+        self.prefill_chunk = int(prefill_chunk)
+        self.chunks_per_tick = int(chunks_per_tick)
+        #: priority decay per waited tick, in remaining-prefill
+        #: tokens. Default a quarter-chunk per tick: gentle enough
+        #: that one admission burst's shorts clear before a parked
+        #: long re-promotes into their chunk queue (promoting it
+        #: mid-burst would re-create a slice of the fifo pathology),
+        #: firm enough that the starvation bound stays O(capacity)
+        #: ticks — ~4*ceil(cap/chunk) to the floor.
+        self.age_rate = int(age_rate_tokens
+                            or max(1, prefill_chunk // 4))
+        #: budget shaping is a property of the non-FIFO policies: fifo
+        #: must keep the constant pre-ISSUE-15 budget (parity pins)
+        self.shape_budget = policy != "fifo"
+        self._tick = 0
+        #: tick at which each slot last opened a chunk (or was
+        #: admitted) — the aging anchor
+        self._anchor = np.zeros(self.num_slots, np.int64)
+        #: observability: worst admission->first-chunk wait seen, in
+        #: ticks (the starvation-bound test reads this)
+        self.max_wait_ticks_seen = 0
+        self._first_open_pending = [False] * self.num_slots
+        # budget-shaping telemetry: the engine feeds each finished
+        # request's TTFT/TPOT directly (note_finish — O(1) per
+        # request; walking the profiler event ring per tick would put
+        # an O(ring) scan on the hot loop), percentiles refresh every
+        # ``stats_every`` ticks over the bounded recent window (the
+        # same nearest-rank convention + bounded-window approximation
+        # as profiler.request_latency_stats)
+        self._stats_every = max(1, int(stats_every))
+        self._ttft_window: deque = deque(maxlen=64)
+        self._tpot_window: deque = deque(maxlen=64)
+        self._ttft_p95 = 0.0
+        self._tpot_p95 = 0.0
+        # slow EWMA baselines the current percentiles compare against
+        # ("rising vs its own recent past", not an absolute ms bar —
+        # absolute bars would be machine-speed-dependent)
+        self._ttft_ref = 0.0
+        self._tpot_ref = 0.0
+
+    # -- lifecycle bookkeeping ---------------------------------------------
+    def on_tick(self) -> None:
+        """One scheduler step elapsed (the aging clock)."""
+        self._tick += 1
+        if self.shape_budget and self._tick % self._stats_every == 0:
+            self._refresh_stats()
+
+    def note_admit(self, slot: int) -> None:
+        self._anchor[slot] = self._tick
+        self._first_open_pending[slot] = True
+
+    def note_open(self, slot: int) -> None:
+        """Slot opened a chunk: its aging restarts, and the first open
+        of an admission cycle records the observed wait."""
+        waited = int(self._tick - self._anchor[slot])
+        if self._first_open_pending[slot]:
+            self._first_open_pending[slot] = False
+            self.max_wait_ticks_seen = max(self.max_wait_ticks_seen,
+                                           waited)
+        self._anchor[slot] = self._tick
+
+    def note_release(self, slot: int) -> None:
+        """Slot freed (finish/preempt/export) mid-wait: drop the
+        pending-first-open latch so a requeue's wait restarts."""
+        self._first_open_pending[slot] = False
+
+    def note_finish(self, ttft_ms: Optional[float],
+                    tpot_ms: Optional[float]) -> None:
+        """One finished request's latency sample (engine ``_finish``
+        feeds this) — the budget shaper's rolling TTFT/TPOT source."""
+        if ttft_ms is not None:
+            self._ttft_window.append(float(ttft_ms))
+        if tpot_ms is not None:
+            self._tpot_window.append(float(tpot_ms))
+
+    def ttft_p95(self) -> float:
+        """Rolling TTFT p95 over the bounded recent window, computed
+        fresh (O(window log window), window <= 64) — the cheap read
+        the disagg admission vote uses instead of re-deriving the
+        percentile from the whole profiler event ring every round.
+        Fed for EVERY policy (note_finish is unconditional); 0.0
+        until the first finish."""
+        if not self._ttft_window:
+            return 0.0
+        from ..profiler.metrics import percentile
+
+        return float(percentile(sorted(self._ttft_window), 95))
+
+    # -- chunk selection ----------------------------------------------------
+    def pick(self, cands: Sequence[Tuple[int, int, int]]
+             ) -> Optional[int]:
+        """Choose the next slot to open a prefill chunk from
+        ``cands = [(slot, admit_seq, remaining_prefill_tokens), ...]``.
+        Returns the slot, or None when no candidate is pending."""
+        if not cands:
+            return None
+        if self.policy == "fifo":
+            return min(cands, key=lambda c: c[1])[0]
+        if self.policy == "sjf":
+            # shortest remaining prefill first; FIFO tie-break keeps
+            # the order total and deterministic
+            return min(cands, key=lambda c: (c[2], c[1]))[0]
+        # aged-sjf: effective priority = remaining minus the aging
+        # credit, floored at 0 — the floor is what makes the
+        # starvation bound provable (an aged slot's priority reaches
+        # the global minimum and FIFO tie-break takes over)
+        def key(c):
+            slot, seq, rem = c
+            waited = self._tick - int(self._anchor[slot])
+            return (max(rem - self.age_rate * waited, 0), seq)
+
+        best = min(cands, key=key)
+        if best[2] > min(c[2] for c in cands):
+            # aging promoted a slot pure SJF would have passed over
+            _registry().counter("serving/aged_promotions").add(1)
+        return best[0]
+
+    def starvation_bound_ticks(self) -> int:
+        """Upper bound on admission -> first chunk open under
+        ``aged-sjf`` (PROVABLE, assuming at least one chunk is opened
+        per tick while any slot is pending — :meth:`chunk_budget`'s
+        floor of 1 plus the engine's try-next-candidate-on-failure
+        selection deliver this whenever any pending slot CAN acquire
+        its pages; a pool so pressured that NO pending slot can open
+        resolves through the preemption machinery, outside this
+        bound):
+
+        - a pending slot's effective priority hits the floor (0) after
+          at most ``ceil(slot_capacity / age_rate)`` waited ticks
+          (remaining <= slot_capacity always);
+        - at the floor it can lose only to other floor-priority slots
+          with OLDER admit_seq — at most ``num_slots - 1`` of them,
+          each needing at most ``ceil(slot_capacity / prefill_chunk)``
+          chunks to finish prefill and stop competing;
+
+        so the wait is bounded by ``ceil(cap / age_rate) +
+        (num_slots - 1) * ceil(cap / chunk) + 1`` ticks. Not tight —
+        the hostile-flood test asserts observed <= this."""
+        cap = self.slot_capacity
+        to_floor = -(-cap // self.age_rate)
+        chunks_per_slot = -(-cap // self.prefill_chunk)
+        return to_floor + (self.num_slots - 1) * chunks_per_slot + 1
+
+    # -- budget shaping -----------------------------------------------------
+    def _refresh_stats(self) -> None:
+        """Refresh the rolling TTFT/TPOT p95 over the bounded recent
+        window and fold them into the slow baselines."""
+        from ..profiler.metrics import percentile
+
+        self._ttft_p95 = float(percentile(
+            sorted(self._ttft_window), 95)) if self._ttft_window \
+            else 0.0
+        self._tpot_p95 = float(percentile(
+            sorted(self._tpot_window), 95)) if self._tpot_window \
+            else 0.0
+        # slow EWMA (alpha 0.25): the reference tracks the run's own
+        # recent latency so "rising" is relative, not absolute
+        for cur, ref in (("_ttft_p95", "_ttft_ref"),
+                         ("_tpot_p95", "_tpot_ref")):
+            c = getattr(self, cur)
+            if c > 0.0:
+                r = getattr(self, ref)
+                setattr(self, ref, c if r == 0.0 else
+                        0.75 * r + 0.25 * c)
+
+    def chunk_budget(self, pending_prefill: int, resident_decodes: int,
+                     queue_depth: int) -> int:
+        """Per-tick prefill budget in ``[1, chunks_per_tick]`` (the
+        compiled worst case is the hard cap — the tick shape never
+        retraces; a smaller selection rides the fixed shape's pad
+        rows). FIFO returns the constant pre-ISSUE-15 budget.
+
+        Shaping logic (deterministic, host-only):
+
+        - **decode-stall pressure** — when at least half the slots are
+          actively decoding and nothing is queued behind the pending
+          prefills, every extra chunk row only stalls resident decode
+          tokens (a chunk adds ``prefill_chunk`` tokens of compute to
+          the tick every decode token waits behind): halve the budget;
+          if the rolling TPOT p95 has risen >= 1.5x above its own
+          recent baseline, cut to the floor of 1.
+        - **TTFT pressure** — a queue backlog (arrivals waiting for
+          slots) or a rolling TTFT p95 >= 1.5x its baseline buys the
+          full budget back: prefill throughput is what drains it.
+
+        The floor of 1 whenever anything is pending is load-bearing:
+        the aged-sjf starvation bound assumes at least one chunk opens
+        per tick while a slot is pending."""
+        npf = self.chunks_per_tick
+        if not self.shape_budget or pending_prefill <= 0 or npf <= 1:
+            return npf
+        budget = npf
+        if queue_depth == 0 and 2 * resident_decodes >= self.num_slots:
+            budget = max(1, npf // 2)
+            if self._tpot_ref > 0.0 and \
+                    self._tpot_p95 >= 1.5 * self._tpot_ref:
+                budget = 1
+        if queue_depth > 0 or (
+                self._ttft_ref > 0.0
+                and self._ttft_p95 >= 1.5 * self._ttft_ref):
+            budget = npf
+        return budget
+
+
+class SpecKController:
+    """Adaptive per-slot speculation depth (``SpecConfig.adaptive``).
+
+    Per-slot accept-rate EWMA ``a_s`` (tokens accepted / tokens
+    drafted per verify tick, alpha ``ewma_alpha``), mapped to a draft
+    depth ``floor(a_s * k + 0.5)`` clamped to the compiled ``[0, k]``
+    range. New tenants start optimistic (``a_s = 1`` -> full depth —
+    the draft must earn its demotion, not its promotion, because an
+    un-speculated slot generates no evidence). A slot that decays to
+    depth 0 becomes a plain decode row and stops producing
+    observations: it stays at 0 for the residency (documented —
+    re-probing is a follow-up; admission/preemption/finish reset the
+    slot via :meth:`reset`, so the stickiness is bounded by one
+    residency period).
+
+    Depth changes never touch the compiled verify tick: ``k_s`` rides
+    the existing per-slot ``row_len``/``tok_limit`` metadata, exactly
+    like the budget/headroom clamps the engine already applies."""
+
+    def __init__(self, num_slots: int, k: int,
+                 ewma_alpha: float = 0.5):
+        if not 0.0 < ewma_alpha <= 1.0:
+            raise ValueError("ewma_alpha must be in (0, 1]")
+        self.k = int(k)
+        self.alpha = float(ewma_alpha)
+        self._ewma = np.ones(int(num_slots), np.float64)
+
+    def reset(self, slot: int) -> None:
+        self._ewma[slot] = 1.0
+
+    def depth(self, slot: int) -> int:
+        return int(min(self.k,
+                       int(self._ewma[slot] * self.k + 0.5)))
+
+    def observe(self, slot: int, accepted: int, drafted: int) -> None:
+        if drafted <= 0:
+            return
+        rate = min(max(accepted / drafted, 0.0), 1.0)
+        self._ewma[slot] += self.alpha * (rate - self._ewma[slot])
+
+    def ewma(self, slot: int) -> float:
+        return float(self._ewma[slot])
+
+
+# ---------------------------------------------------------------------------
+# load-shaped routing (serving/disagg.py::route_requests reducer)
+# ---------------------------------------------------------------------------
+def ttfc_key(votes: Dict[int, dict], rank: int,
+             extra_tokens: Dict[int, int],
+             extra_reqs: Dict[int, int]) -> Tuple[float, float, int]:
+    """Deterministic estimated-time-to-first-chunk sort key of
+    ``rank`` given one consensus round's votes (smaller = route here).
+
+    Primary term: the rank's queued-prefill-token backlog (vote key
+    ``prefill_backlog``; falls back to ``queued * chunk`` for a
+    pre-ISSUE-15 voter) plus what this round already assigned it,
+    in CHUNK-TRAIN units (``ceil(tokens / prefill_chunk)`` — a new
+    arrival's first chunk waits behind exactly that many chunk
+    selections), plus a slot-overflow penalty (arrivals beyond the
+    rank's free slots wait a whole residency, not a chunk train: 8
+    chunk-units each — the old reducer's queued:free_slots weight
+    ratio, kept so mixed-version meshes still order sanely), plus a
+    PAGE-pressure penalty (projected tokens beyond the rank's free
+    page capacity — ``free_pages * page_size`` — cost preemption
+    churn, not just a chunk wait: 4 chunk-units per deficit chunk,
+    so the backlog term the old ``-free_pages`` load kept is not
+    lost). Secondary term: the rank's rolling p95 TTFT
+    (``ttft_p95_ms``; 0 when absent) — measured pressure breaks
+    backlog ties toward the rank actually serving first tokens
+    faster. Final tie-break: the rank id (total order; every leader
+    computes the same assignment).
+
+    A rank with no vote this round prices as unroutable-busy (the
+    pre-existing dead-peer rule). Pure function of the votes — the
+    reducer stays rank-deterministic and rides the SAME consensus
+    round as before."""
+    v = votes.get(rank)
+    if v is None:
+        return (float(1 << 20), float(1 << 20), rank)
+    chunk = max(1, int(v.get("chunk", 64)))
+    backlog = v.get("prefill_backlog")
+    if backlog is None:                 # pre-ISSUE-15 voter
+        backlog = int(v.get("queued", 0)) * chunk
+    tokens = int(backlog) + int(extra_tokens.get(rank, 0))
+    chunks_ahead = -(-tokens // chunk)
+    over = max(0, int(extra_reqs.get(rank, 0))
+               + int(v.get("queued", 0))
+               - int(v.get("free_slots", 0)))
+    # page pressure: tokens routed past the rank's free page capacity
+    # trigger the preemption escalation there — far costlier than a
+    # chunk wait, so weight each deficit chunk heavily
+    free_tokens = int(v.get("free_pages", 0)) * \
+        int(v.get("page_size", 16))
+    deficit = max(0, tokens - free_tokens)
+    p95 = float(v.get("ttft_p95_ms") or 0.0)
+    return (float(chunks_ahead + 8 * over
+                  + 4 * (-(-deficit // chunk))), p95, rank)

@@ -67,8 +67,10 @@ amortization per dispatch; ``serving/spec_accept_rate`` and
 ``serve_bench --spec-decode`` measure whether the trade pays.
 
 Residue (ROADMAP): greedy only — sampling needs the rejection-sampling
-acceptance rule; ``k`` is static per engine (adaptive k is a policy
-follow-up); the draft cache is dense, not paged.
+acceptance rule; the draft cache is dense, not paged. (The "k is
+static per engine" line is retired: ``SpecConfig.adaptive`` drives a
+per-slot depth from an accept-rate EWMA — ISSUE 15,
+serving/sched.py::SpecKController.)
 """
 from __future__ import annotations
 
@@ -96,10 +98,18 @@ class SpecConfig:
     wasted verify position, accepted ones skip a target dispatch).
     ``k``: draft tokens speculated per verify tick; each slot's actual
     depth is clamped per tick by its remaining token budget and page
-    headroom (down to 0 = a plain decode row)."""
+    headroom (down to 0 = a plain decode row).
+    ``adaptive`` (ISSUE 15; serving/sched.py::SpecKController): drive
+    each slot's depth from an accept-rate EWMA (alpha ``ewma_alpha``)
+    instead of always offering the full ``k`` — high-accept slots run
+    full depth, low-accept slots decay toward 0 (a plain decode row),
+    all inside the compiled ``[0, k]`` range the verify tick already
+    supports via ``row_len``, so neither compiled site changes."""
 
     draft_model: object
     k: int = 4
+    adaptive: bool = False
+    ewma_alpha: float = 0.5
 
 
 class DraftRunner:

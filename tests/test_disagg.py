@@ -777,3 +777,77 @@ class TestCrossHostTTFT:
         finally:
             for srv in servers:
                 srv.close()
+
+    def test_periodic_resync_absorbs_a_mid_run_clock_step(
+            self, tmp_path):
+        """ISSUE 15 satellite (retires the PR 14 "one-shot sync, no
+        drift tracking" residue): with ``clock_resync_s`` set, a
+        clock STEP injected mid-run (the PADDLE_CLOCK_SKEW scenario —
+        here via the equivalent in-process skew fields, which move
+        the server's wall stamps and its sync samples together,
+        exactly what a skewed host is) is re-measured on the
+        heartbeat and, because the offset moved by more than its
+        uncertainty, re-voted: BOTH ranks adopt the corrected table
+        within the drive loop. A resync whose estimate stays inside
+        the uncertainty must NOT churn a new epoch."""
+        from paddle_tpu.profiler import registry
+
+        net = _net()
+        servers = [DisaggServer(net, ServingConfig(**CFG),
+                                MeshSpec(r, 2, prefill_ranks=(0,)),
+                                str(tmp_path), lease_s=30.0,
+                                clock_skew_s=2.5 if r == 1 else 0.0,
+                                clock_resync_s=0.05)
+                   for r in range(2)]
+        try:
+            # first adoption: the usual one-shot sync
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                for srv in servers:
+                    srv._clock_round()
+                t0, t1 = servers[0]._clock_table, \
+                    servers[1]._clock_table
+                if t0 and t1 and "1" in t0 and "1" in t1:
+                    break
+                time.sleep(0.005)
+            e1 = servers[1]._clock_table["1"]
+            assert abs(e1["offset_s"] - 2.5) <= e1["unc_s"] + 0.05
+            r0 = registry().counter("consensus/clock_resyncs").value
+
+            # steady clocks: resync rounds run but must not re-vote
+            deadline = time.time() + 0.5
+            while time.time() < deadline:
+                for srv in servers:
+                    srv._clock_round()
+                time.sleep(0.005)
+            epoch_churn = registry().counter(
+                "consensus/clock_resyncs").value
+            assert epoch_churn == r0
+
+            # inject a +2.0 s STEP on rank 1 (skew 2.5 -> 4.5): the
+            # server's wall stamps AND its sync samples move together
+            servers[1]._skew_s = 4.5
+            servers[1].clock.skew_s = 4.5
+            deadline = time.time() + 10
+            absorbed = False
+            while time.time() < deadline and not absorbed:
+                for srv in servers:
+                    srv._clock_round()
+                for srv in servers:
+                    e = (srv._clock_table or {}).get("1") or {}
+                    off = e.get("offset_s")
+                    absorbed = off is not None and \
+                        abs(off - 4.5) <= (e.get("unc_s") or 0) + 0.05
+                    if not absorbed:
+                        break
+                time.sleep(0.005)
+            assert absorbed, servers[1]._clock_table
+            assert registry().counter(
+                "consensus/clock_resyncs").value > r0
+            # (the process-global disttrace clock state is shared by
+            # both in-process logical ranks — its final value is
+            # whichever adopted last, so only the tables are asserted;
+            # the real-mesh skew tests own the sink-metadata claim)
+        finally:
+            for srv in servers:
+                srv.close()

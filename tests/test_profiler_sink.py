@@ -785,3 +785,78 @@ def test_schema_checker_qcomm_config():
                "int8": {k: v for k, v in i8.items() if k != "losses"}}
     assert any("missing key 'losses'" in e
                for e in _run_check("check_qcomm_config", missing))
+
+
+# ---------------------------------------------------------------------------
+# sink-schema checker: ISSUE 15 blocks (scheduler-policy cells /
+# adaptive spec-k arms) — negative-tested so the v15 CI rules are
+# themselves pinned
+# ---------------------------------------------------------------------------
+
+
+def _sched_cell(policy, **over):
+    cell = {"policy": policy, "tokens_per_sec": 100.0,
+            "ttft_p50_ms": 5.0, "ttft_p95_ms": 20.0,
+            "chunk_wait_p95_ms": 3.0, "budget_cuts": 0,
+            "aged_promotions": 0}
+    cell.update(over)
+    return cell
+
+
+def test_schema_checker_sched_cells():
+    good = {"fifo": _sched_cell("fifo"),
+            "sjf": _sched_cell("sjf", budget_cuts=4),
+            "aged-sjf": _sched_cell("aged-sjf", budget_cuts=2,
+                                    aged_promotions=7)}
+    assert _run_check("check_sched_cells", good) == []
+    # missing a v15 key
+    broke = dict(good, sjf={k: v for k, v in good["sjf"].items()
+                            if k != "chunk_wait_p95_ms"})
+    assert any("missing key 'chunk_wait_p95_ms'" in e
+               for e in _run_check("check_sched_cells", broke))
+    # a negative latency is a writer bug
+    neg = dict(good, fifo=_sched_cell("fifo", ttft_p95_ms=-1.0))
+    assert any("non-negative" in e
+               for e in _run_check("check_sched_cells", neg))
+    # THE fifo invariant: the default policy must not shape or age —
+    # a nonzero counter there means the policy layer leaked into the
+    # path every bitwise parity pin rides on
+    leak = dict(good, fifo=_sched_cell("fifo", aged_promotions=3))
+    assert any("must not shape or age" in e
+               for e in _run_check("check_sched_cells", leak))
+    leak2 = dict(good, fifo=_sched_cell("fifo", budget_cuts=1))
+    assert any("must not shape or age" in e
+               for e in _run_check("check_sched_cells", leak2))
+
+
+def _adaptive_arm(**over):
+    arm = {"tokens_per_sec": 50.0, "accept_rate": 0.5,
+           "drafted_tokens": 100, "accepted_tokens": 50,
+           "verify_ticks": 40}
+    arm.update(over)
+    return arm
+
+
+def test_schema_checker_adaptive_k():
+    good = {"static": _adaptive_arm(),
+            "adaptive": _adaptive_arm(drafted_tokens=60,
+                                      accepted_tokens=40,
+                                      accept_rate=0.66),
+            "speedup": 1.1}
+    assert _run_check("check_adaptive_k", good) == []
+    # both arms required
+    assert any("missing 'adaptive' arm" in e for e in _run_check(
+        "check_adaptive_k", {"static": _adaptive_arm()}))
+    # accept rate outside [0, 1]
+    bad = dict(good, static=_adaptive_arm(accept_rate=1.5))
+    assert any("[0, 1]" in e
+               for e in _run_check("check_adaptive_k", bad))
+    # the defining property: adaptive never out-drafts static
+    over = dict(good, adaptive=_adaptive_arm(drafted_tokens=200))
+    assert any("not clamping" in e
+               for e in _run_check("check_adaptive_k", over))
+    missing = dict(good, adaptive={
+        k: v for k, v in good["adaptive"].items()
+        if k != "verify_ticks"})
+    assert any("missing key 'verify_ticks'" in e
+               for e in _run_check("check_adaptive_k", missing))

@@ -494,6 +494,96 @@ def check_qcomm_config(doc, schema: dict, where: str) -> None:
             "baseline")
 
 
+def check_sched_cells(doc, schema: dict, where: str) -> None:
+    """Validate a serve_bench --sched-matrix block (ISSUE 15): one
+    cell per policy with the v15 keys, non-negative latencies, and the
+    fifo invariants — fifo never shapes the budget and never ages a
+    pick, so nonzero ``budget_cuts``/``aged_promotions`` in the fifo
+    cell is a policy-layer bug leaking into the default path, exactly
+    what would silently move the bitwise parity pins."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for name, cell in doc.items():
+        w = f"{where}.{name}"
+        if not isinstance(cell, dict):
+            err(f"{w}: not a JSON object")
+            continue
+        for k in sc["sched_cell"]:
+            if k not in cell:
+                err(f"{w}: missing key {k!r}")
+        for k in ("ttft_p50_ms", "ttft_p95_ms", "chunk_wait_p95_ms",
+                  "tokens_per_sec"):
+            v = cell.get(k)
+            if k in cell and (not isinstance(v, (int, float))
+                              or v < 0):
+                err(f"{w}: {k} {v!r} not a non-negative number")
+        if cell.get("policy") == "fifo":
+            for k in ("budget_cuts", "aged_promotions"):
+                if cell.get(k) not in (0, 0.0, None):
+                    err(f"{w}: fifo cell has nonzero {k} "
+                        f"({cell.get(k)!r}) — the default policy "
+                        "must not shape or age")
+
+
+def check_adaptive_k(doc, schema: dict, where: str) -> None:
+    """Validate a serve_bench --adaptive-k block (ISSUE 15): both
+    arms carry the v15 keys, accept rates sit in [0, 1], and the
+    defining property holds — the adaptive arm never DRAFTS more
+    than the static arm on the same workload (decayed slots stop
+    offering drafts; an adaptive arm out-drafting static means the
+    controller is not actually clamping)."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for arm_name in ("static", "adaptive"):
+        arm = doc.get(arm_name)
+        if not isinstance(arm, dict):
+            err(f"{where}: missing {arm_name!r} arm")
+            continue
+        for k in sc["adaptive_k_arm"]:
+            if k not in arm:
+                err(f"{where}.{arm_name}: missing key {k!r}")
+        r = arm.get("accept_rate")
+        if not isinstance(r, (int, float)) or not 0.0 <= r <= 1.0:
+            err(f"{where}.{arm_name}: accept_rate {r!r} not a number "
+                "in [0, 1]")
+    st, ad = doc.get("static") or {}, doc.get("adaptive") or {}
+    ds, da = st.get("drafted_tokens"), ad.get("drafted_tokens")
+    if isinstance(ds, int) and isinstance(da, int) and da > ds:
+        err(f"{where}: adaptive arm drafted {da} > static {ds} — "
+            "the depth controller is not clamping")
+
+
+def check_aux_bench_json(path: str, schema: dict) -> None:
+    """Validate a mode-specific serve_bench block (--sched-matrix /
+    --adaptive-k, ISSUE 15): the v15 cells plus the registry snapshot
+    with the new scheduler metrics. The FULL observability contract
+    (latency table, program inventory, events overhead) belongs to
+    the Poisson/prefix blocks, checked via --bench-json."""
+    try:
+        extra = json.load(open(path))["extra"]
+    except Exception as e:
+        return err(f"{path}: unreadable bench JSON ({e})")
+    reg = extra.get("registry")
+    if not isinstance(reg, dict):
+        err(f"{path}: extra.registry (full snapshot) missing")
+        reg = {}
+    if "sched_cells" in extra:
+        check_sched_cells(extra["sched_cells"], schema,
+                          f"{path}: extra.sched_cells")
+        for k in schema["bench_extra"]["sched_registry_required"]:
+            if k not in reg:
+                err(f"{path}: registry missing {k!r} (v15 scheduler "
+                    "observability)")
+    if "mixed_accept" in extra:
+        check_adaptive_k(extra["mixed_accept"], schema,
+                         f"{path}: extra.mixed_accept")
+    if "sched_cells" not in extra and "mixed_accept" not in extra:
+        err(f"{path}: neither sched_cells nor mixed_accept present "
+            "(--aux-bench-json is for the ISSUE 15 modes)")
+
+
 def check_bench_json(path: str, schema: dict,
                      require_trace: bool = False) -> None:
     sc = schema["bench_extra"]
@@ -547,6 +637,13 @@ def check_bench_json(path: str, schema: dict,
     if qc is not None:
         check_qcomm_config(qc, schema,
                            f"{path}: extra.configs.gpt_dp_qcomm_int8")
+    # ISSUE 15 blocks, validated whenever present
+    if "sched_cells" in extra:
+        check_sched_cells(extra["sched_cells"], schema,
+                          f"{path}: extra.sched_cells")
+    if "mixed_accept" in extra:
+        check_adaptive_k(extra["mixed_accept"], schema,
+                         f"{path}: extra.mixed_accept")
 
 
 def main() -> int:
@@ -554,6 +651,12 @@ def main() -> int:
     ap.add_argument("sink_dir", help="directory a MetricsSink wrote")
     ap.add_argument("--bench-json", default=None,
                     help="serve_bench stdout JSON to validate as well")
+    ap.add_argument("--aux-bench-json", action="append", default=[],
+                    help="mode-specific serve_bench JSON "
+                         "(--sched-matrix / --adaptive-k, ISSUE 15): "
+                         "validates the v15 cells + scheduler "
+                         "registry keys without the Poisson block's "
+                         "full observability contract")
     ap.add_argument("--merged-json", default=None,
                     help="tools/merge_traces.py artifact to validate "
                          "as well (ISSUE 14: offset/uncertainty "
@@ -581,6 +684,8 @@ def main() -> int:
     if args.bench_json:
         check_bench_json(args.bench_json, schema,
                          require_trace=args.require_trace)
+    for aux in args.aux_bench_json:
+        check_aux_bench_json(aux, schema)
     if args.merged_json:
         check_merged_trace_file(args.merged_json, schema)
 
