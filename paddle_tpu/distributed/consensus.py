@@ -89,7 +89,7 @@ from collections import Counter as _Counter
 from typing import Any, Callable, Dict, List, Optional, Union
 
 __all__ = ["Consensus", "Decision", "ConsensusTimeout", "REDUCERS",
-           "adopted_epochs"]
+           "adopted_epochs", "lease_ages"]
 
 #: adopted epochs kept on disk behind every live rank's cursor — the
 #: replay window a transiently-slow rank can still read; everything
@@ -115,6 +115,39 @@ def adopted_epochs() -> Dict[str, int]:
     """{family: last adopted epoch} for this process."""
     with _ADOPTED_LOCK:
         return dict(_ADOPTED)
+
+
+def lease_ages(board_dir: str,
+               world: Optional[int] = None) -> Dict[int, float]:
+    """{rank: seconds since its ``lease.<rank>`` was refreshed} read
+    straight off the board directory — a pure-stdlib OBSERVER's view
+    of mesh liveness (ISSUE 16: the LiveAggregator corroborates frame
+    staleness with this before flagging a rank dead; it runs on the
+    driver and holds no Consensus instance). A rank with NO lease file
+    is simply absent from the result — never fabricated. ``world``
+    bounds the scan when given; otherwise every ``lease.*`` file on
+    the board is reported."""
+    out: Dict[int, float] = {}
+    now = time.time()
+    try:
+        names = os.listdir(board_dir)
+    except OSError:
+        return out
+    for n in names:
+        if not n.startswith("lease."):
+            continue
+        try:
+            r = int(n[len("lease."):])
+        except ValueError:
+            continue
+        if world is not None and not 0 <= r < world:
+            continue
+        try:
+            out[r] = max(0.0, now - os.path.getmtime(
+                os.path.join(board_dir, n)))
+        except OSError:
+            pass
+    return out
 
 
 class Decision:

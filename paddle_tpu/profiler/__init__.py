@@ -88,7 +88,9 @@ from __future__ import annotations
 
 from . import device_trace, events, instrument, metrics  # noqa: F401
 from . import recompile, sink, trace, xla_stats  # noqa: F401
-from . import disttrace  # noqa: F401
+from . import disttrace, live, sketch  # noqa: F401
+from .live import AlertRule, LiveAggregator, default_rules  # noqa: F401
+from .sketch import QuantileSketch  # noqa: F401
 from .disttrace import ClockSync, clock_state  # noqa: F401
 from .disttrace import set_clock_state, trace_id  # noqa: F401
 from .device_trace import TraceWindow, last_trace_summary  # noqa: F401
@@ -138,6 +140,8 @@ __all__ = [
     "trace_capture", "TraceWindow", "last_trace_summary",
     # cross-host request tracing (disttrace.py, ISSUE 14)
     "trace_id", "clock_state", "set_clock_state", "ClockSync",
+    # live mesh telemetry plane (sketch.py / live.py, ISSUE 16)
+    "QuantileSketch", "LiveAggregator", "AlertRule", "default_rules",
 ]
 
 

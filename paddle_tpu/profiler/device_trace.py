@@ -57,10 +57,12 @@ On TPU the same parser reads the device-stream slices. Every parser
 path is exercised by checked-in fixture tests on any backend.
 
 **Peak FLOPs** for MFU: TPU generations get their bf16 peak; CPU gets
-a documented NOMINAL placeholder (``_PEAK_FLOPS["cpu"]``, the
-``instrument._LINK_BW`` loopback precedent) so the ledger stays
-numeric on test platforms — ``peak_flops_source`` says which one was
-used; pass ``peak_flops=`` or set ``PADDLE_PEAK_FLOPS`` to override.
+a one-shot MEASURED matmul calibration at the first capture (source
+``"calibrated"`` — ISSUE 16 satellite, retiring the nominal
+placeholder), falling back to the labeled nominal
+``_PEAK_FLOPS["cpu"]`` only if the measurement itself fails —
+``peak_flops_source`` says which one was used; pass ``peak_flops=``
+or set ``PADDLE_PEAK_FLOPS`` to override (the env var always wins).
 
 Entry points::
 
@@ -361,17 +363,57 @@ def parse_timeline(doc: dict) -> Timeline:
 # peak FLOPs (MFU denominator)
 # ---------------------------------------------------------------------------
 #: bf16 peak FLOP/s per chip by device-kind substring (bench.py table);
-#: the CPU entry is a NOMINAL placeholder (the instrument._LINK_BW
-#: loopback precedent) so the MFU ledger stays numeric on test
-#: platforms — peak_flops_source labels it honestly.
+#: the CPU entry is the FALLBACK for hosts where the measured matmul
+#: calibration below fails — peak_flops_source labels which one a
+#: ledger actually used.
 _PEAK_FLOPS = {"v6": 918e12, "v5p": 459e12, "v5": 197e12,
                "v4": 275e12, "cpu": 5e10}
+
+#: one-shot CPU calibration cache: (peak FLOP/s or None, done flag) —
+#: measured at the FIRST capture's summarize and reused for the
+#: process's lifetime (a per-capture re-measure would make MFUs from
+#: the same run mutually incomparable)
+_cpu_calibration: Optional[float] = None
+_cpu_calibrated = False
+_calib_lock = threading.Lock()
+
+
+def _measure_cpu_peak_flops(n: int = 512,
+                            reps: int = 5) -> Optional[float]:
+    """Measured f32 matmul throughput of THIS host (best of ``reps``
+    timed ``n x n`` BLAS multiplies after one warmup) — the honest CPU
+    MFU denominator the old nominal placeholder stood in for. Best-of
+    (not mean) deliberately: the denominator should be the machine's
+    demonstrated peak, so reported MFU stays <= 1 instead of drifting
+    above it when a timing rep got descheduled. Returns None on any
+    failure — the caller falls back to the labeled nominal value,
+    never guesses."""
+    try:
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        b = rng.standard_normal((n, n)).astype(np.float32)
+        a @ b                               # warm the BLAS path
+        best = None
+        flop = 2.0 * n ** 3
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            (a @ b).sum()                   # .sum() defeats lazy eval
+            dt = time.perf_counter() - t0
+            if dt > 0 and (best is None or dt < best):
+                best = dt
+        return None if best is None else flop / best
+    except Exception:  # pragma: no cover - exotic BLAS failure
+        return None
 
 
 def default_peak_flops() -> Tuple[Optional[float], str]:
     """(peak FLOP/s, source label) for the local device. Precedence:
     ``PADDLE_PEAK_FLOPS`` env var, the TPU-generation table, the
-    documented nominal CPU placeholder."""
+    one-shot measured CPU matmul calibration (source
+    ``"calibrated"``), the labeled nominal CPU fallback."""
+    global _cpu_calibration, _cpu_calibrated
     env = os.environ.get("PADDLE_PEAK_FLOPS")
     if env:
         try:
@@ -390,6 +432,12 @@ def default_peak_flops() -> Tuple[Optional[float], str]:
             return _PEAK_FLOPS["v5"], "tpu-default-v5e-bf16-peak"
     except Exception:
         pass
+    with _calib_lock:
+        if not _cpu_calibrated:
+            _cpu_calibration = _measure_cpu_peak_flops()
+            _cpu_calibrated = True
+        if _cpu_calibration is not None:
+            return _cpu_calibration, "calibrated"
     return _PEAK_FLOPS["cpu"], "nominal-cpu-placeholder"
 
 

@@ -80,13 +80,18 @@ __all__ = [
 #: ``waiting_on``). Any event of a request that carries a trace id
 #: additionally bears a ``trace`` attr — the cross-host join key
 #: tools/merge_traces.py stitches on.
+#: Live telemetry (ISSUE 16) adds ``alert``: an AlertRule transition
+#: in profiler/live.py — attrs ``rule``/``state`` (``firing`` or
+#: ``resolved``), ``value``/``threshold`` when the rule is numeric.
+#: Rare by construction (one per rule TRANSITION, hysteresis-damped,
+#: never per tick).
 EVENT_KINDS = (
     "submit", "admit", "prefix_hit", "cow_copy", "chunk",
     "first_token", "draft", "verify", "accept",
     "handoff_out", "handoff_in",
     "route", "clock_sync", "consensus_decision", "lease_expiry",
     "vote_window_expiry",
-    "preempt", "requeue", "finish", "rollback",
+    "preempt", "requeue", "finish", "rollback", "alert",
 )
 
 

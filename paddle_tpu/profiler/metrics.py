@@ -21,7 +21,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-_RESERVOIR = 1024
+from .sketch import QuantileSketch
 
 
 def percentile(sorted_vals, q: float) -> Optional[float]:
@@ -87,51 +87,45 @@ class Gauge:
 
 
 class Histogram:
-    """Distribution metric (per-step ms). Keeps exact count/sum/min/max
-    plus a bounded reservoir of the most recent observations for
-    percentiles — step-time telemetry must not grow without bound over a
-    million-step run."""
+    """Distribution metric (per-step ms). Backed by a mergeable
+    relative-error quantile sketch (sketch.py, ISSUE 16): exact
+    count/sum/min/max, percentiles within a DOCUMENTED 1% relative
+    error, bounded size over a million-step run — and cross-rank
+    aggregation merges bucket-wise (exact), retiring the PR 9
+    NaN-padded bounded-reservoir gather whose error depended on what
+    the recency window happened to hold."""
 
-    __slots__ = ("name", "_n", "_sum", "_min", "_max", "_recent", "_lock")
+    __slots__ = ("name", "_sk", "_lock")
 
     def __init__(self, name: str):
         self.name = name
-        self._n = 0
-        self._sum = 0.0
-        self._min = float("inf")
-        self._max = float("-inf")
-        self._recent: List[float] = []
+        self._sk = QuantileSketch()
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
         v = float(v)
         with self._lock:
-            self._n += 1
-            self._sum += v
-            self._min = min(self._min, v)
-            self._max = max(self._max, v)
-            self._recent.append(v)
-            if len(self._recent) > _RESERVOIR:
-                del self._recent[: len(self._recent) - _RESERVOIR]
+            self._sk.observe(v)
 
     @property
     def count(self) -> int:
-        return self._n
+        return self._sk.count
 
     def percentile(self, q: float) -> Optional[float]:
+        """Within the sketch's ``rel_err`` of the nearest-rank value
+        over the FULL stream (no recency window anymore)."""
         with self._lock:
-            return percentile(sorted(self._recent), q)
+            return self._sk.percentile(q)
+
+    def sketch_dict(self) -> dict:
+        """Consistent JSON form of the backing sketch (one lock hold)
+        — the telemetry-frame payload and the aggregate() wire form."""
+        with self._lock:
+            return self._sk.to_dict()
 
     def snapshot(self) -> dict:
         with self._lock:
-            if self._n == 0:
-                return {"type": "histogram", "count": 0}
-            s = sorted(self._recent)
-            return {"type": "histogram", "count": self._n,
-                    "sum": self._sum, "mean": self._sum / self._n,
-                    "min": self._min, "max": self._max,
-                    "p50": percentile(s, 50), "p90": percentile(s, 90),
-                    "p95": percentile(s, 95), "p99": percentile(s, 99)}
+            return self._sk.snapshot()
 
 
 class MetricsRegistry:
@@ -177,6 +171,16 @@ class MetricsRegistry:
             items = list(self._metrics.items())
         return {name: m.snapshot() for name, m in items}
 
+    def sketch_dicts(self) -> Dict[str, dict]:
+        """JSON sketch payload of every NON-EMPTY histogram — the
+        telemetry frame's ``sketches`` section (ISSUE 16). Empty ones
+        are omitted: a frame is an increment, not a schema census."""
+        with self._lock:
+            items = [(n, m) for n, m in self._metrics.items()
+                     if isinstance(m, Histogram)]
+        return {n: d for n, d in ((n, m.sketch_dict()) for n, m in
+                                  items) if d["n"]}
+
     @staticmethod
     def _schema_union(snap: Dict[str, dict]) -> List[Tuple[str, str]]:
         """All ranks' (name, type) pairs, unioned and sorted — the ONE
@@ -205,14 +209,15 @@ class MetricsRegistry:
                 raw.rstrip(b"\x00").decode()))
         return sorted(union)
 
-    def _gather_reservoir(self, name: str) -> List[float]:
-        """All ranks' reservoir samples for histogram ``name`` merged
-        into one list (just the local reservoir at world_size 1).
-        Rides one max-length allreduce + one NaN-padded allgather per
-        histogram; every rank issues the identical collective sequence
-        even when it lacks the metric locally (the schema-union rule —
-        an empty reservoir still participates). Width 0 (no rank has a
-        sample) skips the gather on every rank alike."""
+    def _gather_sketch(self, name: str) -> Optional[QuantileSketch]:
+        """All ranks' sketches for histogram ``name`` merged into ONE
+        (bucket-wise add — exact; just the local sketch at world_size
+        1). Each rank's JSON-encoded sketch rides a zero-padded uint8
+        allgather after a max-length allreduce (the _schema_union wire
+        idiom); every rank issues the identical collective sequence
+        even when it lacks the metric locally — an empty sketch is the
+        merge's neutral element. Width 0 (no rank has a sample) skips
+        the gather on every rank alike and returns None."""
         from ..distributed.collective import all_gather
         from ..distributed.env import get_world_size
         from ..distributed.fleet import metrics as fm
@@ -220,37 +225,39 @@ class MetricsRegistry:
 
         with self._lock:
             m = self._metrics.get(name)
-        if isinstance(m, Histogram):
-            with m._lock:
-                local = list(m._recent)
-        else:
-            local = []
+        local = m.sketch_dict() if isinstance(m, Histogram) \
+            else QuantileSketch().to_dict()
         if get_world_size() <= 1:
-            return local
-        width = int(fm.max(len(local)))
-        if width == 0:
-            return []
-        buf = np.full(width, np.nan, np.float64)
-        buf[:len(local)] = local
+            return QuantileSketch.from_dict(local) if local["n"] \
+                else None
+        payload = np.frombuffer(
+            json.dumps(local).encode(), np.uint8).copy()
+        any_n = int(fm.max(1 if local["n"] else 0))
+        width = int(fm.max(payload.size))
+        if not any_n:
+            return None
+        buf = np.zeros(width, np.uint8)
+        buf[: payload.size] = payload
         gathered: list = []
         all_gather(gathered, Tensor(buf))
-        out: List[float] = []
+        merged = QuantileSketch()
         for t in gathered:
-            vals = np.asarray(t._value, np.float64).reshape(-1)
-            out.extend(float(v) for v in vals[~np.isnan(vals)])
-        return out
+            raw = bytes(np.asarray(t._value).astype(np.uint8))
+            merged.merge(QuantileSketch.from_dict(
+                json.loads(raw.rstrip(b"\x00").decode())))
+        return merged if merged.count else None
 
     def aggregate(self) -> Dict[str, dict]:
         """Cross-rank reduction of the snapshot: counters and histogram
         count/sum are SUM-reduced, gauges and histogram min/max take the
         MAX/MIN envelope (a fleet-wide high-water mark is the max over
-        ranks), and histogram quantiles are recomputed over the MERGED
-        rank-local reservoirs (each rank contributes its most recent
-        ``_RESERVOIR`` observations — a bounded-window approximation,
-        the same caveat a single rank's snapshot quantiles already
-        carry; the point is that an aggregated p95 is computed from
-        every rank's samples instead of being silently dropped). Rides
-        distributed/fleet/metrics.py — identity at world_size 1.
+        ranks), and histogram quantiles come from the MERGED rank
+        sketches (bucket-wise add — EXACT: the mesh percentile equals
+        the one a single union sketch would report, within the sketch's
+        stated rel_err of the true stream; ISSUE 16, retiring the
+        NaN-padded bounded-reservoir gather whose error was whatever
+        the recency window held). Rides distributed/fleet/metrics.py —
+        identity at world_size 1.
 
         Every fm.* call is a collective, so ranks MUST issue the same
         sequence: the schema union above aligns rank-dependent metric
@@ -285,13 +292,12 @@ class MetricsRegistry:
                 if n:
                     s.update(count=n, sum=tot, mean=tot / n,
                              min=mn, max=mx)
-                merged = self._gather_reservoir(name)
-                if merged:
-                    ss = sorted(merged)
-                    s.update(p50=percentile(ss, 50),
-                             p90=percentile(ss, 90),
-                             p95=percentile(ss, 95),
-                             p99=percentile(ss, 99))
+                merged = self._gather_sketch(name)
+                if merged is not None:
+                    s.update(p50=merged.percentile(50),
+                             p90=merged.percentile(90),
+                             p95=merged.percentile(95),
+                             p99=merged.percentile(99))
                 else:
                     for q in ("p50", "p90", "p95", "p99"):
                         s.pop(q, None)

@@ -150,9 +150,12 @@ class MetricsSink:
                  events_file: str = "events.jsonl",
                  prom_file: str = "metrics.prom",
                  event_log: Optional[_events.EventLog] = None,
-                 rank: Optional[int] = None):
+                 rank: Optional[int] = None,
+                 frames: bool = True, frame_keep: int = 16):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
+        if frame_keep < 2:
+            raise ValueError("frame_keep must be >= 2")
         self.rank = _detect_rank() if rank is None else int(rank)
         if self.rank < 0:
             raise ValueError("rank must be >= 0")
@@ -170,6 +173,14 @@ class MetricsSink:
         self._flushes = 0
         self._flush_errors = 0     # failed/timed-out flush attempts
         self._last_error: Optional[str] = None
+        # telemetry frames (ISSUE 16): every flush additionally
+        # publishes an atomic per-rank frame the LiveAggregator tails
+        self.frames = bool(frames)
+        self._frames_dir = os.path.join(directory, "frames")
+        self._frame_keep = int(frame_keep)
+        self._frames_written = 0
+        self._frame_errors = 0     # failed publications (fire-and-
+        self._prev_counters: Dict[str, float] = {}  # forget, counted)
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -320,9 +331,76 @@ class MetricsSink:
             with open(tmp, "w") as f:
                 f.write(prometheus_text(snap, self.prefix))
             os.replace(tmp, self._prom_path)
+            # telemetry frame (ISSUE 16): fire-and-forget — a dead or
+            # slow aggregator-side filesystem must never fail the flush
+            # the metrics line above already committed
+            if self.frames:
+                try:
+                    self._publish_frame(seq, line, snap)
+                except Exception as e:
+                    self._frame_errors += 1
+                    self._last_error = \
+                        f"frame({seq}): {type(e).__name__}: {e}"
             # deltas in a later flight dump read "since the last flush"
             _events.flight_recorder().mark()
             return line
+
+    def _publish_frame(self, seq: int, line: dict,
+                       snap: Dict[str, dict]) -> None:
+        """Write ``frames/rank<K>-<seq>.json`` atomically (tmp +
+        rename, the consensus-board idiom): cumulative counters with
+        deltas-since-last-frame, last-value gauges, CUMULATIVE sketch
+        buckets (cross-rank merge stays exact; a lost frame costs
+        nothing — the next one carries the full state), this flush's
+        clock anchor, and the consensus epochs this rank adopted. Old
+        frames beyond ``frame_keep`` are pruned — the frames dir is a
+        rolling tail, not an archive (metrics.jsonl is the archive)."""
+        counters: Dict[str, dict] = {}
+        gauges: Dict[str, Optional[float]] = {}
+        new_prev: Dict[str, float] = {}
+        for name, s in snap.items():
+            if s.get("type") == "counter":
+                v = float(s["value"] or 0.0)
+                counters[name] = {
+                    "v": v,
+                    "d": round(v - self._prev_counters.get(name, 0.0),
+                               9)}
+                new_prev[name] = v
+            elif s.get("type") == "gauge":
+                gauges[name] = s["value"]
+        epochs: Dict[str, int] = {}
+        try:
+            from ..distributed.consensus import adopted_epochs
+            epochs = dict(adopted_epochs())
+        except Exception:  # pragma: no cover - consensus unavailable
+            pass
+        frame = {"kind": "telemetry_frame", "rank": self.rank,
+                 "seq": seq, "ts": line["ts"], "t_ns": line["t_ns"],
+                 "clock": line["clock"],
+                 "events_lost": line["events_lost"],
+                 "adopted_epochs": epochs, "counters": counters,
+                 "gauges": gauges,
+                 "sketches": registry().sketch_dicts()}
+        os.makedirs(self._frames_dir, exist_ok=True)
+        name = f"rank{self.rank}-{seq}.json"
+        tmp = os.path.join(self._frames_dir, f".{name}.tmp")
+        with open(tmp, "w") as f:
+            f.write(json.dumps(frame))
+        os.replace(tmp, os.path.join(self._frames_dir, name))
+        # deltas advance only once the frame LANDED — a failed write
+        # re-bases the next frame's "d" on the last published one
+        self._prev_counters.update(new_prev)
+        self._frames_written += 1
+        floor = seq - self._frame_keep
+        if floor >= 0:
+            pat = re.compile(rf"^rank{self.rank}-(\d+)\.json$")
+            for fn in os.listdir(self._frames_dir):
+                m = pat.match(fn)
+                if m and int(m.group(1)) <= floor:
+                    try:
+                        os.remove(os.path.join(self._frames_dir, fn))
+                    except OSError:  # pragma: no cover - racing reader
+                        pass
 
     @property
     def flushes(self) -> int:
@@ -334,6 +412,17 @@ class MetricsSink:
         surfaced in-process via ``profiler.summary()["sink"]``, not
         just implied by holes in the on-disk artifacts."""
         return self._flush_errors
+
+    @property
+    def frames_written(self) -> int:
+        return self._frames_written
+
+    @property
+    def frame_errors(self) -> int:
+        """Telemetry-frame publications that failed (counted, never
+        raised — a dead aggregator-side filesystem must not block the
+        serving process's flush path)."""
+        return self._frame_errors
 
     @property
     def last_error(self) -> Optional[str]:
@@ -432,9 +521,10 @@ def stats() -> dict:
     s = _active
     if s is None:
         return {"active": False, "flushes": 0, "flush_errors": 0,
-                "last_error": None}
+                "frames": 0, "frame_errors": 0, "last_error": None}
     return {"active": True, "directory": s.directory,
             "flushes": s.flushes, "flush_errors": s.flush_errors,
+            "frames": s.frames_written, "frame_errors": s.frame_errors,
             "last_error": s.last_error}
 
 

@@ -750,6 +750,13 @@ class DisaggServer:
                 if req.prefill_rank in (-1, self.mesh.rank):
                     req.ttft_ms = \
                         (er.first_token_t - er.submit_t) * 1e3
+                    # the live plane's mesh TTFT sketch (ISSUE 16):
+                    # the engine's own serving/ttft_ms is bogus-local
+                    # for imported requests, so the coordinator owns
+                    # an e2e histogram — one sample per gid, the same
+                    # values write_results() reports
+                    _registry().histogram(
+                        "serving/e2e_ttft_ms").observe(req.ttft_ms)
                 else:
                     self._stamp_e2e_ttft(req)
             req.meta["finish_w"] = self._walltime()
@@ -778,6 +785,12 @@ class DisaggServer:
                     "serving/handoff_channel_wait_ms").observe(
                     ((import_w - o_me)
                      - (float(ctx["export_w"]) - o_p)) * 1e3)
+                # same latch for the live plane's e2e TTFT sketch
+                # (ISSUE 16): only the offset-corrected value lands —
+                # a sketch cannot retract a skew-corrupted sample the
+                # way _refresh_ttfts re-derives ttft_ms
+                _registry().histogram(
+                    "serving/e2e_ttft_ms").observe(req.ttft_ms)
 
     def _refresh_ttfts(self) -> None:
         """Re-derive handed-off TTFTs from their retained trace

@@ -478,8 +478,15 @@ class ServingEngine:
             #: static k (the PR 9 behavior).
             self._spec_ctl = (
                 SpecKController(b_slots, self._spec_k,
-                                self._spec.ewma_alpha)
+                                self._spec.ewma_alpha,
+                                getattr(self._spec,
+                                        "reprobe_every", 0))
                 if self._spec.adaptive else None)
+            #: per-tick cache of tick_depth() results — the probe
+            #: state machine advances once per slot per tick even
+            #: though depth is consulted at both the draft-feed and
+            #: the ks-clamp points
+            self._spec_tick_depth: Dict[int, int] = {}
             self._draft = DraftRunner(
                 self._spec.draft_model, b_slots,
                 self.pool.slot_capacity, self._spec_k,
@@ -685,6 +692,9 @@ class ServingEngine:
                       trace_id=trace_id)
         self._requests[rid] = req
         self._queue.append(req)
+        # the prefix-hit-rate denominator (ISSUE 16 mesh rollup:
+        # prefix_hit_tokens / prompt_tokens)
+        _registry().counter("serving/prompt_tokens").add(t0)
         self._emit("submit", rid, prompt_tokens=t0,
                    max_new=int(max_new_tokens))
         return rid
@@ -1090,6 +1100,11 @@ class ServingEngine:
             tpot = (now - req.first_token_t) * 1000.0 / max(tokens - 1, 1)
             # budget-shaping telemetry (sched.py): O(1) per finish
             self._sched.note_finish(ttft, tpot)
+            # the SAME per-finish value the finish event carries, as a
+            # mergeable sketch — the live plane's mesh TPOT percentiles
+            # therefore agree with the offline merger's event-derived
+            # ones up to the sketch's stated rel_err (ISSUE 16)
+            _registry().histogram("serving/tpot_ms").observe(tpot)
         self._emit("finish", rid, tokens=tokens, reason=reason,
                    preempts=req.preempts,
                    ttft_ms=None if ttft is None else round(ttft, 3),
@@ -1619,6 +1634,7 @@ class ServingEngine:
         dr = self._draft
         reg = _registry()
         ticking_set = set(ticking)
+        self._spec_tick_depth.clear()   # fresh probe decisions per tick
 
         # ---- draft tick: feed + generate ----
         feed_toks = np.zeros((ns, w), np.int32)
@@ -1635,17 +1651,23 @@ class ServingEngine:
             req = self._requests[rid]
             if s in ticking_set:
                 last_tok[s] = req.out[-1]
-            if self._spec_ctl is not None and \
-                    self._spec_ctl.depth(s) == 0:
-                # adaptive depth decayed to 0 (ISSUE 15): the slot
-                # rides as a plain decode row — feeding/drafting a
-                # cache nobody will verify is pure draft-tick cost,
-                # so the slot drops out of the draft tick entirely
-                # (a tick with nothing to feed and nobody generating
-                # skips the draft dispatch altogether, converging the
-                # engine to plain-engine cost structure). Reset on
-                # the next admission cycle re-enables it.
-                continue
+            if self._spec_ctl is not None:
+                # one probe-state advance per slot per tick (ISSUE 16
+                # re-probe); the ks clamp below reuses the cached value
+                self._spec_tick_depth[s] = \
+                    self._spec_ctl.tick_depth(s)
+                if self._spec_tick_depth[s] == 0:
+                    # adaptive depth decayed to 0 (ISSUE 15): the slot
+                    # rides as a plain decode row — feeding/drafting a
+                    # cache nobody will verify is pure draft-tick cost,
+                    # so the slot drops out of the draft tick entirely
+                    # (a tick with nothing to feed and nobody
+                    # generating skips the draft dispatch altogether,
+                    # converging the engine to plain-engine cost
+                    # structure). Reset on the next admission cycle —
+                    # or a scheduled re-probe (SpecConfig.
+                    # reprobe_every) — re-enables it.
+                    continue
             behind = int(self._slot_len[s]) - int(dr.len[s])
             fed = 0
             if behind > 0:
@@ -1694,8 +1716,11 @@ class ServingEngine:
             if self._spec_ctl is not None:
                 # adaptive depth (ISSUE 15): the slot's accept-rate
                 # EWMA picks a depth in the compiled [0, k] range —
-                # a decayed slot rides as a plain decode row
-                ks = min(ks, self._spec_ctl.depth(s))
+                # a decayed slot rides as a plain decode row. The
+                # cached tick_depth keeps a re-probe tick at depth 1
+                # consistent between the feed loop and this clamp.
+                ks = min(ks, self._spec_tick_depth.get(
+                    s, self._spec_ctl.depth(s)))
             if ks <= 0:
                 continue
             need = self.pool.pages_for(pos0 + ks + 1) \

@@ -323,40 +323,83 @@ class SpecKController:
     depth ``floor(a_s * k + 0.5)`` clamped to the compiled ``[0, k]``
     range. New tenants start optimistic (``a_s = 1`` -> full depth —
     the draft must earn its demotion, not its promotion, because an
-    un-speculated slot generates no evidence). A slot that decays to
-    depth 0 becomes a plain decode row and stops producing
-    observations: it stays at 0 for the residency (documented —
-    re-probing is a follow-up; admission/preemption/finish reset the
-    slot via :meth:`reset`, so the stickiness is bounded by one
-    residency period).
+    un-speculated slot generates no evidence).
+
+    **Re-probing** (ISSUE 16 satellite, closing the PR 15 residue): a
+    slot that decays to depth 0 becomes a plain decode row and stops
+    producing observations — without a probe it would stay at 0 for
+    its whole residency even if its accept rate recovered (a request
+    leaving a hard-to-predict span for boilerplate). Every
+    ``reprobe_every``-th :meth:`tick_depth` call at depth 0 drafts at
+    depth 1; the probe's :meth:`observe` then either re-opens the
+    EWMA (an accepted probe at alpha 0.5 lifts ``a_s`` to ~0.5 — back
+    above the depth-1 line) or confirms the demotion (cost: one
+    drafted token per ``reprobe_every`` ticks). The probe flag LATCHES
+    until its observation lands — draft-feed catch-up can take ticks,
+    and a probe that fizzles before drafting must not count as
+    evidence. ``reprobe_every=0`` disables (the documented PR 15
+    behavior). :meth:`depth` stays pure; only ``tick_depth`` advances
+    probe state, so the engine calls it exactly once per slot per
+    tick. Admission/preemption/finish still :meth:`reset` the slot.
 
     Depth changes never touch the compiled verify tick: ``k_s`` rides
     the existing per-slot ``row_len``/``tok_limit`` metadata, exactly
     like the budget/headroom clamps the engine already applies."""
 
     def __init__(self, num_slots: int, k: int,
-                 ewma_alpha: float = 0.5):
+                 ewma_alpha: float = 0.5, reprobe_every: int = 0):
         if not 0.0 < ewma_alpha <= 1.0:
             raise ValueError("ewma_alpha must be in (0, 1]")
+        if reprobe_every < 0:
+            raise ValueError("reprobe_every must be >= 0")
         self.k = int(k)
         self.alpha = float(ewma_alpha)
+        self.reprobe_every = int(reprobe_every)
         self._ewma = np.ones(int(num_slots), np.float64)
+        self._zero_ticks = np.zeros(int(num_slots), np.int64)
+        self._probing = np.zeros(int(num_slots), bool)
 
     def reset(self, slot: int) -> None:
         self._ewma[slot] = 1.0
+        self._zero_ticks[slot] = 0
+        self._probing[slot] = False
 
     def depth(self, slot: int) -> int:
+        """Pure depth read (no probe side effects) — callers inside a
+        tick use the engine's cached :meth:`tick_depth` result."""
         return int(min(self.k,
                        int(self._ewma[slot] * self.k + 0.5)))
+
+    def tick_depth(self, slot: int) -> int:
+        """The slot's depth for THIS draft tick, advancing re-probe
+        state: counts consecutive depth-0 ticks and returns 1 (the
+        probe) every ``reprobe_every``-th one. Call once per slot per
+        scheduler tick."""
+        d = self.depth(slot)
+        if d > 0 or self.reprobe_every == 0:
+            self._zero_ticks[slot] = 0
+            return d
+        if self._probing[slot]:
+            return 1                # probe still awaiting evidence
+        self._zero_ticks[slot] += 1
+        if self._zero_ticks[slot] >= self.reprobe_every:
+            self._zero_ticks[slot] = 0
+            self._probing[slot] = True
+            return 1
+        return 0
 
     def observe(self, slot: int, accepted: int, drafted: int) -> None:
         if drafted <= 0:
             return
+        self._probing[slot] = False     # the probe's evidence landed
         rate = min(max(accepted / drafted, 0.0), 1.0)
         self._ewma[slot] += self.alpha * (rate - self._ewma[slot])
 
     def ewma(self, slot: int) -> float:
         return float(self._ewma[slot])
+
+    def probing(self, slot: int) -> bool:
+        return bool(self._probing[slot])
 
 
 # ---------------------------------------------------------------------------

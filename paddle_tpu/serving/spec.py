@@ -104,12 +104,17 @@ class SpecConfig:
     instead of always offering the full ``k`` — high-accept slots run
     full depth, low-accept slots decay toward 0 (a plain decode row),
     all inside the compiled ``[0, k]`` range the verify tick already
-    supports via ``row_len``, so neither compiled site changes."""
+    supports via ``row_len``, so neither compiled site changes.
+    ``reprobe_every`` (ISSUE 16 satellite): a slot stuck at depth 0
+    re-probes at depth 1 every this-many draft ticks, so a recovered
+    accept rate regains speculation (0 disables — the PR 15 sticky
+    behavior)."""
 
     draft_model: object
     k: int = 4
     adaptive: bool = False
     ewma_alpha: float = 0.5
+    reprobe_every: int = 64
 
 
 class DraftRunner:

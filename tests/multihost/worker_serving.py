@@ -13,6 +13,15 @@ Modes (argv: out_dir mode):
            (survivor) must: import NOTHING torn (zero handoffs
            received), finish its directly-routed requests bitwise,
            and pass the refcount-consistency audit.
+
+ISSUE 16 rides along in both modes: rank 0 runs a LiveAggregator
+over the mesh's frame stream DURING the run. In ``run`` mode it is a
+passive viewer (emit_alerts=False) whose final mesh_status the
+driver compares against the offline merger; in ``chaos`` mode it is
+the alerting instance — the survivor must flag the corpse dead
+(frame staleness corroborated by its expired consensus lease) within
+one staleness window, fire the dead_rank alert with all three side
+effects, count (never parse) a torn frame, and KEEP SERVING.
 """
 import os
 import sys
@@ -65,8 +74,11 @@ def main():
     # them with tools/merge_traces.py and asserts the stitched
     # cross-host timelines (the launcher may inject a known clock
     # skew via PADDLE_CLOCK_SKEW to prove the offset correction)
-    profiler.enable_sink(os.path.join(out_dir, "sink"),
-                         interval_s=30.0)
+    sink_root = os.path.join(out_dir, "sink")
+    # interval flushes double as the live plane's frame stream
+    # (ISSUE 16): every flush lands a telemetry frame the rank-0
+    # aggregator tails
+    profiler.enable_sink(sink_root, interval_s=0.5)
     if mode == "chaos" and rank == 1:
         # die between the payload bytes landing and the atomic rename
         HandoffChannel.pre_commit = staticmethod(
@@ -83,15 +95,22 @@ def main():
     profiler.flush_active("manual")
 
     ok = os.path.join(out_dir, f"ok.{rank}")
+    board = os.path.join(out_dir, "shared", "board")
     if mode == "run":
+        agg = None
+        if rank == 0:
+            from paddle_tpu.profiler.live import LiveAggregator
+
+            # passive viewer during the run (a viewer must not write
+            # into the mesh's event stream); the driver-side test
+            # compares its final mesh_status against the offline
+            # merger
+            agg = LiveAggregator(sink_root, interval_s=0.25,
+                                 staleness_s=30.0, world=2,
+                                 board_dir=board, lease_s=2.0,
+                                 emit_alerts=False).start()
         srv.run(timeout_s=240.0)
         if rank == 0:                 # the decode rank owns results
-            want = reference(net, prompts)
-            got = srv.results()
-            assert sorted(got) == sorted(want), (sorted(got),
-                                                 sorted(want))
-            for gid in want:
-                np.testing.assert_array_equal(got[gid], want[gid])
             assert srv.handoffs_recv > 0
             # the retired hole (ISSUE 14): every handed-off request
             # has a non-None end-to-end TTFT with an uncertainty
@@ -111,6 +130,25 @@ def main():
         srv.write_results(os.path.join(out_dir, f"results.{rank}.json"))
         profiler.disable_sink()       # os._exit skips atexit: flush NOW
         if rank == 0:
+            # the final aggregation tick must see BOTH ranks' exit
+            # frames: wait for rank 1's marker (its sink is closed by
+            # then), then fold everything into mesh_status.json
+            mp_mesh.wait_for_files([os.path.join(out_dir, "ok.1")],
+                                   timeout_s=60.0)
+            agg.stop()                # final tick publishes the doc
+            st = agg.status
+            assert st is not None and not st["partial"], st
+            assert sorted(st["ranks"]) == ["0", "1"]
+            # bitwise reference ONLY after the sink closed: the
+            # reference engine observes into the same process-wide
+            # registry, and frames carry CUMULATIVE sketches —
+            # running it earlier doubles the live latency counts
+            want = reference(net, prompts)
+            got = srv.results()
+            assert sorted(got) == sorted(want), (sorted(got),
+                                                 sorted(want))
+            for gid in want:
+                np.testing.assert_array_equal(got[gid], want[gid])
             mp_mesh.finish_last(ok, [os.path.join(out_dir, "ok.1")])
         mp_mesh.finish(ok)
 
@@ -127,6 +165,15 @@ def main():
     # bitwise; nothing torn may arrive from the corpse
     import time
 
+    from paddle_tpu.profiler.live import LiveAggregator
+
+    # the ALERTING aggregator (ISSUE 16 acceptance): death needs
+    # frame staleness AND the corpse's expired consensus lease
+    stale_s, lease_s = 1.5, 2.0
+    agg = LiveAggregator(sink_root, interval_s=0.3,
+                         staleness_s=stale_s, world=2,
+                         board_dir=board, lease_s=lease_s,
+                         emit_alerts=True).start()
     direct = [i for i, p in enumerate(prompts)
               if len(p) <= srv.engine.prefill_chunk]
     deadline = time.monotonic() + 75     # inside the jax fatal-poll
@@ -148,7 +195,45 @@ def main():
     leftovers = [n for n in os.listdir(hdir)
                  if n.endswith("-to0.npz")]
     assert leftovers == [], leftovers
+
+    # ---- ISSUE 16 acceptance: the corpse is flagged dead within one
+    # staleness window (+ the lease window the corroboration needs +
+    # tick slack), serving never blocked ----
+    import json as _json
+
+    # a torn frame from the corpse (garbage under the FINAL name):
+    # must be counted, never parsed into the merge
+    torn_dir = os.path.join(sink_root, "rank1", "frames")
+    os.makedirs(torn_dir, exist_ok=True)
+    with open(os.path.join(torn_dir, "rank1-999999.json"), "w") as f:
+        f.write('{"kind": "telemetry_frame", "ra')
+    deadline = time.monotonic() + stale_s + lease_s + 6.0
+    st = None
+    while time.monotonic() < deadline:
+        st = agg.status
+        if st and st["ranks"].get("1", {}).get("dead"):
+            break
+        srv.step()                       # serving NEVER blocks on the
+        time.sleep(0.05)                 # aggregator
+    assert st and st["ranks"]["1"]["dead"], st
+    assert st["partial"] is True
+    assert st["frames_torn"] >= 1, st
+    assert st["alerts"]["dead_rank"]["firing"], st["alerts"]
+    # all three alert side effects landed: ring event, alert-reason
+    # sink line, flight dump (reason sanitized _ -> -)
+    evs, _cur = profiler.event_log().since(0)
+    assert any(e.kind == "alert"
+               and e.attrs.get("rule") == "dead_rank"
+               for e in evs)
+    srv.step()                           # still serving after the fire
+    assert srv.check_consistency() == []
     profiler.disable_sink()              # persist the survivor's half
+    agg.stop()                           # final mesh_status on disk
+    rank0_dir = os.path.join(sink_root, "rank0")
+    assert any("alert-dead-rank" in n for n in os.listdir(rank0_dir))
+    reasons = [_json.loads(ln)["reason"] for ln in
+               open(os.path.join(rank0_dir, "metrics.jsonl"))]
+    assert "alert" in reasons, reasons
     mp_mesh.finish(ok)
 
 

@@ -257,6 +257,27 @@ def test_default_peak_flops_is_labeled():
     assert isinstance(src, str) and src
 
 
+def test_cpu_peak_flops_is_measured_not_nominal(monkeypatch):
+    """ISSUE 16 satellite (retiring the 'documented nominal
+    placeholder' residue): on the CPU backend the MFU denominator is
+    a measured matmul calibration (source ``"calibrated"``), cached
+    one-shot so every MFU within a run shares one denominator."""
+    monkeypatch.delenv("PADDLE_PEAK_FLOPS", raising=False)
+    peak, src = dt.default_peak_flops()
+    assert src == "calibrated"
+    # a real machine's f32 matmul throughput: well above the floor
+    # any BLAS clears, well below any physical single-host ceiling
+    assert 1e8 < peak < 1e15
+    peak2, src2 = dt.default_peak_flops()
+    assert (peak2, src2) == (peak, src)          # one-shot cache
+
+
+def test_peak_flops_env_override_wins(monkeypatch):
+    monkeypatch.setenv("PADDLE_PEAK_FLOPS", "123e9")
+    peak, src = dt.default_peak_flops()
+    assert peak == 123e9 and src == "env:PADDLE_PEAK_FLOPS"
+
+
 # ---------------------------------------------------------------------------
 # record_summary: gauges + sink artifact + flight attachment
 # ---------------------------------------------------------------------------

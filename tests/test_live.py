@@ -1,0 +1,449 @@
+"""ISSUE 16 tentpole: the live mesh telemetry plane — streaming
+telemetry frames out of the sink flush path, the LiveAggregator that
+tails them into a mesh_status artifact, the declarative alert rules,
+and the schema checker's new frame/mesh_status validators (negative-
+tested, per the satellite).
+
+Everything here is pure host I/O over tmp_path (no jit, no
+collectives) — milliseconds inside the tier-1 cap. The REAL
+2-process run (kill-one chaos, live-vs-offline-merger agreement)
+lives in tests/multihost/test_serving_mesh.py.
+"""
+import json
+import os
+import time
+
+import pytest
+
+import paddle_tpu.profiler as profiler
+from paddle_tpu.profiler import events as pevents
+from paddle_tpu.profiler import sink as psink
+from paddle_tpu.profiler.live import (AlertRule, LiveAggregator,
+                                      default_rules)
+from paddle_tpu.profiler.sketch import QuantileSketch
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_state():
+    psink.disable_sink()
+    profiler.reset()
+    pevents.set_enabled(True)
+    yield
+    psink.disable_sink()
+    profiler.reset()
+
+
+def _sketch_of(vals):
+    sk = QuantileSketch()
+    for v in vals:
+        sk.observe(v)
+    return sk.to_dict()
+
+
+def _write_frame(root, rank, seq, *, sketches=None, counters=None,
+                 gauges=None, ts=None, synced=True, offset_s=0.0,
+                 unc_s=0.001, events_lost=0, torn=False):
+    """Hand-author one frame the way the sink lands it (atomic final
+    name). ``torn=True`` writes garbage under the final name — the
+    one damage mode the aggregator must COUNT, never guess at."""
+    d = os.path.join(root, f"rank{rank}", "frames")
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"rank{rank}-{seq}.json")
+    if torn:
+        with open(path, "w") as f:
+            f.write('{"kind": "telemetry_frame", "rank":')
+        return path
+    now = time.time() if ts is None else ts
+    frame = {"kind": "telemetry_frame", "rank": rank, "seq": seq,
+             "ts": now, "t_ns": int(now * 1e9),
+             "clock": {"wall_s": now, "offset_s": offset_s,
+                       "unc_s": unc_s, "ref": 0, "synced": synced,
+                       "anchor_unc_s": 0.001},
+             "events_lost": events_lost, "adopted_epochs": {},
+             "counters": {n: {"v": v, "d": v}
+                          for n, v in (counters or {}).items()},
+             "gauges": dict(gauges or {}),
+             "sketches": dict(sketches or {})}
+    with open(path, "w") as f:
+        json.dump(frame, f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# sink-side: frame publication
+# ---------------------------------------------------------------------------
+
+
+def test_sink_flush_publishes_frames_with_counter_deltas(tmp_path):
+    d = str(tmp_path)
+    psink.enable_sink(d, interval_s=3600.0, per_rank_subdir=False)
+    reg = profiler.registry()
+    reg.counter("x/c").add(5)
+    reg.histogram("x/h").observe(10.0)
+    psink.flush_active("manual")
+    reg.counter("x/c").add(3)
+    psink.flush_active("manual")
+    psink.disable_sink()
+
+    frames = sorted(os.listdir(tmp_path / "frames"))
+    assert len(frames) >= 2
+    docs = [json.load(open(tmp_path / "frames" / n)) for n in frames
+            if not n.endswith(".tmp")]
+    assert all(f["kind"] == "telemetry_frame" for f in docs)
+    first, second = docs[0], docs[1]
+    assert first["counters"]["x/c"] == {"v": 5.0, "d": 5.0}
+    # delta is since the LAST PUBLISHED frame, cumulative v rides along
+    assert second["counters"]["x/c"] == {"v": 8.0, "d": 3.0}
+    # sketches are cumulative (exact cross-rank merge; windows via
+    # subtract), and roundtrip through from_dict
+    sk = QuantileSketch.from_dict(second["sketches"]["x/h"])
+    assert sk.count == 1 and sk.min == 10.0
+
+
+def test_sink_prunes_old_frames(tmp_path):
+    d = str(tmp_path)
+    psink.enable_sink(d, interval_s=3600.0, per_rank_subdir=False,
+                      frame_keep=2)
+    for i in range(6):
+        profiler.registry().counter("x/c").add(1)
+        psink.flush_active("manual")
+    psink.disable_sink()
+    kept = [n for n in os.listdir(tmp_path / "frames")
+            if not n.endswith(".tmp")]
+    assert 0 < len(kept) <= 3   # frame_keep window (+ the exit flush)
+
+
+# ---------------------------------------------------------------------------
+# aggregator: merge, rollups, honesty
+# ---------------------------------------------------------------------------
+
+
+def test_aggregator_merges_sketches_across_ranks(tmp_path):
+    root = str(tmp_path)
+    a_vals = [100.0 + i for i in range(40)]
+    b_vals = [500.0 + i for i in range(40)]
+    _write_frame(root, 0, 0,
+                 sketches={"serving/e2e_ttft_ms": _sketch_of(a_vals)},
+                 counters={"serving/tokens_generated": 100.0,
+                           "serving/prompt_tokens": 50.0,
+                           "serving/prefix_hit_tokens": 10.0},
+                 unc_s=0.002)
+    _write_frame(root, 1, 0,
+                 sketches={"serving/e2e_ttft_ms": _sketch_of(b_vals)},
+                 counters={"serving/tokens_generated": 60.0},
+                 gauges={"serving/page_util": 0.7},
+                 unc_s=0.005)
+    agg = LiveAggregator(root, interval_s=0.01, staleness_s=1e9,
+                         world=2, emit_alerts=False)
+    st = agg.tick()
+    lat = st["latency"]["ttft_ms"]
+    union = sorted(a_vals + b_vals)
+    exact_p95 = union[min(int(0.95 * len(union)), len(union) - 1)]
+    assert lat["count"] == 80
+    assert abs(lat["p95"] - exact_p95) <= lat["rel_err"] * exact_p95
+    assert lat["ranks"] == [0, 1]
+    # clock-uncertainty bound: worst synced pair = 2x the largest
+    assert lat["unc_ms"] == pytest.approx(2 * 0.005 * 1e3)
+    assert st["partial"] is False
+    # rate rollups need a window — None on the first tick, honest
+    assert st["rollups"]["tokens_per_sec"] is None
+    assert st["rollups"]["prefix_hit_rate"] == pytest.approx(0.2)
+    assert st["rollups"]["page_pressure"] == 0.7
+    # second tick with more tokens -> a real rate
+    time.sleep(0.02)
+    _write_frame(root, 0, 1,
+                 sketches={"serving/e2e_ttft_ms": _sketch_of(a_vals)},
+                 counters={"serving/tokens_generated": 200.0,
+                           "serving/prompt_tokens": 50.0,
+                           "serving/prefix_hit_tokens": 10.0})
+    st = agg.tick()
+    assert st["rollups"]["tokens_per_sec"] > 0
+
+
+def test_e2e_ttft_outranks_engine_local(tmp_path):
+    # the disaggregated mesh's rule: if ANY rank publishes the
+    # e2e-stamped TTFT, engine-local ttft_ms (bogus for imported
+    # requests) must NOT pollute the mesh percentile
+    root = str(tmp_path)
+    _write_frame(root, 0, 0,
+                 sketches={"serving/ttft_ms": _sketch_of([1.0, 2.0])})
+    _write_frame(root, 1, 0,
+                 sketches={"serving/e2e_ttft_ms":
+                           _sketch_of([800.0, 900.0])})
+    st = LiveAggregator(root, interval_s=0.01, staleness_s=1e9,
+                        emit_alerts=False).tick()
+    lat = st["latency"]["ttft_ms"]
+    assert lat["count"] == 2 and lat["ranks"] == [1]
+    assert lat["p50"] >= 700.0
+
+
+def test_unsynced_rank_makes_ttft_uncertainty_unstatable(tmp_path):
+    root = str(tmp_path)
+    _write_frame(root, 0, 0,
+                 sketches={"serving/e2e_ttft_ms": _sketch_of([5.0])},
+                 synced=False, offset_s=None, unc_s=None)
+    st = LiveAggregator(root, interval_s=0.01, staleness_s=1e9,
+                        emit_alerts=False).tick()
+    assert st["latency"]["ttft_ms"]["unc_ms"] is None
+    assert st["ranks"]["0"]["synced"] is False
+
+
+def test_torn_frame_counted_never_guessed(tmp_path):
+    root = str(tmp_path)
+    _write_frame(root, 0, 0,
+                 sketches={"serving/tpot_ms": _sketch_of([4.0])})
+    _write_frame(root, 0, 1, torn=True)
+    agg = LiveAggregator(root, interval_s=0.01, staleness_s=1e9,
+                         emit_alerts=False)
+    st = agg.tick()
+    assert st["frames_torn"] == 1
+    assert st["partial"] is True
+    assert st["ranks"]["0"]["frames"] == 1     # last good frame kept
+    # the cursor ADVANCED past the torn seq (atomic rename = a bad
+    # landing is final): a later good frame still gets ingested
+    _write_frame(root, 0, 2,
+                 sketches={"serving/tpot_ms": _sketch_of([4.0, 6.0])})
+    st = agg.tick()
+    assert st["ranks"]["0"]["frames"] == 2
+    assert st["frames_torn"] == 1              # counted once, not per tick
+    assert st["latency"]["tpot_ms"]["count"] == 2
+
+
+def test_staleness_and_lease_corroboration(tmp_path):
+    root = str(tmp_path)
+    board = tmp_path / "board"
+    board.mkdir()
+    old = time.time() - 10.0
+    _write_frame(root, 0, 0, ts=old,
+                 sketches={"serving/tpot_ms": _sketch_of([1.0])})
+    # no board: frame staleness alone decides (documented weaker
+    # evidence)
+    st = LiveAggregator(root, interval_s=0.01, staleness_s=0.5,
+                        emit_alerts=False).tick()
+    assert st["ranks"]["0"]["stale"] and st["ranks"]["0"]["dead"]
+    assert st["partial"] is True
+    # a FRESH lease vetoes death: the rank is alive but quiet
+    lease = board / "lease.0"
+    lease.write_text("")
+    st = LiveAggregator(root, interval_s=0.01, staleness_s=0.5,
+                        board_dir=str(board), lease_s=5.0,
+                        emit_alerts=False).tick()
+    blk = st["ranks"]["0"]
+    assert blk["stale"] and not blk["dead"]
+    # an EXPIRED lease corroborates: dead
+    os.utime(lease, (old, old))
+    st = LiveAggregator(root, interval_s=0.01, staleness_s=0.5,
+                        board_dir=str(board), lease_s=5.0,
+                        emit_alerts=False).tick()
+    assert st["ranks"]["0"]["dead"]
+
+
+def test_aggregator_missing_rank_marks_partial(tmp_path):
+    root = str(tmp_path)
+    _write_frame(root, 0, 0,
+                 sketches={"serving/tpot_ms": _sketch_of([1.0])})
+    st = LiveAggregator(root, interval_s=0.01, staleness_s=1e9,
+                        world=2, emit_alerts=False).tick()
+    assert st["partial"] is True               # rank 1 never reported
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+
+
+def test_alert_rule_for_ticks_hysteresis_and_clear(tmp_path):
+    vals = iter([5.0, 5.0, 5.0,     # 3 breaches -> fires on the 3rd
+                 4.8,               # above hysteresis line: stays firing
+                 None,              # not evaluable: streaks HOLD
+                 4.0, 4.0])         # 2 clears -> resolves on the 2nd
+    rule = AlertRule("r", lambda st: next(vals), threshold=5.0,
+                     for_ticks=3, hysteresis=0.9, clear_ticks=2)
+    out = [rule.evaluate({}) for _ in range(7)]
+    assert out == [None, None, "firing", None, None, None, "resolved"]
+    assert rule.fired_count == 1 and not rule.firing
+
+
+def test_alert_rule_streak_resets_below_threshold():
+    vals = iter([5.0, 5.0, 1.0, 5.0, 5.0, 5.0])
+    rule = AlertRule("r", lambda st: next(vals), threshold=5.0,
+                     for_ticks=3)
+    out = [rule.evaluate({}) for _ in range(6)]
+    assert out == [None, None, None, None, None, "firing"]
+
+
+def test_default_rules_cover_issue_set():
+    names = {r.name for r in default_rules()}
+    assert names == {"p95_ttft_over_target", "dead_rank",
+                     "decode_stall", "pool_pressure", "events_lost"}
+
+
+def test_dead_rank_alert_side_effects(tmp_path):
+    """The ISSUE's acceptance triple on a single host: the dead-rank
+    alert lands as (1) an ``alert`` ring event, (2) an alert-reason
+    sink flush line, (3) a flight-recorder dump — and the aggregator
+    keeps ticking (serving is never blocked)."""
+    d = str(tmp_path)
+    psink.enable_sink(d, interval_s=3600.0, per_rank_subdir=False)
+    profiler.registry().counter("x/c").add(1)
+    psink.flush_active("manual")
+    agg = LiveAggregator(d, interval_s=0.01, staleness_s=0.05,
+                         emit_alerts=True)
+    agg.tick()
+    time.sleep(0.08)                    # frame goes stale -> dead
+    st = agg.tick()
+    assert st["ranks"]["0"]["dead"]
+    assert st["alerts"]["dead_rank"]["firing"]
+    tr = [t for t in st["alert_transitions"]
+          if t["rule"] == "dead_rank"]
+    assert tr and tr[0]["state"] == "firing"
+    # (1) ring event
+    evs, _ = pevents.log().since(0)
+    alerts = [e for e in evs if e.kind == "alert"]
+    assert any(e.attrs.get("rule") == "dead_rank" for e in alerts)
+    # (3) flight dump (reason sanitized: underscores -> dashes)
+    psink.disable_sink()
+    assert any("alert-dead-rank" in n for n in os.listdir(tmp_path))
+    # (2) alert-reason flush line
+    reasons = [json.loads(ln)["reason"]
+               for ln in open(tmp_path / "metrics.jsonl")]
+    assert "alert" in reasons
+    # aggregator still ticks after the sink is gone
+    st = agg.tick()
+    assert st["tick"] >= 3
+
+
+def test_viewer_mode_emits_nothing(tmp_path):
+    # a passive dashboard (emit_alerts=False) must not write into the
+    # run's event stream even when rules transition
+    d = str(tmp_path)
+    _write_frame(d, 0, 0, ts=time.time() - 10.0,
+                 sketches={"serving/tpot_ms": _sketch_of([1.0])})
+    total0 = pevents.log().total
+    st = LiveAggregator(d, interval_s=0.01, staleness_s=0.1,
+                        emit_alerts=False).tick()
+    assert st["alerts"]["dead_rank"]["firing"]
+    assert pevents.log().total == total0
+
+
+# ---------------------------------------------------------------------------
+# schema checker: frame + mesh_status validators (negative-tested)
+# ---------------------------------------------------------------------------
+
+
+def _load_checker():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tools",
+                        "check_sink_schema.py")
+    spec = importlib.util.spec_from_file_location("check_sink_schema",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    schema = json.load(open(os.path.join(
+        os.path.dirname(path), "sink_schema.json")))
+    return mod, schema
+
+
+def test_checker_accepts_real_live_run(tmp_path):
+    d = str(tmp_path)
+    psink.enable_sink(d, interval_s=3600.0, per_rank_subdir=False)
+    profiler.registry().histogram("serving/e2e_ttft_ms").observe(9.0)
+    psink.flush_active("manual")
+    LiveAggregator(d, interval_s=0.01, staleness_s=1e9,
+                   emit_alerts=False).tick()
+    psink.disable_sink()
+    mod, schema = _load_checker()
+    mod._ERRORS.clear()
+    mod.check_live_status_dir(d, schema)
+    assert mod._ERRORS == [], mod._ERRORS
+
+
+def test_checker_flags_unbalanced_sketch_ledger(tmp_path):
+    mod, schema = _load_checker()
+    sk = _sketch_of([1.0, 2.0, 3.0])
+    sk["n"] = 99
+    p = _write_frame(str(tmp_path), 0, 0, sketches={"s/h": sk})
+    mod._ERRORS.clear()
+    mod.check_frames_dir(os.path.dirname(p), schema)
+    assert any("99" in e and "bucket counts" in e
+               for e in mod._ERRORS)
+
+
+def test_checker_flags_frame_name_body_mismatch(tmp_path):
+    mod, schema = _load_checker()
+    p = _write_frame(str(tmp_path), 0, 0)
+    os.rename(p, os.path.join(os.path.dirname(p), "rank0-7.json"))
+    mod._ERRORS.clear()
+    mod.check_frames_dir(os.path.dirname(p), schema)
+    assert any("body seq 0 != filename seq 7" in e
+               for e in mod._ERRORS)
+
+
+def _valid_mesh_status():
+    return {
+        "kind": "mesh_status", "ts": 1.0, "root": "/x", "tick": 1,
+        "interval_s": 1.0, "staleness_s": 3.0, "world": 1,
+        "ranks": {"0": {"seq": 0, "frames": 1, "torn": 0,
+                        "age_s": 0.1, "synced": True,
+                        "offset_s": 0.0, "unc_s": 0.001,
+                        "stale": False, "dead": False,
+                        "lease_age_s": None, "events_lost": 0,
+                        "gauges": {}, "adopted_epochs": {}}},
+        "partial": False, "frames_torn": 0, "events_lost": 0,
+        "latency": {"ttft_ms": {"count": 2, "min": 1.0, "max": 9.0,
+                                "p50": 2.0, "p90": 8.0, "p95": 8.5,
+                                "p99": 9.0, "unc_ms": 0.1,
+                                "rel_err": 0.01, "ranks": [0]}},
+        "rollups": {"tokens_per_sec": 1.0, "prefix_hit_rate": 0.5,
+                    "page_pressure": 0.5, "goodput_busy_frac": 0.9},
+        "alerts": {"dead_rank": {"firing": False, "value": 0.0,
+                                 "threshold": 1.0, "fired_count": 0}},
+    }
+
+
+def _mesh_errs(doc):
+    mod, schema = _load_checker()
+    mod._ERRORS.clear()
+    mod.check_mesh_status(doc, schema, "ms")
+    return list(mod._ERRORS)
+
+
+def test_checker_accepts_valid_mesh_status():
+    assert _mesh_errs(_valid_mesh_status()) == []
+
+
+def test_checker_flags_disordered_percentiles():
+    doc = _valid_mesh_status()
+    doc["latency"]["ttft_ms"]["p50"] = 100.0   # > p90
+    assert any("percentiles out of order" in e
+               for e in _mesh_errs(doc))
+
+
+def test_checker_flags_dead_without_staleness_evidence():
+    doc = _valid_mesh_status()
+    doc["ranks"]["0"].update(dead=True, stale=False, age_s=0.1)
+    doc["partial"] = True
+    errs = _mesh_errs(doc)
+    assert any("dead without stale" in e for e in errs)
+    assert any("age_s=0.1" in e for e in errs)
+
+
+def test_checker_flags_partial_lie():
+    doc = _valid_mesh_status()
+    doc["ranks"]["0"].update(dead=True, stale=True, age_s=99.0)
+    # partial stays False: the artifact lies about completeness
+    assert any("lying about" in e for e in _mesh_errs(doc))
+
+
+def test_checker_flags_alert_event_missing_rule(tmp_path):
+    mod, schema = _load_checker()
+    p = str(tmp_path / "events.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"seq": 0, "t_ns": 1, "kind": "alert",
+                            "rank": 0, "state": "panicking"}) + "\n")
+    mod._ERRORS.clear()
+    mod.check_events_jsonl(p, schema)
+    errs = list(mod._ERRORS)
+    assert any("alert event missing 'rule'" in e for e in errs)
+    assert any("not firing/resolved" in e for e in errs)

@@ -131,41 +131,63 @@ class TestMetricsRegistry:
         reg.histogram("a/h").observe(1.0)
         assert reg.aggregate() == reg.snapshot()
 
-    def test_aggregate_merges_rank_local_reservoirs(self, monkeypatch):
-        """ISSUE 9 satellite (closes the 'rank-local quantiles'
-        residue): aggregated histogram snapshots carry p50/p90/p95/p99
-        computed over the MERGED rank reservoirs, not dropped. The
-        collectives are faked to simulate a 2-rank fleet: rank 1
-        reports the same schema, double counts, and a disjoint
-        reservoir — the quantiles must move to the union's."""
+    def test_aggregate_merges_rank_local_sketches(self, monkeypatch):
+        """ISSUE 16 tentpole: aggregated histogram quantiles come from
+        the bucket-wise MERGE of every rank's quantile sketch (exact —
+        the mesh percentile equals a single union sketch's, within the
+        sketch's rel_err), retiring the NaN-padded reservoir gather.
+        The collectives are faked to simulate a 2-rank fleet: rank 1
+        rides the same JSON-sketch wire with a disjoint value set —
+        the quantiles must move to the union's."""
+        import json
+
         import numpy as np
 
         from paddle_tpu.distributed import collective as coll
         from paddle_tpu.distributed import env as denv
         from paddle_tpu.distributed.fleet import metrics as fm
         from paddle_tpu.framework.tensor import Tensor
+        from paddle_tpu.profiler.sketch import QuantileSketch
 
         reg = profiler.registry()
+        h = reg.histogram("m/h")
         for v in (1.0, 2.0, 3.0, 4.0):
-            reg.histogram("m/h").observe(v)
-        peer = [5.0, 6.0, 7.0, 8.0]
+            h.observe(v)
+        peer_sk = QuantileSketch()
+        for v in (5.0, 6.0, 7.0, 8.0):
+            peer_sk.observe(v)
+        peer_payload = np.frombuffer(
+            json.dumps(peer_sk.to_dict()).encode(), np.uint8).copy()
+        wire_sizes = {
+            len(json.dumps(h.sketch_dict()).encode()),
+            peer_payload.size,
+        }
 
         monkeypatch.setattr(denv, "get_world_size", lambda: 2)
         monkeypatch.setattr(fm, "get_world_size", lambda: 2)
         monkeypatch.setattr(fm, "sum", lambda x, **kw: 2.0 * float(
             np.asarray(x, np.float64)))
-        monkeypatch.setattr(fm, "max", lambda x, **kw: float(
-            np.asarray(x, np.float64)))
+
+        def fake_max(x, **kw):
+            # the sketch-wire width allreduce must see BOTH ranks'
+            # payload sizes; every other max is identity (same-schema
+            # ranks, peer envelope not exercised here)
+            v = float(np.asarray(x, np.float64))
+            if v in wire_sizes:
+                return float(max(wire_sizes))
+            return v
+
+        monkeypatch.setattr(fm, "max", fake_max)
         monkeypatch.setattr(fm, "min", lambda x, **kw: float(
             np.asarray(x, np.float64)))
 
         def fake_all_gather(out, tensor, group=None, **kw):
             local = np.asarray(tensor._value)
             out.append(Tensor(local))
-            if np.issubdtype(local.dtype, np.floating):  # reservoir
-                buf = np.full(local.shape, np.nan, np.float64)
-                n = min(len(peer), buf.shape[0])
-                buf[:n] = peer[:n]
+            raw = bytes(local.astype(np.uint8)).rstrip(b"\x00")
+            if isinstance(json.loads(raw.decode()), dict):  # sketch
+                buf = np.zeros(local.shape, np.uint8)
+                buf[: peer_payload.size] = peer_payload
                 out.append(Tensor(buf))
             else:                               # schema-union gather
                 out.append(Tensor(local))
@@ -173,10 +195,13 @@ class TestMetricsRegistry:
         monkeypatch.setattr(coll, "all_gather", fake_all_gather)
         agg = reg.aggregate()["m/h"]
         assert agg["count"] == 8                # sum-reduced
-        # nearest-rank percentiles over the UNION [1..8]
-        assert agg["p50"] == 5.0
-        assert agg["p99"] == 8.0
-        assert agg["p90"] == 8.0
+        # nearest-rank percentiles over the UNION [1..8], within the
+        # sketch's stated relative-error bound
+        rel = QuantileSketch().rel_err
+        assert abs(agg["p50"] - 5.0) <= rel * 5.0 + 1e-9
+        assert abs(agg["p90"] - 8.0) <= rel * 8.0 + 1e-9
+        assert abs(agg["p99"] - 8.0) <= rel * 8.0 + 1e-9
+        assert agg["p50"] <= agg["p90"] <= agg["p99"]
 
     def test_schema_union_is_sorted_name_type_pairs(self):
         # the deterministic reduction order every rank walks in

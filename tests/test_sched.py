@@ -439,6 +439,109 @@ class TestAdaptiveSpecK:
 
 
 # ---------------------------------------------------------------------------
+# sticky depth-0 re-probe (ISSUE 16 satellite, closing the PR 15 residue)
+# ---------------------------------------------------------------------------
+class TestSpecKReprobe:
+    def _decayed(self, reprobe):
+        from paddle_tpu.serving.sched import SpecKController
+
+        c = SpecKController(num_slots=2, k=4, reprobe_every=reprobe)
+        for _ in range(8):
+            c.observe(0, 0, 4)           # ~0% acceptance
+        assert c.depth(0) == 0
+        return c
+
+    def test_probe_fires_every_nth_zero_tick_and_latches(self):
+        c = self._decayed(4)
+        assert [c.tick_depth(0) for _ in range(4)] == [0, 0, 0, 1]
+        # the probe LATCHES at depth 1 until its observation lands —
+        # draft-feed catch-up can take ticks, and a fizzled probe must
+        # not count as evidence
+        assert c.probing(0)
+        assert c.tick_depth(0) == 1
+        c.observe(0, 0, 1)               # rejected: demotion confirmed
+        assert not c.probing(0)
+        assert c.depth(0) == 0
+        # the cycle restarts: cost is one drafted token per
+        # reprobe_every zero-ticks
+        assert [c.tick_depth(0) for _ in range(4)] == [0, 0, 0, 1]
+
+    def test_accepted_probe_reopens_the_depth(self):
+        c = self._decayed(2)
+        assert [c.tick_depth(0) for _ in range(2)] == [0, 1]
+        c.observe(0, 1, 1)               # accepted: EWMA back to ~0.5
+        assert c.depth(0) >= 1           # speculating again
+        assert c.tick_depth(0) == c.depth(0)
+
+    def test_reprobe_zero_disables(self):
+        # the documented PR 15 behavior is reprobe_every=0: a decayed
+        # slot never drafts again for its residency
+        c = self._decayed(0)
+        assert all(c.tick_depth(0) == 0 for _ in range(50))
+
+    def test_depth_stays_pure(self):
+        c = self._decayed(3)
+        for _ in range(50):
+            assert c.depth(0) == 0       # no probe side effects
+        assert c.tick_depth(0) == 0      # counter untouched by depth()
+
+    def test_reset_clears_probe_state(self):
+        c = self._decayed(2)
+        c.tick_depth(0)
+        c.tick_depth(0)
+        assert c.probing(0)
+        c.reset(0)                       # new tenant: optimistic again
+        assert not c.probing(0) and c.depth(0) == 4
+
+    def test_slots_probe_independently(self):
+        c = self._decayed(2)             # slot 0 decayed, slot 1 fresh
+        assert c.tick_depth(1) == 4
+        assert [c.tick_depth(0) for _ in range(2)] == [0, 1]
+        assert c.tick_depth(1) == 4      # untouched by slot 0's probe
+
+    def test_engine_reprobe_resumes_drafting_bitwise(self):
+        """End-to-end: after a slot decays to 0 under an independent
+        draft, a small ``reprobe_every`` makes the engine draft again
+        (the probe), and the greedy stream STAYS bitwise the dense
+        reference — the acceptance invariant is probe-independent."""
+        from paddle_tpu.profiler import registry
+
+        net = _net()
+        eng = ServingEngine(net, ServingConfig(
+            num_slots=2, page_size=8, pages_per_slot=3,
+            prefill_chunk=8,
+            spec=SpecConfig(draft_model=_ind_draft(), k=4,
+                            adaptive=True, reprobe_every=2)))
+        prompts = _prompts((8, 8))
+        rids = [eng.submit(p, 16) for p in prompts]
+        for _ in range(64):
+            if eng.idle():
+                break
+            eng.step()
+            live = [s for s, r in enumerate(eng._slot_rid)
+                    if r is not None]
+            if live and all(eng._spec_ctl.depth(s) == 0
+                            for s in live):
+                break
+        live = [s for s, r in enumerate(eng._slot_rid)
+                if r is not None]
+        assert live and all(eng._spec_ctl.depth(s) == 0 for s in live)
+        t0 = registry().counter("serving/spec_drafted_tokens").value
+        for _ in range(6):
+            if eng.idle():
+                break
+            eng.step()
+        # unlike reprobe_every=0 (see the decay test above), the
+        # probe drafts again within the window
+        assert registry().counter(
+            "serving/spec_drafted_tokens").value > t0
+        out = eng.run()
+        for p, rid in zip(prompts, rids):
+            np.testing.assert_array_equal(out[rid],
+                                          _dense(net, p, 16))
+
+
+# ---------------------------------------------------------------------------
 # load-shaped routing key (pure)
 # ---------------------------------------------------------------------------
 class TestTtfcKey:

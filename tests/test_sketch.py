@@ -1,0 +1,155 @@
+"""ISSUE 16 tentpole: the mergeable relative-error quantile sketch
+(profiler/sketch.py) behind every serving-latency histogram and the
+live mesh aggregation. The guarantees under test are the ones the
+telemetry plane's honesty rests on: percentiles within the DOCUMENTED
+rel_err of the nearest-rank value over the full stream, bucket-wise
+merge EXACTLY equal to a single union sketch, a JSON wire format that
+roundtrips to identity, windowed subtract with exact counts, bounded
+size under collapse with the upper quantiles still in bound, and a
+from_dict that raises on malformed documents instead of guessing
+(torn frames are counted, never merged).
+
+Pure host code — no jit, milliseconds inside the tier-1 cap.
+"""
+import math
+import random
+
+import pytest
+
+from paddle_tpu.profiler.sketch import QuantileSketch
+
+
+def _nearest_rank(sorted_vals, q):
+    return sorted_vals[min(int(q / 100.0 * len(sorted_vals)),
+                           len(sorted_vals) - 1)]
+
+
+def _assert_in_bound(sk, sorted_vals, quantiles=(50, 90, 95, 99)):
+    for q in quantiles:
+        exact = _nearest_rank(sorted_vals, q)
+        got = sk.percentile(q)
+        assert abs(got - exact) <= sk.rel_err * abs(exact) + 1e-12, \
+            f"p{q}: {got} vs exact {exact} (rel_err {sk.rel_err})"
+
+
+def test_empty_sketch():
+    sk = QuantileSketch()
+    assert sk.count == 0
+    assert sk.percentile(50) is None
+    assert sk.snapshot() == {"type": "histogram", "count": 0}
+
+
+def test_percentile_accuracy_lognormal():
+    # heavy-tailed latency-shaped stream: every quoted percentile must
+    # sit within the documented relative error of the nearest-rank
+    # value — this is the bound README quotes for serving SLOs
+    rng = random.Random(7)
+    vals = [math.exp(rng.gauss(3.0, 1.0)) for _ in range(2000)]
+    sk = QuantileSketch()
+    for v in vals:
+        sk.observe(v)
+    vals.sort()
+    assert sk.count == 2000
+    assert sk.min == vals[0] and sk.max == vals[-1]   # exact extremes
+    _assert_in_bound(sk, vals)
+
+
+def test_merge_equals_union_sketch():
+    # the property the whole live plane rests on: merging per-rank
+    # sketches is EXACT — bit-identical to one sketch that saw the
+    # union stream (so mesh percentiles never degrade with fan-in)
+    rng = random.Random(11)
+    a_vals = [rng.uniform(0.5, 50.0) for _ in range(300)]
+    b_vals = [rng.uniform(20.0, 900.0) for _ in range(500)]
+    a, b, union = QuantileSketch(), QuantileSketch(), QuantileSketch()
+    for v in a_vals:
+        a.observe(v)
+        union.observe(v)
+    for v in b_vals:
+        b.observe(v)
+        union.observe(v)
+    merged = a.copy().merge(b)
+    dm, du = merged.to_dict(), union.to_dict()
+    # sum differs only by float accumulation order; buckets, counts
+    # and extremes are bit-identical
+    assert math.isclose(dm.pop("sum"), du.pop("sum"), rel_tol=1e-12)
+    assert dm == du
+    for q in (50, 90, 95, 99):
+        assert merged.percentile(q) == union.percentile(q)
+    # and merge() must not have mutated its argument
+    assert b.count == 500
+
+
+def test_merge_rejects_mismatched_rel_err():
+    with pytest.raises(ValueError):
+        QuantileSketch(rel_err=0.01).merge(QuantileSketch(rel_err=0.05))
+
+
+def test_json_roundtrip_identity():
+    import json
+
+    sk = QuantileSketch()
+    for v in (-3.0, -0.5, 0.0, 0.0, 1.0, 2.5, 700.0):
+        sk.observe(v)
+    wire = json.loads(json.dumps(sk.to_dict()))   # through real JSON
+    back = QuantileSketch.from_dict(wire)
+    assert back.to_dict() == sk.to_dict()
+    assert back.percentile(50) == sk.percentile(50)
+
+
+def test_subtract_window_counts_exact():
+    # cumulative snapshots -> windowed delta: counts are exact, the
+    # window percentile stays within bound of the window's own values
+    older = QuantileSketch()
+    for v in (10.0, 20.0, 30.0):
+        older.observe(v)
+    newer = older.copy()
+    window_vals = [100.0, 200.0, 300.0, 400.0]
+    for v in window_vals:
+        newer.observe(v)
+    win = newer.subtract(older)
+    assert win.count == len(window_vals)
+    _assert_in_bound(win, sorted(window_vals), quantiles=(50, 95))
+
+
+def test_collapse_bounds_size_and_keeps_upper_quantiles():
+    # a stream spanning many decades with a tiny bucket budget: the
+    # sketch folds its LOWEST buckets, so p90/p95/p99 keep the bound
+    rng = random.Random(3)
+    vals = [math.exp(rng.uniform(math.log(1e-3), math.log(1e6)))
+            for _ in range(4000)]
+    sk = QuantileSketch(max_buckets=300)
+    for v in vals:
+        sk.observe(v)
+    assert len(sk.to_dict()["pos"]) <= 300
+    assert sk.collapsed > 0
+    vals.sort()
+    _assert_in_bound(sk, vals, quantiles=(90, 95, 99))
+
+
+def test_negative_and_zero_values():
+    sk = QuantileSketch()
+    vals = [-40.0, -30.0, -20.0, -10.0, 0.0, 10.0, 20.0, 30.0]
+    for v in vals:
+        sk.observe(v)
+    assert sk.count == len(vals)
+    assert sk.min == -40.0 and sk.max == 30.0
+    _assert_in_bound(sk, sorted(vals), quantiles=(50, 95))
+    # clamp: no estimate ever escapes [min, max]
+    assert sk.percentile(0) >= -40.0
+    assert sk.percentile(100) <= 30.0
+
+
+@pytest.mark.parametrize("mutation", [
+    {"n": 99},                              # ledger doesn't balance
+    {"pos": {"3": -2}},                     # negative bucket count
+    {"min": None, "max": None},             # non-empty without bounds
+])
+def test_from_dict_rejects_malformed(mutation):
+    sk = QuantileSketch()
+    for v in (1.0, 2.0, 3.0):
+        sk.observe(v)
+    d = sk.to_dict()
+    d.update(mutation)
+    with pytest.raises(ValueError):
+        QuantileSketch.from_dict(d)

@@ -26,8 +26,14 @@ present, null only when honestly unsynced), the
 route/consensus_decision/clock_sync event kinds, and — via
 ``--merged-json`` — the tools/merge_traces.py artifact (per-rank
 offset + uncertainty fields required, per-request TTFT bounds
-ordered lo <= ttft <= hi). stdlib only (the CI image installs jax +
-numpy + pytest, nothing else).
+ordered lo <= ttft <= hi), and (ISSUE 16) the live telemetry plane
+via ``--live-status``: every streaming frame's sketch bucket ledger
+must balance (sum(pos) + sum(neg) + zero == n), the aggregator's
+mesh_status.json must keep its merged percentiles ordered
+(min <= p50 <= p90 <= p95 <= p99 <= max), a ``dead`` rank verdict
+must rest on staleness evidence (age_s >= staleness_s), and alert
+events must name their rule and state. stdlib only (the CI image
+installs jax + numpy + pytest, nothing else).
 
 Note on events.jsonl seq monotonicity: the sink's writer is
 at-least-once under I/O errors — a partially-landed segment is re-sent
@@ -209,6 +215,17 @@ def check_events_jsonl(path: str, schema: dict) -> None:
                 if kk not in ev:
                     err(f"{path}:{i + 1}: clock_sync event missing "
                         f"{kk!r}")
+        if ev.get("kind") == "alert":
+            # live-plane alert transitions (ISSUE 16): which rule
+            # moved and to which state — an alert event that cannot
+            # be attributed to a rule is operationally useless
+            for kk in sc.get("alert_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: alert event missing {kk!r}")
+            if "state" in ev and ev["state"] not in ("firing",
+                                                     "resolved"):
+                err(f"{path}:{i + 1}: alert state {ev['state']!r} "
+                    "not firing/resolved")
         seq = ev.get("seq")
         if not isinstance(seq, int) or seq <= last:
             err(f"{path}:{i + 1}: seq {seq!r} not strictly increasing "
@@ -584,6 +601,267 @@ def check_aux_bench_json(path: str, schema: dict) -> None:
             "(--aux-bench-json is for the ISSUE 15 modes)")
 
 
+def check_sketch(doc, schema: dict, where: str) -> None:
+    """Validate one serialized QuantileSketch (ISSUE 16): the
+    mergeable wire format must be exactly reconstructible, so the
+    bucket-count ledger has to balance — sum(pos) + sum(neg) + zero
+    == n — and a non-empty sketch must carry the exact min/max the
+    percentile clamp depends on. A sketch failing here would merge
+    into a silently-wrong mesh percentile, which is the one failure
+    mode the live plane promises not to have."""
+    sc = schema["telemetry_frame"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: sketch not an object")
+    for k in sc["sketch_required"]:
+        if k not in doc:
+            err(f"{where}: sketch missing {k!r}")
+    rel = doc.get("rel_err")
+    if not isinstance(rel, (int, float)) or not 0.0 < rel < 1.0:
+        err(f"{where}: rel_err {rel!r} not a number in (0, 1)")
+    n = doc.get("n")
+    if not isinstance(n, int) or n < 0:
+        err(f"{where}: n {n!r} not a non-negative int")
+        n = None
+    bucketed = 0
+    countable = True
+    for side in ("pos", "neg"):
+        b = doc.get(side)
+        if not isinstance(b, dict):
+            err(f"{where}: {side} not an object")
+            countable = False
+            continue
+        for idx, c in b.items():
+            if not isinstance(c, int) or c <= 0:
+                err(f"{where}: {side}[{idx}] count {c!r} not a "
+                    "positive int")
+                countable = False
+            else:
+                bucketed += c
+    z = doc.get("zero")
+    if not isinstance(z, int) or z < 0:
+        err(f"{where}: zero {z!r} not a non-negative int")
+        countable = False
+    else:
+        bucketed += z
+    if countable and n is not None and bucketed != n:
+        err(f"{where}: bucket counts sum to {bucketed} != n={n} — "
+            "the sketch would merge into a wrong mesh percentile")
+    if n and (not isinstance(doc.get("min"), (int, float))
+              or not isinstance(doc.get("max"), (int, float))):
+        err(f"{where}: non-empty sketch (n={n}) without numeric "
+            "min/max")
+
+
+def check_frame(doc, schema: dict, where: str,
+                expect_rank=None, expect_seq=None) -> None:
+    """Validate one streaming telemetry frame (ISSUE 16): the
+    required envelope, the counter {cumulative, delta} pairs, the
+    clock stamp the aggregator places the frame with, and every
+    embedded sketch. ``expect_rank``/``expect_seq`` come from the
+    filename — a frame whose body disagrees with its own name was
+    written by a buggy or impersonating writer."""
+    sc = schema["telemetry_frame"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["required"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    if doc.get("kind") != sc["kind"]:
+        err(f"{where}: kind {doc.get('kind')!r} != {sc['kind']!r}")
+    r = doc.get("rank")
+    if not isinstance(r, int) or r < 0:
+        err(f"{where}: rank {r!r} not a non-negative int")
+    elif expect_rank is not None and r != expect_rank:
+        err(f"{where}: body rank {r} != filename rank {expect_rank}")
+    s = doc.get("seq")
+    if not isinstance(s, int) or s < 0:
+        err(f"{where}: seq {s!r} not a non-negative int")
+    elif expect_seq is not None and s != expect_seq:
+        err(f"{where}: body seq {s} != filename seq {expect_seq}")
+    clock = doc.get("clock")
+    if not isinstance(clock, dict):
+        err(f"{where}: clock not an object")
+    else:
+        for k in schema["metrics_jsonl"]["clock_required"]:
+            if k not in clock:
+                err(f"{where}: clock missing {k!r}")
+    el = doc.get("events_lost")
+    if not isinstance(el, int) or el < 0:
+        err(f"{where}: events_lost {el!r} not a non-negative int")
+    counters = doc.get("counters")
+    if isinstance(counters, dict):
+        for name, entry in counters.items():
+            if not isinstance(entry, dict):
+                err(f"{where}: counters.{name} not an object")
+                continue
+            for k in sc["counter_entry"]:
+                if not isinstance(entry.get(k), (int, float)):
+                    err(f"{where}: counters.{name}.{k} "
+                        f"{entry.get(k)!r} not a number")
+    elif counters is not None:
+        err(f"{where}: counters not an object")
+    sketches = doc.get("sketches")
+    if isinstance(sketches, dict):
+        for name, sk in sketches.items():
+            check_sketch(sk, schema, f"{where}: sketches.{name}")
+    elif sketches is not None:
+        err(f"{where}: sketches not an object")
+
+
+_FRAME_FILE_RE = re.compile(r"^rank(\d+)-(\d+)\.json$")
+
+
+def check_frames_dir(d: str, schema: dict) -> None:
+    """Validate every landed frame in one ``frames/`` directory. A
+    ``.tmp`` file is in-flight, not torn — atomic rename means only
+    fully-written frames ever carry the final name, so every
+    ``rank<K>-<seq>.json`` here must parse; one that doesn't is a
+    writer bug, not a benign race."""
+    names = sorted(n for n in os.listdir(d)
+                   if _FRAME_FILE_RE.match(n))
+    if not names:
+        return err(f"{d}: frames dir exists but holds no frames")
+    for name in names:
+        m = _FRAME_FILE_RE.match(name)
+        path = os.path.join(d, name)
+        try:
+            doc = json.load(open(path))
+        except Exception as e:
+            err(f"{path}: unparseable frame ({e}) — atomic rename "
+                "should make this impossible")
+            continue
+        check_frame(doc, schema, path,
+                    expect_rank=int(m.group(1)),
+                    expect_seq=int(m.group(2)))
+
+
+def check_mesh_status(doc, schema: dict, where: str) -> None:
+    """Validate a LiveAggregator ``mesh_status.json`` artifact (ISSUE
+    16): the envelope, per-rank health blocks (a ``dead`` verdict
+    must rest on staleness evidence — age_s >= staleness_s — not
+    appear from nowhere), merged-latency percentile ordering
+    (min <= p50 <= p90 <= p95 <= p99 <= max; a violation means the
+    sketch merge is broken), window rollups, and the alert table."""
+    sc = schema["mesh_status"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["required"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    if doc.get("kind") != sc["kind"]:
+        err(f"{where}: kind {doc.get('kind')!r} != {sc['kind']!r}")
+    stale_s = doc.get("staleness_s")
+    ranks = doc.get("ranks")
+    any_dead = any_torn = False
+    if not isinstance(ranks, dict):
+        err(f"{where}: ranks not an object")
+        ranks = {}
+    for r, entry in ranks.items():
+        w = f"{where}: ranks.{r}"
+        if not isinstance(entry, dict):
+            err(f"{w}: not an object")
+            continue
+        for k in sc["rank_entry"]:
+            if k not in entry:
+                err(f"{w}: missing {k!r}")
+        if entry.get("dead"):
+            any_dead = True
+            age = entry.get("age_s")
+            if not entry.get("stale"):
+                err(f"{w}: dead without stale — death needs "
+                    "staleness evidence")
+            if not isinstance(age, (int, float)) or \
+                    not isinstance(stale_s, (int, float)) or \
+                    age < stale_s:
+                err(f"{w}: dead with age_s={age!r} < "
+                    f"staleness_s={stale_s!r}")
+        if entry.get("torn"):
+            any_torn = True
+    lat = doc.get("latency")
+    if not isinstance(lat, dict):
+        err(f"{where}: latency not an object")
+        lat = {}
+    for key, m in lat.items():
+        w = f"{where}: latency.{key}"
+        if not isinstance(m, dict):
+            err(f"{w}: not an object")
+            continue
+        for k in sc["latency_entry"]:
+            if k not in m:
+                err(f"{w}: missing {k!r}")
+        order = [m.get(k) for k in sc["percentiles_ordered"]]
+        if all(isinstance(v, (int, float)) for v in order):
+            for a, b, ka, kb in zip(order, order[1:],
+                                    sc["percentiles_ordered"],
+                                    sc["percentiles_ordered"][1:]):
+                if a > b:
+                    err(f"{w}: {ka}={a} > {kb}={b} — percentiles "
+                        "out of order, the sketch merge is broken")
+        else:
+            err(f"{w}: non-numeric percentile among "
+                f"{sc['percentiles_ordered']}")
+        u = m.get("unc_ms")
+        if u is not None and (not isinstance(u, (int, float))
+                              or u < 0):
+            err(f"{w}: unc_ms {u!r} neither null nor a non-negative "
+                "number")
+    roll = doc.get("rollups")
+    if not isinstance(roll, dict):
+        err(f"{where}: rollups not an object")
+    else:
+        for k in sc["rollup_keys"]:
+            if k not in roll:
+                err(f"{where}: rollups missing {k!r}")
+    alerts = doc.get("alerts")
+    if not isinstance(alerts, dict):
+        err(f"{where}: alerts not an object")
+        alerts = {}
+    for rule, st in alerts.items():
+        for k in sc["alert_entry"]:
+            if k not in (st or {}):
+                err(f"{where}: alerts.{rule} missing {k!r}")
+    if (any_dead or any_torn) and doc.get("partial") is not True:
+        err(f"{where}: dead/torn ranks but partial is "
+            f"{doc.get('partial')!r} — the artifact is lying about "
+            "its own completeness")
+    if not isinstance(doc.get("partial"), bool):
+        err(f"{where}: partial not a bool")
+
+
+def check_live_status_dir(d: str, schema: dict) -> None:
+    """Validate a live-telemetry directory (ISSUE 16): the
+    aggregator's mesh_status.json plus every frames/ directory
+    underneath (single-host ``frames/`` or per-rank
+    ``rank<K>/frames/``)."""
+    ms = os.path.join(d, "mesh_status.json")
+    if not os.path.exists(ms):
+        err(f"{ms}: missing (no aggregator tick ever published)")
+    else:
+        try:
+            doc = json.load(open(ms))
+        except Exception as e:
+            err(f"{ms}: unreadable ({e})")
+        else:
+            check_mesh_status(doc, schema, ms)
+    frame_dirs = []
+    top = os.path.join(d, "frames")
+    if os.path.isdir(top):
+        frame_dirs.append(top)
+    try:
+        subs = sorted(os.listdir(d))
+    except OSError:
+        subs = []
+    for sub in subs:
+        fd = os.path.join(d, sub, "frames")
+        if re.match(r"^rank\d+$", sub) and os.path.isdir(fd):
+            frame_dirs.append(fd)
+    if not frame_dirs:
+        err(f"{d}: no frames/ directory (streaming publication "
+            "never ran)")
+    for fd in frame_dirs:
+        check_frames_dir(fd, schema)
+
+
 def check_bench_json(path: str, schema: dict,
                      require_trace: bool = False) -> None:
     sc = schema["bench_extra"]
@@ -661,6 +939,14 @@ def main() -> int:
                     help="tools/merge_traces.py artifact to validate "
                          "as well (ISSUE 14: offset/uncertainty "
                          "fields required, TTFT bounds ordered)")
+    ap.add_argument("--live-status", default=None,
+                    help="live-telemetry directory to validate as "
+                         "well (ISSUE 16): the LiveAggregator's "
+                         "mesh_status.json — percentiles ordered, "
+                         "dead ranks backed by staleness evidence — "
+                         "plus every frames/ dir of streaming "
+                         "telemetry frames (sketch bucket ledgers "
+                         "must balance)")
     ap.add_argument("--require-trace", action="store_true",
                     help="fail unless trace_summary.json exists in the "
                          "sink dir AND the bench block carries "
@@ -688,6 +974,8 @@ def main() -> int:
         check_aux_bench_json(aux, schema)
     if args.merged_json:
         check_merged_trace_file(args.merged_json, schema)
+    if args.live_status:
+        check_live_status_dir(args.live_status, schema)
 
     if _ERRORS:
         print(f"sink schema: {len(_ERRORS)} violation(s)")

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""In-terminal live view of the mesh telemetry plane (ISSUE 16).
+
+Drives a ``LiveAggregator`` over a sink root (the directory
+``enable_sink`` / ``serve_bench --sink-dir`` wrote — per-rank
+``rank<K>/frames/`` or a flat ``frames/``) and repaints a compact
+status table every tick: per-rank health (frame age, clock sync,
+dead/stale flags), mesh-wide TTFT/TPOT/queue-wait percentiles from
+the merged sketches, window rollups, and the alert board. The
+``mesh_status.json`` / ``mesh_status.prom`` artifacts are rewritten
+under the root on every tick as a side effect — one aggregation path,
+two surfaces. Alert SIDE EFFECTS (ring events, alert-reason flushes,
+flight dumps) stay off: a viewer must not write into the run's event
+stream; run the aggregator embedded (``serve_bench --live-status``)
+for those.
+
+Usage::
+
+    python tools/live_dash.py /tmp/sink --interval 2 \
+        --board /tmp/sink/board --world 2
+    python tools/live_dash.py /tmp/sink --once      # one tick, print
+
+Pure stdlib + the profiler package; no jax import, safe to run on the
+driver while the mesh serves.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from paddle_tpu.profiler.live import LiveAggregator, default_rules  # noqa: E402
+
+
+def _fmt(v, nd=1):
+    if v is None:
+        return "-"
+    return f"{v:.{nd}f}"
+
+
+def render(st: dict) -> str:
+    lines = []
+    flags = []
+    if st["partial"]:
+        flags.append("PARTIAL")
+    if st["frames_torn"]:
+        flags.append(f"torn={st['frames_torn']}")
+    if st["events_lost"]:
+        flags.append(f"events_lost={st['events_lost']}")
+    lines.append(
+        f"mesh_status tick={st['tick']} "
+        f"ranks={len(st['ranks'])}"
+        + (f"/{st['world']}" if st.get("world") else "")
+        + (" [" + " ".join(flags) + "]" if flags else " [ok]"))
+    lines.append(f"{'rank':>4} {'seq':>5} {'age_s':>7} {'sync':>5} "
+                 f"{'state':>6} {'torn':>4} {'lease':>7}")
+    for r, blk in st["ranks"].items():
+        state = ("DEAD" if blk["dead"]
+                 else "stale" if blk["stale"] else "live")
+        lines.append(
+            f"{r:>4} {blk['seq']:>5} {_fmt(blk['age_s'], 2):>7} "
+            f"{'y' if blk['synced'] else 'n':>5} {state:>6} "
+            f"{blk['torn']:>4} {_fmt(blk['lease_age_s'], 1):>7}")
+    if st["latency"]:
+        lines.append(f"{'latency':>14} {'count':>7} {'p50':>9} "
+                     f"{'p95':>9} {'p99':>9} {'unc_ms':>8}")
+        for key, m in st["latency"].items():
+            lines.append(
+                f"{key:>14} {m['count']:>7} {_fmt(m['p50']):>9} "
+                f"{_fmt(m['p95']):>9} {_fmt(m['p99']):>9} "
+                f"{_fmt(m['unc_ms'], 3):>8}")
+    ro = st["rollups"]
+    lines.append(
+        f"tokens/s={_fmt(ro['tokens_per_sec'])} "
+        f"prefix_hit={_fmt(ro['prefix_hit_rate'], 3)} "
+        f"page_util={_fmt(ro['page_pressure'], 3)} "
+        f"busy_frac={_fmt(ro['goodput_busy_frac'], 3)}")
+    firing = [n for n, a in st.get("alerts", {}).items()
+              if a["firing"]]
+    lines.append("alerts: " + (", ".join(
+        f"{n}(v={_fmt(st['alerts'][n]['value'], 1)})"
+        for n in firing) if firing else "none firing"))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", help="sink root directory to tail")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="aggregation tick seconds (default 2)")
+    ap.add_argument("--staleness", type=float, default=None,
+                    help="rank-dead frame age (default 3x interval)")
+    ap.add_argument("--world", type=int, default=None,
+                    help="expected rank count (partial below it)")
+    ap.add_argument("--board", default=None,
+                    help="consensus board dir for lease corroboration")
+    ap.add_argument("--lease-s", type=float, default=5.0)
+    ap.add_argument("--ttft-slo-ms", type=float, default=2000.0,
+                    help="p95 TTFT alert target")
+    ap.add_argument("--once", action="store_true",
+                    help="one tick, print, exit (CI / scripting)")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="stop after this many seconds")
+    args = ap.parse_args(argv)
+
+    agg = LiveAggregator(
+        args.root, interval_s=args.interval,
+        staleness_s=args.staleness, world=args.world,
+        board_dir=args.board, lease_s=args.lease_s,
+        rules=default_rules(ttft_p95_ms=args.ttft_slo_ms),
+        emit_alerts=False)  # a reader must not write alert events
+    if args.once:
+        print(render(agg.tick()))
+        return 0
+    t0 = time.time()
+    try:
+        while args.duration is None or \
+                time.time() - t0 < args.duration:
+            st = agg.tick()
+            # repaint in place when attached to a tty; plain append
+            # otherwise (logs stay readable)
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(render(st), flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,7 +37,7 @@ process track per rank, request spans as complete events, handoffs
 linked by flow arrows keyed on the trace id — load in
 chrome://tracing or Perfetto.
 
-Stdlib only (json/os/math/argparse): the merger must run anywhere the
+Stdlib only (json/os/argparse): the merger must run anywhere the
 artifacts land, with no jax on the path.
 
 Usage::
@@ -52,7 +52,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import re
 import sys
@@ -79,9 +78,7 @@ def percentile(vals: List[float], q: float) -> Optional[float]:
     if not vals:
         return None
     s = sorted(vals)
-    k = max(0, min(len(s) - 1,
-                   int(math.ceil(q / 100.0 * len(s))) - 1))
-    return s[k]
+    return s[min(int(q / 100.0 * len(s)), len(s) - 1)]
 
 
 def stats(vals: List[float]) -> dict:
