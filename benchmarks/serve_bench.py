@@ -65,6 +65,7 @@ full runs of both.
     python benchmarks/serve_bench.py --kernel-matrix # unified vs legacy
     python benchmarks/serve_bench.py --sched-matrix  # fifo/sjf/aged-sjf
     python benchmarks/serve_bench.py --adaptive-k    # adaptive spec-k
+    python benchmarks/serve_bench.py --elastic       # kill-one redispatch
     python benchmarks/serve_bench.py --tiny [...]    # CI smoke sizes
     python benchmarks/serve_bench.py --sink-dir DIR  # + persistent sink
     python benchmarks/serve_bench.py --trace-window 8  # + device trace
@@ -1592,6 +1593,170 @@ def bench_multihost(args, tiny):
     }
 
 
+def bench_elastic(args, tiny):
+    """Elastic serving mesh (ISSUE 17): what a mid-run rank death
+    costs the re-dispatched tail. Two cells on REAL processes (env-
+    protocol ranks, no jax.distributed — its fatal poller would abort
+    the survivors), same 3-rank symmetric mesh, same seeded Poisson
+    trace:
+
+      undisturbed   all three ranks serve to completion
+      kill_one      rank 2 ``os._exit(137)``s once the clock passes
+                    die_after_s while it holds unserved assigned work
+                    (a real corpse with real orphans); the survivors
+                    detect the stale lease, agree the member out, and
+                    re-dispatch every orphan through the normal router
+
+    Headline: p95 TTFT of the kill cell's RE-DISPATCHED gids over the
+    undisturbed cell's p95 — the orphaned tail pays one dead-rank
+    detection window (~2x lease) plus a fresh prefill, and this cell
+    prices exactly that. Zero-loss is asserted, not assumed: the
+    survivors' served sets must union to every submitted gid, exactly
+    once. Valid on CPU wall clocks: both cells timeshare the same
+    core, and the headline compares tails across cells of the SAME
+    workload, so the delta is detection + re-dispatch structure."""
+    import tempfile
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import mp_mesh
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "serve_worker.py")
+    world = 3
+    n_req = 18 if tiny else 36
+    max_new = 16 if tiny else 24
+    rate = 4.0 if tiny else 6.0
+    plens = (8, 16, 12) if tiny else (16, 32, 24)
+    ps = 8
+    slots = 4
+    pps = -(-(max(plens) + max_new) // ps)
+    lease_s = 1.0
+    # arrivals span n_req/rate seconds; dying ~a third of the way in
+    # guarantees pending work on the corpse AND a long survivor tail
+    die_after_s = (n_req / rate) / 3.0
+    model = {"vocab": 128, "hidden": 64, "layers": 4, "heads": 4,
+             "max_seq_len": 128}
+
+    def run_cell(name, die):
+        root = tempfile.mkdtemp(prefix=f"serve_el_{name}_")
+        cfg = {
+            "seed": 7, "rate": rate, "n_requests": n_req,
+            "prompt_lens": list(plens), "max_new": max_new,
+            "prefill_ranks": [], "world": world, "model": model,
+            "shared_dir": os.path.join(root, "shared"),
+            "engine": {"num_slots": slots, "page_size": ps,
+                       "pages_per_slot": pps, "prefill_chunk": ps},
+            "env_only": True, "lease_s": lease_s,
+            "timeout_s": 600,
+        }
+        if die:
+            cfg["die_rank"] = world - 1
+            cfg["die_after_s"] = die_after_s
+        cfg_path = os.path.join(root, "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        res = mp_mesh.launch(
+            world, worker, [cfg_path, root],
+            log_dir=os.path.join(root, "logs"), timeout=720,
+            expect_fail_ranks=(world - 1,) if die else ())
+        if not res.ok:
+            raise SystemExit(f"elastic cell {name} failed:\n"
+                             f"{res.tail()}")
+        ranks = range(world - 1) if die else range(world)
+        stats = []
+        for r in ranks:
+            with open(os.path.join(root, f"bench.{r}.json")) as f:
+                stats.append(json.load(f))
+        served = sorted(g for s in stats for g in s["served"])
+        assert served == list(range(n_req)), \
+            f"cell {name}: lost/duplicated requests " \
+            f"({len(served)} served of {n_req})"
+        ttfts = {g: v for s in stats
+                 for g, v in s["ttft_ms"].items()}
+        redis = {g: m for s in stats
+                 for g, m in s["redispatched"].items()}
+        return {
+            "stats": stats, "ttft_ms": ttfts, "redispatched": redis,
+            "members": stats[0]["members"],
+        }
+
+    undis = run_cell("undisturbed", die=False)
+    kill = run_cell("kill_one", die=True)
+
+    assert not undis["redispatched"], "undisturbed cell re-dispatched"
+    assert kill["redispatched"], \
+        "the corpse held nothing — no re-dispatched tail to price"
+    assert kill["members"] == [0, 1], kill["members"]
+
+    undis_all = list(undis["ttft_ms"].values())
+    tail = [kill["ttft_ms"][g] for g in kill["redispatched"]
+            if g in kill["ttft_ms"]]
+    assert len(tail) == len(kill["redispatched"]), \
+        "a re-dispatched gid finished without a TTFT"
+    rest = [v for g, v in kill["ttft_ms"].items()
+            if g not in kill["redispatched"]]
+    undis_p95 = pct(undis_all, 95)
+    tail_p95 = pct(tail, 95)
+    inflation = tail_p95 / max(undis_p95, 1e-9)
+
+    def cell_block(c, die):
+        ranks = (0, 1) if die else (0, 1, 2)
+        return {
+            "world": world, "ranks_finished": list(ranks),
+            "tokens": sum(s["tokens"] for s in c["stats"]),
+            "ttft_p50_ms": round(pct(list(c["ttft_ms"].values()), 50),
+                                 2),
+            "ttft_p95_ms": round(pct(list(c["ttft_ms"].values()), 95),
+                                 2),
+            "handoffs": sum(s["handoffs_sent"] for s in c["stats"]),
+            "redispatched": len(c["redispatched"]),
+            "members": c["members"],
+        }
+
+    modes = {}
+    for m in kill["redispatched"].values():
+        modes[m] = modes.get(m, 0) + 1
+    return {
+        "metric": "serving_elastic_redispatch_ttft_inflation",
+        "value": round(inflation, 4),
+        "unit": "x p95 TTFT, kill-one cell's re-dispatched tail vs "
+                "the undisturbed mesh (same workload, zero lost)",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "model": model, "world": world,
+            "requests": n_req, "max_new": max_new,
+            "prompt_lens": list(plens), "arrival_rate_hz": rate,
+            "page_size": ps, "slots_per_rank": slots,
+            "lease_s": lease_s, "die_after_s": round(die_after_s, 2),
+            "die_rank": world - 1,
+            "cells": {"undisturbed": cell_block(undis, False),
+                      "kill_one": cell_block(kill, True)},
+            "redispatched_tail": {
+                "count": len(tail),
+                "modes": modes,
+                "ttft_p50_ms": round(pct(tail, 50), 2),
+                "ttft_p95_ms": round(tail_p95, 2),
+            },
+            "kill_undisturbed_requests_ttft_p95_ms": round(
+                pct(rest, 95), 2) if rest else None,
+            "undisturbed_ttft_p95_ms": round(undis_p95, 2),
+            "note": ("zero-loss asserted in BOTH cells: every "
+                     "submitted gid finished on exactly one "
+                     "surviving rank. The re-dispatched tail pays "
+                     "the dead-rank detection window (lease_s-based, "
+                     "~2x lease) plus a fresh prefill (or a "
+                     "scavenged-KV import when the corpse's export "
+                     "survived and audits clean) — the inflation "
+                     "prices exactly that recovery path. Env-"
+                     "protocol ranks (no jax.distributed): the "
+                     "coordination service's fatal poller would "
+                     "abort the survivors ~100 s after the kill, "
+                     "which is the opposite of elastic"),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
@@ -1653,6 +1818,13 @@ def main():
                          "block — true e2e disagg TTFT with clock "
                          "uncertainty + handoff breakdown (ISSUE 14; "
                          "BENCH_SERVE_r14.json)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="elastic-mesh cell (ISSUE 17): 3 real "
+                         "env-protocol ranks, undisturbed vs kill-one "
+                         "(rank 2 dies mid-run holding work); headline "
+                         "is the re-dispatched tail's p95 TTFT over "
+                         "the undisturbed mesh's, zero-loss asserted "
+                         "in both cells (BENCH_SERVE_r17.json)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=48)
@@ -1714,6 +1886,13 @@ def main():
                             or args.prefix_cache):
         ap.error("--adaptive-k is its own comparison mode (the "
                  "static-vs-adaptive spec engines are built inside)")
+    if args.elastic and (args.kernel_matrix or args.spec_decode or
+                         args.prefix_cache or args.sched_matrix or
+                         args.adaptive_k or args.kv_dtype != "f32" or
+                         args.hosts > 1 or args.trace_window or
+                         args.sink_dir or args.live_status):
+        ap.error("--elastic is its own comparison mode (real "
+                 "processes; per-cell sinks live in the cell dirs)")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -1738,7 +1917,9 @@ def main():
         live_agg = LiveAggregator(args.live_status, interval_s=1.0,
                                   staleness_s=30.0).start()
 
-    if args.hosts > 1:
+    if args.elastic:
+        out = bench_elastic(args, args.tiny)
+    elif args.hosts > 1:
         if args.kernel_matrix or args.spec_decode or \
                 args.prefix_cache or args.kv_dtype != "f32" or \
                 args.sched_matrix or args.adaptive_k:

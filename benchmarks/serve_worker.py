@@ -26,7 +26,14 @@ def main():
         cfg = json.load(f)
     out_dir = sys.argv[2]
     world = int(cfg["world"])
-    if world > 1:
+    env_only = bool(cfg.get("env_only"))
+    if world > 1 and env_only:
+        # elastic cells (ISSUE 17): no jax.distributed — its fatal
+        # poller would abort the survivors the moment the die_rank
+        # exits; the mesh's own board is the only control plane
+        rank, w = mp_mesh.init_env_only()
+        assert w == world
+    elif world > 1:
         rank, w = mp_mesh.init()
         assert w == world
     else:
@@ -102,11 +109,25 @@ def main():
     if cfg.get("sink_dir"):
         _profiler.enable_sink(cfg["sink_dir"], interval_s=10.0)
 
-    if world > 1:
+    if world > 1 and env_only:
+        # file-based warm barrier: there is no coordination service
+        with open(os.path.join(out_dir, f"warm.{rank}"), "w") as f:
+            f.write("ok\n")
+        assert mp_mesh.wait_for_files(
+            [os.path.join(out_dir, f"warm.{r}") for r in range(world)],
+            timeout_s=300.0)
+    elif world > 1:
         mp_mesh.barrier("warm")
     ru0 = resource.getrusage(resource.RUSAGE_SELF)
     start_w = time.time()
     pending = list(trace)
+    # elastic kill cell (ISSUE 17): this rank dies ABRUPTLY once the
+    # clock passes die_after_s AND it holds at least one unserved
+    # assigned request — a real corpse with real orphans, not a
+    # graceful drain (the holding gate keeps the measurement honest:
+    # arrivals still pending at die time guarantee it fires)
+    die_at = (float(cfg["die_after_s"])
+              if rank == cfg.get("die_rank") else None)
     # end_w stamps the LAST serving progress (tokens/handoffs), not
     # the done-agreement adoption: the completion vote is control
     # plane (rate-limited rounds) and must not pollute the throughput
@@ -118,6 +139,11 @@ def main():
         while pending and pending[0][0] <= now:
             _, p, mn = pending.pop(0)
             srv.submit(p, mn)
+        if die_at is not None and now >= die_at:
+            served_now = srv.results()
+            if any(d == rank and g not in served_now
+                   for g, (_, d) in srv._assignments.items()):
+                os._exit(137)    # no close, no stats, no goodbyes
         progressed = srv.step()
         sig = (_reg().counter("serving/tokens_generated").value,
                srv.handoffs_sent, srv.handoffs_recv)
@@ -165,6 +191,12 @@ def main():
         "prefix_evictions": registry().counter(
             "cache_share/prefix_evictions").value,
         "ticks": registry().counter("serving/ticks").value,
+        # elastic evidence (ISSUE 17): which gids this rank re-served
+        # after a peer died, and by which mode — the driver's
+        # re-dispatched-tail TTFT inflation cell reads these
+        "redispatched": {str(g): m
+                         for g, m in srv.redispatched.items()},
+        "members": sorted(srv._members),
     }
     path = os.path.join(out_dir, f"bench.{rank}.json")
     with open(path + ".tmp", "w") as f:
@@ -175,7 +207,9 @@ def main():
     srv.close()
     ok = os.path.join(out_dir, f"ok.{rank}")
     if world > 1:
-        if rank == 0:
+        if rank == 0 and not env_only:
+            # rank 0 only hosts a coordination service on the
+            # jax.distributed path — env-only ranks exit freely
             mp_mesh.finish_last(ok, [os.path.join(out_dir, f"ok.{r}")
                                      for r in range(1, world)])
         mp_mesh.finish(ok)

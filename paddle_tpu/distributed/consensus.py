@@ -311,11 +311,35 @@ class Consensus:
         self._hb_thread = None
         self._hb_stop = None
 
+    def board_ranks(self) -> List[int]:
+        """Every rank with a ``lease.<r>`` file on the board — the
+        DISCOVERED membership candidates (ISSUE 17: a joiner outside
+        this instance's original ``world`` announces itself by writing
+        its lease; static meshes see exactly ``range(world)`` because
+        nobody else ever writes one). Self always counts."""
+        cand = set(range(self.world))
+        cand.add(self.rank)
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return sorted(cand)
+        for n in names:
+            if n.startswith("lease.") and ".tmp" not in n:
+                try:
+                    cand.add(int(n[len("lease."):]))
+                except ValueError:
+                    pass
+        return sorted(cand)
+
     def alive(self) -> List[int]:
-        """Ranks with a fresh lease (self always counts)."""
+        """Ranks with a fresh lease (self always counts). Candidates
+        are discovered from the board (:meth:`board_ranks`), not
+        assumed from ``world`` — leadership and vote-await semantics
+        follow the mesh that actually exists, so a mid-run joiner is
+        awaited the moment its lease lands (ISSUE 17)."""
         now = time.time()
         out = []
-        for r in range(self.world):
+        for r in self.board_ranks():
             if r == self.rank:
                 out.append(r)
                 continue
@@ -350,6 +374,32 @@ class Consensus:
         if family not in self._epochs:
             self._epochs[family] = 0
             os.makedirs(self._family_dir(family), exist_ok=True)
+        return self._epochs[family]
+
+    def fast_forward(self, family: str) -> int:
+        """Joiner catch-up (ISSUE 17): position this rank's epoch
+        cursor at the OLDEST epoch still on the board for ``family``.
+        A rank that joins after earlier epochs were pruned
+        (KEEP_EPOCHS) cannot adopt them in order — ``epoch()``'s dense
+        contract would stall it forever at a directory that no longer
+        exists. It fast-forwards to the surviving history's head and
+        adopts from there; whatever state the pruned epochs carried
+        reaches it through the membership decision's sync snapshot
+        (serving/disagg.py ``_member_reducer``). Returns the cursor
+        (unchanged — possibly 0 — when the full history survives)."""
+        fam = self._family_dir(family)
+        cur = self.epoch(family)
+        oldest: Optional[int] = None
+        try:
+            names = os.listdir(fam)
+        except OSError:
+            names = []
+        for n in names:
+            if n.startswith("e") and len(n) == 7 and n[1:].isdigit():
+                e = int(n[1:])
+                oldest = e if oldest is None else min(oldest, e)
+        if oldest is not None and oldest > cur:
+            self._epochs[family] = oldest
         return self._epochs[family]
 
     # -- voting ------------------------------------------------------------

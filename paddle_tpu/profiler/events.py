@@ -85,12 +85,26 @@ __all__ = [
 #: ``resolved``), ``value``/``threshold`` when the rule is numeric.
 #: Rare by construction (one per rule TRANSITION, hysteresis-damped,
 #: never per tick).
+#: The elastic mesh (ISSUE 17) adds four control-plane kinds, all
+#: O(1) per membership change or per orphaned request, never per
+#: token: ``member_join`` / ``member_leave`` (a consensus membership
+#: round admitted or evicted a rank — attrs ``member``/``role``/
+#: ``epoch``, plus ``reason`` on leave), ``redispatch`` (a dead
+#: rank's orphaned request was reconstructed and re-dispatched —
+#: attrs ``gid``/``trace``/``mode`` (``requeue`` = back through
+#: ``route_requests`` for a fresh prefill, ``reprefill`` = the decode
+#: owner re-prefills locally, ``scavenge`` = a surviving exported-KV
+#: file was claimed and reused) and ``dead_rank``), and ``cancel``
+#: (the engine abandoned a request without a result — orphan
+#: bookkeeping when a re-dispatched gid's stale local work is torn
+#: down; attr ``reason``).
 EVENT_KINDS = (
     "submit", "admit", "prefix_hit", "cow_copy", "chunk",
     "first_token", "draft", "verify", "accept",
     "handoff_out", "handoff_in",
     "route", "clock_sync", "consensus_decision", "lease_expiry",
     "vote_window_expiry",
+    "member_join", "member_leave", "redispatch", "cancel",
     "preempt", "requeue", "finish", "rollback", "alert",
 )
 

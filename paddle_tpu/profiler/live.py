@@ -17,7 +17,18 @@ and rewrites two artifacts per tick, atomically:
   sketch's stated ``rel_err`` of the true stream), window rollups
   (tokens/s, prefix-hit rate, page pressure, goodput-busy frac), and
   the alert board;
-- ``mesh_status.prom`` — the same, Prometheus-textfile-shaped.
+- ``mesh_status.prom`` — the same, Prometheus-textfile-shaped;
+- ``mesh_status_history.jsonl`` — a rolling JSONL tail of every
+  published status (ISSUE 17), for ``live_dash.py --history``.
+
+Elastic mesh (ISSUE 17): when the consensus board carries a
+``member`` family, the aggregator's world FOLLOWS the latest agreed
+member set (``membership`` block in the status: epoch, members,
+source) instead of the static ``--world`` — a joiner is expected the
+moment its membership round publishes, and a voted-out rank stops
+reading as "missing". Alert rules may be ``per_rank=True``: the
+``dead_rank`` stock rule keeps an independent damped streak per rank,
+and its transitions/ring events name the rank.
 
 Transport is the shared directory, like the consensus board — compiled
 cross-process collectives are unavailable on this backend, and a file
@@ -88,16 +99,29 @@ class AlertRule:
     ``clear_ticks`` consecutive ticks with ``value <
     hysteresis * threshold`` (``hysteresis <= 1`` pulls the resolve
     line below the fire line, so a value sitting on the threshold
-    cannot flap the alert)."""
+    cannot flap the alert).
+
+    ``per_rank=True`` (ISSUE 17 satellite) changes the probe contract:
+    ``probe(status) -> Dict[rank, Optional[float]]`` and the rule runs
+    an INDEPENDENT streak machine per rank — rank 3 flapping must not
+    reset rank 1's breach streak, and one transition names the rank it
+    happened on (``"rank"`` in the transition dict and on the ring
+    event). The aggregate view stays intact: ``firing`` is true while
+    ANY rank fires, ``last_value`` is the worst evaluable rank, and
+    ``state()`` keeps the scalar keys plus a ``per_rank`` sub-block.
+    A rank that disappears from the probe's dict (left the mesh)
+    holds its streaks, like a None value."""
 
     __slots__ = ("name", "probe", "threshold", "for_ticks",
                  "hysteresis", "clear_ticks", "firing", "fired_count",
-                 "last_value", "_streak", "_clear")
+                 "last_value", "_streak", "_clear", "per_rank",
+                 "_rank_states")
 
     def __init__(self, name: str,
                  probe: Callable[[dict], Optional[float]],
                  threshold: float, for_ticks: int = 1,
-                 hysteresis: float = 1.0, clear_ticks: int = 1):
+                 hysteresis: float = 1.0, clear_ticks: int = 1,
+                 per_rank: bool = False):
         if for_ticks < 1 or clear_ticks < 1:
             raise ValueError("for_ticks/clear_ticks must be >= 1")
         if not 0.0 < hysteresis <= 1.0:
@@ -108,16 +132,22 @@ class AlertRule:
         self.for_ticks = int(for_ticks)
         self.hysteresis = float(hysteresis)
         self.clear_ticks = int(clear_ticks)
+        self.per_rank = bool(per_rank)
         self.firing = False
         self.fired_count = 0
         self.last_value: Optional[float] = None
         self._streak = 0
         self._clear = 0
+        self._rank_states: Dict[str, dict] = {}
 
     def evaluate(self, status: dict) -> Optional[str]:
         """Advance the state machine one tick; returns the transition
         (``"firing"`` / ``"resolved"``) or None. Never raises — a
-        probe error reads as not-evaluable."""
+        probe error reads as not-evaluable. Scalar rules only; a
+        per-rank rule is driven via :meth:`evaluate_all`."""
+        if self.per_rank:
+            raise TypeError(f"rule {self.name!r} is per_rank: drive "
+                            "it with evaluate_all()")
         try:
             v = self.probe(status)
         except Exception:
@@ -146,10 +176,81 @@ class AlertRule:
             self._clear = 0
         return None
 
+    def _step_rank(self, rs: dict, v: Optional[float]) -> Optional[str]:
+        """One rank's streak machine — the same damped transitions as
+        the scalar path, over the rank's own state dict."""
+        rs["value"] = None if v is None else float(v)
+        if v is None:
+            return None
+        if not rs["firing"]:
+            if v >= self.threshold:
+                rs["streak"] += 1
+                if rs["streak"] >= self.for_ticks:
+                    rs["firing"] = True
+                    rs["fired_count"] += 1
+                    rs["clear"] = 0
+                    return "firing"
+            else:
+                rs["streak"] = 0
+            return None
+        if v < self.hysteresis * self.threshold:
+            rs["clear"] += 1
+            if rs["clear"] >= self.clear_ticks:
+                rs["firing"] = False
+                rs["streak"] = 0
+                return "resolved"
+        else:
+            rs["clear"] = 0
+        return None
+
+    def evaluate_all(self, status: dict) -> List[dict]:
+        """Advance one tick and return this rule's transition dicts
+        (possibly several for a per-rank rule: rank 1 can fire on the
+        same tick rank 3 resolves)."""
+        if not self.per_rank:
+            tr = self.evaluate(status)
+            if tr is None:
+                return []
+            return [{"rule": self.name, "state": tr,
+                     "value": self.last_value,
+                     "threshold": self.threshold,
+                     "fired_count": self.fired_count}]
+        try:
+            vals = self.probe(status)
+        except Exception:
+            vals = None
+        if not isinstance(vals, dict):
+            vals = {}
+        out = []
+        for rank in sorted(vals, key=str):
+            rs = self._rank_states.setdefault(
+                str(rank), {"firing": False, "streak": 0, "clear": 0,
+                            "fired_count": 0, "value": None})
+            tr = self._step_rank(rs, vals[rank])
+            if tr == "firing":
+                self.fired_count += 1
+            if tr is not None:
+                out.append({"rule": self.name, "state": tr,
+                            "rank": str(rank), "value": rs["value"],
+                            "threshold": self.threshold,
+                            "fired_count": self.fired_count})
+        self.firing = any(rs["firing"]
+                          for rs in self._rank_states.values())
+        evaluable = [rs["value"] for rs in self._rank_states.values()
+                     if rs["value"] is not None]
+        self.last_value = max(evaluable) if evaluable else None
+        return out
+
     def state(self) -> dict:
-        return {"firing": self.firing, "value": self.last_value,
-                "threshold": self.threshold,
-                "fired_count": self.fired_count}
+        st = {"firing": self.firing, "value": self.last_value,
+              "threshold": self.threshold,
+              "fired_count": self.fired_count}
+        if self.per_rank:
+            st["per_rank"] = {
+                r: {"firing": rs["firing"], "value": rs["value"],
+                    "fired_count": rs["fired_count"]}
+                for r, rs in sorted(self._rank_states.items())}
+        return st
 
 
 def default_rules(ttft_p95_ms: float = 2000.0,
@@ -165,7 +266,8 @@ def default_rules(ttft_p95_ms: float = 2000.0,
         return None if m is None else m.get("p95")
 
     def _dead(st):
-        return float(sum(1 for r in st["ranks"].values() if r["dead"]))
+        return {r: float(blk["dead"])
+                for r, blk in st["ranks"].items()}
 
     def _stall(st):
         tps = st["rollups"].get("tokens_per_sec")
@@ -185,7 +287,7 @@ def default_rules(ttft_p95_ms: float = 2000.0,
     return [
         AlertRule("p95_ttft_over_target", _p95, ttft_p95_ms,
                   for_ticks=for_ticks, hysteresis=0.9),
-        AlertRule("dead_rank", _dead, 1.0),
+        AlertRule("dead_rank", _dead, 1.0, per_rank=True),
         AlertRule("decode_stall", _stall, 1.0, for_ticks=for_ticks),
         AlertRule("pool_pressure", _pressure, pool_util,
                   for_ticks=for_ticks, hysteresis=0.95),
@@ -226,7 +328,8 @@ class LiveAggregator:
                  lease_s: float = 5.0,
                  rules: Optional[List[AlertRule]] = None,
                  prefix: str = "paddle_tpu",
-                 emit_alerts: bool = True):
+                 emit_alerts: bool = True,
+                 history_limit: int = 512):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
         self.root = root
@@ -245,6 +348,13 @@ class LiveAggregator:
         self.emit_alerts = bool(emit_alerts)
         self.status_json = os.path.join(root, "mesh_status.json")
         self.status_prom = os.path.join(root, "mesh_status.prom")
+        #: rolling JSONL of every published status (ISSUE 17
+        #: satellite) — ``live_dash.py --history`` replays it; kept to
+        #: the last ``history_limit`` lines (0 disables the history)
+        self.status_history = os.path.join(
+            root, "mesh_status_history.jsonl")
+        self.history_limit = int(history_limit)
+        self._history_appends = 0
         self._ranks: Dict[int, _RankState] = {}
         self._ticks = 0
         self._last_status: Optional[dict] = None
@@ -424,13 +534,47 @@ class LiveAggregator:
                 self._gauge_max("trace/goodput_busy_frac"),
         }
 
+    def _membership(self) -> Optional[dict]:
+        """The latest agreed ``member`` decision on the consensus
+        board (ISSUE 17): the aggregator's world FOLLOWS the mesh's
+        own membership — a joiner shows up in ``mesh_status`` the
+        moment the round publishes, a dead rank stops counting as
+        "missing" once voted out. None when no board, no member
+        family, or no decision yet (static-world fallback)."""
+        if self.board_dir is None:
+            return None
+        fam = os.path.join(self.board_dir, "member")
+        try:
+            # dir names are e<epoch>, zero-padded by the consensus
+            # (e000004) — keep the real name, sort by the number
+            epochs = sorted((int(n[1:]), n) for n in os.listdir(fam)
+                            if n.startswith("e") and n[1:].isdigit())
+        except OSError:
+            return None
+        for e, name in reversed(epochs):
+            path = os.path.join(fam, name, "decision.json")
+            try:
+                with open(path) as f:
+                    dec = json.load(f)
+                members = {str(k): str(v) for k, v in
+                           ((dec.get("value") or {})
+                            .get("members") or {}).items()}
+                if not members:
+                    raise ValueError("empty member table")
+                return {"epoch": e, "members": members,
+                        "source": "board"}
+            except (OSError, ValueError, KeyError, TypeError):
+                continue            # undecided/torn epoch: look older
+        return None
+
     # -- publication -------------------------------------------------------
-    def _rank_block(self, now: float) -> Dict[str, dict]:
+    def _rank_block(self, now: float,
+                    world: Optional[int]) -> Dict[str, dict]:
         lease_ages: Dict[int, float] = {}
         if self.board_dir is not None:
             try:
                 from ..distributed.consensus import lease_ages as _la
-                lease_ages = _la(self.board_dir, self.world)
+                lease_ages = _la(self.board_dir, world)
             except Exception:
                 lease_ages = {}
         out: Dict[str, dict] = {}
@@ -461,15 +605,24 @@ class LiveAggregator:
         return out
 
     def _build_status(self, now: float) -> dict:
-        ranks = self._rank_block(now)
-        missing = (self.world is not None
-                   and len(ranks) < self.world)
+        membership = self._membership()
+        # the agreed member set outranks the static --world: joiners
+        # count, voted-out ranks stop reading as "missing"
+        world = (len(membership["members"]) if membership
+                 else self.world)
+        ranks = self._rank_block(now, world)
+        if membership:
+            present = {str(r) for r in membership["members"]}
+            missing = bool(present - set(ranks))
+        else:
+            missing = world is not None and len(ranks) < world
         status = {
             "kind": "mesh_status", "ts": round(now, 6),
             "root": self.root, "tick": self._ticks,
             "interval_s": self.interval_s,
             "staleness_s": self.staleness_s,
-            "world": self.world,
+            "world": world,
+            "membership": membership,
             "ranks": ranks,
             "partial": bool(missing
                             or any(r["dead"] or r["torn"]
@@ -490,6 +643,10 @@ class LiveAggregator:
                  f"{p}_mesh_frames_torn {status['frames_torn']}",
                  f"# TYPE {p}_mesh_events_lost gauge",
                  f"{p}_mesh_events_lost {status['events_lost']}"]
+        if status.get("membership"):
+            lines += [f"# TYPE {p}_mesh_members gauge",
+                      f"{p}_mesh_members "
+                      f"{len(status['membership']['members'])}"]
         for r, blk in status["ranks"].items():
             lines.append(f'{p}_mesh_rank_dead{{rank="{r}"}} '
                          f'{int(blk["dead"])}')
@@ -520,18 +677,37 @@ class LiveAggregator:
             with open(tmp, "w") as f:
                 f.write(text)
             os.replace(tmp, path)
+        self._append_history(status)
+
+    def _append_history(self, status: dict) -> None:
+        """One compact JSONL line per publish, rolled to the last
+        ``history_limit`` lines. The trim rewrites atomically (tmp +
+        replace) so a tailing dashboard never reads a torn file; it
+        runs every 64 appends, so the file briefly overshoots the
+        limit — a bounded-disk guarantee, not an exact-length one."""
+        if self.history_limit <= 0:
+            return
+        line = json.dumps(status, separators=(",", ":"))
+        with open(self.status_history, "a") as f:
+            f.write(line + "\n")
+        self._history_appends += 1
+        if self._history_appends % 64 == 0:
+            try:
+                with open(self.status_history) as f:
+                    lines = f.readlines()
+                if len(lines) > self.history_limit:
+                    tmp = self.status_history + ".tmp"
+                    with open(tmp, "w") as f:
+                        f.writelines(lines[-self.history_limit:])
+                    os.replace(tmp, self.status_history)
+            except OSError:
+                pass                # next trim pass retries
 
     # -- alerting ----------------------------------------------------------
     def _evaluate_rules(self, status: dict) -> List[dict]:
         transitions = []
         for rule in self.rules:
-            tr = rule.evaluate(status)
-            if tr is not None:
-                transitions.append(
-                    {"rule": rule.name, "state": tr,
-                     "value": rule.last_value,
-                     "threshold": rule.threshold,
-                     "fired_count": rule.fired_count})
+            transitions.extend(rule.evaluate_all(status))
         status["alerts"] = {r.name: r.state() for r in self.rules}
         status["alert_transitions"] = transitions
         if transitions and self.emit_alerts:
@@ -547,9 +723,11 @@ class LiveAggregator:
         from . import sink as _sink
         for t in transitions:
             try:
-                _events.emit("alert", rule=t["rule"],
-                             state=t["state"], value=t["value"],
-                             threshold=t["threshold"])
+                kw = dict(rule=t["rule"], state=t["state"],
+                          value=t["value"], threshold=t["threshold"])
+                if "rank" in t:       # per-rank rule: name the rank
+                    kw["rank"] = t["rank"]
+                _events.emit("alert", **kw)
             except Exception:
                 pass
             if t["state"] == "firing" and t["fired_count"] == 1:

@@ -38,9 +38,46 @@ the leader reduces the votes with the pure routing function
 then admits exactly its own requests, from its own copy of the stream.
 No request data ever rides the vote; only loads and ids do. A rank
 whose vote misses a round still adopts the published assignment, and a
-dead rank is dropped from routing by lease expiry (its already-routed
-requests die with it — re-dispatch of orphaned requests is residue,
-ROADMAP).
+dead rank is dropped from routing by lease expiry.
+
+Elastic mesh (ISSUE 17)
+-----------------------
+Membership is no longer the static ``MeshSpec``: a consensus
+``member`` family agrees on who is on the mesh, and routing topology,
+done-agreement ledgers, clock participation, and the live plane all
+follow the agreed member set.
+
+- **dead-rank re-dispatch**: every rank holds every gid's prompt (the
+  SPMD driver contract) and the published assignments, so when the
+  mesh DECLARES a rank dead (its consensus lease stale past
+  ``dead_after_s`` — the same lease evidence the PR 16 live plane
+  corroborates with), survivors reconstruct its orphaned requests
+  from their own route/ledger records and re-dispatch them through
+  :func:`route_requests`. Re-prefill from the prompt is the honest
+  fallback; a surviving exported-KV file addressed to the corpse is
+  scavenged (atomic rename + payload audit) by a deterministic
+  claimer instead of burning a fresh chunk train. The ``done``
+  ledgers rebalance by VOIDING handoffs whose peer died
+  (``sent - void_sent == recv - void_recv``), so the mesh still
+  converges with zero lost requests.
+- **dynamic membership**: a joiner announces itself by writing its
+  consensus lease (``Consensus.alive`` discovers ranks from the
+  board, not ``range(world)``), fast-forwards past pruned agreement
+  history, and votes in a ``member`` round; the adopted decision
+  carries the routing high-water mark so the joiner never re-routes
+  already-assigned work.
+- **live rebalancing**: a joiner (or a survivor inheriting a corpse's
+  share) picks up queued and re-dispatched work through the existing
+  load-shaped admission votes — the page-pool-pressure term in
+  :func:`sched.ttfc_key` keeps the handoff sane.
+
+Exactly-once honesty: the mesh guarantees every submitted request
+FINISHES exactly once in the final converged ledger, but a request
+whose owner died after serving it is re-served by a survivor — its
+result is produced again (the corpse's in-memory copy is gone). A
+consumer that already read a result from a rank that later died may
+observe the re-serve; de-duplication by ``trace`` id is the
+consumer's contract (README "Elastic serving mesh" table).
 
 KV handoff
 ----------
@@ -92,10 +129,11 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..distributed.consensus import Consensus
+from ..distributed.consensus import Consensus, lease_ages
 from ..profiler import disttrace as _disttrace
 from ..profiler import events as _pevents
 from ..profiler.metrics import registry as _registry
+from ..utils.retry import RetryError, retry as _retry
 from .engine import ServingConfig, ServingEngine
 from .sched import ttfc_key
 
@@ -146,27 +184,52 @@ class HandoffChannel:
     ``.tmp`` nobody reads. ``poll`` consumes arrivals for THIS rank.
     ``pre_commit`` is the chaos seam: tests point it at
     ``mp_mesh.chaos_point`` to kill a rank between the payload bytes
-    landing and the handoff becoming visible."""
+    landing and the handoff becoming visible.
+
+    Transient I/O (ISSUE 17 satellite): every filesystem touch rides
+    :func:`utils.retry.retry` exponential backoff against
+    EINTR/ENOSPC-class ``OSError`` — a flaky shared dir must not look
+    like a dead peer to the elastic mesh's death detector. Retries are
+    counted into ``serving/handoff_retries``."""
 
     #: chaos hook, invoked between tmp-write and the atomic rename
     pre_commit = staticmethod(lambda: None)
+
+    #: transient-I/O retry policy; class attributes so chaos tests can
+    #: tighten the schedule without monkeypatching utils.retry
+    retry_attempts = 4
+    retry_base_delay_s = 0.01
 
     def __init__(self, directory: str, rank: int):
         self.dir = directory
         self.rank = int(rank)
         os.makedirs(directory, exist_ok=True)
 
+    def _retry_io(self, fn):
+        def _count(_i, _e, _d):
+            _registry().counter("serving/handoff_retries").add(1)
+        return _retry(fn, attempts=self.retry_attempts,
+                      base_delay=self.retry_base_delay_s,
+                      exceptions=(OSError,), on_retry=_count)
+
+    def _path_to(self, gid: int, dst: int) -> str:
+        return os.path.join(self.dir, f"h-{gid:08d}-to{dst}.npz")
+
     def send(self, dst: int, gid: int, payload: dict) -> int:
         """Ship ``payload`` to rank ``dst``; returns payload bytes."""
-        final = os.path.join(self.dir, f"h-{gid:08d}-to{dst}.npz")
+        final = self._path_to(gid, dst)
         tmp = final + f".tmp{os.getpid()}"
         arrays = {}
         for k, v in payload.items():
             arrays[k] = np.asarray(v)
-        with open(tmp, "wb") as f:
-            np.savez(f, **arrays)
+
+        def _write():
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+
+        self._retry_io(_write)
         HandoffChannel.pre_commit()
-        os.rename(tmp, final)
+        self._retry_io(lambda: os.rename(tmp, final))
         return sum(a.nbytes for a in arrays.values())
 
     def poll(self) -> List[Tuple[int, dict]]:
@@ -182,18 +245,66 @@ class HandoffChannel:
                 continue
             path = os.path.join(self.dir, n)
             gid = int(n[2:10])
+
+            def _load(p=path):
+                with np.load(p) as z:
+                    return {k: z[k] for k in z.files}
+
             try:
-                with np.load(path) as z:
-                    payload = {k: z[k] for k in z.files}
-            except (OSError, ValueError):
-                continue            # racing rename: next poll
+                payload = self._retry_io(_load)
+            except (RetryError, ValueError):
+                continue            # racing rename / torn: next poll
             for k in ("orig_prompt_len", "max_new", "first_token",
                       "n_tokens", "preempts"):
                 if k in payload:
                     payload[k] = int(payload[k])
-            os.unlink(path)
+            try:
+                self._retry_io(lambda p=path: os.unlink(p))
+            except RetryError:
+                continue            # must not import without consuming
             out.append((gid, payload))
         return out
+
+    def scavenge(self, gid: int, dead_rank: int) -> bool:
+        """Claim a DEAD rank's unconsumed payload for this rank
+        (ISSUE 17 re-dispatch): atomically rename
+        ``h-<gid>-to<dead>.npz`` to address this rank, then audit that
+        the payload actually loads with the keys an import needs — a
+        torn or inconsistent file is deleted, not imported (the caller
+        falls back to re-prefill, the honest path). Only safe once the
+        mesh has DECLARED the addressee dead: a live addressee could
+        race the rename with its own poll. Returns True when the
+        payload is claimed and clean (the normal ``poll`` imports it
+        next heartbeat)."""
+        src = self._path_to(gid, dead_rank)
+        dst = self._path_to(gid, self.rank)
+        try:
+            os.rename(src, dst)
+        except OSError:
+            if not os.path.exists(dst):   # nothing to claim
+                return False
+        try:
+            with np.load(dst) as z:
+                keys = set(z.files)
+                need = {"prompt", "orig_prompt_len", "max_new",
+                        "first_token", "key", "n_tokens", "kv_dtype",
+                        "k", "v"}
+                if not need <= keys:
+                    raise ValueError(
+                        f"payload missing {sorted(need - keys)}")
+                if int(z["n_tokens"]) < 1 or \
+                        z["k"].shape != z["v"].shape:
+                    raise ValueError("inconsistent KV payload")
+        except (OSError, ValueError, KeyError):
+            try:
+                os.unlink(dst)
+            except OSError:
+                pass
+            _registry().counter(
+                "serving/handoff_scavenge_failed").add(1)
+            return False
+        _registry().counter("serving/handoffs_scavenged").add(1)
+        return True
 
 
 def route_requests(votes: Dict[int, dict]) -> dict:
@@ -220,12 +331,22 @@ def route_requests(votes: Dict[int, dict]) -> dict:
     backlog/p95 keys) degrade to a queue-depth estimate, so a
     mixed-version mesh still orders sanely. Deterministic tie-break
     toward the lower rank; same consensus round as before.
+
+    Elastic extensions (ISSUE 17): the round's high-water mark is the
+    MAX of the voters' (a joiner that fast-forwarded past pruned admit
+    history votes a low hwm — every gid below the mesh's real mark was
+    already assigned in decisions the lagging voter adopts in order,
+    so re-routing them would double-serve); and a vote may carry a
+    ``requeue`` list — gids whose assigned rank the mesh declared dead
+    — which are re-routed through the same load-shaped pick, after
+    the fresh range (their lens ride ``pending`` like any unrouted
+    gid's).
     """
     topo = votes[min(votes)]["topology"]
     prefill = list(topo["prefill"])
     decode = list(topo["decode"])
     threshold = int(topo["threshold"])
-    routed = min(int(v["routed"]) for v in votes.values())
+    routed = max(int(v["routed"]) for v in votes.values())
     upto = min(int(v["seen"]) for v in votes.values())
     lens: Dict[int, int] = {}
     for v in votes.values():
@@ -243,11 +364,7 @@ def route_requests(votes: Dict[int, dict]) -> dict:
         return min(ranks, key=lambda r: ttfc_key(
             votes, r, extra_tokens, extra_reqs))
 
-    assign = {}
-    for gid in range(routed, upto):
-        plen = lens.get(gid)
-        if plen is None:            # no voter carried it: leave queued
-            break
+    def place(gid, plen, assign):
         d = pick(decode)
         extra_reqs[d] += 1
         p = -1
@@ -258,7 +375,24 @@ def route_requests(votes: Dict[int, dict]) -> dict:
         else:
             extra_tokens[d] += plen   # short prompts prefill where
         assign[str(gid)] = [p, d]     # they decode
-    return {"assign": assign, "routed": routed + len(assign)}
+
+    assign: Dict[str, List[int]] = {}
+    fresh = 0
+    for gid in range(routed, upto):
+        plen = lens.get(gid)
+        if plen is None:            # no voter carried it: leave queued
+            break
+        place(gid, plen, assign)
+        fresh += 1
+    requeue = sorted({int(g) for v in votes.values()
+                      for g in v.get("requeue", [])}
+                     - {int(g) for g in assign})
+    for gid in requeue:
+        plen = lens.get(gid)
+        if plen is None:
+            continue                # no voter carries it any more
+        place(gid, plen, assign)
+    return {"assign": assign, "routed": routed + fresh}
 
 
 def _clock_reducer(votes: Dict[int, dict]) -> dict:
@@ -272,6 +406,42 @@ def _clock_reducer(votes: Dict[int, dict]) -> dict:
             "offsets": {str(r): {"offset_s": v.get("offset_s"),
                                  "unc_s": v.get("unc_s")}
                         for r, v in sorted(votes.items())}}
+
+
+def _member_reducer(votes: Dict[int, dict]) -> dict:
+    """The ``member`` round's reducer (ISSUE 17): one agreed member
+    set from the voters' views. Pure and deterministic:
+
+    - the member table is the UNION of the voters' tables (iterated
+      rank-sorted, first writer wins on role), plus every voter's own
+      announcement (``me``/``role``) — that is how a joiner enters;
+    - the dead set is the union of the voters' observations MINUS the
+      voters themselves (casting a vote is proof of life — a rank can
+      never be voted out of a round it is participating in), and dead
+      ranks leave the member table;
+    - ``routed`` is the MAX of the voters' admission high-water marks:
+      the sync point a joiner adopts so it never re-routes work the
+      mesh assigned before it arrived.
+    """
+    members: Dict[int, str] = {}
+    for r in sorted(votes):
+        v = votes[r]
+        for k, role in sorted((v.get("members") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+            members.setdefault(int(k), str(role))
+        me = v.get("me")
+        if me is not None:
+            members.setdefault(int(me), str(v.get("role", "decode")))
+    dead = set()
+    for v in votes.values():
+        dead.update(int(d) for d in v.get("dead", []))
+    dead -= set(votes)
+    for d in sorted(dead):
+        members.pop(d, None)
+    routed = max([int(v.get("routed", 0)) for v in votes.values()]
+                 or [0])
+    return {"members": {str(r): members[r] for r in sorted(members)},
+            "dead": sorted(dead), "routed": routed}
 
 
 @dataclass
@@ -318,6 +488,8 @@ class DisaggServer:
                  long_prompt_threshold: Optional[int] = None,
                  consensus: Optional[Consensus] = None,
                  lease_s: float = 5.0,
+                 dead_after_s: Optional[float] = None,
+                 join: bool = False,
                  clock_skew_s: Optional[float] = None,
                  clock_resync_s: float = 0.0):
         self.mesh = mesh
@@ -352,6 +524,49 @@ class DisaggServer:
         self.handoffs_recv = 0
         self._done_verdict: Optional[bool] = None
         self._done_open_t = 0.0
+        # -- elastic membership (ISSUE 17) ------------------------------
+        #: the agreed member set {rank: "prefill"|"decode"} — routing
+        #: topology, done ledgers, and death observation all follow
+        #: THIS, not the static MeshSpec. A joiner starts knowing only
+        #: itself (the member round teaches it the rest); everyone
+        #: else seeds from the spec.
+        my_role = "prefill" if mesh.is_prefill else "decode"
+        if join:
+            self._members: Dict[int, str] = {mesh.rank: my_role}
+        else:
+            self._members = {
+                r: ("prefill" if r in mesh.prefill_ranks
+                    else "decode")
+                for r in range(mesh.world)}
+        #: a member is DECLARED dead when its consensus lease is stale
+        #: past this — 2 leases by default, the same double-evidence
+        #: margin the PR 16 live plane demands before flagging
+        self.dead_after_s = (2.0 * lease_s if dead_after_s is None
+                             else float(dead_after_s))
+        #: False until the member round admits this rank: a joiner
+        #: adopts the agreed routing high-water mark BEFORE it may
+        #: influence routing, so it can never re-route assigned work
+        self._joined = not join
+        self._voted_member = False
+        self._member_open_t = 0.0
+        self._member_epoch = -1
+        self._dead: set = set()
+        #: gids orphaned by a death, waiting for re-routing — they ride
+        #: the admission vote's ``requeue`` list until an assignment
+        #: for them publishes
+        self._requeued: set = set()
+        #: per-gid handoff ledgers + void counters: the done round
+        #: balances ``sent - void_sent == recv - void_recv``, so a
+        #: handoff whose peer died REBALANCES instead of wedging the
+        #: mesh (the monotonic sent/recv counters survive for bench)
+        self._sent_log: Dict[int, int] = {}
+        self._recv_log: Dict[int, int] = {}
+        self.handoffs_void_sent = 0
+        self.handoffs_void_recv = 0
+        #: gids whose KV payload this rank claimed off a corpse: their
+        #: import counts void (the sender's ledger entry was voided
+        #: with the sender)
+        self._scavenged: set = set()
         # -- cross-host tracing (ISSUE 14) ------------------------------
         #: injected test skew applied to EVERY wall stamp this server
         #: makes (submit/export/import) AND to its clock-sync samples —
@@ -390,6 +605,8 @@ class DisaggServer:
         # must say so or a fast peer transiently "survives" it and
         # decides rounds alone (Consensus.start_heartbeat docstring).
         self.consensus.start_heartbeat()
+        if join:
+            self._catch_up()
 
     def close(self) -> None:
         self.consensus.stop_heartbeat()
@@ -565,9 +782,328 @@ class DisaggServer:
         return float(e["offset_s"]), (None if unc is None
                                       else float(unc))
 
+    # -- elastic membership (ISSUE 17) -------------------------------------
+    def _topology(self) -> dict:
+        """Routing topology derived from the AGREED member set — a
+        dead rank has left it, a joiner has entered it. Degenerate
+        guard: a mesh whose every decode member died routes everything
+        to the surviving ranks (they all decode) rather than crash the
+        reducer on an empty pick set."""
+        prefill = sorted(r for r, ro in self._members.items()
+                         if ro == "prefill")
+        decode = sorted(r for r, ro in self._members.items()
+                        if ro == "decode")
+        if not decode:
+            prefill, decode = [], (sorted(self._members)
+                                   or [self.mesh.rank])
+        return {"prefill": prefill, "decode": decode,
+                "threshold": self.long_prompt_threshold}
+
+    def _observe_dead(self) -> List[int]:
+        """Members whose consensus lease went stale past
+        ``dead_after_s`` — the evidence a ``member`` round is opened
+        on. The ABSENCE of a lease file is not death evidence (mesh
+        bring-up); only a lease that existed and stopped refreshing
+        is."""
+        ages = lease_ages(self.consensus.dir)
+        me = self.mesh.rank
+        return sorted(r for r in self._members
+                      if r != me and ages.get(r) is not None
+                      and ages[r] >= self.dead_after_s)
+
+    def _member_round(self) -> None:
+        """Non-blocking membership agreement: a rank OPENS a
+        ``member`` round when it observes a death or wants to join
+        (rate-limited — death evidence persists until adopted);
+        everyone else joins the pending round. Every vote carries the
+        voter's member table, so the reduced union teaches a joiner
+        the mesh and the mesh the joiner."""
+        cons = self.consensus
+        if self._voted_member:
+            dec = cons.outcome("member", reducer=_member_reducer)
+            if dec is not None:
+                self._voted_member = False
+                self._adopt_members(dec)
+            return
+        dead = self._observe_dead()
+        want = bool(dead) or not self._joined
+        now = time.monotonic()
+        if cons.pending("member") or \
+                (want and now - self._member_open_t > 0.5):
+            cons.vote("member", {
+                "members": {str(r): ro for r, ro in
+                            sorted(self._members.items())},
+                "me": self.mesh.rank,
+                "role": ("prefill" if self.mesh.is_prefill
+                         else "decode"),
+                "dead": dead,
+                "routed": self._routed_hwm,
+            })
+            self._voted_member = True
+            self._member_open_t = now
+
+    def _adopt_members(self, dec) -> None:
+        value = dec.value
+        new = {int(r): str(ro)
+               for r, ro in (value.get("members") or {}).items()}
+        dead = [int(d) for d in value.get("dead", [])]
+        old = dict(self._members)
+        self._members = new
+        self._member_epoch = int(dec.epoch)
+        me = self.mesh.rank
+        _registry().gauge("serving/mesh_members").set(float(len(new)))
+        if new and me == min(new):
+            # one membership event per transition MESH-wide (the
+            # route-event idiom): the lowest surviving member announces
+            for r in sorted(set(new) - set(old)):
+                _registry().counter("serving/member_joins").add(1)
+                _pevents.emit("member_join", member=int(r),
+                              role=new[r], epoch=int(dec.epoch))
+            for r in sorted(r for r in dead if r in old):
+                _registry().counter("serving/member_leaves").add(1)
+                _pevents.emit("member_leave", member=int(r),
+                              role=old.get(r, "decode"),
+                              epoch=int(dec.epoch),
+                              reason="lease_expired")
+        if me in new and not self._joined:
+            # admitted: adopt the agreed routing high-water mark so a
+            # joiner can never re-route work assigned before it came
+            self._joined = True
+            self._routed_hwm = max(self._routed_hwm,
+                                   int(value.get("routed", 0)))
+        if me not in new and self._joined:
+            self._on_evicted()
+            return
+        newly_dead = sorted(r for r in dead if r in old and r != me)
+        if newly_dead:
+            self._dead.update(newly_dead)
+            self._rebalance_ledgers(newly_dead)
+            self._redispatch_orphans(newly_dead)
+            self._done_verdict = None
+
+    def _rebalance_ledgers(self, newly_dead: List[int]) -> None:
+        """VOID every handoff ledger entry whose peer died: the
+        corpse's side of the count will never be voted again, so the
+        surviving side must not wedge ``_done_reducer``'s
+        sent/recv balance forever (the monotonic ``handoffs_sent`` /
+        ``handoffs_recv`` counters are untouched — bench reads them)."""
+        dead = set(newly_dead)
+        for gid, dst in list(self._sent_log.items()):
+            if dst in dead:
+                del self._sent_log[gid]
+                self.handoffs_void_sent += 1
+        for gid, src in list(self._recv_log.items()):
+            if src in dead:
+                del self._recv_log[gid]
+                self.handoffs_void_recv += 1
+
+    def _redispatch_orphans(self, newly_dead: List[int]) -> None:
+        """Reconstruct and re-dispatch every request orphaned by the
+        dead ranks, from records every survivor already holds: the
+        prompt (SPMD driver contract), the published assignment, and
+        the handoff ledgers/trace contexts.
+
+        - assigned DECODE rank died: its (possibly in-flight) result
+          is gone. If an exported-KV file addressed to it survives on
+          the channel, a deterministic claimer — pure function of
+          (member set, gid), so every survivor repoints the assignment
+          identically without another round — renames it to itself and
+          audits the payload (``HandoffChannel.scavenge``); otherwise
+          the gid re-routes from scratch through the next admission
+          round's ``requeue`` list. Re-prefill from the prompt is the
+          honest fallback, never a guessed KV state.
+        - assigned PREFILL rank died, decode owner alive: only the
+          decode owner acts, locally. Work that already landed (or a
+          complete file in flight — sends are atomic, a corpse leaves
+          only ``.tmp``) is left alone; otherwise the owner re-runs
+          the prefill itself.
+        """
+        me = self.mesh.rank
+        dead = set(newly_dead)
+        mine = set(self._local.values())
+        pending = {g for g, _ in self._pending_imports}
+        topo = self._topology()
+        live_decode = [r for r in topo["decode"] if r not in dead]
+        for gid in sorted(self._reqs):
+            req = self._reqs[gid]
+            if not req.routed or gid in self._collected:
+                continue
+            p, d = req.prefill_rank, req.decode_rank
+            if d in dead:
+                claimer = (live_decode[gid % len(live_decode)]
+                           if live_decode else -1)
+                has_file = claimer >= 0 and (
+                    os.path.exists(self.channel._path_to(gid, d)) or
+                    os.path.exists(self.channel._path_to(gid,
+                                                         claimer)))
+                if has_file:
+                    claimed = True
+                    if claimer == me:
+                        claimed = self.channel.scavenge(gid, d)
+                        if claimed:
+                            self._scavenged.add(gid)
+                            req.meta["redispatched"] = "scavenge"
+                            req.meta["redispatch_w"] = \
+                                self._walltime()
+                            _registry().counter(
+                                "serving/redispatches").add(1)
+                            _pevents.emit(
+                                "redispatch", gid=gid,
+                                trace=req.trace, mode="scavenge",
+                                dead_rank=int(d))
+                    if claimed:
+                        req.decode_rank = claimer
+                        self._assignments[gid] = (p, claimer)
+                        self._done_verdict = None
+                        continue
+                self._requeue_gid(gid, dead_rank=d)
+            elif p in dead:
+                if d != me:
+                    continue
+                if gid in mine or gid in pending or \
+                        gid in self._handoff_ctx or \
+                        gid in self._scavenged:
+                    continue        # the handoff beat the death
+                if os.path.exists(self.channel._path_to(gid, me)):
+                    continue        # complete and in flight: poll()
+                self._reprefill_local(gid, dead_rank=p)
+
+    def _requeue_gid(self, gid: int, dead_rank: int) -> None:
+        """Send an orphaned gid back through routing: tear down any
+        local work under the dead assignment, mark it unrouted, and
+        let the next admission round's ``requeue`` list re-place it
+        (load-shaped like any fresh arrival)."""
+        req = self._reqs[gid]
+        for rid, g in list(self._local.items()):
+            if g != gid:
+                continue
+            er = self.engine._requests.get(rid)
+            if er is not None and not er.done:
+                self.engine.cancel(rid)
+            del self._local[rid]
+        req.routed = False
+        req.prefill_rank = -1
+        req.decode_rank = -1
+        self._assignments.pop(gid, None)
+        self._requeued.add(gid)
+        req.meta["redispatched"] = "requeue"
+        req.meta.setdefault("redispatch_w", self._walltime())
+        self._done_verdict = None
+        me = self.mesh.rank
+        if self._members and me == min(self._members):
+            # one re-dispatch event per gid mesh-wide (every survivor
+            # runs this symmetrically)
+            _registry().counter("serving/redispatches").add(1)
+            _pevents.emit("redispatch", gid=gid, trace=req.trace,
+                          mode="requeue", dead_rank=int(dead_rank))
+
+    def _reprefill_local(self, gid: int, *, mode: str = "reprefill",
+                         dead_rank: int = -1) -> None:
+        """Honest fallback: THIS rank re-runs the prefill from the
+        prompt it holds and decodes locally — no routing round needed,
+        the route already names this rank as the visible owner."""
+        req = self._reqs.get(gid)
+        if req is None or gid in self._collected:
+            return
+        req.meta["redispatched"] = mode
+        req.meta["redispatch_w"] = self._walltime()
+        lr = self.engine.submit(req.prompt, req.max_new,
+                                trace_id=req.trace)
+        self._local[lr] = gid
+        req.prefill_rank = -1
+        req.decode_rank = self.mesh.rank
+        req.routed = True
+        self._assignments[gid] = (-1, self.mesh.rank)
+        self._done_verdict = None
+        _registry().counter("serving/redispatches").add(1)
+        _pevents.emit("redispatch", gid=gid, trace=req.trace,
+                      mode=mode, dead_rank=int(dead_rank))
+
+    def _on_evicted(self) -> None:
+        """The mesh voted US out — a false-positive death (our lease
+        went stale while we kept running: long GC, suspended VM).
+        Survivors requeued everything assigned here, INCLUDING work we
+        already served (they cannot see our collections), so the
+        honest reaction is to become a joiner again: abandon in-flight
+        work, retract collected results (they re-serve elsewhere — the
+        at-least-once edge the README table documents), zero our side
+        of the handoff ledgers the way the survivors voided theirs,
+        and re-announce through the member round."""
+        self._joined = False
+        for rid, gid in list(self._local.items()):
+            er = self.engine._requests.get(rid)
+            if er is not None and not er.done:
+                self.engine.cancel(rid, reason="evicted")
+            del self._local[rid]
+        self._served_total -= len(self._collected)
+        for gid in self._collected:
+            req = self._reqs.get(gid)
+            if req is not None:
+                req.out = None
+                req.ttft_ms = None
+                req.ttft_unc_ms = None
+        self._collected.clear()
+        self.handoffs_void_sent = self.handoffs_sent
+        self.handoffs_void_recv = self.handoffs_recv
+        self._sent_log.clear()
+        self._recv_log.clear()
+        self._requeued.clear()
+        self._done_verdict = None
+        _registry().counter("serving/self_evictions").add(1)
+
+    def _catch_up(self) -> None:
+        """Joiner bring-up: fast-forward every agreement family past
+        pruned history (``Consensus.fast_forward``), then DRAIN the
+        surviving decisions in order — assignments park (``submit``
+        applies them when the driver replays the stream), the clock
+        table and member set adopt, and stale ``done`` verdicts are
+        discarded (a mesh that was idle-done before we joined must not
+        make OUR ``run()`` return before we served anything)."""
+        cons = self.consensus
+        for fam in ("member", "clock", "admit", "done"):
+            cons.fast_forward(fam)
+        while True:
+            dec = cons.outcome("member", reducer=_member_reducer)
+            if dec is None:
+                break
+            self._adopt_members(dec)
+        while True:
+            dec = cons.outcome("clock", reducer=_clock_reducer)
+            if dec is None:
+                break
+            self._adopt_clock(dec.value)
+        while True:
+            dec = cons.outcome("admit", reducer=route_requests)
+            if dec is None:
+                break
+            self._adopt_assignment_decision(dec)
+        while True:
+            if cons.outcome("done", reducer=_done_reducer) is None:
+                break
+        self._done_verdict = None
+
+    @property
+    def members(self) -> Dict[int, str]:
+        """The agreed member set {rank: role} as of
+        ``_member_epoch``."""
+        return dict(self._members)
+
+    @property
+    def redispatched(self) -> Dict[int, str]:
+        """{gid: mode} of requests re-dispatched after a death as
+        seen by THIS rank (mode in requeue|reprefill|scavenge) —
+        bench and tests intersect this with ``results()`` for the
+        re-served tail."""
+        return {g: r.meta["redispatched"]
+                for g, r in self._reqs.items()
+                if "redispatched" in r.meta}
+
     # -- scheduling --------------------------------------------------------
     def _unrouted(self) -> List[int]:
-        return [g for g in range(self._routed_hwm, self._next_gid)]
+        # requeued gids (orphans of a death, below the high-water
+        # mark) need routing exactly like never-routed ones
+        return sorted(set(range(self._routed_hwm, self._next_gid))
+                      | self._requeued)
 
     def _admission_round(self) -> None:
         """Non-blocking consensus admission: vote when there is
@@ -599,6 +1135,7 @@ class DisaggServer:
                 "routed": self._routed_hwm,
                 "pending": {str(g): int(self._reqs[g].prompt.shape[0])
                             for g in unrouted},
+                "requeue": sorted(self._requeued),
                 "free_pages": int(eng.pool.allocator.num_free),
                 "free_slots": int(free_slots),
                 "queued": int(len(eng._queue)) + len(eng._held_ready),
@@ -606,11 +1143,10 @@ class DisaggServer:
                 "ttft_p95_ms": round(float(p95), 3),
                 "chunk": int(eng.prefill_chunk),
                 "page_size": int(eng.pool.page_size),
-                "topology": {
-                    "prefill": list(self.mesh.prefill_ranks),
-                    "decode": list(self.mesh.decode_ranks),
-                    "threshold": self.long_prompt_threshold,
-                },
+                # topology follows the AGREED member set, not the
+                # static MeshSpec (ISSUE 17): a dead rank left it, a
+                # joiner entered it
+                "topology": self._topology(),
             }
             cons.vote("admit", vote)
             self._voted_admit = True
@@ -618,21 +1154,46 @@ class DisaggServer:
         if dec is None:
             return
         self._voted_admit = False
+        self._adopt_assignment_decision(dec)
+
+    def _adopt_assignment_decision(self, dec) -> None:
+        """Apply one published admission decision (the shared adoption
+        step of the live round and the joiner's history catch-up)."""
         assign = dec.value["assign"]
         if assign:
             _registry().counter("consensus/requests_routed") \
                 .add(len(assign))
+        me = self.mesh.rank
         for g_str, (p_rank, d_rank) in sorted(assign.items(),
                                               key=lambda kv: int(kv[0])):
             gid = int(g_str)
-            self._assignments[gid] = (int(p_rank), int(d_rank))
-            if int(d_rank) == self.mesh.rank:
+            p_rank, d_rank = int(p_rank), int(d_rank)
+            prev = self._assignments.get(gid)
+            self._assignments[gid] = (p_rank, d_rank)
+            self._requeued.discard(gid)
+            if prev is not None and prev != (p_rank, d_rank) and \
+                    gid in self._reqs and gid not in self._collected:
+                # a re-dispatch OVERWROTE a stale claim (e.g. a failed
+                # scavenge audit re-routed a gid the mesh had
+                # repointed at the claimer): tear down local work
+                # under the old assignment, re-apply under the new
+                req = self._reqs[gid]
+                for rid, g in list(self._local.items()):
+                    if g == gid:
+                        er = self.engine._requests.get(rid)
+                        if er is not None and not er.done:
+                            self.engine.cancel(rid)
+                        del self._local[rid]
+                req.routed = False
+                req.prefill_rank = -1
+                req.decode_rank = -1
+            if d_rank == me:
                 # the routing decision, as an event on the rank that
                 # will OWN the visible result (one event per request
                 # mesh-wide, not one per rank)
                 _pevents.emit("route", gid=gid,
                               trace=_disttrace.trace_id(gid),
-                              prefill=int(p_rank), decode=int(d_rank))
+                              prefill=p_rank, decode=d_rank)
             if gid in self._reqs:
                 self._apply_assignment(gid)
             # else: routed before our driver submitted it — submit()
@@ -656,6 +1217,14 @@ class DisaggServer:
             lr = self.engine.submit(req.prompt, req.max_new,
                                     trace_id=req.trace)
             self._local[lr] = gid
+        else:
+            return
+        if "redispatched" in req.meta:
+            # the re-dispatch clock restarts at the actual re-submit:
+            # TTFT accounting charges the user wait from the ORIGINAL
+            # submit up to here, then the engine pair takes over
+            # (same-host wall stamps — no clock correction involved)
+            req.meta["redispatch_w"] = self._walltime()
 
     def _export_held(self) -> None:
         eng = self.engine
@@ -687,17 +1256,63 @@ class DisaggServer:
             self.channel.send(req.decode_rank, gid, payload)
             eng.release_exported(rid)
             self.handoffs_sent += 1
+            # per-gid ledger entry: voided if the receiver dies before
+            # the mesh's done balance can count its recv
+            self._sent_log[gid] = int(req.decode_rank)
+
+    @staticmethod
+    def _payload_src(payload: dict) -> Optional[int]:
+        """Sender rank from the payload's trace context (None for a
+        pre-ISSUE-14 payload without one)."""
+        raw = payload.get("trace_ctx")
+        if raw is None:
+            return None
+        try:
+            return int(json.loads(str(raw)).get("prefill_rank", -1))
+        except (ValueError, TypeError):
+            return None
+
+    def _note_recv(self, gid: int, payload: dict) -> None:
+        """Recv-side ledger bookkeeping: a scavenged payload (or one
+        whose sender the mesh already declared dead) counts VOID — the
+        sender's side of the balance is gone with the sender."""
+        self.handoffs_recv += 1
+        if gid in self._scavenged:
+            self._scavenged.discard(gid)
+            self.handoffs_void_recv += 1
+            return
+        src = self._payload_src(payload)
+        if src is None:
+            return                    # legacy payload: unvoidable
+        if src in self._dead:
+            self.handoffs_void_recv += 1
+        else:
+            self._recv_log[gid] = src
 
     def _import_arrivals(self) -> None:
         self._pending_imports.extend(self.channel.poll())
         still: List[Tuple[int, dict]] = []
         for gid, payload in self._pending_imports:
-            lr = self.engine.admit_prefilled(payload)
+            try:
+                lr = self.engine.admit_prefilled(payload)
+            except ValueError:
+                # the engine's admission audit rejected the payload
+                # (page count / dtype — e.g. a scavenged file from a
+                # mismatched corpse): never a torn import into the
+                # pool — drop it and re-prefill locally, the honest
+                # fallback
+                _registry().counter(
+                    "serving/handoff_import_rejected").add(1)
+                src = self._payload_src(payload)
+                self._note_recv(gid, payload)
+                self._reprefill_local(
+                    gid, dead_rank=-1 if src is None else src)
+                continue
             if lr is None:
                 still.append((gid, payload))    # no slot/pages yet
                 continue
             self._local[lr] = gid
-            self.handoffs_recv += 1
+            self._note_recv(gid, payload)
             # stamp the import wall moment + keep the payload's trace
             # context: together with the agreed clock offsets they make
             # the handed-off request's end-to-end TTFT computable HERE
@@ -727,6 +1342,9 @@ class DisaggServer:
             er = eng._requests.get(rid)
             if er is None or not er.done:
                 continue
+            if getattr(er, "canceled", False):
+                del self._local[rid]    # re-dispatched away: no result
+                continue
             if gid in self._collected:
                 continue
             req = self._reqs[gid]
@@ -750,6 +1368,17 @@ class DisaggServer:
                 if req.prefill_rank in (-1, self.mesh.rank):
                     req.ttft_ms = \
                         (er.first_token_t - er.submit_t) * 1e3
+                    rw = req.meta.get("redispatch_w")
+                    if rw is not None:
+                        # a re-dispatched request's first token only
+                        # exists because of the re-submit: the user
+                        # waited from the ORIGINAL submit. Both wall
+                        # stamps are this host's — no clock
+                        # correction involved. (A handed-off requeue
+                        # needs no term: its e2e path already anchors
+                        # at the original submit_w from the ctx.)
+                        req.ttft_ms += max(
+                            0.0, (rw - req.submit_w) * 1e3)
                     # the live plane's mesh TTFT sketch (ISSUE 16):
                     # the engine's own serving/ttft_ms is bogus-local
                     # for imported requests, so the coordinator owns
@@ -814,6 +1443,7 @@ class DisaggServer:
         dispatched device work (the driver's idle signal)."""
         self.consensus.heartbeat()
         self._clock_round()
+        self._member_round()
         self._admission_round()
         self._import_arrivals()
         progressed = self.engine.step()
@@ -874,6 +1504,8 @@ class DisaggServer:
             cons.vote("done", {"idle": q,
                                "sent": self.handoffs_sent,
                                "recv": self.handoffs_recv,
+                               "void_sent": self.handoffs_void_sent,
+                               "void_recv": self.handoffs_void_recv,
                                "served": self._served_total,
                                "seen": self._next_gid,
                                "routed": self._routed_hwm})
@@ -895,9 +1527,13 @@ class DisaggServer:
                 raise TimeoutError(
                     f"disagg mesh did not drain: rank {self.mesh.rank} "
                     f"unrouted={len(self._unrouted())} "
+                    f"requeued={len(self._requeued)} "
                     f"held={len(self.engine._held_ready)} "
                     f"imports={len(self._pending_imports)} "
-                    f"sent={self.handoffs_sent} recv={self.handoffs_recv}")
+                    f"members={sorted(self._members)} "
+                    f"sent={self.handoffs_sent} recv={self.handoffs_recv} "
+                    f"void={self.handoffs_void_sent}/"
+                    f"{self.handoffs_void_recv}")
         return self.results()
 
     # -- results -----------------------------------------------------------
@@ -913,9 +1549,15 @@ class DisaggServer:
         consuming ``results()``; mesh-wide done accounting survives
         (``_served_total`` is a monotonic counter, not a scan)."""
         drop_rids = []
+        canceled_rids = []
         for rid, gid in self._local.items():
             er = self.engine._requests.get(rid)
             if er is None or not er.done:
+                continue
+            if getattr(er, "canceled", False):
+                # re-dispatched away: free the rid, but KEEP the gid's
+                # mesh state — it lives (or lived) somewhere else
+                canceled_rids.append(rid)
                 continue
             req = self._reqs.get(gid)
             exported = req is not None and \
@@ -923,6 +1565,8 @@ class DisaggServer:
                 req.decode_rank != self.mesh.rank
             if gid in self._collected or exported:
                 drop_rids.append(rid)
+        for rid in canceled_rids:
+            self._local.pop(rid)
         for rid in drop_rids:
             gid = self._local.pop(rid)
             self._reqs.pop(gid, None)
@@ -985,6 +1629,13 @@ class DisaggServer:
             "clock": _disttrace.clock_state(),
             "handoffs_sent": self.handoffs_sent,
             "handoffs_recv": self.handoffs_recv,
+            "handoffs_void_sent": self.handoffs_void_sent,
+            "handoffs_void_recv": self.handoffs_void_recv,
+            "members": {str(r): ro
+                        for r, ro in sorted(self._members.items())},
+            "member_epoch": self._member_epoch,
+            "redispatched": {str(g): m
+                             for g, m in self.redispatched.items()},
         }
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w") as f:
@@ -1003,10 +1654,20 @@ def _done_reducer(votes: Dict[int, dict]) -> bool:
     actually served (each gid finishes on exactly one rank, so served
     counts sum to the stream length). The served term is what makes a
     round decided while one rank's vote is transiently missing come out
-    False instead of declaring victory over its unserved work."""
+    False instead of declaring victory over its unserved work.
+
+    Elastic rebalance (ISSUE 17): the balance nets out VOIDED
+    handoffs — entries whose peer the mesh declared dead, whose side
+    of the count will never be voted — so a death rebalances the
+    ledgers instead of wedging them (``sent - void_sent ==
+    recv - void_recv``; pre-elastic votes default the void terms to
+    0). ``served == seen`` still holds because survivors re-dispatch
+    and re-serve every orphaned gid."""
     idle = all(v["idle"] for v in votes.values())
-    sent = sum(int(v["sent"]) for v in votes.values())
-    recv = sum(int(v["recv"]) for v in votes.values())
+    sent = sum(int(v["sent"]) - int(v.get("void_sent", 0))
+               for v in votes.values())
+    recv = sum(int(v["recv"]) - int(v.get("void_recv", 0))
+               for v in votes.values())
     served = sum(int(v["served"]) for v in votes.values())
     seen = {int(v["seen"]) for v in votes.values()}
     routed = {int(v["routed"]) for v in votes.values()}

@@ -275,6 +275,11 @@ class Request:
     #: carried across the KV handoff so the decode rank's events join
     #: the same trace. None (local-only request) emits no attr.
     trace_id: Optional[str] = None
+    #: abandoned without a result (ISSUE 17 orphan bookkeeping): set
+    #: by ``cancel()`` when the mesh re-dispatched this gid elsewhere —
+    #: ``done`` is True so the scheduler forgets it, but it must never
+    #: surface as a served output (``run()``/coordinators skip it)
+    canceled: bool = False
 
 
 class _Inflight:
@@ -753,7 +758,8 @@ class ServingEngine:
         done = self._tokens_done() - tokens0
         _registry().gauge("serving/tokens_per_sec").set(done / wall)
         return {rid: np.asarray(r.out, np.int32)
-                for rid, r in self._requests.items() if r.done}
+                for rid, r in self._requests.items()
+                if r.done and not r.canceled}
 
     def drain(self, target: int = 0) -> None:
         """Materialize in-flight ticks until at most ``target`` remain."""
@@ -768,6 +774,44 @@ class ServingEngine:
         """Forget finished requests (long-running host keeps memory flat)."""
         self._requests = {rid: r for rid, r in self._requests.items()
                           if not r.done}
+
+    def cancel(self, rid: int, reason: str = "redispatch") -> bool:
+        """Abandon a request wherever it stands — queued, resident
+        (prefilling or decoding), or held-ready — freeing its slot and
+        pages WITHOUT producing a result (ISSUE 17 orphan bookkeeping:
+        when the mesh re-dispatches a gid away from this rank, the
+        stale local work must be torn down or it would double-serve).
+        Drains in-flight ticks first (a slot cannot be released under
+        a tick that still carries its row), releases the slot/pages,
+        marks the request done+canceled so the scheduler forgets it,
+        and emits a ``cancel`` event. Returns False for an unknown or
+        already-finished request (idempotent)."""
+        req = self._requests.get(rid)
+        if req is None or req.done:
+            return False
+        if any(r.rid == rid for r in self._queue):
+            self._queue = type(self._queue)(
+                r for r in self._queue if r.rid != rid)
+        elif rid in self._slot_rid:
+            # rare control-plane op: materializing the in-flight
+            # window is the price of releasing a live slot safely
+            self._drain(0)
+            if rid in self._slot_rid:     # not finished by the drain
+                slot = self._slot_rid.index(rid)
+                self._spec_reset(slot)
+                self._sched.note_release(slot)
+                self.pool.release_slot(slot)
+                self._slot_rid[slot] = None
+                self._slot_len[slot] = 0
+        if req.done:                      # the drain finished it for
+            return False                  # real — a result exists
+        self._held_ready.discard(rid)
+        req.done = True
+        req.canceled = True
+        req.out = []
+        _registry().counter("serving/requests_canceled").add(1)
+        self._emit("cancel", rid, reason=reason)
+        return True
 
     # ------------------------------------------------------------------
     # KV handoff (ISSUE 13, serving/disagg.py): a prefill-group engine

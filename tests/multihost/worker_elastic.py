@@ -1,0 +1,223 @@
+"""Elastic serving mesh worker (ISSUE 17): real processes, real
+corpses, real joiners.
+
+Unlike worker_serving.py this worker uses ``init_env_only()`` — NO
+``jax.distributed.initialize``. Two container truths force that (and
+the elastic control plane makes it the honest choice): the jax
+coordination service cannot rendezvous a process that was not in the
+original world (so a mid-run joiner could never come up), and its
+fatal-error poller aborts survivors once it notices a SIGKILLed peer
+(so a kill-one leg could never drain). The elastic mesh's control
+plane is the shared board + handoff dir — exactly what these legs
+must prove — and per-rank device compute needs no collectives.
+
+Modes (argv: out_dir mode):
+  kill — ranks 0..2, symmetric decode mesh, one shared Poisson-timed
+         request stream per rank (SPMD driver contract). Rank 0 drops
+         ``kill.ready`` once the whole stream is routed and results
+         are flowing; the DRIVER then SIGKILLs rank 2. Survivors must
+         re-dispatch the corpse's orphans and finish EVERY request
+         exactly once, bitwise the dense reference, with balanced
+         void-netted ledgers — and rank 0's live aggregator must end
+         with membership {0, 1}.
+  join — ranks 0,1 drain wave 1, rank 0 drops ``wave1.done``; the
+         driver spawns rank 2 (``join=True``). Everyone submits wave
+         2 only after the member round admits the joiner, so the
+         load-shaped router can actually spill onto it. The joiner
+         must serve routed traffic; rank 0's final mesh_status must
+         list it in membership.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir, "tools"))
+import mp_mesh  # noqa: E402
+
+MAX_NEW = 6
+CFG = dict(num_slots=2, page_size=8, pages_per_slot=4,
+           prefill_chunk=8)
+KILL_LENS = (16, 4, 12, 6, 18, 5, 10, 7)
+JOIN_WAVE1 = (4, 6)
+JOIN_WAVE2 = (4, 6, 5, 7, 4, 6)
+POISSON_MEAN_S = 0.06
+
+
+def build(lens):
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt_tiny
+
+    paddle.seed(0)
+    net = gpt_tiny(initializer_range=0.2)
+    net.eval()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 128, (t,)).astype(np.int32)
+               for t in lens]
+    return net, prompts
+
+
+def reference_outputs(net, prompts):
+    import numpy as np
+    import paddle_tpu as paddle
+
+    out = {}
+    for g, p in enumerate(prompts):
+        ids, _ = net.generate(paddle.to_tensor(p[None]),
+                              max_new_tokens=MAX_NEW)
+        out[g] = np.asarray(ids.numpy()[0])
+    return out
+
+
+def drive(srv, pred, deadline_s, what):
+    deadline = time.monotonic() + deadline_s
+    while not pred():
+        srv.step()
+        if time.monotonic() > deadline:
+            raise SystemExit(
+                f"rank {srv.mesh.rank}: timeout driving {what}: "
+                f"requeued={sorted(srv._requeued)} "
+                f"members={sorted(srv._members)} "
+                f"served={sorted(srv.results())} "
+                f"verdict={srv._done_verdict}")
+        time.sleep(0.005)
+
+
+def main():
+    out_dir, mode = sys.argv[1], sys.argv[2]
+    rank, world = mp_mesh.init_env_only()
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.serving import (DisaggServer, MeshSpec,
+                                    ServingConfig)
+
+    sink_root = os.path.join(out_dir, "sink")
+    # env-only init means the sink cannot auto-detect rank/world from
+    # jax.distributed — pass them, or three processes share one file
+    profiler.enable_sink(sink_root, per_rank_subdir=True, rank=rank,
+                         interval_s=0.5)
+    shared = os.path.join(out_dir, "shared")
+    board = os.path.join(shared, "board")
+    ok = os.path.join(out_dir, f"ok.{rank}")
+
+    if mode == "kill":
+        import numpy as np
+
+        net, prompts = build(KILL_LENS)
+        srv = DisaggServer(net, ServingConfig(**CFG),
+                           MeshSpec(rank, 3, prefill_ranks=()),
+                           shared, lease_s=1.0)
+        if rank == 2:
+            # pin the victim's work in flight: it heartbeats, routes
+            # and decodes honestly but never publishes a finished
+            # request, so the mesh cannot drain before the driver's
+            # SIGKILL lands — the kill is guaranteed to orphan real
+            # assigned gids instead of racing the drain (the organic
+            # interleavings are covered in-process by
+            # tests/test_elastic_serving.py)
+            srv._collect_finished = lambda: None
+        agg = None
+        if rank == 0:
+            from paddle_tpu.profiler.live import LiveAggregator
+
+            agg = LiveAggregator(sink_root, interval_s=0.3,
+                                 staleness_s=30.0, world=3,
+                                 board_dir=board, lease_s=1.0,
+                                 emit_alerts=False).start()
+        # Poisson arrivals: the same seeded schedule on every rank
+        # (SPMD stream contract) — steps keep the mesh live between
+        # arrivals, which is what makes the kill land mid-flight
+        gaps = np.random.RandomState(7).exponential(
+            POISSON_MEAN_S, len(prompts))
+        for p, gap in zip(prompts, gaps):
+            until = time.monotonic() + float(gap)
+            while time.monotonic() < until:
+                srv.step()
+            srv.submit(p, MAX_NEW)
+        if rank == 0:
+            drive(srv, lambda: srv._routed_hwm >= len(prompts)
+                  and len(srv.results()) >= 1, 120.0, "pre-kill load")
+            with open(os.path.join(out_dir, "kill.ready"), "w") as f:
+                f.write("ready\n")
+        # rank 2 just keeps serving until the driver's SIGKILL; the
+        # survivors drain to the agreed done verdict
+        drive(srv, lambda: bool(srv._done_verdict), 180.0, "drain")
+        assert srv.check_consistency() == [], srv.check_consistency()
+        assert sorted(srv._members) == [0, 1], srv._members
+        # bitwise: everything served HERE matches the dense stream
+        want = reference_outputs(net, prompts)
+        for g, got in srv.results().items():
+            np.testing.assert_array_equal(got, want[g])
+        srv.write_results(os.path.join(out_dir,
+                                       f"results.{rank}.json"))
+        profiler.disable_sink()          # os._exit skips atexit
+        if agg is not None:
+            mp_mesh.wait_for_files([os.path.join(out_dir, "ok.1")],
+                                   timeout_s=60.0)
+            agg.stop()                   # final membership on disk
+            st = agg.status
+            assert st is not None, "aggregator never ticked"
+            assert st["membership"] is not None, st
+            assert sorted(st["membership"]["members"]) == ["0", "1"], \
+                st["membership"]
+        mp_mesh.finish(ok)
+
+    # ---- join mode ----
+    import numpy as np
+
+    net, all_prompts = build(JOIN_WAVE1 + JOIN_WAVE2)
+    wave1 = all_prompts[:len(JOIN_WAVE1)]
+    wave2 = all_prompts[len(JOIN_WAVE1):]
+    joiner = rank == 2
+    spec = (MeshSpec(2, 3, prefill_ranks=()) if joiner
+            else MeshSpec(rank, 2, prefill_ranks=()))
+    # lease_s is generous here: the joiner is a FRESH process whose
+    # first prefill/decode steps pay jax compiles — a single long
+    # step must not read as a death (the kill leg, whose subject IS
+    # detection latency, keeps the tight 1 s lease)
+    srv = DisaggServer(net, ServingConfig(**CFG), spec, shared,
+                       lease_s=3.0, join=joiner)
+    agg = None
+    if rank == 0:
+        from paddle_tpu.profiler.live import LiveAggregator
+
+        agg = LiveAggregator(sink_root, interval_s=0.3,
+                             staleness_s=30.0, board_dir=board,
+                             lease_s=1.0, emit_alerts=False).start()
+    # every rank replays the same stream: the joiner re-submits wave
+    # 1 (already served — routed history fast-forwards past it)
+    for p in wave1:
+        srv.submit(p, MAX_NEW)
+    if not joiner:
+        drive(srv, lambda: bool(srv._done_verdict), 120.0, "wave1")
+        if rank == 0:
+            with open(os.path.join(out_dir, "wave1.done"), "w") as f:
+                f.write("done\n")
+    # wave 2 is held until the member round ADMITS the joiner — the
+    # router can only spill onto a member
+    drive(srv, lambda: 2 in srv.members and srv._joined, 120.0,
+          "admission")
+    for p in wave2:
+        srv.submit(p, MAX_NEW)
+    drive(srv, lambda: bool(srv._done_verdict), 180.0, "wave2")
+    assert srv.check_consistency() == [], srv.check_consistency()
+    assert sorted(srv._members) == [0, 1, 2], srv._members
+    want = reference_outputs(net, all_prompts)
+    for g, got in srv.results().items():
+        np.testing.assert_array_equal(got, want[g])
+    srv.write_results(os.path.join(out_dir, f"results.{rank}.json"))
+    profiler.disable_sink()
+    if agg is not None:
+        mp_mesh.wait_for_files([os.path.join(out_dir, "ok.1"),
+                                os.path.join(out_dir, "ok.2")],
+                               timeout_s=60.0)
+        agg.stop()
+        st = agg.status
+        assert st is not None and st["membership"] is not None, st
+        assert "2" in st["membership"]["members"], st["membership"]
+        assert st["world"] == 3, st["world"]
+    mp_mesh.finish(ok)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,499 @@
+"""Elastic serving mesh (ISSUE 17): dead-rank re-dispatch, dynamic
+membership, live rebalancing — in-process protocol tests (logical
+ranks drive their DisaggServers step-by-step over a shared board, so
+every death interleaving is exact and deterministic). The REAL
+N-process chaos legs live in tests/multihost/test_elastic_mesh.py.
+
+Interleavings pinned here (the re-dispatch accounting satellite):
+- died BEFORE export: the orphan re-routes from scratch (requeue);
+- died MID-handoff (exported-KV file addressed to the corpse
+  survives): the deterministic claimer scavenges the payload instead
+  of burning a fresh chunk train;
+- died WHILE decoding (payload consumed): honest re-prefill via
+  requeue.
+Every scenario must converge with ZERO lost requests, no duplicate
+finishes, balanced (void-netted) handoff ledgers, clean pool audits on
+the survivors, and BITWISE the dense single-host outputs — greedy
+re-dispatch replays the same deterministic stream.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.profiler import events as pevents
+from paddle_tpu.profiler.metrics import registry
+from paddle_tpu.serving import (DisaggServer, HandoffChannel, MeshSpec,
+                                ServingConfig, route_requests)
+from paddle_tpu.serving.disagg import _member_reducer
+from paddle_tpu.utils.retry import RetryError
+
+pytestmark = pytest.mark.serving
+
+CFG = dict(num_slots=2, page_size=8, pages_per_slot=4, prefill_chunk=8)
+MAX_NEW = 6
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    net = gpt_tiny(initializer_range=0.2)
+    net.eval()
+    return net
+
+
+def _prompts(lens, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 128, (t,)).astype(np.int32) for t in lens]
+
+
+def _dense(net, prompt, max_new=MAX_NEW):
+    ids, _ = net.generate(paddle.to_tensor(prompt[None]),
+                          max_new_tokens=max_new)
+    return ids.numpy()[0]
+
+
+def _mesh(tmp_path, net, ranks, world, prefill_ranks=(0,), **kw):
+    kw.setdefault("lease_s", 0.5)
+    return [DisaggServer(net, ServingConfig(**CFG),
+                         MeshSpec(r, world,
+                                  prefill_ranks=prefill_ranks),
+                         str(tmp_path), **kw)
+            for r in ranks]
+
+
+def _kill(srv):
+    """In-process death: the heartbeat stops and the lease is
+    backdated past any staleness window — exactly what a killed
+    process looks like on the board. The server is never stepped
+    again."""
+    srv.close()
+    lease = os.path.join(srv.consensus.dir,
+                         f"lease.{srv.mesh.rank}")
+    t = time.time() - 60.0
+    os.utime(lease, (t, t))
+
+
+def _drive(servers, pred, timeout_s=240.0, label=""):
+    deadline = time.monotonic() + timeout_s
+    while not pred():
+        for s in servers:
+            s.step()
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"drive timeout ({label}): " + " | ".join(
+                    f"r{s.mesh.rank} unrouted={len(s._unrouted())} "
+                    f"requeued={sorted(s._requeued)} "
+                    f"members={sorted(s._members)} "
+                    f"served={sorted(s.results())} "
+                    f"verdict={s._done_verdict}"
+                    for s in servers))
+
+
+def _merged_exactly_once(servers, n):
+    """Union of the survivors' results covers gid 0..n-1 with no gid
+    served on two ranks (no duplicate finishes)."""
+    merged = {}
+    for s in servers:
+        for g, out in s.results().items():
+            assert g not in merged, \
+                f"gid {g} finished on two ranks"
+            merged[g] = out
+    assert sorted(merged) == list(range(n)), sorted(merged)
+    return merged
+
+
+def _assert_bitwise(merged, net, prompts):
+    for g, out in merged.items():
+        np.testing.assert_array_equal(
+            out, _dense(net, prompts[g]),
+            err_msg=f"gid {g} diverged from dense reference")
+
+
+def _close_all(servers):
+    for s in servers:
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# units: reducers + channel retry/scavenge
+# ---------------------------------------------------------------------------
+class TestMemberReducer:
+    def test_join_unions_member_tables(self):
+        votes = {0: {"members": {"0": "prefill", "1": "decode"},
+                     "me": 0, "role": "prefill", "dead": [],
+                     "routed": 7},
+                 1: {"members": {"0": "prefill", "1": "decode"},
+                     "me": 1, "role": "decode", "dead": [],
+                     "routed": 7},
+                 2: {"members": {"2": "decode"}, "me": 2,
+                     "role": "decode", "dead": [], "routed": 0}}
+        v = _member_reducer(votes)
+        assert v["members"] == {"0": "prefill", "1": "decode",
+                                "2": "decode"}
+        assert v["dead"] == []
+        # the joiner's low hwm must not win: max, not min
+        assert v["routed"] == 7
+
+    def test_dead_leaves_and_voters_never_die(self):
+        votes = {0: {"members": {"0": "prefill", "1": "decode",
+                                 "2": "decode"},
+                     "me": 0, "role": "prefill", "dead": [2],
+                     "routed": 3},
+                 1: {"members": {"0": "prefill", "1": "decode",
+                                 "2": "decode"},
+                     # rank 1 (wrongly) also reports rank 0 dead: a
+                     # voter is alive by definition — only 2 leaves
+                     "me": 1, "role": "decode", "dead": [0, 2],
+                     "routed": 3}}
+        v = _member_reducer(votes)
+        assert v["members"] == {"0": "prefill", "1": "decode"}
+        assert v["dead"] == [2]
+
+    def test_deterministic_across_voter_subsets(self):
+        votes = {0: {"members": {"0": "decode", "1": "decode"},
+                     "me": 0, "role": "decode", "dead": [],
+                     "routed": 2},
+                 1: {"members": {"0": "decode", "1": "decode"},
+                     "me": 1, "role": "decode", "dead": [],
+                     "routed": 2}}
+        assert _member_reducer(votes) == _member_reducer(
+            dict(sorted(votes.items(), reverse=True)))
+
+
+class TestRouteRequestsElastic:
+    def _vote(self, seen, routed, pending, requeue=(), fp=100, fs=4,
+              q=0, prefill=(0,), decode=(1, 2), thr=9):
+        return {"seen": seen, "routed": routed,
+                "pending": {str(g): ln for g, ln in pending.items()},
+                "requeue": list(requeue),
+                "free_pages": fp, "free_slots": fs, "queued": q,
+                "topology": {"prefill": list(prefill),
+                             "decode": list(decode),
+                             "threshold": thr}}
+
+    def test_hwm_is_max_of_voters(self):
+        """A joiner voting a stale low hwm must not re-route gids the
+        mesh already assigned."""
+        votes = {0: self._vote(4, 4, {}),
+                 1: self._vote(4, 4, {}),
+                 2: self._vote(4, 0, {0: 4, 1: 4, 2: 4, 3: 4})}
+        v = route_requests(votes)
+        assert v["assign"] == {}
+        assert v["routed"] == 4
+
+    def test_requeued_gids_are_rerouted(self):
+        votes = {0: self._vote(4, 4, {1: 16, 3: 4},
+                               requeue=[1, 3], decode=(1,)),
+                 1: self._vote(4, 4, {1: 16, 3: 4},
+                               requeue=[1], decode=(1,))}
+        v = route_requests(votes)
+        # union of requeue lists, placed by the same load-shaped pick
+        assert sorted(v["assign"]) == ["1", "3"]
+        p, d = v["assign"]["1"]
+        assert p == 0 and d == 1        # long prompt: prefill group
+        assert v["assign"]["3"] == [-1, 1]
+        assert v["routed"] == 4         # requeues never move the hwm
+
+    def test_requeue_without_lens_is_skipped(self):
+        votes = {0: self._vote(2, 2, {}, requeue=[0], decode=(1,))}
+        v = route_requests(votes)
+        assert v["assign"] == {}
+
+
+class TestHandoffRetry:
+    def test_transient_send_errors_backoff_and_count(self, tmp_path,
+                                                     monkeypatch):
+        ch = HandoffChannel(str(tmp_path), 0)
+        ch.retry_base_delay_s = 0.0
+        before = registry().counter("serving/handoff_retries").value
+        real_rename = os.rename
+        fails = {"n": 2}
+
+        def flaky(src, dst):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise OSError(28, "No space left on device")
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(os, "rename", flaky)
+        ch.send(1, 0, {"max_new": 1, "x": np.zeros(4, np.float32)})
+        after = registry().counter("serving/handoff_retries").value
+        assert after - before == 2
+        monkeypatch.undo()
+        got = HandoffChannel(str(tmp_path), 1).poll()
+        assert [g for g, _ in got] == [0]
+
+    def test_exhausted_retries_surface(self, tmp_path, monkeypatch):
+        ch = HandoffChannel(str(tmp_path), 0)
+        ch.retry_attempts = 2
+        ch.retry_base_delay_s = 0.0
+
+        def always(src, dst):
+            raise OSError(4, "Interrupted system call")
+
+        monkeypatch.setattr(os, "rename", always)
+        with pytest.raises(RetryError):
+            ch.send(1, 0, {"max_new": 1,
+                           "x": np.zeros(4, np.float32)})
+
+
+class TestScavenge:
+    PAYLOAD = dict(prompt=np.arange(4, dtype=np.int32),
+                   orig_prompt_len=4, max_new=3, first_token=7,
+                   key=np.zeros(2, np.uint32), n_tokens=4,
+                   kv_dtype="float32",
+                   k=np.ones((2, 1, 8, 4, 16), np.float32),
+                   v=np.ones((2, 1, 8, 4, 16), np.float32))
+
+    def test_claims_and_readdresses(self, tmp_path):
+        dead = HandoffChannel(str(tmp_path), 2)
+        dead_sender = HandoffChannel(str(tmp_path), 0)
+        dead_sender.send(2, 5, dict(self.PAYLOAD))
+        claimer = HandoffChannel(str(tmp_path), 1)
+        assert claimer.scavenge(5, 2)
+        assert dead.poll() == []           # no longer addressed to 2
+        got = claimer.poll()
+        assert [g for g, _ in got] == [5]
+
+    def test_missing_file_is_not_claimed(self, tmp_path):
+        assert not HandoffChannel(str(tmp_path), 1).scavenge(9, 2)
+
+    def test_torn_payload_is_deleted_not_imported(self, tmp_path):
+        bad = dict(self.PAYLOAD)
+        del bad["k"]
+        HandoffChannel(str(tmp_path), 0).send(2, 7, bad)
+        claimer = HandoffChannel(str(tmp_path), 1)
+        before = registry().counter(
+            "serving/handoff_scavenge_failed").value
+        assert not claimer.scavenge(7, 2)
+        assert registry().counter(
+            "serving/handoff_scavenge_failed").value == before + 1
+        assert claimer.poll() == []        # audit deleted it
+        assert not any(n.endswith(".npz")
+                       for n in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# death interleavings (the re-dispatch accounting satellite)
+# ---------------------------------------------------------------------------
+class TestDeadRankRedispatch:
+    LENS = (16, 4, 12)
+
+    def _submit_all(self, servers, prompts):
+        for s in servers:
+            for p in prompts:
+                s.submit(p, MAX_NEW)
+
+    def _finish(self, live, net, prompts, n):
+        _drive(live, lambda: all(s._done_verdict for s in live),
+               label="post-kill drain")
+        merged = _merged_exactly_once(live, n)
+        _assert_bitwise(merged, net, prompts)
+        for s in live:
+            assert s.check_consistency() == []
+            assert sorted(s._members) == sorted(
+                x.mesh.rank for x in live)
+        return merged
+
+    def test_died_before_export_requeues_from_scratch(self, tmp_path):
+        net = _net()
+        prompts = _prompts(self.LENS)
+        servers = _mesh(tmp_path, net, range(3), 3)
+        try:
+            seq0 = pevents.log().next_seq
+            self._submit_all(servers, prompts)
+            # hold every export back so rank 2's death lands BEFORE
+            # any KV file exists: the orphan must re-route from the
+            # prompt alone
+            servers[0]._export_held, orig = (
+                lambda: None), servers[0]._export_held
+            _drive(servers,
+                   lambda: all(len(s._assignments) == len(prompts)
+                               for s in servers),
+                   label="routing")
+            victims = [g for g, (_p, d) in
+                       servers[0]._assignments.items() if d == 2]
+            assert victims, "routing sent nothing to rank 2"
+            _kill(servers[2])
+            servers[0]._export_held = orig
+            live = servers[:2]
+            self._finish(live, net, prompts, len(prompts))
+            redis = {}
+            for s in live:
+                redis.update(s.redispatched)
+            assert set(victims) <= set(redis)
+            assert all(m == "requeue" for g, m in redis.items()
+                       if g in victims)
+            kinds = [e.kind for e in pevents.log().events(
+                since_seq=seq0)]
+            assert "member_leave" in kinds
+            assert "redispatch" in kinds
+        finally:
+            _close_all(servers)
+
+    def test_died_mid_handoff_scavenges_surviving_kv(self, tmp_path):
+        net = _net()
+        prompts = _prompts(self.LENS)
+        servers = _mesh(tmp_path, net, range(3), 3)
+        try:
+            self._submit_all(servers, prompts)
+            # rank 2 keeps voting (the mesh stays snappy) but never
+            # consumes its arrivals: the exported payload survives
+            # its death on the channel
+            servers[2]._import_arrivals = lambda: None
+            handoff = os.path.join(str(tmp_path), "handoff")
+            _drive(servers,
+                   lambda: any(n.endswith("-to2.npz")
+                               for n in os.listdir(handoff)),
+                   label="export lands")
+            orphan = [int(n[2:10]) for n in os.listdir(handoff)
+                      if n.endswith("-to2.npz")]
+            _kill(servers[2])
+            live = servers[:2]
+            before = registry().counter(
+                "serving/handoffs_scavenged").value
+            self._finish(live, net, prompts, len(prompts))
+            assert registry().counter(
+                "serving/handoffs_scavenged").value > before
+            # the surviving decode rank claimed the corpse's payload
+            assert any(servers[1].redispatched.get(g) == "scavenge"
+                       for g in orphan)
+        finally:
+            _close_all(servers)
+
+    def test_died_while_decoding_reprefills_honestly(self, tmp_path):
+        net = _net()
+        prompts = _prompts(self.LENS)
+        servers = _mesh(tmp_path, net, range(3), 3)
+        try:
+            self._submit_all(servers, prompts)
+            _drive(servers,
+                   lambda: servers[2].handoffs_recv >= 1,
+                   label="import lands")
+            for _ in range(3):          # a few decode ticks, then die
+                servers[2].step()
+            _kill(servers[2])
+            live = servers[:2]
+            merged = self._finish(live, net, prompts, len(prompts))
+            redis = {}
+            for s in live:
+                redis.update(s.redispatched)
+            assert redis, "nothing was re-dispatched"
+            assert set(redis) <= set(merged)
+            # re-dispatched tail still reports a TTFT, charged from
+            # the ORIGINAL submit (inflation is measured, not hidden)
+            ttfts = {}
+            for s in live:
+                ttfts.update(s.ttfts())
+            assert set(redis) <= set(ttfts)
+        finally:
+            _close_all(servers)
+
+    def test_ledgers_rebalance_with_voids(self, tmp_path):
+        """After a death the done round's balance nets the voided
+        entries — the surviving counters alone need not match."""
+        net = _net()
+        prompts = _prompts(self.LENS)
+        servers = _mesh(tmp_path, net, range(3), 3)
+        try:
+            self._submit_all(servers, prompts)
+            _drive(servers,
+                   lambda: servers[2].handoffs_recv >= 1,
+                   label="import lands")
+            _kill(servers[2])
+            live = servers[:2]
+            self._finish(live, net, prompts, len(prompts))
+            sent = sum(s.handoffs_sent - s.handoffs_void_sent
+                       for s in live)
+            recv = sum(s.handoffs_recv - s.handoffs_void_recv
+                       for s in live)
+            assert sent == recv
+            assert any(s.handoffs_void_sent for s in live)
+        finally:
+            _close_all(servers)
+
+
+# ---------------------------------------------------------------------------
+# dynamic membership: join mid-run
+# ---------------------------------------------------------------------------
+class TestJoinMidRun:
+    def test_joiner_is_admitted_and_serves(self, tmp_path):
+        net = _net()
+        wave1 = _prompts((4, 6), seed=3)
+        wave2 = _prompts((4, 6, 5, 7, 4, 6), seed=5)
+        prompts = wave1 + wave2
+        seq0 = pevents.log().next_seq
+        servers = _mesh(tmp_path, net, range(2), 2,
+                        prefill_ranks=())
+        try:
+            for s in servers:
+                for p in wave1:
+                    s.submit(p, MAX_NEW)
+            _drive(servers,
+                   lambda: all(s._done_verdict for s in servers),
+                   label="wave1")
+            # a third rank JOINS the running mesh: fresh spec, same
+            # board, join=True (catch-up + member announce)
+            joiner = _mesh(tmp_path, net, [2], 3, prefill_ranks=(),
+                           join=True)[0]
+            servers.append(joiner)
+            assert not joiner._joined
+            # SPMD driver contract: the joiner replays the stream
+            for p in wave1:
+                joiner.submit(p, MAX_NEW)
+            _drive(servers, lambda: joiner._joined,
+                   label="admission")
+            # wave 2 arrives AFTER admission: load-shaped routing
+            # must spill onto the idle joiner
+            for s in servers:
+                for p in wave2:
+                    s.submit(p, MAX_NEW)
+            _drive(servers,
+                   lambda: all(s._done_verdict for s in servers),
+                   label="wave2")
+            for s in servers:
+                assert sorted(s._members) == [0, 1, 2]
+            merged = _merged_exactly_once(servers, len(prompts))
+            _assert_bitwise(merged, net, prompts)
+            # live rebalancing: the idle joiner took real traffic
+            assert joiner.results(), \
+                "joiner never served a routed request"
+            kinds = [e.kind for e in pevents.log().events(
+                since_seq=seq0)]
+            assert "member_join" in kinds
+        finally:
+            _close_all(servers)
+
+    def test_joiner_never_reroutes_assigned_work(self, tmp_path):
+        """The adopted member decision carries the routing hwm: the
+        joiner's admission votes must not drag it down (no gid is
+        assigned twice)."""
+        net = _net()
+        prompts = _prompts((4, 6, 5), seed=7)
+        servers = _mesh(tmp_path, net, range(2), 2,
+                        prefill_ranks=())
+        try:
+            for s in servers:
+                for p in prompts:
+                    s.submit(p, MAX_NEW)
+            _drive(servers,
+                   lambda: all(s._done_verdict for s in servers),
+                   label="pre-join drain")
+            hwm = servers[0]._routed_hwm
+            joiner = _mesh(tmp_path, net, [2], 3, prefill_ranks=(),
+                           join=True)[0]
+            servers.append(joiner)
+            for p in prompts:
+                joiner.submit(p, MAX_NEW)
+            _drive(servers, lambda: joiner._joined,
+                   label="admission")
+            assert joiner._routed_hwm >= hwm
+            _drive(servers,
+                   lambda: all(s._done_verdict for s in servers),
+                   label="post-join drain")
+            _merged_exactly_once(servers, len(prompts))
+        finally:
+            _close_all(servers)

@@ -384,6 +384,7 @@ def _valid_mesh_status():
     return {
         "kind": "mesh_status", "ts": 1.0, "root": "/x", "tick": 1,
         "interval_s": 1.0, "staleness_s": 3.0, "world": 1,
+        "membership": None,
         "ranks": {"0": {"seq": 0, "frames": 1, "torn": 0,
                         "age_s": 0.1, "synced": True,
                         "offset_s": 0.0, "unc_s": 0.001,
@@ -447,3 +448,256 @@ def test_checker_flags_alert_event_missing_rule(tmp_path):
     errs = list(mod._ERRORS)
     assert any("alert event missing 'rule'" in e for e in errs)
     assert any("not firing/resolved" in e for e in errs)
+
+
+# ---------------------------------------------------------------------------
+# elastic mesh (ISSUE 17): per-rank rules, membership, history
+# ---------------------------------------------------------------------------
+
+
+def test_per_rank_rule_keeps_independent_streaks():
+    """Rank 1 flapping must not reset rank 0's breach streak, and a
+    transition names the rank it happened on."""
+    seq = iter([{"0": 5.0, "1": 5.0},
+                {"0": 5.0, "1": 0.0},    # rank 1 flaps clear
+                {"0": 5.0, "1": 0.0}])   # rank 0's 3rd breach: fires
+    rule = AlertRule("r", lambda st: next(seq), threshold=5.0,
+                     for_ticks=3, per_rank=True)
+    assert rule.evaluate_all({}) == []
+    assert rule.evaluate_all({}) == []
+    trs = rule.evaluate_all({})
+    assert [(t["rank"], t["state"]) for t in trs] == [("0", "firing")]
+    assert rule.firing and rule.fired_count == 1
+    st = rule.state()
+    assert st["per_rank"]["0"]["firing"] is True
+    assert st["per_rank"]["1"]["firing"] is False
+    # aggregate value is the worst evaluable rank
+    assert st["value"] == 5.0
+
+
+def test_per_rank_rule_same_tick_fire_and_resolve():
+    seq = iter([{"0": 5.0, "1": 0.0},
+                {"0": 0.0, "1": 5.0}])   # 0 resolves, 1 fires: ONE tick
+    rule = AlertRule("r", lambda st: next(seq), threshold=5.0,
+                     per_rank=True)
+    assert [(t["rank"], t["state"]) for t in rule.evaluate_all({})] \
+        == [("0", "firing")]
+    trs = rule.evaluate_all({})
+    assert [(t["rank"], t["state"]) for t in trs] \
+        == [("0", "resolved"), ("1", "firing")]
+    assert rule.firing                   # rank 1 still breaches
+
+
+def test_per_rank_rule_missing_rank_holds_state():
+    seq = iter([{"0": 5.0, "1": 5.0}, {"0": 5.0}, {"0": 0.0}])
+    rule = AlertRule("r", lambda st: next(seq), threshold=5.0,
+                     per_rank=True)
+    rule.evaluate_all({})                # both fire
+    rule.evaluate_all({})                # rank 1 left the mesh: HOLDS
+    assert rule.state()["per_rank"]["1"]["firing"] is True
+    rule.evaluate_all({})                # rank 0 resolves
+    assert rule.firing                   # the departed rank still holds
+
+
+def test_per_rank_rule_rejects_scalar_drive():
+    rule = AlertRule("r", lambda st: {"0": 1.0}, 1.0, per_rank=True)
+    with pytest.raises(TypeError):
+        rule.evaluate({})
+
+
+def test_dead_rank_transition_names_the_rank(tmp_path):
+    d = str(tmp_path)
+    _write_frame(d, 0, 0, ts=time.time())
+    _write_frame(d, 1, 0, ts=time.time() - 99.0)
+    agg = LiveAggregator(d, interval_s=0.01, staleness_s=1.0,
+                         emit_alerts=False)
+    st = agg.tick()
+    assert st["ranks"]["1"]["dead"] and not st["ranks"]["0"]["dead"]
+    tr = [t for t in st["alert_transitions"]
+          if t["rule"] == "dead_rank"]
+    assert [(t["rank"], t["state"]) for t in tr] == [("1", "firing")]
+    assert st["alerts"]["dead_rank"]["per_rank"]["1"]["firing"]
+
+
+def test_membership_follows_board_decision(tmp_path):
+    """When the board carries a member family, the status's world is
+    the AGREED member count — a joiner is expected the moment the
+    round publishes, a voted-out rank stops reading as missing."""
+    d = str(tmp_path)
+    board = os.path.join(d, "board")
+    fam = os.path.join(board, "member")
+    os.makedirs(os.path.join(fam, "e0"))
+    os.makedirs(os.path.join(fam, "e1"))
+    with open(os.path.join(fam, "e1", "decision.json"), "w") as f:
+        json.dump({"value": {"members": {"0": "prefill",
+                                         "1": "decode",
+                                         "2": "decode"}}}, f)
+    _write_frame(d, 0, 0)
+    _write_frame(d, 1, 0)
+    st = LiveAggregator(d, interval_s=0.01, staleness_s=1e9,
+                        world=2, board_dir=board,
+                        emit_alerts=False).tick()
+    assert st["membership"] == {
+        "epoch": 1, "source": "board",
+        "members": {"0": "prefill", "1": "decode", "2": "decode"}}
+    assert st["world"] == 3              # follows the member count
+    assert st["partial"] is True         # member 2 has no frames yet
+
+
+def test_membership_absent_without_board(tmp_path):
+    _write_frame(str(tmp_path), 0, 0)
+    st = LiveAggregator(str(tmp_path), interval_s=0.01,
+                        staleness_s=1e9, world=1,
+                        emit_alerts=False).tick()
+    assert st["membership"] is None
+    assert st["partial"] is False
+
+
+def test_status_history_rolls(tmp_path):
+    d = str(tmp_path)
+    _write_frame(d, 0, 0)
+    agg = LiveAggregator(d, interval_s=0.01, staleness_s=1e9,
+                         emit_alerts=False, history_limit=100)
+    for _ in range(130):
+        agg.tick()
+    path = os.path.join(d, "mesh_status_history.jsonl")
+    lines = open(path).read().strip().splitlines()
+    # trimmed on the 128th append: bounded, and every line parses
+    assert len(lines) <= 100 + 64
+    docs = [json.loads(ln) for ln in lines]
+    assert all(doc["kind"] == "mesh_status" for doc in docs)
+    assert docs[-1]["tick"] == 130
+    # ticks stay contiguous across the trim
+    ticks = [doc["tick"] for doc in docs]
+    assert ticks == list(range(ticks[0], ticks[0] + len(ticks)))
+
+
+def test_status_history_disabled(tmp_path):
+    d = str(tmp_path)
+    agg = LiveAggregator(d, interval_s=0.01, staleness_s=1e9,
+                         emit_alerts=False, history_limit=0)
+    agg.tick()
+    assert not os.path.exists(
+        os.path.join(d, "mesh_status_history.jsonl"))
+
+
+def test_live_dash_history_renders(tmp_path, capsys):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "live_dash", os.path.join(os.path.dirname(__file__), "..",
+                                  "tools", "live_dash.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    d = str(tmp_path)
+    _write_frame(d, 0, 0)
+    LiveAggregator(d, interval_s=0.01, staleness_s=1e9,
+                   emit_alerts=False).tick()
+    assert mod.main([d, "--history", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "tick" in out and "members" in out
+
+
+# ---------------------------------------------------------------------------
+# checker: elastic mesh (ISSUE 17) negative tests
+# ---------------------------------------------------------------------------
+
+
+def test_checker_requires_membership_key():
+    doc = _valid_mesh_status()
+    del doc["membership"]
+    assert any("missing key 'membership'" in e for e in _mesh_errs(doc))
+
+
+def test_checker_accepts_board_membership():
+    doc = _valid_mesh_status()
+    doc["membership"] = {"epoch": 2, "source": "board",
+                         "members": {"0": "decode"}}
+    assert _mesh_errs(doc) == []
+
+
+def test_checker_flags_world_not_following_members():
+    doc = _valid_mesh_status()
+    doc["membership"] = {"epoch": 2, "source": "board",
+                         "members": {"0": "decode", "1": "decode",
+                                     "2": "decode"}}
+    # world stayed 1: the status is not following the agreed set
+    assert any("following the agreed member set" in e
+               for e in _mesh_errs(doc))
+
+
+def test_checker_flags_empty_member_table():
+    doc = _valid_mesh_status()
+    doc["membership"] = {"epoch": 2, "source": "board", "members": {}}
+    assert any("membership.members" in e for e in _mesh_errs(doc))
+
+
+def test_checker_flags_incomplete_membership_block():
+    doc = _valid_mesh_status()
+    doc["membership"] = {"members": {"0": "decode"}}
+    doc["world"] = 1
+    errs = _mesh_errs(doc)
+    assert any("membership missing 'epoch'" in e for e in errs)
+    assert any("membership missing 'source'" in e for e in errs)
+
+
+def test_checker_flags_per_rank_alert_missing_keys():
+    doc = _valid_mesh_status()
+    doc["alerts"]["dead_rank"]["per_rank"] = {
+        "0": {"firing": False, "value": 0.0}}  # no fired_count
+    assert any("per_rank.0 missing 'fired_count'" in e
+               for e in _mesh_errs(doc))
+
+
+def _event_errs(tmp_path, *rows):
+    mod, schema = _load_checker()
+    p = str(tmp_path / "events.jsonl")
+    with open(p, "w") as f:
+        for i, row in enumerate(rows):
+            row = dict({"seq": i, "t_ns": i + 1, "rank": 0}, **row)
+            f.write(json.dumps(row) + "\n")
+    mod._ERRORS.clear()
+    mod.check_events_jsonl(p, schema)
+    return list(mod._ERRORS)
+
+
+def test_checker_accepts_valid_elastic_events(tmp_path):
+    errs = _event_errs(
+        tmp_path,
+        {"kind": "redispatch", "gid": 3, "trace": "t-3",
+         "mode": "scavenge", "dead_rank": 2},
+        {"kind": "member_join", "member": 2, "role": "decode",
+         "epoch": 4},
+        {"kind": "member_leave", "member": 1, "role": "decode",
+         "epoch": 5, "reason": "lease_expired"},
+        {"kind": "cancel", "rid": 7, "eng": 0,
+         "reason": "redispatch"})
+    assert errs == []
+
+
+def test_checker_flags_redispatch_event_holes(tmp_path):
+    errs = _event_errs(
+        tmp_path,
+        {"kind": "redispatch", "gid": 3, "trace": "t-3",
+         "mode": "teleport"},      # unknown mode, no dead_rank
+        {"kind": "redispatch", "gid": 4, "trace": "t-4",
+         "mode": "requeue", "dead_rank": "two"})
+    assert any("missing 'dead_rank'" in e for e in errs)
+    assert any("mode 'teleport'" in e for e in errs)
+    assert any("dead_rank 'two' not an int" in e for e in errs)
+
+
+def test_checker_flags_member_event_holes(tmp_path):
+    errs = _event_errs(
+        tmp_path,
+        {"kind": "member_join", "member": 2, "epoch": -1},
+        {"kind": "member_leave", "member": 1, "role": "decode",
+         "epoch": 5})              # a leave must say WHY
+    assert any("member_join event missing 'role'" in e for e in errs)
+    assert any("epoch -1 not a non-negative int" in e for e in errs)
+    assert any("member_leave event missing 'reason'" in e
+               for e in errs)
+
+
+def test_checker_flags_cancel_without_reason(tmp_path):
+    errs = _event_errs(tmp_path, {"kind": "cancel", "rid": 7})
+    assert any("cancel event missing 'reason'" in e for e in errs)

@@ -32,7 +32,15 @@ must balance (sum(pos) + sum(neg) + zero == n), the aggregator's
 mesh_status.json must keep its merged percentiles ordered
 (min <= p50 <= p90 <= p95 <= p99 <= max), a ``dead`` rank verdict
 must rest on staleness evidence (age_s >= staleness_s), and alert
-events must name their rule and state. stdlib only (the CI image
+events must name their rule and state, and (ISSUE 17) the elastic
+mesh: ``redispatch`` events must attribute the move (gid/trace/mode/
+dead_rank, mode one of requeue/scavenge/reprefill),
+``member_join``/``member_leave`` events must carry member/role/epoch
+(and a leave its reason), ``cancel`` events their rid/reason, and
+``mesh_status`` must carry a ``membership`` key (null = static
+world; a board-sourced block must be non-empty with ``world``
+following the agreed member count) plus per-rank alert sub-blocks
+with their own firing/value/fired_count. stdlib only (the CI image
 installs jax + numpy + pytest, nothing else).
 
 Note on events.jsonl seq monotonicity: the sink's writer is
@@ -214,6 +222,47 @@ def check_events_jsonl(path: str, schema: dict) -> None:
             for kk in sc.get("clock_sync_event_required", ()):
                 if kk not in ev:
                     err(f"{path}:{i + 1}: clock_sync event missing "
+                        f"{kk!r}")
+        if ev.get("kind") == "redispatch":
+            # elastic re-dispatch (ISSUE 17): which request moved off
+            # which corpse, and via which path — "requeue" (re-prefill
+            # from the prompt), "scavenge" (adopted the dead rank's
+            # exported KV), or "reprefill" (local fallback). A
+            # redispatch that cannot be attributed is an exactly-once
+            # audit hole.
+            for kk in sc.get("redispatch_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: redispatch event missing "
+                        f"{kk!r}")
+            mode = ev.get("mode")
+            if "mode" in ev and mode not in sc.get(
+                    "redispatch_modes", ()):
+                err(f"{path}:{i + 1}: redispatch mode {mode!r} not "
+                    f"one of {sc.get('redispatch_modes')}")
+            dr = ev.get("dead_rank")
+            if "dead_rank" in ev and not isinstance(dr, int):
+                err(f"{path}:{i + 1}: redispatch dead_rank {dr!r} "
+                    "not an int")
+        if ev.get("kind") in ("member_join", "member_leave"):
+            # dynamic membership (ISSUE 17): who entered/left the
+            # agreed member set, under which membership epoch
+            for kk in sc.get("member_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: {ev['kind']} event missing "
+                        f"{kk!r}")
+            ep = ev.get("epoch")
+            if "epoch" in ev and (not isinstance(ep, int) or ep < 0):
+                err(f"{path}:{i + 1}: {ev['kind']} epoch {ep!r} not "
+                    "a non-negative int")
+            if ev.get("kind") == "member_leave":
+                for kk in sc.get("member_leave_extra_required", ()):
+                    if kk not in ev:
+                        err(f"{path}:{i + 1}: member_leave event "
+                            f"missing {kk!r}")
+        if ev.get("kind") == "cancel":
+            for kk in sc.get("cancel_event_required", ()):
+                if kk not in ev:
+                    err(f"{path}:{i + 1}: cancel event missing "
                         f"{kk!r}")
         if ev.get("kind") == "alert":
             # live-plane alert transitions (ISSUE 16): which rule
@@ -750,6 +799,33 @@ def check_mesh_status(doc, schema: dict, where: str) -> None:
             err(f"{where}: missing key {k!r}")
     if doc.get("kind") != sc["kind"]:
         err(f"{where}: kind {doc.get('kind')!r} != {sc['kind']!r}")
+    # dynamic membership (ISSUE 17): the key must be PRESENT (null =
+    # static world, honestly); when the board supplied a member
+    # decision the block must be attributable and non-empty
+    mem = doc.get("membership")
+    if mem is not None:
+        if not isinstance(mem, dict):
+            err(f"{where}: membership neither null nor an object")
+        else:
+            for k in sc.get("membership_entry", ()):
+                if k not in mem:
+                    err(f"{where}: membership missing {k!r}")
+            ep = mem.get("epoch")
+            if "epoch" in mem and (not isinstance(ep, int)
+                                   or ep < 0):
+                err(f"{where}: membership.epoch {ep!r} not a "
+                    "non-negative int")
+            mm = mem.get("members")
+            if "members" in mem and (not isinstance(mm, dict)
+                                     or not mm):
+                err(f"{where}: membership.members {mm!r} not a "
+                    "non-empty object")
+            w = doc.get("world")
+            if isinstance(mm, dict) and mm and \
+                    isinstance(w, int) and w != len(mm):
+                err(f"{where}: world={w} != membership member "
+                    f"count {len(mm)} — the status is not "
+                    "following the agreed member set")
     stale_s = doc.get("staleness_s")
     ranks = doc.get("ranks")
     any_dead = any_torn = False
@@ -820,6 +896,18 @@ def check_mesh_status(doc, schema: dict, where: str) -> None:
         for k in sc["alert_entry"]:
             if k not in (st or {}):
                 err(f"{where}: alerts.{rule} missing {k!r}")
+        # per-rank rule state (ISSUE 17): each rank's sub-block must
+        # carry its own firing/value/fired_count
+        pr = (st or {}).get("per_rank")
+        if pr is not None:
+            if not isinstance(pr, dict):
+                err(f"{where}: alerts.{rule}.per_rank not an object")
+            else:
+                for r, sub in pr.items():
+                    for k in sc.get("per_rank_alert_entry", ()):
+                        if k not in (sub or {}):
+                            err(f"{where}: alerts.{rule}."
+                                f"per_rank.{r} missing {k!r}")
     if (any_dead or any_torn) and doc.get("partial") is not True:
         err(f"{where}: dead/torn ranks but partial is "
             f"{doc.get('partial')!r} — the artifact is lying about "

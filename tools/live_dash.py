@@ -19,6 +19,8 @@ Usage::
     python tools/live_dash.py /tmp/sink --interval 2 \
         --board /tmp/sink/board --world 2
     python tools/live_dash.py /tmp/sink --once      # one tick, print
+    python tools/live_dash.py /tmp/sink --history 50  # replay the
+        # rolling mesh_status_history.jsonl timeline and exit
 
 Pure stdlib + the profiler package; no jax import, safe to run on the
 driver while the mesh serves.
@@ -26,6 +28,7 @@ driver while the mesh serves.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -55,6 +58,12 @@ def render(st: dict) -> str:
         f"ranks={len(st['ranks'])}"
         + (f"/{st['world']}" if st.get("world") else "")
         + (" [" + " ".join(flags) + "]" if flags else " [ok]"))
+    mem = st.get("membership")
+    if mem:
+        roster = " ".join(f"{r}:{role}" for r, role in
+                          sorted(mem["members"].items(),
+                                 key=lambda kv: int(kv[0])))
+        lines.append(f"members e{mem['epoch']} [{roster}]")
     lines.append(f"{'rank':>4} {'seq':>5} {'age_s':>7} {'sync':>5} "
                  f"{'state':>6} {'torn':>4} {'lease':>7}")
     for r, blk in st["ranks"].items():
@@ -86,6 +95,43 @@ def render(st: dict) -> str:
     return "\n".join(lines)
 
 
+def render_history(root: str, last: int) -> str:
+    """Compact one-line-per-tick replay of the rolling
+    ``mesh_status_history.jsonl`` the aggregator appends on every
+    publish (ISSUE 17): when did the member set change, when did a
+    rank die, how did the p95 move. Torn/partial lines are skipped
+    (the trim rewrite is atomic; a torn TAIL line means a writer is
+    mid-append right now)."""
+    path = os.path.join(root, "mesh_status_history.jsonl")
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return f"no history at {path} (aggregator never published?)"
+    out = [f"{'tick':>6} {'ts':>12} {'ranks':>5} {'members':>9} "
+           f"{'dead':>4} {'ttft_p95':>9} alerts"]
+    for raw in lines[-last:]:
+        try:
+            st = json.loads(raw)
+        except ValueError:
+            continue
+        mem = st.get("membership")
+        members = ("e{} n={}".format(mem["epoch"],
+                                     len(mem["members"]))
+                   if mem else "-")
+        dead = sum(1 for b in st.get("ranks", {}).values()
+                   if b.get("dead"))
+        p95 = (st.get("latency", {}).get("ttft_ms") or {}).get("p95")
+        firing = [n for n, a in st.get("alerts", {}).items()
+                  if a.get("firing")]
+        out.append(
+            f"{st.get('tick', -1):>6} {st.get('ts', 0):>12.1f} "
+            f"{len(st.get('ranks', {})):>5} {members:>9} {dead:>4} "
+            f"{_fmt(p95):>9} "
+            + (",".join(firing) if firing else "-"))
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("root", help="sink root directory to tail")
@@ -102,9 +148,18 @@ def main(argv=None) -> int:
                     help="p95 TTFT alert target")
     ap.add_argument("--once", action="store_true",
                     help="one tick, print, exit (CI / scripting)")
+    ap.add_argument("--history", type=int, nargs="?", const=50,
+                    default=None, metavar="N",
+                    help="replay the last N lines of "
+                         "mesh_status_history.jsonl and exit "
+                         "(default 50)")
     ap.add_argument("--duration", type=float, default=None,
                     help="stop after this many seconds")
     args = ap.parse_args(argv)
+
+    if args.history is not None:
+        print(render_history(args.root, args.history))
+        return 0
 
     agg = LiveAggregator(
         args.root, interval_s=args.interval,

@@ -20,6 +20,15 @@ Launcher (driver side, e.g. inside a pytest test)::
                          expect_fail_ranks=(1,))
     assert res.ok, res.tail()
 
+Elastic chaos driver (ISSUE 17) — run the mesh ASYNC, kill a member
+and/or spawn a mid-run joiner from the test process, then wait::
+
+    h = mp_mesh.launch_async(2, worker, [out_dir], log_dir=log_dir)
+    ...                                  # watch the shared dir
+    h.kill_rank(1)                       # a real SIGKILL corpse
+    h.spawn_rank(2, world=3)             # joiner (init_env_only)
+    assert h.wait(120).ok
+
 Worker side (the launched script)::
 
     import mp_mesh                       # tools/ is put on sys.path
@@ -193,41 +202,176 @@ def launch(nprocs: int, script: str, script_args: Sequence[str] = (),
         procs.append(subprocess.Popen(
             [sys.executable, script] + [str(a) for a in script_args],
             env=env, stdout=out, stderr=subprocess.STDOUT, cwd=REPO))
-    rcs: Dict[int, int] = {}
-    deadline = time.time() + timeout
-    timed_out = False
-    try:
-        while len(rcs) < nprocs:
-            if time.time() > deadline:
-                timed_out = True
-                break
-            hard_fail = False
-            for r, p in enumerate(procs):
-                if r in rcs:
-                    continue
-                rc = p.poll()
-                if rc is not None:
-                    rcs[r] = rc
-                    if rc != 0 and r not in expect_fail_ranks:
-                        hard_fail = True
-            if hard_fail:
-                break
-            time.sleep(0.05)
-    finally:
-        for r, p in enumerate(procs):
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        kill_at = time.time() + 10
-        for r, p in enumerate(procs):
-            while p.poll() is None and time.time() < kill_at:
-                time.sleep(0.1)
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-            rcs.setdefault(r, p.returncode)
-        for f in logs:
-            f.close()
-    return MeshResult(rcs, log_dir, expect_fail_ranks, timed_out)
+    handle = MeshHandle(script, list(script_args), log_dir,
+                        endpoints, list(expect_fail_ranks), chaos,
+                        host_devices, env_extra)
+    handle._procs = dict(enumerate(procs))
+    handle._logs = logs
+    return handle.wait(timeout)
+
+
+class MeshHandle:
+    """An ASYNC mesh (ISSUE 17): the workers run while the driver —
+    the test process — interacts with them. This is what the elastic
+    chaos legs need: spawn a JOINER process mid-run
+    (``spawn_rank(rank, world)``), hard-kill a member
+    (``kill_rank``), then ``wait()`` for the same verdict ``launch``
+    returns. Joiner workers use ``init_env_only()`` + the shared
+    board: jax's coordination service cannot rendezvous a process
+    that wasn't in the original world, and the elastic control plane
+    deliberately doesn't need it to."""
+
+    def __init__(self, script: str, script_args: List[str],
+                 log_dir: str, endpoints: List[str],
+                 expect_fail_ranks: List[int], chaos: Optional[str],
+                 host_devices: int,
+                 env_extra: Optional[Dict[str, str]]):
+        self.script = script
+        self.script_args = script_args
+        self.log_dir = log_dir
+        self.endpoints = endpoints
+        self.expect_fail_ranks = expect_fail_ranks
+        self.chaos = chaos
+        self.host_devices = host_devices
+        self.env_extra = env_extra
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._logs: List = []
+
+    def _worker_env(self, rank: int, world: int) -> Dict[str, str]:
+        while len(self.endpoints) < world:
+            self.endpoints.append(f"127.0.0.1:{_free_port()}")
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": self.endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS":
+                ",".join(self.endpoints[:world]),
+            "PADDLE_COORDINATOR": self.endpoints[0],
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "PYTHONPATH": REPO + os.pathsep
+                + env.get("PYTHONPATH", ""),
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count="
+                          + str(self.host_devices)).strip(),
+        })
+        if self.chaos:
+            env[CHAOS_ENV] = self.chaos
+        if self.env_extra:
+            env.update(self.env_extra)
+        return env
+
+    def spawn_rank(self, rank: int, world: int,
+                   script_args: Optional[Sequence[str]] = None,
+                   env_extra: Optional[Dict[str, str]] = None
+                   ) -> subprocess.Popen:
+        """Start one MORE worker process — the mid-run joiner. The
+        joiner sees ``PADDLE_TRAINERS_NUM=world`` (its own view of
+        the target world; existing members keep theirs — dynamic
+        membership reconciles them on the board, which is the point
+        being tested). Its exit code joins the ``wait()`` verdict."""
+        if rank in self._procs:
+            raise ValueError(f"rank {rank} already running")
+        env = self._worker_env(rank, world)
+        if env_extra:
+            env.update(env_extra)
+        out = open(os.path.join(self.log_dir,
+                                f"workerlog.{rank}"), "w")
+        self._logs.append(out)
+        args = (self.script_args if script_args is None
+                else list(script_args))
+        p = subprocess.Popen(
+            [sys.executable, self.script] + [str(a) for a in args],
+            env=env, stdout=out, stderr=subprocess.STDOUT, cwd=REPO)
+        self._procs[rank] = p
+        return p
+
+    def kill_rank(self, rank: int) -> None:
+        """SIGKILL one member — the real corpse the elastic legs
+        re-dispatch around (no cleanup, no goodbyes, like the OOM
+        killer). The rank is auto-added to ``expect_fail_ranks``."""
+        p = self._procs[rank]
+        if p.poll() is None:
+            p.kill()
+        if rank not in self.expect_fail_ranks:
+            self.expect_fail_ranks.append(rank)
+
+    def poll_rank(self, rank: int) -> Optional[int]:
+        return self._procs[rank].poll()
+
+    def wait(self, timeout: float = 300.0) -> MeshResult:
+        """Watch every spawned process (including late joiners) to
+        completion — same tolerance contract as ``launch``."""
+        rcs: Dict[int, int] = {}
+        deadline = time.time() + timeout
+        timed_out = False
+        try:
+            while len(rcs) < len(self._procs):
+                if time.time() > deadline:
+                    timed_out = True
+                    break
+                hard_fail = False
+                for r, p in list(self._procs.items()):
+                    if r in rcs:
+                        continue
+                    rc = p.poll()
+                    if rc is not None:
+                        rcs[r] = rc
+                        if rc != 0 and \
+                                r not in self.expect_fail_ranks:
+                            hard_fail = True
+                if hard_fail:
+                    break
+                time.sleep(0.05)
+        finally:
+            for r, p in self._procs.items():
+                if p.poll() is None:
+                    p.send_signal(signal.SIGTERM)
+            kill_at = time.time() + 10
+            for r, p in self._procs.items():
+                while p.poll() is None and time.time() < kill_at:
+                    time.sleep(0.1)
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+                rcs.setdefault(r, p.returncode)
+            for f in self._logs:
+                f.close()
+            self._logs = []
+        return MeshResult(rcs, self.log_dir,
+                          tuple(self.expect_fail_ranks), timed_out)
+
+
+def launch_async(nprocs: int, script: str,
+                 script_args: Sequence[str] = (), *, log_dir: str,
+                 chaos: Optional[str] = None,
+                 expect_fail_ranks: Sequence[int] = (),
+                 host_devices: int = 1,
+                 world: Optional[int] = None,
+                 env_extra: Optional[Dict[str, str]] = None
+                 ) -> MeshHandle:
+    """Start ``nprocs`` workers and return WITHOUT waiting: the
+    elastic chaos driver (ISSUE 17) — kill a rank mid-run, spawn a
+    joiner, then ``handle.wait()``. ``world`` overrides the
+    PADDLE_TRAINERS_NUM the initial ranks see (default ``nprocs``);
+    the endpoint list grows as joiners spawn."""
+    if nprocs < 1:
+        raise ValueError("nprocs must be >= 1")
+    os.makedirs(log_dir, exist_ok=True)
+    endpoints = [f"127.0.0.1:{_free_port()}" for _ in range(nprocs)]
+    handle = MeshHandle(script, list(script_args), log_dir,
+                        endpoints, list(expect_fail_ranks), chaos,
+                        host_devices, env_extra)
+    for rank in range(nprocs):
+        env = handle._worker_env(rank, world or nprocs)
+        out = open(os.path.join(log_dir, f"workerlog.{rank}"), "w")
+        handle._logs.append(out)
+        handle._procs[rank] = subprocess.Popen(
+            [sys.executable, script]
+            + [str(a) for a in script_args],
+            env=env, stdout=out, stderr=subprocess.STDOUT, cwd=REPO)
+    return handle
 
 
 # ---------------------------------------------------------------------------
