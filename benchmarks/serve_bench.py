@@ -1757,6 +1757,325 @@ def bench_elastic(args, tiny):
     }
 
 
+def bench_prefix_routing(args, tiny):
+    """Global KV economy (ISSUE 18): prefix-affinity routing + hot-
+    chain migration vs the affinity-BLIND mesh, on 2 REAL processes
+    over a shared-system-prompt tenant workload.
+
+    Three tenants, each with its own system prompt, interleaved with
+    a deliberate skew (tenant 0 sends half the traffic): every rank
+    publishes digest chains of its cached prefixes through the board,
+    the router prices a published prefix hit against the load vote,
+    and when load overrides affinity the hot chain's pages MIGRATE to
+    the loaded-onto rank (int8 scales travel with the pages). The
+    affinity-blind arm is the same mesh with ``prefix_routing`` off —
+    local prefix caching still on, so the delta prices the ECONOMY
+    (placement + migration), not caching itself.
+
+    Headline: paired-median over interleaved reps of
+    ``blind mean TTFT / affinity mean TTFT`` (PR 15 precedent: pairing
+    and interleaving cancel the container's timeshared-CPU drift).
+    Correctness is asserted in-run, not assumed: every cell must serve
+    every gid exactly once, and every f32 cell's full decoded
+    sequences must be BITWISE equal to dense ``generate()`` references
+    the driver computes itself — routing and migration move placement,
+    never tokens. A final affinity cell at ``kv_dtype='int8'`` prices
+    migration bytes by dtype (quantized pages ship ~4x fewer payload
+    bytes + their per-page per-head scales); int8 is outside the
+    bitwise contract (PR 12) so that cell skips the dense check."""
+    import tempfile
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import mp_mesh
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "serve_worker.py")
+    world = 2
+    tenants = 5
+    sys_len = 48 if tiny else 96
+    # a suffix SHORTER than one page: only full pages are indexed, so
+    # the trie holds exactly the shared system chains — a page-sized
+    # suffix would index every request's unique tail page, polluting
+    # the pool until nothing else fits (least of all a migrated chain,
+    # whose import refuses to evict)
+    sfx_len = 7
+    # prefill-dominated requests (long system prompt, SHORT decodes)
+    # at a rate service can keep up with: TTFT is then prefill chunks
+    # + small queue waits, the term the economy actually moves.
+    # Single-slot ranks keep the over-penalty live — any arrival
+    # overlap queues, the router spills the hot tenant, and the spill
+    # fires migration EARLY enough that later hot-tenant arrivals
+    # route against the replicated chain (an overloaded mesh routes
+    # its whole trace before the first migration completes — the r18
+    # tuning trap; and long decodes make queue waits, which affinity
+    # concentration amplifies, swamp the prefill savings).
+    max_new = 6 if tiny else 8
+    n_req = 24 if tiny else 40
+    rate = 16.0 if tiny else 8.0
+    ps = 8
+    # routing chunk COARSER than the page: the affinity discount
+    # (hit tokens // chunk) then prices BELOW one queued request's
+    # over-penalty, so the router abandons the affine rank the moment
+    # a real queue forms instead of tolerating standing queue depth
+    # whose wait dwarfs the saved prefill
+    chunk = 16
+    slots = 1
+    pps = -(-(sys_len + sfx_len + max_new) // ps)
+    # pool sized so a rank can cache ITS tenants' system chains PLUS
+    # one migrated hot chain (imports use the non-evicting allocator
+    # — no room means the chain is dropped, honestly) but not
+    # everyone's: the blind arm spreads all 5 tenants across both
+    # ranks and pays chain eviction + full re-prefill; the affinity
+    # arm's tenant partition fits. That capacity asymmetry is the
+    # economy's edge, and it is priced in pages, not assumed.
+    num_pages = slots * pps + (24 if tiny else 48) + 1
+    # tenant 0 is hot AND bursty (back-to-back doubles): the second
+    # T0 of a double arrives while its affine rank still decodes the
+    # first, so that rank's live vote shows the slot busy, the
+    # over-penalty beats the affinity discount, the request spills —
+    # and the spill drags the chain across via migration, after which
+    # BOTH ranks serve tenant 0 with hits (the dst's are the
+    # cross-rank remote hits the acceptance gate counts)
+    pattern = [0, 0, 1, 2, 0, 0, 3, 4]
+    lease_s = 1.0
+    model = {"vocab": 128, "hidden": 64, "layers": 4, "heads": 4,
+             "max_seq_len": 128} if tiny else \
+            {"vocab": 256, "hidden": 128, "layers": 4, "heads": 4,
+             "max_seq_len": 192}
+    reps = 1 if tiny else max(2, args.reps)
+
+    # ---- the driver replays the workers' trace RNG (systems first,
+    # then per-request gap + suffix) and computes dense references —
+    # the parity oracle no serving-side bug can also infect ----------
+    def tenant_trace(seed):
+        rng = np.random.RandomState(seed)
+        systems = [rng.randint(0, 128, (sys_len,)).astype(np.int32)
+                   for _ in range(tenants)]
+        out = []
+        t = 0.0
+        for i in range(n_req):
+            t += float(rng.exponential(1.0 / rate))
+            sfx = rng.randint(0, 128, (sfx_len,)).astype(np.int32)
+            out.append(np.concatenate(
+                [systems[pattern[i % len(pattern)]], sfx]))
+        return out
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPT, GPTConfig
+
+    paddle.seed(0)
+    net = GPT(GPTConfig(vocab_size=model["vocab"],
+                        hidden_size=model["hidden"],
+                        num_layers=model["layers"],
+                        num_heads=model["heads"],
+                        max_seq_len=model["max_seq_len"],
+                        initializer_range=0.2))
+    net.eval()
+    prompts = tenant_trace(seed=7)
+    refs = {}
+    for g, p in enumerate(prompts):
+        ids, _ = net.generate(paddle.to_tensor(p[None]),
+                              max_new_tokens=max_new)
+        refs[g] = [int(x) for x in ids.numpy()[0]]
+
+    def run_cell(name, affinity, kv=None, sink_root=None,
+                 verify=True):
+        root = tempfile.mkdtemp(prefix=f"serve_px_{name}_")
+        eng_cfg = {"num_slots": slots, "page_size": ps,
+                   "pages_per_slot": pps, "num_pages": num_pages,
+                   "prefill_chunk": chunk}
+        if kv:
+            eng_cfg["kv_dtype"] = kv
+        cfg = {
+            "seed": 7, "rate": rate, "n_requests": n_req,
+            "prompt_lens": [sys_len + sfx_len], "max_new": max_new,
+            "tenants": {"n": tenants, "sys_len": sys_len,
+                        "sfx_len": sfx_len, "pattern": pattern},
+            "prefill_ranks": [], "world": world, "model": model,
+            "shared_dir": os.path.join(root, "shared"),
+            "engine": eng_cfg,
+            "env_only": True, "lease_s": lease_s,
+            "prefix_routing": bool(affinity),
+            "prefix_publish_s": 0.1,
+            "return_outputs": True,
+            "timeout_s": 600,
+        }
+        if sink_root:
+            cfg["sink_dir"] = sink_root
+        cfg_path = os.path.join(root, "config.json")
+        with open(cfg_path, "w") as f:
+            json.dump(cfg, f)
+        res = mp_mesh.launch(world, worker, [cfg_path, root],
+                             log_dir=os.path.join(root, "logs"),
+                             timeout=720)
+        if not res.ok:
+            raise SystemExit(f"prefix-routing cell {name} failed:\n"
+                             f"{res.tail()}")
+        stats = []
+        for r in range(world):
+            with open(os.path.join(root, f"bench.{r}.json")) as f:
+                stats.append(json.load(f))
+        served = sorted(g for s in stats for g in s["served"])
+        assert served == list(range(n_req)), \
+            f"cell {name}: lost/duplicated requests " \
+            f"({len(served)} served of {n_req})"
+        if verify:
+            for s in stats:
+                for g, seq in s["outputs"].items():
+                    assert seq == refs[int(g)], \
+                        f"cell {name}: gid {g} diverged from the " \
+                        "dense reference on rank " \
+                        f"{s['rank']} — routing/migration moved " \
+                        "tokens, not just placement"
+        ttfts = [v for s in stats for v in s["ttft_ms"].values()]
+        px = [s["prefix"] for s in stats]
+        wall = max(s["end_w"] for s in stats) - \
+            min(s["start_w"] for s in stats)
+        return {
+            "affinity": bool(affinity),
+            "kv_dtype": px[0]["kv_dtype"],
+            "mean_ttft_ms": round(float(np.mean(ttfts)), 2),
+            "ttft_p50_ms": round(pct(ttfts, 50), 2),
+            "ttft_p95_ms": round(pct(ttfts, 95), 2),
+            "tokens": sum(s["tokens"] for s in stats),
+            "wall_s": round(wall, 3),
+            "prefill_chunks": int(sum(s["prefill_chunks"]
+                                      for s in stats)),
+            "prefix_hit_tokens": sum(p["prefix_hit_tokens"]
+                                     for p in px),
+            "remote_hit_tokens": sum(p["remote_hit_tokens"]
+                                     for p in px),
+            "migrations": sum(p["migrations_out"] for p in px),
+            "migration_bytes": sum(p["migration_bytes_out"]
+                                   for p in px),
+            "stale_withdrawals": sum(p["stale_withdrawals"]
+                                     for p in px),
+            "published_chains": [p["published_chains"] for p in px],
+            "per_rank_hit_tokens": [p["prefix_hit_tokens"]
+                                    for p in px],
+            "per_rank_prefix": px,
+        }
+
+    # ---- interleaved paired reps: blind then affinity, back to back
+    # per rep, so timeshared-CPU drift hits both arms of a pair ------
+    aff_cells, blind_cells = [], []
+    sink_root = os.path.join(args.sink_dir, "px_aff") \
+        if args.sink_dir else tempfile.mkdtemp(prefix="serve_px_sink_")
+    for rep in range(reps):
+        blind_cells.append(run_cell(f"blind{rep}", affinity=False))
+        aff_cells.append(run_cell(
+            f"aff{rep}", affinity=True,
+            sink_root=sink_root if rep == reps - 1 else None))
+    ratios = sorted(b["mean_ttft_ms"] / max(a["mean_ttft_ms"], 1e-9)
+                    for a, b in zip(aff_cells, blind_cells))
+    ratio = ratios[len(ratios) // 2]
+
+    # ---- economy evidence, asserted (the full-run artifact is the
+    # acceptance gate; tiny smoke keeps the structural asserts only) -
+    hit_total = sum(c["prefix_hit_tokens"] for c in aff_cells)
+    remote_total = sum(c["remote_hit_tokens"] for c in aff_cells)
+    migr_total = sum(c["migrations"] for c in aff_cells)
+    assert hit_total > 0, \
+        "affinity arm never hit a prefix — the economy did nothing"
+    assert all(any(n > 0 for n in c["published_chains"])
+               for c in aff_cells), "no rank ever published a digest"
+    if not tiny:
+        assert migr_total > 0, \
+            "no hot chain ever migrated — the spill pressure the " \
+            "workload skew exists to create never materialized"
+        assert remote_total > 0, \
+            "no cross-rank hit: migrated chains never served a " \
+            "request on their new rank"
+
+    # ---- migration bytes by dtype: one int8 affinity cell (outside
+    # the bitwise contract, PR 12 — no dense check) ------------------
+    int8_cell = run_cell("int8", affinity=True, kv="int8",
+                         verify=False)
+    bytes_by_dtype = {
+        "float32": {
+            "migrations": migr_total,
+            "migration_bytes": sum(c["migration_bytes"]
+                                   for c in aff_cells)},
+        "int8": {
+            "migrations": int8_cell["migrations"],
+            "migration_bytes": int8_cell["migration_bytes"]},
+    }
+
+    # ---- merged cross-host trace (PR 14 merger) over the last
+    # affinity rep's per-rank sinks: e2e TTFT with uncertainty -------
+    import merge_traces
+
+    mdoc = merge_traces.merge(sink_root)
+    mpath = os.path.join(sink_root, "merged_trace.json")
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(mdoc, f)
+    os.replace(mpath + ".tmp", mpath)
+    merged_block = {
+        "artifact": mpath,
+        "partial": mdoc["partial"],
+        "requests_total": mdoc["requests_total"],
+        "requests_complete": mdoc["requests_complete"],
+        "e2e_ttft_ms": mdoc["latency"]["ttft_ms"],
+        "e2e_ttft_unc_ms": mdoc["latency"]["ttft_unc_ms"],
+    }
+
+    agg = {
+        "prefix_hit_tokens": hit_total,
+        "remote_hit_tokens": remote_total,
+        "migrations": migr_total,
+        "migration_bytes_out": sum(c["migration_bytes"]
+                                   for c in aff_cells),
+        "stale_withdrawals": sum(c["stale_withdrawals"]
+                                 for c in aff_cells),
+        "kv_dtype": "float32",
+    }
+    return {
+        "metric": "serving_prefix_economy_ttft_speedup",
+        "value": round(ratio, 4),
+        "unit": "x mean TTFT, affinity-blind mesh over the "
+                "prefix-economy mesh (paired-median over interleaved "
+                "reps; >1 = economy wins)",
+        "extra": {
+            "mode": "tiny" if tiny else "full",
+            "model": model, "world": world,
+            "tenants": tenants, "tenant_pattern": pattern,
+            "system_prompt_tokens": sys_len,
+            "suffix_tokens": sfx_len, "requests": n_req,
+            "max_new": max_new, "arrival_rate_hz": rate,
+            "page_size": ps, "slots_per_rank": slots,
+            "pages_per_rank": num_pages, "lease_s": lease_s,
+            "reps": reps,
+            "paired_ttft_ratios": [round(r, 4) for r in ratios],
+            "prefix_economy": agg,
+            "migration_bytes_by_dtype": bytes_by_dtype,
+            "cells": {"affinity": aff_cells, "blind": blind_cells,
+                      "int8": int8_cell},
+            "merged_trace": merged_block,
+            "note": ("both arms run the SAME seeded tenant trace on "
+                     "the same 2-process mesh with local prefix "
+                     "caching ON — the blind arm differs only in "
+                     "prefix_routing=False, so the headline prices "
+                     "placement + migration, not caching. Every f32 "
+                     "cell's full decoded sequences are asserted "
+                     "bitwise-equal to dense generate() references "
+                     "computed by the driver; the int8 cell prices "
+                     "migration bytes at 4x pool-byte density "
+                     "(PR 12's token-match contract, not bitwise) "
+                     "and ships per-page per-head scales with the "
+                     "pages. Digests (chain hashes + lengths) are "
+                     "the ONLY thing published through the board; "
+                     "page bytes move point-to-point over the "
+                     "handoff channel on migrate directives. "
+                     "One-core container: arms are paired and "
+                     "interleaved so timeshared-CPU drift cancels "
+                     "in the ratio"),
+        },
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
@@ -1825,6 +2144,17 @@ def main():
                          "is the re-dispatched tail's p95 TTFT over "
                          "the undisturbed mesh's, zero-loss asserted "
                          "in both cells (BENCH_SERVE_r17.json)")
+    ap.add_argument("--prefix-routing", action="store_true",
+                    help="global-KV-economy cell (ISSUE 18): 2 real "
+                         "env-protocol ranks on a skewed shared-"
+                         "system-prompt tenant workload, prefix-"
+                         "affinity routing + hot-chain migration vs "
+                         "the affinity-blind mesh (local caching on "
+                         "in both); headline is the paired-median "
+                         "blind/affinity mean-TTFT ratio, bitwise "
+                         "parity to dense references asserted, plus "
+                         "an int8 cell pricing migration bytes by "
+                         "dtype (BENCH_SERVE_r18.json)")
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--max-new", type=int, default=48)
@@ -1893,6 +2223,14 @@ def main():
                          args.sink_dir or args.live_status):
         ap.error("--elastic is its own comparison mode (real "
                  "processes; per-cell sinks live in the cell dirs)")
+    if args.prefix_routing and (
+            args.kernel_matrix or args.spec_decode or
+            args.prefix_cache or args.sched_matrix or
+            args.adaptive_k or args.kv_dtype != "f32" or
+            args.hosts > 1 or args.elastic or args.trace_window or
+            args.live_status):
+        ap.error("--prefix-routing is its own comparison mode (real "
+                 "processes; --sink-dir feeds the merged-trace block)")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -1919,6 +2257,8 @@ def main():
 
     if args.elastic:
         out = bench_elastic(args, args.tiny)
+    elif args.prefix_routing:
+        out = bench_prefix_routing(args, args.tiny)
     elif args.hosts > 1:
         if args.kernel_matrix or args.spec_decode or \
                 args.prefix_cache or args.kv_dtype != "f32" or \

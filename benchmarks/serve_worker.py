@@ -57,18 +57,41 @@ def main():
     rng = np.random.RandomState(cfg["seed"])
     trace = []
     t = 0.0
-    for i in range(cfg["n_requests"]):
-        t += float(rng.exponential(1.0 / cfg["rate"]))
-        ln = cfg["prompt_lens"][i % len(cfg["prompt_lens"])]
-        trace.append((t, rng.randint(0, 128, (ln,)).astype(np.int32),
-                      int(cfg["max_new"])))
+    tn = cfg.get("tenants")
+    if tn:
+        # prefix-economy cells (ISSUE 18): T tenants, each with its
+        # own system prompt, interleaved round-robin — every request
+        # is <tenant system prefix> + <unique suffix>. RNG call order
+        # (systems first, then one suffix per request) is the contract
+        # the driver replays to compute dense-reference outputs.
+        systems = [rng.randint(0, 128, (int(tn["sys_len"]),))
+                   .astype(np.int32) for _ in range(int(tn["n"]))]
+        # optional skew pattern (e.g. [0, 1, 0, 2]: tenant 0 is the
+        # hot one) — load on the hot tenant's affine rank is what
+        # forces spill + hot-chain migration
+        pat = tn.get("pattern") or list(range(len(systems)))
+        for i in range(cfg["n_requests"]):
+            t += float(rng.exponential(1.0 / cfg["rate"]))
+            sfx = rng.randint(0, 128, (int(tn["sfx_len"]),)) \
+                .astype(np.int32)
+            trace.append((t, np.concatenate(
+                [systems[pat[i % len(pat)]], sfx]),
+                int(cfg["max_new"])))
+    else:
+        for i in range(cfg["n_requests"]):
+            t += float(rng.exponential(1.0 / cfg["rate"]))
+            ln = cfg["prompt_lens"][i % len(cfg["prompt_lens"])]
+            trace.append((t, rng.randint(0, 128, (ln,))
+                          .astype(np.int32), int(cfg["max_new"])))
 
     scfg = ServingConfig(**cfg["engine"])
     srv = DisaggServer(
         net, scfg, MeshSpec(rank, world,
                             prefill_ranks=tuple(cfg["prefill_ranks"])),
         cfg["shared_dir"], lease_s=float(cfg.get("lease_s", 5.0)),
-        long_prompt_threshold=cfg.get("long_prompt_threshold"))
+        long_prompt_threshold=cfg.get("long_prompt_threshold"),
+        prefix_routing=bool(cfg.get("prefix_routing")),
+        prefix_publish_s=float(cfg.get("prefix_publish_s", 0.5)))
 
     # ---- warm every compiled program OFF the measured clock: the
     # tick (via a held prefill), the export read AND the import
@@ -88,6 +111,14 @@ def main():
         if all(r is None for r in eng._slot_rid) and not eng._queue:
             break
     eng.drain(0)
+    # warm the prefix-migration round trip too (ISSUE 18): the warm
+    # request's chain is still indexed — export it through the
+    # fixed-shape jitted gather and re-import it (a duplicate chain:
+    # its pages bounce straight back to the pool), so a mid-run
+    # migration never pays either compile on the measured clock
+    mig = eng.export_prefix_chain(warm_p)
+    if mig is not None:
+        eng.import_prefix_chain(mig)
     eng.pool.drop_prefix_cache()
     eng.reset_results()
 
@@ -107,7 +138,16 @@ def main():
     # <sink_dir>/rank<K>/ with tools/merge_traces.py into the
     # mesh-wide clock-aligned latency block
     if cfg.get("sink_dir"):
-        _profiler.enable_sink(cfg["sink_dir"], interval_s=10.0)
+        if world > 1 and env_only:
+            # env-protocol ranks have no jax.distributed to detect
+            # the rank from (_detect_rank would say 0 on every rank,
+            # interleaving one JSONL file) — place each rank's sink
+            # explicitly so the merger still sees rank<K>/ dirs
+            _profiler.enable_sink(
+                os.path.join(cfg["sink_dir"], f"rank{rank}"),
+                per_rank_subdir=False, rank=rank, interval_s=10.0)
+        else:
+            _profiler.enable_sink(cfg["sink_dir"], interval_s=10.0)
 
     if world > 1 and env_only:
         # file-based warm barrier: there is no coordination service
@@ -197,7 +237,32 @@ def main():
         "redispatched": {str(g): m
                          for g, m in srv.redispatched.items()},
         "members": sorted(srv._members),
+        # global KV economy evidence (ISSUE 18): per-rank because each
+        # rank is its own PROCESS here — the registry split the
+        # in-process threaded tests cannot observe. Same shape as
+        # write_results' prefix_economy block; present in BOTH arms
+        # (the affinity-blind arm still serves local prefix hits, so
+        # its hit_tokens are the baseline the speedup is priced
+        # against).
+        "prefix": {
+            "prefix_hit_tokens": int(registry().counter(
+                "serving/prefix_hit_tokens").value),
+            "remote_hit_tokens": int(registry().counter(
+                "serving/prefix_hit_tokens_remote").value),
+            "migrations_out": srv.prefix_migrations_out,
+            "migrations_in": srv.prefix_migrations_in,
+            "migration_bytes_out": srv.prefix_migration_bytes_out,
+            "migration_bytes_in": srv.prefix_migration_bytes_in,
+            "stale_withdrawals": srv.stale_digest_withdrawals,
+            "kv_dtype": str(np.dtype(srv.engine.pool.k.dtype)),
+            "published_chains": len(srv._published_chains),
+        },
     }
+    if cfg.get("return_outputs"):
+        # full decoded sequences (prompt + generation), gid-keyed:
+        # the driver bitwise-compares them against dense references
+        stats["outputs"] = {str(g): [int(x) for x in v]
+                            for g, v in res.items()}
     path = os.path.join(out_dir, f"bench.{rank}.json")
     with open(path + ".tmp", "w") as f:
         json.dump(stats, f)

@@ -135,7 +135,8 @@ from ..profiler import events as _pevents
 from ..profiler.metrics import registry as _registry
 from ..utils.retry import RetryError, retry as _retry
 from .engine import ServingConfig, ServingEngine
-from .sched import ttfc_key
+from .paged_cache import chain_hashes
+from .sched import prefix_affinity_key, ttfc_key
 
 __all__ = ["MeshSpec", "HandoffChannel", "DisaggServer",
            "route_requests"]
@@ -212,12 +213,18 @@ class HandoffChannel:
                       base_delay=self.retry_base_delay_s,
                       exceptions=(OSError,), on_retry=_count)
 
-    def _path_to(self, gid: int, dst: int) -> str:
-        return os.path.join(self.dir, f"h-{gid:08d}-to{dst}.npz")
+    def _path_to(self, gid: int, dst: int, kind: str = "h") -> str:
+        return os.path.join(self.dir, f"{kind}-{gid:08d}-to{dst}.npz")
 
-    def send(self, dst: int, gid: int, payload: dict) -> int:
-        """Ship ``payload`` to rank ``dst``; returns payload bytes."""
-        final = self._path_to(gid, dst)
+    def send(self, dst: int, gid: int, payload: dict,
+             kind: str = "h") -> int:
+        """Ship ``payload`` to rank ``dst``; returns payload bytes.
+        ``kind`` prefixes the filename (default ``h`` = request
+        handoff; ``m`` = prefix-chain migration, ISSUE 18) so the two
+        payload families can never cross a poll: a migration chain
+        imported as a request — or scavenged off a corpse as one —
+        would be a torn admission."""
+        final = self._path_to(gid, dst, kind)
         tmp = final + f".tmp{os.getpid()}"
         arrays = {}
         for k, v in payload.items():
@@ -232,19 +239,21 @@ class HandoffChannel:
         self._retry_io(lambda: os.rename(tmp, final))
         return sum(a.nbytes for a in arrays.values())
 
-    def poll(self) -> List[Tuple[int, dict]]:
-        """Consume every complete payload addressed to this rank."""
+    def poll(self, kind: str = "h") -> List[Tuple[int, dict]]:
+        """Consume every complete ``kind`` payload addressed to this
+        rank."""
         out = []
+        prefix = f"{kind}-"
         suffix = f"-to{self.rank}.npz"
         try:
             names = sorted(os.listdir(self.dir))
         except OSError:
             return out
         for n in names:
-            if not (n.startswith("h-") and n.endswith(suffix)):
+            if not (n.startswith(prefix) and n.endswith(suffix)):
                 continue
             path = os.path.join(self.dir, n)
-            gid = int(n[2:10])
+            gid = int(n[len(prefix):len(prefix) + 8])
 
             def _load(p=path):
                 with np.load(p) as z:
@@ -307,7 +316,23 @@ class HandoffChannel:
         return True
 
 
-def route_requests(votes: Dict[int, dict]) -> dict:
+def _chain_hit_tokens(chain: List[str], digest: dict) -> int:
+    """Tokens of ``chain`` (a prompt's chunk-hash chain, lowest chunk
+    first) covered by a rank's published ``digest`` — the longest
+    UNBROKEN published prefix (a gap means the parent chain was
+    evicted; anything past it is unusable)."""
+    chains = digest.get("chains") or {}
+    hit = 0
+    for h in chain:
+        n = chains.get(str(h))
+        if n is None:
+            break
+        hit = int(n)
+    return hit
+
+
+def route_requests(votes: Dict[int, dict],
+                   prefix_index: Optional[dict] = None) -> dict:
     """The admission reducer: a PURE function of one round's votes —
     whichever live rank leads publishes the same assignment.
 
@@ -341,7 +366,41 @@ def route_requests(votes: Dict[int, dict]) -> dict:
     — which are re-routed through the same load-shaped pick, after
     the fresh range (their lens ride ``pending`` like any unrouted
     gid's).
+
+    Global KV economy (ISSUE 18): when the caller passes the adopted
+    mesh ``prefix_index`` ({rank: digest}) and votes carry per-gid
+    chunk-hash ``chains``, the pick discounts each candidate by its
+    published prefix coverage (:func:`sched.prefix_affinity_key` —
+    hit length priced in the SAME chunk currency as the load terms,
+    so a hot rank is not swamped by affinity). When the load vote
+    still sends a request AWAY from its best published prefix by a
+    page or more, the decision carries a ``migrate`` directive
+    ``{gid: [src, dst]}`` — the owning rank replicates the hot chain
+    to where the request will actually prefill. Pure policy: only the
+    leader computes this; every peer ADOPTS the published decision,
+    so a stale or rank-skewed index costs performance, never
+    divergence.
+
+    Membership fix (ISSUE 18 satellite): a rank the member round
+    agreed OUT is excluded from every pick set — even when a stale
+    vote of its still sits on the board — instead of being priced as
+    merely busy. Votes without a ``members`` key (pre-ISSUE-18) keep
+    the old price-as-busy behavior for missing voters.
     """
+    members: Optional[set] = None
+    for v in votes.values():
+        m = v.get("members")
+        if m is None:
+            continue
+        m = {int(r) for r in m}
+        members = m if members is None else (members & m)
+    if members:
+        # an agreed-out rank's stale vote must not shape the round
+        # either: casting a vote proves liveness, but a lingering
+        # board file from before the eviction proves nothing
+        live = {r: v for r, v in votes.items() if r in members}
+        if live:
+            votes = live
     topo = votes[min(votes)]["topology"]
     prefill = list(topo["prefill"])
     decode = list(topo["decode"])
@@ -349,40 +408,79 @@ def route_requests(votes: Dict[int, dict]) -> dict:
     routed = max(int(v["routed"]) for v in votes.values())
     upto = min(int(v["seen"]) for v in votes.values())
     lens: Dict[int, int] = {}
-    for v in votes.values():
-        for g, ln in v["pending"].items():
+    chains: Dict[int, List[str]] = {}
+    for r in sorted(votes):
+        for g, ln in votes[r]["pending"].items():
             lens[int(g)] = int(ln)
+        for g, c in (votes[r].get("chains") or {}).items():
+            chains.setdefault(int(g), [str(h) for h in c])
 
     # keyed by the TOPOLOGY's ranks, not the voters': a dead peer's
     # vote is missing but its rank is still routable (ttfc_key prices
-    # it as busy — indexing it must not crash the leader)
+    # it as busy — indexing it must not crash the leader) — UNLESS
+    # the member round agreed it out
+    if members is not None:
+        prefill = [r for r in prefill if r in members]
+        decode = [r for r in decode if r in members]
     ranks_all = set(prefill) | set(decode)
     extra_tokens = {r: 0 for r in ranks_all}
     extra_reqs = {r: 0 for r in ranks_all}
 
-    def pick(ranks):
+    def hits_for(gid):
+        chain = chains.get(gid)
+        if prefix_index is None or not chain:
+            return None
+        out = {}
+        for r in ranks_all:
+            dig = prefix_index.get(str(r)) or prefix_index.get(r)
+            if dig:
+                out[r] = _chain_hit_tokens(chain, dig)
+        return out or None
+
+    def pick(ranks, hits=None):
+        if hits:
+            return min(ranks, key=lambda r: prefix_affinity_key(
+                votes, r, extra_tokens, extra_reqs, hits.get(r, 0)))
         return min(ranks, key=lambda r: ttfc_key(
             votes, r, extra_tokens, extra_reqs))
 
-    def place(gid, plen, assign):
-        d = pick(decode)
+    def place(gid, plen, assign, migrate):
+        if not decode:
+            return False            # no routable decode rank: park
+        hits = hits_for(gid)
+        d = pick(decode, hits)
         extra_reqs[d] += 1
         p = -1
         if prefill and plen >= threshold:
-            p = pick(prefill)
+            p = pick(prefill, hits)
             extra_reqs[p] += 1
             extra_tokens[p] += plen   # the chunk train runs HERE
         else:
             extra_tokens[d] += plen   # short prompts prefill where
         assign[str(gid)] = [p, d]     # they decode
+        if hits:
+            # the prefix pays off on the rank that RUNS the prefill;
+            # when load pushed the request a page or more away from
+            # its best published chain, direct the owner to replicate
+            # the chain to the runner (hot-chain migration)
+            runner = p if p >= 0 else d
+            best = max(hits, key=lambda r: (hits[r], -r))
+            ps = int((votes.get(best) or votes[min(votes)])
+                     .get("page_size", 16))
+            if best != runner and \
+                    hits[best] - hits.get(runner, 0) >= ps:
+                migrate[str(gid)] = [int(best), int(runner)]
+        return True
 
     assign: Dict[str, List[int]] = {}
+    migrate: Dict[str, List[int]] = {}
     fresh = 0
     for gid in range(routed, upto):
         plen = lens.get(gid)
         if plen is None:            # no voter carried it: leave queued
             break
-        place(gid, plen, assign)
+        if not place(gid, plen, assign, migrate):
+            break
         fresh += 1
     requeue = sorted({int(g) for v in votes.values()
                       for g in v.get("requeue", [])}
@@ -391,8 +489,11 @@ def route_requests(votes: Dict[int, dict]) -> dict:
         plen = lens.get(gid)
         if plen is None:
             continue                # no voter carries it any more
-        place(gid, plen, assign)
-    return {"assign": assign, "routed": routed + fresh}
+        place(gid, plen, assign, migrate)
+    out = {"assign": assign, "routed": routed + fresh}
+    if migrate:
+        out["migrate"] = migrate
+    return out
 
 
 def _clock_reducer(votes: Dict[int, dict]) -> dict:
@@ -444,6 +545,17 @@ def _member_reducer(votes: Dict[int, dict]) -> dict:
             "dead": sorted(dead), "routed": routed}
 
 
+def _prefix_reducer(votes: Dict[int, dict]) -> dict:
+    """The ``prefix`` round's reducer (ISSUE 18): the mesh prefix
+    index is simply every voter's digest keyed by rank — pure,
+    deterministic (votes arrive rank-sorted), and tiny: chunk-hash
+    chains with token lengths, NEVER page bytes or token ids. Adoption
+    MERGES per rank across rounds (a round's voters may be a subset),
+    and membership changes prune dead ranks' entries."""
+    return {"index": {str(r): (v.get("digest") or {})
+                      for r, v in sorted(votes.items())}}
+
+
 @dataclass
 class _GlobalReq:
     gid: int
@@ -491,7 +603,9 @@ class DisaggServer:
                  dead_after_s: Optional[float] = None,
                  join: bool = False,
                  clock_skew_s: Optional[float] = None,
-                 clock_resync_s: float = 0.0):
+                 clock_resync_s: float = 0.0,
+                 prefix_routing: bool = False,
+                 prefix_publish_s: float = 0.5):
         self.mesh = mesh
         self.engine = ServingEngine(model, config)
         self.consensus = consensus if consensus is not None else \
@@ -600,6 +714,36 @@ class DisaggServer:
         #: per-gid handoff trace context of IMPORTED requests:
         #: {gid: (ctx dict from the payload, import wall stamp)}
         self._handoff_ctx: Dict[int, Tuple[dict, float]] = {}
+        # -- global KV economy (ISSUE 18) -------------------------------
+        #: publish local prefix digests + route on the mesh index +
+        #: replicate hot chains; forced off without a prefix cache
+        #: (nothing to publish). Pure host-side policy either way.
+        self.prefix_routing = bool(prefix_routing) and \
+            self.engine.pool.prefix is not None
+        self.prefix_publish_s = float(prefix_publish_s)
+        #: the adopted mesh prefix index {str(rank): digest}, merged
+        #: across rounds, pruned on membership change
+        self._prefix_index: Dict[str, dict] = {}
+        self._voted_prefix = False
+        self._prefix_open_t = 0.0
+        self._published_rev = -1          # trie rev at last vote
+        self._published_chains: set = set()
+        self._withdrawals_due = 0         # dirty: publish immediately
+        #: migration directives adopted from routing decisions where
+        #: THIS rank is the chain owner: {gid: dst rank}
+        self._migrate_out: Dict[int, int] = {}
+        #: (dst, chain tail hash) already shipped — the same hot chain
+        #: is not re-sent every round the index lags
+        self._migrated_sent: set = set()
+        self.prefix_migrations_out = 0
+        self.prefix_migrations_in = 0
+        self.prefix_migration_bytes_out = 0
+        self.prefix_migration_bytes_in = 0
+        self.stale_digest_withdrawals = 0
+        if self.prefix_routing:
+            # withdraw-before-reclaim (ISSUE 18 satellite): the hook
+            # runs while the index still holds the page's refcount
+            self.engine.pool.prefix.on_drop = self._on_prefix_drop
         # lease upkeep on a daemon thread: a rank COMPILING its first
         # tick (tens of seconds on a small box) is alive, and its lease
         # must say so or a fast peer transiently "survives" it and
@@ -871,6 +1015,10 @@ class DisaggServer:
             self._joined = True
             self._routed_hwm = max(self._routed_hwm,
                                    int(value.get("routed", 0)))
+        # the mesh prefix index follows membership (ISSUE 18): an
+        # agreed-out rank's published chains must stop attracting
+        # routing the moment the eviction adopts
+        self._prune_prefix_index()
         if me not in new and self._joined:
             self._on_evicted()
             return
@@ -1048,6 +1196,7 @@ class DisaggServer:
         self._sent_log.clear()
         self._recv_log.clear()
         self._requeued.clear()
+        self._migrate_out.clear()
         self._done_verdict = None
         _registry().counter("serving/self_evictions").add(1)
 
@@ -1060,7 +1209,7 @@ class DisaggServer:
         discarded (a mesh that was idle-done before we joined must not
         make OUR ``run()`` return before we served anything)."""
         cons = self.consensus
-        for fam in ("member", "clock", "admit", "done"):
+        for fam in ("member", "clock", "admit", "done", "prefix"):
             cons.fast_forward(fam)
         while True:
             dec = cons.outcome("member", reducer=_member_reducer)
@@ -1073,7 +1222,13 @@ class DisaggServer:
                 break
             self._adopt_clock(dec.value)
         while True:
-            dec = cons.outcome("admit", reducer=route_requests)
+            dec = cons.outcome("prefix", reducer=_prefix_reducer)
+            if dec is None:
+                break
+            if self.prefix_routing:
+                self._adopt_prefix(dec.value)
+        while True:
+            dec = cons.outcome("admit", reducer=self._route_reducer)
             if dec is None:
                 break
             self._adopt_assignment_decision(dec)
@@ -1097,6 +1252,152 @@ class DisaggServer:
         return {g: r.meta["redispatched"]
                 for g, r in self._reqs.items()
                 if "redispatched" in r.meta}
+
+    # -- global KV economy (ISSUE 18) --------------------------------------
+    def _on_prefix_drop(self, chain_hash: str, n_tokens: int) -> None:
+        """PrefixCache eviction hook, called BEFORE the page is handed
+        back to the allocator: a chain this rank may have published is
+        going away, so record the withdrawal NOW — the next prefix
+        round publishes immediately (no rate-limit wait), and until it
+        lands a peer routing on the stale digest merely mis-prices a
+        pick (the lookup on arrival is an honest miss)."""
+        if chain_hash in self._published_chains:
+            self._withdrawals_due += 1
+            self.stale_digest_withdrawals += 1
+            _registry().counter(
+                "serving/stale_digest_withdrawals").add(1)
+            _pevents.emit("prefix_withdraw", chain=chain_hash,
+                          tokens=int(n_tokens))
+
+    def _prefix_round(self) -> None:
+        """Non-blocking digest publication through the consensus board
+        (family ``prefix``): vote this rank's current trie digest when
+        it CHANGED since the last vote — rate-limited, except a
+        withdrawal publishes immediately — or when a peer opened the
+        round; adopt the merged mesh index when it publishes. Digests
+        only: chunk-hash chains + token lengths ride the board, page
+        bytes ride the handoff channel and only on an agreed migrate
+        directive."""
+        if not self.prefix_routing:
+            return
+        cons = self.consensus
+        if self._voted_prefix:
+            dec = cons.outcome("prefix", reducer=_prefix_reducer)
+            if dec is not None:
+                self._voted_prefix = False
+                self._adopt_prefix(dec.value)
+            return
+        trie = self.engine.pool.prefix
+        now = time.monotonic()
+        changed = trie.rev != self._published_rev
+        want = changed and (
+            self._withdrawals_due > 0
+            or now - self._prefix_open_t > self.prefix_publish_s)
+        if cons.pending("prefix") or want:
+            digest = trie.digest()
+            cons.vote("prefix", {"digest": digest})
+            self._voted_prefix = True
+            self._prefix_open_t = now
+            self._published_rev = trie.rev
+            self._published_chains = set(digest["chains"])
+            self._withdrawals_due = 0
+            _pevents.emit("prefix_publish",
+                          chains=len(digest["chains"]))
+
+    def _adopt_prefix(self, value: dict) -> None:
+        for r, dig in (value.get("index") or {}).items():
+            self._prefix_index[str(r)] = dig
+        self._prune_prefix_index()
+
+    def _prune_prefix_index(self) -> None:
+        """Membership prunes the mesh index: an agreed-out rank's
+        digests must not attract routing (its pages are gone with
+        it)."""
+        keep = {str(r) for r in self._members}
+        for r in [r for r in self._prefix_index if r not in keep]:
+            del self._prefix_index[r]
+
+    def _route_reducer(self, votes: Dict[int, dict]) -> dict:
+        """The admission reducer actually registered on the board:
+        :func:`route_requests` closed over this rank's adopted mesh
+        prefix index. SPMD-safe even though the index is per-rank
+        state: only the round's LEADER computes the reducer — every
+        other rank adopts the published decision verbatim — so index
+        staleness or skew costs placement quality, never stream
+        divergence."""
+        return route_requests(
+            votes, prefix_index=(self._prefix_index
+                                 if self.prefix_routing else None))
+
+    def _export_migrations(self) -> None:
+        """Execute adopted migrate directives owned by this rank:
+        replicate the hot chain's raw pages (+ scales) to the rank the
+        router placed the request on, over the handoff channel's
+        ``m`` family. The chain may have been evicted since the
+        decision — the honest outcome is a skipped send, never a
+        guessed payload."""
+        if not self._migrate_out:
+            return
+        ps = self.engine.pool.page_size
+        for gid, dst in sorted(self._migrate_out.items()):
+            req = self._reqs.get(gid)
+            if req is None:
+                continue          # driver not caught up: retry later
+            del self._migrate_out[gid]
+            if dst not in self._members or dst in self._dead:
+                continue
+            payload = self.engine.export_prefix_chain(req.prompt)
+            if payload is None:
+                continue          # evicted since published: honest miss
+            n_tok = int(payload["n_tokens"])
+            tail = chain_hashes(req.prompt[:n_tok], ps)[-1]
+            if (dst, tail) in self._migrated_sent:
+                continue
+            self._migrated_sent.add((dst, tail))
+            nbytes = self.channel.send(dst, gid, payload, kind="m")
+            self.prefix_migrations_out += 1
+            self.prefix_migration_bytes_out += nbytes
+            reg = _registry()
+            reg.counter("serving/prefix_migrations_out").add(1)
+            reg.counter("serving/prefix_migration_bytes_out") \
+                .add(nbytes)
+            _pevents.emit("prefix_migrate_out", gid=int(gid),
+                          dst=int(dst), tokens=n_tok, bytes=nbytes,
+                          kv_dtype=str(payload["kv_dtype"]))
+
+    def _import_migrations(self) -> None:
+        """Consume migrated chains addressed to this rank and insert
+        them into the local trie under the normal refcount rules
+        (``ServingEngine.import_prefix_chain``); the next prefix round
+        republishes the grown digest, so followers of the same tenant
+        route here and hit REMOTELY-prefilled pages."""
+        if not self.prefix_routing:
+            return
+        for gid, payload in self.channel.poll(kind="m"):
+            try:
+                tokens = self.engine.import_prefix_chain(payload)
+            except ValueError:
+                _registry().counter(
+                    "serving/prefix_migration_rejected").add(1)
+                continue
+            if not tokens:
+                # pool full or nothing new: dropped. Counted — a mesh
+                # whose every migration lands in a full pool is a
+                # sizing problem the operator must be able to SEE.
+                _registry().counter(
+                    "serving/prefix_migration_dropped").add(1)
+                continue
+            nbytes = sum(np.asarray(payload[k]).nbytes
+                         for k in ("k", "v", "k_scale", "v_scale")
+                         if k in payload)
+            self.prefix_migrations_in += 1
+            self.prefix_migration_bytes_in += nbytes
+            reg = _registry()
+            reg.counter("serving/prefix_migrations_in").add(1)
+            reg.counter("serving/prefix_migration_bytes_in").add(nbytes)
+            _pevents.emit("prefix_migrate_in", gid=int(gid),
+                          tokens=int(tokens), bytes=nbytes,
+                          kv_dtype=str(payload.get("kv_dtype")))
 
     # -- scheduling --------------------------------------------------------
     def _unrouted(self) -> List[int]:
@@ -1147,10 +1448,27 @@ class DisaggServer:
                 # static MeshSpec (ISSUE 17): a dead rank left it, a
                 # joiner entered it
                 "topology": self._topology(),
+                # the agreed member set rides every admission vote
+                # (ISSUE 18 satellite): an agreed-out rank's stale
+                # vote or topology row is EXCLUDED by the reducer,
+                # not priced as busy
+                "members": sorted(self._members),
             }
+            if self.prefix_routing:
+                # per-gid chunk-hash chains (capped — the affinity
+                # term saturates long before 32 pages) so the leader
+                # can price published-prefix coverage per candidate
+                ch = {}
+                for g in unrouted:
+                    c = chain_hashes(self._reqs[g].prompt,
+                                     eng.pool.page_size)[:32]
+                    if c:
+                        ch[str(g)] = c
+                if ch:
+                    vote["chains"] = ch
             cons.vote("admit", vote)
             self._voted_admit = True
-        dec = cons.outcome("admit", reducer=route_requests)
+        dec = cons.outcome("admit", reducer=self._route_reducer)
         if dec is None:
             return
         self._voted_admit = False
@@ -1198,6 +1516,16 @@ class DisaggServer:
                 self._apply_assignment(gid)
             # else: routed before our driver submitted it — submit()
             # applies the parked assignment when the gid arrives
+        for g_str, sd in (dec.value.get("migrate") or {}).items():
+            src, dst = int(sd[0]), int(sd[1])
+            if src == me and dst != me:
+                # this rank owns the hot chain: replicate it to where
+                # the request will actually prefill (_export_migrations
+                # runs it on the heartbeat — the prompt is known here
+                # by the SPMD driver contract, so the chain is
+                # recoverable from the trie even though the directive
+                # carries only ranks)
+                self._migrate_out.setdefault(int(g_str), dst)
         self._routed_hwm = max(self._routed_hwm,
                                int(dec.value["routed"]))
 
@@ -1444,8 +1772,11 @@ class DisaggServer:
         self.consensus.heartbeat()
         self._clock_round()
         self._member_round()
+        self._prefix_round()
         self._admission_round()
+        self._export_migrations()
         self._import_arrivals()
+        self._import_migrations()
         progressed = self.engine.step()
         if not progressed and self.engine._inflight:
             self.engine.drain(0)
@@ -1476,6 +1807,7 @@ class DisaggServer:
         return (self._clock_settled()
                 and not self._unrouted()
                 and not self._pending_imports
+                and not self._migrate_out
                 and not eng._held_ready
                 and not eng._queue and not eng._inflight
                 and all(r is None for r in eng._slot_rid))
@@ -1637,6 +1969,21 @@ class DisaggServer:
             "redispatched": {str(g): m
                              for g, m in self.redispatched.items()},
         }
+        if self.prefix_routing:
+            reg = _registry()
+            doc["prefix_economy"] = {
+                "prefix_hit_tokens": int(reg.counter(
+                    "serving/prefix_hit_tokens").value),
+                "remote_hit_tokens": int(reg.counter(
+                    "serving/prefix_hit_tokens_remote").value),
+                "migrations_out": self.prefix_migrations_out,
+                "migrations_in": self.prefix_migrations_in,
+                "migration_bytes_out": self.prefix_migration_bytes_out,
+                "migration_bytes_in": self.prefix_migration_bytes_in,
+                "stale_withdrawals": self.stale_digest_withdrawals,
+                "kv_dtype": str(np.dtype(self.engine.pool.k.dtype)),
+                "published_chains": len(self._published_chains),
+            }
         tmp = path + f".tmp{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f)

@@ -444,6 +444,7 @@ class ServingEngine:
         #: export_held() (disaggregated prefill group, ISSUE 13)
         self._held_ready: set = set()
         self._import_fn = None       # lazy jitted KV-import scatter
+        self._export_fn = None       # lazy jitted KV-export gather
         self.max_inflight_seen = 0
         # device state
         self._last_tok = jnp.zeros((b_slots,), jnp.int32)
@@ -997,13 +998,18 @@ class ServingEngine:
         return rid
 
     def _write_imported_pages(self, slot: int, payload: dict) -> None:
+        self._write_pages(self.pool._held[slot], payload)
+
+    def _write_pages(self, pages, payload: dict) -> None:
         """One fixed-shape jitted scatter (padded to ``pages_per_slot``
         with the null page, whose content is always masked and whose
         scale pad is 0 — the null-scale pin survives) so imports of any
-        page count share one compiled program."""
+        page count share one compiled program. ``pages`` is the
+        explicit destination list: a slot's held pages for a request
+        handoff, or freshly-allocated index pages for a migrated
+        prefix chain (ISSUE 18) — both ride the SAME jitted writer."""
         pool = self.pool
         pps = pool.pages_per_slot
-        pages = pool._held[slot]
         n = len(pages)
         dst = np.zeros(pps, np.int32)
         dst[:n] = pages
@@ -1048,6 +1054,109 @@ class ServingEngine:
             else:
                 pool.k, pool.v = self._import_fn(pool.k, pool.v, kbuf,
                                                  vbuf, dst)
+
+    # ------------------------------------------------------------------
+    # hot prefix-chain migration (ISSUE 18). Host-side policy on the
+    # SAME handoff representation as export_held/admit_prefilled: raw
+    # page content (+ scales when quantized), never re-derived — so a
+    # request admitted onto a migrated chain stays bitwise the stream
+    # it would have produced where the chain originated. The jitted
+    # page writer is shared with the request-handoff import; no new
+    # compiled site.
+    # ------------------------------------------------------------------
+    def export_prefix_chain(self, tokens) -> Optional[dict]:
+        """Payload replicating this rank's cached prefix chain of
+        ``tokens`` (full indexed pages only, capped at one slot's
+        worth — longer can't be aliased into any slot anyway), or None
+        when nothing is cached — the chain may have been evicted since
+        it was published, and a missed migration is a perf event, not
+        an error."""
+        pool = self.pool
+        if pool.prefix is None:
+            return None
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        pages, _hashes = pool.prefix.chain_pages(toks)
+        pages = pages[:pool.pages_per_slot]
+        if not pages:
+            return None
+        n = len(pages)
+        n_tok = n * pool.page_size
+        # one fixed-shape jitted gather (padded to pages_per_slot,
+        # pad rows sliced off on the host) — chains of EVERY length
+        # share one compiled program, so the first mid-serving
+        # migration never pays a compile (the mirror of _write_pages)
+        src = np.zeros(pool.pages_per_slot, np.int32)
+        src[:n] = pages
+        if self._export_fn is None:
+            if self._quantized:
+                def gat(kp, vp, ks, vs, s):
+                    return kp[:, s], vp[:, s], ks[:, s], vs[:, s]
+            else:
+                def gat(kp, vp, s):
+                    return kp[:, s], vp[:, s]
+            self._export_fn = jax.jit(gat)
+        payload = {
+            "tokens": toks[:n_tok],
+            "n_tokens": n_tok,
+            "kv_dtype": str(np.dtype(pool.k.dtype)),
+        }
+        if self._quantized:
+            k, v, ks, vs = self._export_fn(pool.k, pool.v,
+                                           pool.k_scale, pool.v_scale,
+                                           src)
+            payload["k_scale"] = np.asarray(ks)[:, :n]
+            payload["v_scale"] = np.asarray(vs)[:, :n]
+        else:
+            k, v = self._export_fn(pool.k, pool.v, src)
+        payload["k"] = np.asarray(k)[:, :n]
+        payload["v"] = np.asarray(v)[:, :n]
+        return payload
+
+    def import_prefix_chain(self, payload: dict) -> int:
+        """Insert a migrated prefix chain into this rank's own trie
+        under the normal refcount/COW rules: allocate fresh pages,
+        write the transferred content (+ scales), index them, then
+        drop the import's temporary reference — a chunk the local trie
+        already held keeps the FIRST tenant's page (the import's copy
+        of it returns straight to the pool). Returns the tokens newly
+        indexed (0 = pool full right now, or nothing new — both
+        perf-only). Raises ValueError on a payload this pool must not
+        store (dtype/shape mismatch)."""
+        pool = self.pool
+        if pool.prefix is None:
+            return 0
+        toks = np.asarray(payload["tokens"], np.int32).reshape(-1)
+        src_dtype = payload.get("kv_dtype")
+        if src_dtype is not None and \
+                str(np.dtype(str(src_dtype))) != \
+                str(np.dtype(pool.k.dtype)):
+            raise ValueError(
+                f"migrated chain carries {str(src_dtype)!r} pages but "
+                f"this pool stores {np.dtype(pool.k.dtype)!s}")
+        n_pages = int(payload["k"].shape[1])
+        ps = pool.page_size
+        if n_pages < 1 or n_pages > pool.pages_per_slot or \
+                n_pages * ps != toks.shape[0] or \
+                payload["k"].shape != payload["v"].shape:
+            raise ValueError("inconsistent migrated chain payload")
+        if self._quantized and "k_scale" not in payload:
+            raise ValueError("quantized chain without scales")
+        # plain free-list alloc, deliberately NOT pool._alloc: a
+        # speculative import must never evict committed local cache
+        # entries to make room for itself
+        pages = pool.allocator.alloc(n_pages)
+        if pages is None:
+            return 0                 # no room: drop
+        self._write_pages(pages, payload)
+        new = pool.prefix.insert(toks, pages)
+        # drop the import's temporary refcount: newly-indexed pages
+        # stay at 1 (index-held); duplicates of already-cached chunks
+        # hit 0 and return to the pool (their scales re-queue for
+        # reset via the allocator's on_zero hook)
+        pool.allocator.free(pages)
+        kept = [p for p in pages if pool.allocator.refcount(p) > 0]
+        pool.migrated_pages.update(kept)
+        return new * ps
 
     def _tokens_done(self) -> int:
         return sum(len(r.out) for r in self._requests.values())
@@ -1215,9 +1324,17 @@ class ServingEngine:
         full_pages, partial = self.pool.prefix.lookup(req.prompt)
         _registry().counter("serving/prefix_lookups").add(1)
         hit = 0
+        remote = 0
         if full_pages:
             self.pool.share_into_slot(slot, full_pages)
             hit = len(full_pages) * self.pool.page_size
+            if self.pool.migrated_pages:
+                # cross-rank economy evidence (ISSUE 18): tokens served
+                # off pages that arrived via chain migration — this
+                # rank never prefilled them
+                remote = sum(1 for p in full_pages
+                             if p in self.pool.migrated_pages) \
+                    * self.pool.page_size
         if partial is not None:
             src, lcp = partial
             # pin the donor page: the grow below may evict unreferenced
@@ -1253,7 +1370,11 @@ class ServingEngine:
         self._slot_len[slot] = hit
         if hit:
             _registry().counter("serving/prefix_hit_tokens").add(hit)
-            self._emit("prefix_hit", req.rid, slot=slot, tokens=hit)
+            if remote:
+                _registry().counter(
+                    "serving/prefix_hit_tokens_remote").add(remote)
+            self._emit("prefix_hit", req.rid, slot=slot, tokens=hit,
+                       remote_tokens=remote)
 
     def _observe_wait(self, req: "Request") -> None:
         """One wait sample per admission cycle. Fresh admissions anchor

@@ -47,14 +47,48 @@ arguments.
 """
 from __future__ import annotations
 
+import hashlib
 import heapq
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax.numpy as jnp
 
 NULL_PAGE = 0
+
+#: hex chars kept per chunk-chain hash (blake2b); 16 hex chars = 64
+#: bits — collision-safe for any realistic mesh index size, and short
+#: enough that a whole digest rides a consensus vote as plain JSON.
+CHAIN_HASH_LEN = 16
+
+
+def chain_hash(parent_hash: str, chunk) -> str:
+    """Stable hash of one page-aligned chunk IN ITS CHAIN CONTEXT:
+    ``blake2b(parent_hash_bytes || chunk_token_bytes)``. Two ranks that
+    cached the same prompt prefix compute the same chain of hashes
+    (never Python ``hash()`` — that is salted per process), which is
+    what lets the mesh index match prefixes by digest without ever
+    shipping token bytes."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(parent_hash.encode("ascii"))
+    h.update(np.asarray(list(chunk), np.int64).tobytes())
+    return h.hexdigest()[:CHAIN_HASH_LEN]
+
+
+def chain_hashes(tokens, page_size: int) -> List[str]:
+    """Chunk-hash chain of every FULL page of ``tokens`` — the key a
+    router uses to ask "which rank has the longest cached prefix of
+    this prompt". Matches the hashes :class:`PrefixCache` stores on its
+    trie nodes, by construction."""
+    toks = np.asarray(tokens).reshape(-1)
+    ps = int(page_size)
+    out: List[str] = []
+    parent = ""
+    for i in range(toks.shape[0] // ps):
+        parent = chain_hash(parent, toks[i * ps:(i + 1) * ps])
+        out.append(parent)
+    return out
 
 
 def _registry():
@@ -76,6 +110,12 @@ class PageAllocator:
         # would make release_slot O(pages_freed * free_list_len))
         self._free_set = set(self._free)
         self._ref: Dict[int, int] = {}       # allocated page -> refcount
+        #: called with the list of pages whose LAST reference was just
+        #: dropped (they are already back on the free list). The int8
+        #: pool hooks this to queue a scale reset at free time instead
+        #: of realloc time — a zero-freed page's stale running-max
+        #: scale is scheduling history, not content (ISSUE 18).
+        self.on_zero: Optional[Callable[[List[int]], None]] = None
 
     @property
     def num_free(self) -> int:
@@ -126,6 +166,7 @@ class PageAllocator:
         raises (double-free of the LAST reference is a bug; releasing a
         still-shared page is the normal sharing path)."""
         released = 0
+        zeroed: List[int] = []
         for i in ids:
             i = int(i)
             if i == NULL_PAGE:
@@ -137,15 +178,18 @@ class PageAllocator:
                 del self._ref[i]
                 self._free.append(i)
                 self._free_set.add(i)
+                zeroed.append(i)
             else:
                 released += 1
         if released:
             _registry().counter("cache_share/releases").add(released)
+        if zeroed and self.on_zero is not None:
+            self.on_zero(zeroed)
 
 
 class _TrieNode:
     __slots__ = ("chunk", "page", "children", "first_ix", "parent",
-                 "last_use")
+                 "last_use", "hash", "depth")
 
     def __init__(self, chunk: Tuple[int, ...], page: int,
                  parent: Optional["_TrieNode"]):
@@ -161,6 +205,13 @@ class _TrieNode:
         self.first_ix: Dict[int, List["_TrieNode"]] = {}
         self.parent = parent
         self.last_use = 0
+        # chain hash + chain depth (root = depth 0): the digest the
+        # mesh index publishes for this node (ISSUE 18)
+        if parent is None:
+            self.hash, self.depth = "", 0
+        else:
+            self.hash = chain_hash(parent.hash, chunk)
+            self.depth = parent.depth + 1
 
 
 class PrefixCache:
@@ -181,6 +232,17 @@ class PrefixCache:
         self.allocator = allocator
         self._root = _TrieNode((), NULL_PAGE, None)
         self._clock = 0
+        #: structural revision — bumps whenever the set of indexed
+        #: chains changes (insert of a NEW node, any drop), so a
+        #: publisher can skip recomputing/re-voting an unchanged
+        #: digest on every heartbeat (ISSUE 18)
+        self.rev = 0
+        #: called as ``on_drop(chain_hash, n_tokens)`` when an indexed
+        #: chain node is evicted, BEFORE its page goes back to the
+        #: allocator — the hook a mesh-published rank uses to withdraw
+        #: the digest from the board before the page is reclaimable
+        #: (ISSUE 18: no routing to a stale digest).
+        self.on_drop: Optional[Callable[[str, int], None]] = None
 
     def __len__(self) -> int:
         n, stack = 0, list(self._root.children.values())
@@ -260,6 +322,8 @@ class PrefixCache:
                 new += 1
             self._touch(node)
             parent = node
+        if new:
+            self.rev += 1
         return new
 
     def _evictable_leaves(self) -> List[_TrieNode]:
@@ -279,6 +343,13 @@ class PrefixCache:
         bucket.remove(node)
         if not bucket:
             del parent.first_ix[node.chunk[0]]
+        # withdraw-before-reclaim: the hook must run while the index
+        # still holds its reference — a router acting on the stale
+        # digest one instant later must never find the page recycled
+        # under it without the withdrawal having been recorded first
+        if self.on_drop is not None:
+            self.on_drop(node.hash, node.depth * self.page_size)
+        self.rev += 1
         self.allocator.free([node.page])
 
     def evict_for(self, n: int) -> int:
@@ -304,6 +375,44 @@ class PrefixCache:
         if freed:
             _registry().counter("cache_share/prefix_evictions").add(freed)
         return freed
+
+    def digest(self) -> Dict[str, object]:
+        """JSON-able digest of every cached chain node: chunk-hash ->
+        token count (``depth * page_size``). Digests — never token or
+        page bytes — are what a rank publishes to the mesh index
+        (ISSUE 18): small enough to ride a consensus vote, stable
+        across processes, and sufficient for a router to compute the
+        longest published prefix of any prompt via
+        :func:`chain_hashes`."""
+        chains: Dict[str, int] = {}
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            chains[node.hash] = node.depth * self.page_size
+            stack.extend(node.children.values())
+        return {"page_size": self.page_size, "chains": chains}
+
+    def chain_pages(self, tokens) -> Tuple[List[int], List[str]]:
+        """Walk the trie along the FULL chunks of ``tokens`` and return
+        ``(pages, hashes)`` of the matched chain — the export side of
+        hot-chain migration (no ``len - 1`` cap, no partial/COW leg:
+        only whole indexed pages can be shipped). Touches the matched
+        nodes (a migrating chain is hot by definition)."""
+        toks = np.asarray(tokens).reshape(-1)
+        ps = self.page_size
+        pages: List[int] = []
+        hashes: List[str] = []
+        node = self._root
+        for i in range(toks.shape[0] // ps):
+            key = tuple(int(t) for t in toks[i * ps:(i + 1) * ps])
+            nxt = node.children.get(key)
+            if nxt is None:
+                break
+            node = nxt
+            self._touch(node)
+            pages.append(node.page)
+            hashes.append(node.hash)
+        return pages, hashes
 
     def pages(self) -> List[int]:
         """Every page id the index currently holds a refcount on (one
@@ -360,13 +469,18 @@ class PagePool:
         # allocations are tracked host-side and the engine folds a
         # scale reset for them into the next tick's arguments.
         self.quantized = jnp.dtype(dtype) == jnp.int8
+        self.allocator = PageAllocator(num_pages)
         if self.quantized:
             self.k_scale = jnp.zeros((num_layers, num_pages, num_heads),
                                      jnp.float32)
             self.v_scale = jnp.zeros((num_layers, num_pages, num_heads),
                                      jnp.float32)
             self._fresh: List[int] = []
-        self.allocator = PageAllocator(num_pages)
+        self.allocator.on_zero = self._on_zero_free
+        # pages that arrived via cross-rank chain migration (ISSUE 18):
+        # host-side provenance so a prefix hit on one can be counted as
+        # a REMOTE hit (the evidence the bench asserts on)
+        self.migrated_pages: set = set()
         self.prefix: Optional[PrefixCache] = (
             PrefixCache(page_size, self.allocator) if prefix_cache
             else None)
@@ -385,6 +499,21 @@ class PagePool:
 
     def slot_pages(self, slot: int) -> int:
         return len(self._held[slot])
+
+    def _on_zero_free(self, pages: List[int]) -> None:
+        """Allocator hook: runs when pages drop their LAST reference.
+        ISSUE 18 quantizer fix — queue the int8 scale reset at free
+        time, not at the next allocation: a page parked on the free
+        list must not carry its old tenant's running-max scale as
+        latent scheduling history (the PR 13 "tolerance-by-contract"
+        residue). ``take_fresh``/``claim_fresh`` already dedupe, so
+        re-listing a page the next ``_alloc`` will list again is
+        harmless. Migration provenance ends with the last reference
+        too: a recycled page id is not a migrated page."""
+        if self.quantized:
+            self._fresh.extend(pages)
+        if self.migrated_pages:
+            self.migrated_pages.difference_update(pages)
 
     def _alloc(self, n: int) -> Optional[List[int]]:
         """Allocate ``n`` pages, evicting unreferenced prefix-cache

@@ -455,3 +455,24 @@ def ttfc_key(votes: Dict[int, dict], rank: int,
     p95 = float(v.get("ttft_p95_ms") or 0.0)
     return (float(chunks_ahead + 8 * over
                   + 4 * (-(-deficit // chunk))), p95, rank)
+
+
+def prefix_affinity_key(votes: Dict[int, dict], rank: int,
+                        extra_tokens: Dict[int, int],
+                        extra_reqs: Dict[int, int],
+                        hit_tokens: int) -> Tuple[float, float, int]:
+    """:func:`ttfc_key` with a prefix-affinity discount (ISSUE 18): a
+    rank holding ``hit_tokens`` of the request's published prefix
+    skips that much prefill work, so the hit is priced in the SAME
+    currency as the load term — chunk-train units — rather than as an
+    absolute preference. A hot rank with a long backlog still loses to
+    an idle rank once the backlog outweighs the saved chunks, which is
+    what keeps affinity from swamping it. A rank with no vote stays
+    unroutable-busy regardless of its published prefix (a digest on
+    the board is no proof of life — the vote is)."""
+    load, p95, r = ttfc_key(votes, rank, extra_tokens, extra_reqs)
+    v = votes.get(rank)
+    if v is None or hit_tokens <= 0:
+        return (load, p95, r)
+    chunk = max(1, int(v.get("chunk", 64)))
+    return (load - float(hit_tokens // chunk), p95, r)

@@ -1,0 +1,60 @@
+"""Global KV economy chaos leg on REAL processes (ISSUE 18
+acceptance): the migration SENDER dies between the chain payload's
+bytes landing and the atomic rename (``kill:0:pre_handoff_commit``
+inside ``HandoffChannel.send(kind="m")``).
+
+The survivor must import NOTHING torn (the half-written chain stays
+an invisible ``.tmp``; zero migrations in), agree the membership down
+to itself, PRUNE the corpse's published digests from the mesh prefix
+index (a dead rank's pages are gone with it — ISSUE 18's membership
+fix), keep serving the same tenant bitwise WITHOUT the migrated chain
+(full re-prefill, the honest path), and pass both the server audit
+and ``PagePool.check_consistency`` — all asserted inside the
+surviving worker (a failed assert fails its exit code here) and
+re-checked from its evidence file.
+"""
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "tools"))
+import mp_mesh  # noqa: E402
+
+pytestmark = [pytest.mark.multihost, pytest.mark.slow]
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "worker_prefix.py")
+
+
+def test_kill_migration_sender_mid_send_survivor_consistent(tmp_path):
+    res = mp_mesh.launch(2, WORKER, [str(tmp_path)],
+                         log_dir=str(tmp_path / "logs"), timeout=480,
+                         chaos="kill:0:pre_handoff_commit",
+                         expect_fail_ranks=(0,))
+    assert res.ok, res.tail()
+    assert res.returncodes[0] == mp_mesh.KILL_EXIT
+    assert "chaos-killed" in res.log(0)
+
+    # the half-sent chain is an ignorable .tmp under the migration
+    # family's name — never a consumable m-payload addressed anywhere
+    hdir = tmp_path / "shared" / "handoff"
+    names = os.listdir(hdir)
+    assert any(n.startswith("m-") and ".tmp" in n for n in names), \
+        names
+    assert not any(n.endswith(".npz") for n in names), names
+
+    with open(tmp_path / "results.1.json") as f:
+        doc = json.load(f)
+    assert doc["members"] == [1], doc["members"]
+    assert doc["migrations_in"] == 0
+    assert doc["migration_bytes_in"] == 0
+    # the corpse's digests stopped attracting routing
+    assert "0" not in doc["prefix_index_ranks"], doc
+    # the survivor kept serving (bitwise-checked in-worker) and both
+    # audits came back clean
+    assert 1 in doc["served"], doc["served"]
+    assert doc["consistency"] == [], doc["consistency"]
+    assert doc["pool_consistency"] == [], doc["pool_consistency"]

@@ -1,0 +1,154 @@
+"""Prefix-economy chaos worker (ISSUE 18): kill the migration SENDER
+between the chain payload's bytes landing and the atomic rename.
+
+Real 2-process symmetric mesh over ``init_env_only()`` (no
+jax.distributed — its fatal poller would abort the survivor the
+moment the corpse exits; the board is the only control plane, which
+is exactly what the leg must prove). Rank 0 serves a tenant-prefixed
+request, caches + publishes the chain digest; once rank 1 has ADOPTED
+the mesh index (file barrier), rank 0 is handed a migrate directive
+and dies inside ``HandoffChannel.send(kind="m")`` at the
+``pre_handoff_commit`` chaos point — a torn ``m-*.tmp`` on disk,
+never a consumable payload.
+
+The survivor must: import NOTHING (zero migrations in — the .tmp is
+invisible to ``poll``), agree the membership down to {1}, PRUNE the
+corpse's digests from its mesh prefix index (a dead rank's pages are
+gone with it — its chains must stop attracting routing), keep serving
+the same tenant bitwise vs the dense reference WITHOUT the migrated
+chain (full re-prefill, the honest path), and pass both the server
+audit and the pool-shard refcount audit. Evidence lands in
+``results.1.json`` for the driver test.
+
+argv: out_dir
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(
+    __file__)), os.pardir, os.pardir, "tools"))
+import mp_mesh  # noqa: E402
+
+SYS_LEN = 24
+SFX_LEN = 8
+MAX_NEW = 6
+CFG = dict(num_slots=2, page_size=8, pages_per_slot=6,
+           num_pages=24, prefill_chunk=8)
+
+
+def main():
+    out_dir = sys.argv[1]
+    rank, world = mp_mesh.init_env_only()
+    assert world == 2
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import gpt_tiny
+    from paddle_tpu.serving import (DisaggServer, HandoffChannel,
+                                    MeshSpec, ServingConfig)
+
+    paddle.seed(0)
+    net = gpt_tiny(initializer_range=0.2)
+    net.eval()
+    rng = np.random.RandomState(3)
+    system = rng.randint(0, 128, (SYS_LEN,)).astype(np.int32)
+    sfx = [rng.randint(0, 128, (SFX_LEN,)).astype(np.int32)
+           for _ in range(2)]
+    prompts = [np.concatenate([system, s]) for s in sfx]
+
+    if rank == 0:
+        # the victim: die between the migration payload's bytes and
+        # the atomic rename (the driver launched us with
+        # ``kill:0:pre_handoff_commit``)
+        HandoffChannel.pre_commit = staticmethod(
+            lambda: mp_mesh.chaos_point("pre_handoff_commit"))
+
+    srv = DisaggServer(net, ServingConfig(**CFG),
+                       MeshSpec(rank, 2, prefill_ranks=()),
+                       os.path.join(out_dir, "shared"), lease_s=1.0,
+                       prefix_routing=True, prefix_publish_s=0.1)
+
+    def drive(pred, deadline_s, what):
+        deadline = time.monotonic() + deadline_s
+        while not pred():
+            srv.step()
+            if time.monotonic() > deadline:
+                raise SystemExit(
+                    f"rank {rank}: timeout driving {what}: "
+                    f"members={sorted(srv._members)} "
+                    f"served={sorted(srv.results())} "
+                    f"index={sorted(srv._prefix_index)}")
+            time.sleep(0.002)
+
+    # ---- phase 1: gid 0 routes to rank 0 (the idle-tie pick), which
+    # caches the tenant chain and publishes its digest; rank 1 drops
+    # the barrier file once it ADOPTED an index entry for rank 0 ----
+    srv.submit(prompts[0], MAX_NEW)
+    adopted = os.path.join(out_dir, "adopted.1")
+    if rank == 0:
+        drive(lambda: 0 in srv.results()
+              and len(srv._published_chains) > 0, 120.0,
+              "serve+publish gid 0")
+        assert mp_mesh.wait_for_files([adopted], timeout_s=120.0), \
+            "rank 1 never adopted the published digest"
+        # ---- phase 2: a migrate directive for the chain this rank
+        # owns, destination rank 1 — the next step() exports it and
+        # the chaos point fires INSIDE the channel send
+        srv._migrate_out[0] = 1
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            srv.step()
+        raise SystemExit("chaos kill never fired on rank 0")
+
+    # ---- rank 1, the survivor ----
+    drive(lambda: any(str(r) == "0" and (d.get("chains") or {})
+                      for r, d in srv._prefix_index.items()
+                      for d in [d]), 120.0, "adopt rank 0's digest")
+    with open(adopted, "w") as f:
+        f.write("ok\n")
+    # the corpse dies mid-send; the lease expires; the member round
+    # agrees it out — and the membership fix must PRUNE its digests
+    drive(lambda: sorted(srv._members) == [1], 90.0,
+          "membership shrink to the survivor")
+    assert not any(str(r) == "0" for r in srv._prefix_index), \
+        f"dead rank's digests still attract routing: " \
+        f"{sorted(srv._prefix_index)}"
+    # nothing torn arrived: the half-written chain is an invisible
+    # .tmp, never a consumable m-payload
+    assert srv.prefix_migrations_in == 0, srv.prefix_migrations_in
+
+    # the same tenant keeps being served — WITHOUT the migrated chain
+    # (full re-prefill is the honest path), bitwise the dense stream
+    srv.submit(prompts[1], MAX_NEW)
+    drive(lambda: 1 in srv.results(), 120.0, "serve gid 1 solo")
+    want = {}
+    for g, p in enumerate(prompts):
+        ids, _ = net.generate(paddle.to_tensor(p[None]),
+                              max_new_tokens=MAX_NEW)
+        want[g] = np.asarray(ids.numpy()[0])
+    for g, got in srv.results().items():
+        np.testing.assert_array_equal(got, want[g])
+
+    audit = srv.check_consistency()
+    pool_audit = srv.engine.pool.check_consistency()
+    doc = {
+        "rank": rank,
+        "members": sorted(int(r) for r in srv._members),
+        "prefix_index_ranks": sorted(str(r)
+                                     for r in srv._prefix_index),
+        "migrations_in": srv.prefix_migrations_in,
+        "migration_bytes_in": srv.prefix_migration_bytes_in,
+        "served": sorted(int(g) for g in srv.results()),
+        "consistency": audit,
+        "pool_consistency": pool_audit,
+    }
+    with open(os.path.join(out_dir, "results.1.json"), "w") as f:
+        json.dump(doc, f)
+    assert audit == [], audit
+    assert pool_audit == [], pool_audit
+    mp_mesh.finish(os.path.join(out_dir, "ok.1"))
+
+
+if __name__ == "__main__":
+    main()

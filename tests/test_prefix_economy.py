@@ -1,0 +1,559 @@
+"""Global KV economy (ISSUE 18): cross-rank prefix publication,
+prefix-aware routing, and hot-chain page migration.
+
+What is pinned here, bottom-up:
+
+- chunk-hash chains (``chain_hash``/``chain_hashes``) are
+  deterministic, parent-dependent, and prefix-stable — the digest a
+  rank publishes is recomputable by any peer from tokens alone;
+- withdraw-before-reclaim (satellite): ``PrefixCache.on_drop`` fires
+  while the dropped node's pages are STILL refcount-held, so a
+  locally-evicted published chain is withdrawn from the board before
+  its pages can be reused;
+- the int8 scale-reset-at-free fix (satellite): a page dropping its
+  last reference is queued for a scale reset immediately, and loses
+  its migrated-page provenance;
+- ``route_requests`` with a mesh ``prefix_index``: affinity steers
+  ties, load outweighs affinity (priced in the same chunk currency),
+  decisions stay voter-order deterministic, and a request routed a
+  page or more away from its best published chain carries a
+  ``migrate`` directive;
+- the membership fix (satellite): a rank the member round agreed OUT
+  is excluded from every pick set even when its stale vote still sits
+  on the board — never merely priced as busy;
+- engine-level chain migration: ``export_prefix_chain`` →
+  ``import_prefix_chain`` under the normal refcount/COW rules, with
+  bitwise f32 parity (and int8 token-match) for a request admitted
+  onto the migrated pages, remote-hit accounting, and a clean
+  ``check_consistency`` audit throughout;
+- a 2-rank in-process DisaggServer run with ``prefix_routing=True``:
+  parity holds, the mesh index converges, a directed migration lands,
+  and eviction of published chains counts withdrawals.
+
+The REAL N-process mesh (per-process registries, kill-one chaos)
+re-pins the mechanics in tests/multihost/.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import gpt_tiny
+from paddle_tpu.profiler.metrics import registry
+from paddle_tpu.serving import (DisaggServer, MeshSpec, PagePool,
+                                ServingConfig, ServingEngine,
+                                route_requests)
+from paddle_tpu.serving.paged_cache import chain_hash, chain_hashes
+from paddle_tpu.serving.sched import prefix_affinity_key, ttfc_key
+
+pytestmark = pytest.mark.serving
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    net = gpt_tiny(initializer_range=0.2)
+    net.eval()
+    return net
+
+
+def _dense(net, prompt, max_new, **kw):
+    ids, _ = net.generate(paddle.to_tensor(prompt[None]),
+                          max_new_tokens=max_new, **kw)
+    return ids.numpy()[0]
+
+
+def _prompts(lens, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 128, (t,)).astype(np.int32) for t in lens]
+
+
+CFG = dict(num_slots=2, page_size=8, pages_per_slot=4, prefill_chunk=8)
+
+
+def _pool(**over):
+    kw = dict(num_layers=1, num_pages=9, page_size=8, num_heads=2,
+              head_dim=4, num_slots=2, pages_per_slot=3,
+              prefix_cache=True)
+    kw.update(over)
+    return PagePool(**kw)
+
+
+# ---------------------------------------------------------------------------
+# chunk-hash chains and the published digest
+# ---------------------------------------------------------------------------
+class TestChainHashes:
+    def test_deterministic_and_parent_dependent(self):
+        c = np.arange(8, dtype=np.int32)
+        h1 = chain_hash("", c)
+        assert chain_hash("", c) == h1 and len(h1) == 16
+        # the same chunk under a different parent hashes differently:
+        # a chain hash names the WHOLE prefix, not one page's content
+        assert chain_hash(h1, c) != h1
+        assert chain_hash("", np.arange(1, 9)) != h1
+
+    def test_chain_is_prefix_stable(self):
+        long, short = np.arange(24), np.arange(16)
+        assert chain_hashes(long, 8)[:2] == chain_hashes(short, 8)
+        # partial trailing chunks never enter the chain
+        assert chain_hashes(np.arange(23), 8) == chain_hashes(short, 8)
+        assert chain_hashes(np.arange(7), 8) == []
+
+    def test_digest_and_chain_pages_match_recomputation(self):
+        p = _pool()
+        toks = np.arange(16, dtype=np.int32)
+        p.grow_slot(0, 2)
+        held = list(p._held[0])
+        p.prefix.insert(toks, held)
+        hs = chain_hashes(toks, 8)
+        d = p.prefix.digest()
+        assert d["page_size"] == 8
+        assert d["chains"] == {hs[0]: 8, hs[1]: 16}
+        pages, hashes = p.prefix.chain_pages(toks)
+        assert pages == held and hashes == hs
+        # a longer prompt walks only its cached prefix
+        pages2, _ = p.prefix.chain_pages(np.arange(24, dtype=np.int32))
+        assert pages2 == held
+
+
+# ---------------------------------------------------------------------------
+# withdraw-before-reclaim (satellite): on_drop ordering + rev
+# ---------------------------------------------------------------------------
+class TestWithdrawBeforeReclaim:
+    def test_on_drop_fires_while_pages_still_held(self):
+        p = _pool()
+        p.grow_slot(0, 1)
+        page = p._held[0][0]
+        toks = np.arange(8, dtype=np.int32)
+        p.prefix.insert(toks, [page])
+        p.release_slot(0)               # the index alone holds it now
+        assert p.allocator.refcount(page) == 1
+        seen = []
+        p.prefix.on_drop = lambda h, n: seen.append(
+            (h, n, p.allocator.refcount(page)))
+        rev0 = p.prefix.rev
+        assert p.prefix.evict_for(1) >= 1
+        # the withdrawal hook observed refcount 1: the board entry can
+        # be withdrawn BEFORE the page is reclaimable by anyone else
+        assert seen == [(chain_hashes(toks, 8)[0], 8, 1)]
+        assert p.allocator.refcount(page) == 0
+        assert p.prefix.rev > rev0
+        assert p.check_consistency() == []
+
+    def test_rev_tracks_structural_changes_only(self):
+        p = _pool()
+        p.grow_slot(0, 2)
+        toks = np.arange(16, dtype=np.int32)
+        rev0 = p.prefix.rev
+        p.prefix.insert(toks, list(p._held[0]))
+        rev1 = p.prefix.rev
+        assert rev1 > rev0
+        # re-inserting the same chain shares nodes: no new structure
+        p.prefix.insert(toks, list(p._held[0]))
+        assert p.prefix.rev == rev1
+
+
+# ---------------------------------------------------------------------------
+# int8 scale reset at last-ref free (satellite)
+# ---------------------------------------------------------------------------
+class TestZeroFreeHook:
+    def test_zero_freed_page_queues_a_scale_reset(self):
+        p = _pool(dtype=jnp.int8)
+        pages = p.allocator.alloc(2)
+        p._fresh.clear()                # drop the alloc-time listing
+        p.allocator.free(pages[:1])     # last ref: scale reset queued
+        assert pages[0] in p._fresh
+        assert pages[1] not in p._fresh
+        p.allocator.free(pages[1:])
+
+    def test_shared_page_resets_only_at_last_ref(self):
+        p = _pool(dtype=jnp.int8)
+        (page,) = p.allocator.alloc(1)
+        p.allocator.share([page])       # refcount 2
+        p._fresh.clear()
+        p.allocator.free([page])
+        assert page not in p._fresh     # still held by the other ref
+        p.allocator.free([page])
+        assert page in p._fresh
+
+    def test_migrated_provenance_ends_at_last_ref(self):
+        p = _pool()
+        (page,) = p.allocator.alloc(1)
+        p.migrated_pages.add(page)
+        p.allocator.free([page])
+        # a recycled page id is not a migrated page
+        assert page not in p.migrated_pages
+
+
+# ---------------------------------------------------------------------------
+# prefix-aware routing key (pure)
+# ---------------------------------------------------------------------------
+class TestPrefixAffinityKey:
+    def _vote(self, backlog=0, chunk=8):
+        return {"prefill_backlog": backlog, "chunk": chunk,
+                "queued": 0, "free_slots": 4, "free_pages": 100}
+
+    def test_hit_discount_is_priced_in_chunks(self):
+        votes = {0: self._vote(), 1: self._vote()}
+        base = ttfc_key(votes, 1, {}, {})
+        k = prefix_affinity_key(votes, 1, {}, {}, hit_tokens=24)
+        assert k[0] == base[0] - 3.0       # 24 tokens / 8-token chunk
+        assert k[1:] == base[1:]
+        # no hit, no discount
+        assert prefix_affinity_key(votes, 1, {}, {}, 0) == base
+
+    def test_unvoted_rank_gets_no_discount(self):
+        # a digest is no proof of life: the dead-peer price stands
+        votes = {0: self._vote()}
+        assert prefix_affinity_key(votes, 1, {}, {}, 999)[0] \
+            >= float(1 << 20)
+
+
+# ---------------------------------------------------------------------------
+# route_requests: affinity, migration directives, membership
+# ---------------------------------------------------------------------------
+class TestPrefixRouting:
+    def _vote(self, seen, routed, pending, *, backlog=0, fs=4,
+              members=None, chains=None, decode=(0, 1), prefill=()):
+        v = {"seen": seen, "routed": routed,
+             "pending": {str(g): ln for g, ln in pending.items()},
+             "free_pages": 100, "free_slots": fs, "queued": 0,
+             "prefill_backlog": backlog, "chunk": 8, "page_size": 8,
+             "topology": {"prefill": list(prefill),
+                          "decode": list(decode), "threshold": 9}}
+        if members is not None:
+            v["members"] = sorted(members)
+        if chains is not None:
+            v["chains"] = {str(g): list(c) for g, c in chains.items()}
+        return v
+
+    def _chain(self, n=24):
+        return chain_hashes(np.arange(n, dtype=np.int32), 8)
+
+    def _digest(self, chain):
+        return {"page_size": 8,
+                "chains": {h: (i + 1) * 8
+                           for i, h in enumerate(chain)}}
+
+    def test_affinity_breaks_a_load_tie(self):
+        chain = self._chain()
+        votes = {r: self._vote(1, 0, {0: 24}, chains={0: chain})
+                 for r in (0, 1)}
+        # without an index the tie breaks toward rank 0
+        assert route_requests(votes)["assign"]["0"] == [-1, 0]
+        # rank 1 published the whole chain: affinity wins the tie
+        idx = {"1": self._digest(chain)}
+        out = route_requests(votes, prefix_index=idx)
+        assert out["assign"]["0"] == [-1, 1]
+        assert "migrate" not in out        # routed TO its best chain
+
+    def test_load_outweighs_affinity_and_directs_migration(self):
+        chain = self._chain()
+        votes = {0: self._vote(1, 0, {0: 24}, chains={0: chain}),
+                 1: self._vote(1, 0, {0: 24}, chains={0: chain},
+                               backlog=64)}
+        idx = {"1": self._digest(chain)}
+        out = route_requests(votes, prefix_index=idx)
+        # 8 chunk-trains of backlog swamp a 3-chunk discount: the
+        # request lands on rank 0 — and the decision tells rank 1 to
+        # replicate the hot chain to where the prefill will run
+        assert out["assign"]["0"] == [-1, 0]
+        assert out["migrate"] == {"0": [1, 0]}
+
+    def test_no_migration_when_runner_matches_best(self):
+        chain = self._chain()
+        votes = {r: self._vote(1, 0, {0: 24}, chains={0: chain})
+                 for r in (0, 1)}
+        # both ranks hold the full chain: wherever the request lands
+        # is already a best holder — no directive
+        idx = {"0": self._digest(chain), "1": self._digest(chain)}
+        out = route_requests(votes, prefix_index=idx)
+        assert "migrate" not in out
+
+    def test_broken_chain_stops_the_hit_at_the_gap(self):
+        chain = self._chain()
+        holed = self._digest(chain)
+        del holed["chains"][chain[1]]      # middle link evicted
+        votes = {0: self._vote(1, 0, {0: 24}, chains={0: chain},
+                               backlog=8),
+                 1: self._vote(1, 0, {0: 24}, chains={0: chain},
+                               backlog=8)}
+        out = route_requests(votes, prefix_index={"1": holed})
+        # only 8 covered tokens survive the gap: a 1-chunk discount
+        # exactly cancels rank 1's extra chunk... backlogs are equal
+        # here, so the discount still steers — but the migration gain
+        # (8 tokens == one page) reflects the TRUNCATED hit, pinning
+        # that unpublished tail chunks are unusable
+        assert out["assign"]["0"] == [-1, 1]
+
+    def test_decision_is_voter_order_deterministic(self):
+        chain = self._chain()
+        votes = {0: self._vote(2, 0, {0: 24, 1: 16},
+                               chains={0: chain}),
+                 1: self._vote(2, 0, {0: 24, 1: 16},
+                               chains={0: chain}, backlog=64)}
+        idx = {"1": self._digest(chain)}
+        assert route_requests(votes, prefix_index=idx) == \
+            route_requests(dict(reversed(list(votes.items()))),
+                           prefix_index=idx)
+
+
+class TestMembersExclusion:
+    """Satellite fix: an agreed-out rank must be EXCLUDED from the
+    pick sets, not priced as busy — a stale vote of its on the board
+    proves nothing."""
+
+    _vote = TestPrefixRouting._vote
+
+    def test_stale_vote_of_evicted_rank_gets_nothing(self):
+        votes = {0: self._vote(4, 0, {g: 8 for g in range(4)},
+                               members=(0, 1), decode=(0, 1, 2)),
+                 1: self._vote(4, 0, {g: 8 for g in range(4)},
+                               members=(0, 1), decode=(0, 1, 2)),
+                 # rank 2 was agreed out AFTER writing this vote; its
+                 # idle load would otherwise win every pick, and its
+                 # stale seen=1 would cap the round at one gid
+                 2: self._vote(1, 0, {0: 8},
+                               members=(0, 1, 2), decode=(0, 1, 2))}
+        out = route_requests(votes)
+        assert out["routed"] == 4          # stale seen did not bind
+        assert len(out["assign"]) == 4
+        assert all(2 not in pair for pair in out["assign"].values())
+
+    def test_no_member_decode_rank_parks_the_round(self):
+        # the survivors' member set contains no decode-capable rank:
+        # park (routed stays) rather than assign to a ghost
+        votes = {0: self._vote(2, 0, {0: 8, 1: 8},
+                               members=(0,), decode=(1,),
+                               prefill=(0,))}
+        out = route_requests(votes)
+        assert out["assign"] == {} and out["routed"] == 0
+
+    def test_votes_without_members_keep_old_pricing(self):
+        # pre-ISSUE-18 voters carry no members key: a missing voter
+        # for a topology rank still prices as busy (never a KeyError,
+        # never an exclusion)
+        votes = {0: self._vote(2, 0, {0: 16, 1: 4}, decode=(0, 1))}
+        out = route_requests(votes)
+        assert all(d == 0 for _, d in out["assign"].values())
+        assert out["routed"] == 2
+
+
+# ---------------------------------------------------------------------------
+# engine-level chain migration: export → import → serve
+# ---------------------------------------------------------------------------
+def _engine(net, **over):
+    cfg = dict(CFG)
+    cfg.update(over)
+    return ServingEngine(net, ServingConfig(**cfg))
+
+
+class TestChainMigrationEngine:
+    def test_migrated_chain_serves_bitwise_f32(self):
+        net = _net()
+        prompt = _prompts((24,))[0]
+        a = _engine(net)
+        rid = a.submit(prompt, 4)
+        out_a = a.run()[rid]
+        payload = a.export_prefix_chain(prompt)
+        assert payload is not None and payload["n_tokens"] == 24
+        assert str(payload["kv_dtype"]) == "float32"
+
+        b = _engine(net)
+        hits0 = registry().counter("serving/prefix_hit_tokens").value
+        rem0 = registry().counter(
+            "serving/prefix_hit_tokens_remote").value
+        assert b.import_prefix_chain(payload) == 24
+        assert b.pool.migrated_pages
+        assert b.pool.check_consistency() == []
+        # importing the SAME chain again shares every node: the
+        # temporary pages all return to the pool, nothing leaks
+        assert b.import_prefix_chain(payload) == 0
+        assert b.pool.check_consistency() == []
+
+        rid_b = b.submit(prompt, 4)
+        out_b = b.run()[rid_b]
+        np.testing.assert_array_equal(out_b, out_a)
+        np.testing.assert_array_equal(out_b, _dense(net, prompt, 4))
+        # the hit was REMOTE: pages this rank never prefilled
+        assert registry().counter(
+            "serving/prefix_hit_tokens").value > hits0
+        assert registry().counter(
+            "serving/prefix_hit_tokens_remote").value > rem0
+        assert b.pool.check_consistency() == []
+
+    def test_import_rejects_mismatched_payloads(self):
+        net = _net()
+        prompt = _prompts((16,))[0]
+        a = _engine(net)
+        a.submit(prompt, 4)
+        a.run()
+        payload = a.export_prefix_chain(prompt)
+        assert payload is not None
+        with pytest.raises(ValueError, match="int8"):
+            a.import_prefix_chain(dict(payload, kv_dtype="int8"))
+        bad = dict(payload, tokens=payload["tokens"][:8])
+        with pytest.raises(ValueError, match="inconsistent"):
+            a.import_prefix_chain(bad)
+        assert a.pool.check_consistency() == []
+
+    def test_import_into_a_full_pool_is_a_clean_miss(self):
+        net = _net()
+        prompt = _prompts((16,))[0]
+        a = _engine(net)
+        a.submit(prompt, 4)
+        a.run()
+        payload = a.export_prefix_chain(prompt)
+        b = _engine(net)
+        grabbed = []
+        while True:
+            got = b.pool.allocator.alloc(1)
+            if got is None:
+                break
+            grabbed += got
+        assert b.import_prefix_chain(payload) == 0
+        b.pool.allocator.free(grabbed)
+        assert b.pool.check_consistency() == []
+
+    @pytest.mark.slow
+    def test_migrated_chain_token_match_int8(self):
+        """Int8 pages travel WITH their per-page per-head scales; a
+        request admitted onto the migrated chain token-matches the
+        origin rank's own serve (int8 is bitwise BETWEEN int8 engines,
+        per the PR 12 contract) on the standard-init workload."""
+        paddle.seed(0)
+        net = gpt_tiny()                 # standard init: int8 regime
+        net.eval()
+        prompt = _prompts((24,))[0]
+        a = _engine(net, kv_dtype="int8")
+        rid = a.submit(prompt, 4)
+        out_a = a.run()[rid]
+        payload = a.export_prefix_chain(prompt)
+        assert payload is not None and "k_scale" in payload
+        assert str(payload["kv_dtype"]) == "int8"
+
+        b = _engine(net, kv_dtype="int8")
+        with pytest.raises(ValueError, match="scales"):
+            naked = {k: v for k, v in payload.items()
+                     if not k.endswith("_scale")}
+            b.import_prefix_chain(naked)
+        assert b.import_prefix_chain(payload) == 24
+        rid_b = b.submit(prompt, 4)
+        out_b = b.run()[rid_b]
+        np.testing.assert_array_equal(out_b, out_a)
+        assert b.pool.check_consistency() == []
+
+
+# ---------------------------------------------------------------------------
+# 2-rank in-process mesh: the economy end to end
+# ---------------------------------------------------------------------------
+def _drive_two(servers, timeout_s=420.0):
+    outs = [None] * len(servers)
+    errs = []
+
+    def drive(i):
+        try:
+            outs[i] = servers[i].run(timeout_s=timeout_s)
+        except Exception as e:      # pragma: no cover - failure detail
+            errs.append((i, repr(e)))
+
+    ts = [threading.Thread(target=drive, args=(i,))
+          for i in range(len(servers))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs, errs
+    merged = {}
+    for o in outs:
+        merged.update(o)
+    return merged
+
+
+@pytest.mark.slow
+class TestPrefixEconomyMesh:
+    def test_cross_rank_economy_end_to_end(self, tmp_path):
+        """Shared-system-prompt workload on a symmetric 2-rank mesh
+        with the economy ON: outputs stay bitwise-equal to the
+        single-host reference, prefix hits accrue, the adopted mesh
+        index converges, a directed migration lands on the peer under
+        clean refcount audits, and evicting published chains counts
+        withdrawals. (Threads share one process registry, so per-rank
+        counter splits — and live load-imbalance migration — are
+        pinned by the real-process mesh tests and the bench.)"""
+        net = _net()
+        sys_prefix = _prompts((16,), seed=11)[0]
+        tails = _prompts((8, 8, 8, 8), seed=12)
+        prompts = [np.concatenate([sys_prefix, t]).astype(np.int32)
+                   for t in tails]
+        max_new = 4
+        ref = ServingEngine(net, ServingConfig(**CFG))
+        rids = [ref.submit(p, max_new) for p in prompts]
+        want = ref.run()
+
+        servers = [DisaggServer(net, ServingConfig(**CFG),
+                                MeshSpec(r, 2), str(tmp_path),
+                                lease_s=2.0, prefix_routing=True,
+                                prefix_publish_s=0.05)
+                   for r in range(2)]
+        for srv in servers:
+            for p in prompts:
+                srv.submit(p, max_new)
+        hits0 = registry().counter("serving/prefix_hit_tokens").value
+        merged = _drive_two(servers)
+        assert sorted(merged) == list(range(len(prompts)))
+        for gid, rid in zip(range(len(prompts)), rids):
+            np.testing.assert_array_equal(merged[gid], want[rid])
+        assert registry().counter(
+            "serving/prefix_hit_tokens").value > hits0
+        for srv in servers:
+            assert srv.check_consistency() == []
+
+        # the mesh index converged: each rank adopted at least one
+        # peer digest with chains (pump a few post-run steps in case
+        # the final publish was mid-flight at the done verdict)
+        def adopted():
+            return all(any((srv._prefix_index.get(r) or {})
+                           .get("chains")
+                           for r in ("0", "1")) for srv in servers)
+
+        deadline = time.time() + 30.0
+        while not adopted() and time.time() < deadline:
+            for srv in servers:
+                srv.step()
+            time.sleep(0.02)
+        assert adopted(), "mesh prefix index never converged"
+
+        # directed migration: pick a chain the source rank actually
+        # holds and push it to the peer through the m-family channel
+        src, dst = servers[0], servers[1]
+        gids = sorted(set(src._local.values()))
+        assert gids, "rank 0 served nothing — workload regressed"
+        sent0 = src.prefix_migrations_out
+        src._migrate_out = {gids[0]: 1}
+        src._export_migrations()
+        assert src.prefix_migrations_out == sent0 + 1
+        assert src.prefix_migration_bytes_out > 0
+        got0 = dst.prefix_migrations_in
+        dst._import_migrations()
+        # chunks dst already cached dedupe to zero new tokens — the
+        # send is still consumed and the audit stays clean either way
+        assert dst.prefix_migrations_in >= got0
+        assert dst.check_consistency() == []
+
+        # withdraw-before-reclaim at the server layer: evicting a
+        # published chain counts a stale-digest withdrawal and forces
+        # the next publish past the rate limit
+        dst._published_chains = set(
+            dst.engine.pool.prefix.digest()["chains"])
+        assert dst._published_chains
+        sd0 = dst.stale_digest_withdrawals
+        assert dst.engine.pool.drop_prefix_cache() > 0
+        assert dst.stale_digest_withdrawals > sd0
+        assert dst._withdrawals_due > 0
+        assert dst.check_consistency() == []
+        for srv in servers:
+            srv.close()

@@ -869,3 +869,61 @@ def test_schema_checker_adaptive_k():
         if k != "verify_ticks"})
     assert any("missing key 'verify_ticks'" in e
                for e in _run_check("check_adaptive_k", missing))
+
+
+# ---------------------------------------------------------------------------
+# sink-schema checker: ISSUE 18 blocks (prefix-economy counters /
+# migration bytes by dtype) — negative-tested so the prefix-routing CI
+# leg's new rules are themselves pinned
+# ---------------------------------------------------------------------------
+
+
+def _economy(**over):
+    doc = {"prefix_hit_tokens": 480, "remote_hit_tokens": 64,
+           "migrations": 2, "migration_bytes_out": 131400,
+           "stale_withdrawals": 3, "kv_dtype": "float32"}
+    doc.update(over)
+    return doc
+
+
+def test_schema_checker_prefix_economy():
+    assert _run_check("check_prefix_economy", _economy()) == []
+    # the nesting invariant: a remote hit IS a hit
+    inverted = _economy(remote_hit_tokens=500)
+    assert any("must nest" in e
+               for e in _run_check("check_prefix_economy", inverted))
+    # bytes that no migration accounts for
+    orphan = _economy(migrations=0, migration_bytes_out=4096)
+    assert any("no migration accounts" in e
+               for e in _run_check("check_prefix_economy", orphan))
+    # missing a counter entirely
+    missing = {k: v for k, v in _economy().items()
+               if k != "stale_withdrawals"}
+    assert any("missing key 'stale_withdrawals'" in e
+               for e in _run_check("check_prefix_economy", missing))
+    # negative counts are writer bugs
+    neg = _economy(prefix_hit_tokens=-1)
+    assert any("non-negative" in e
+               for e in _run_check("check_prefix_economy", neg))
+    # kv_dtype must name the pool dtype
+    blank = _economy(kv_dtype="")
+    assert any("kv_dtype" in e
+               for e in _run_check("check_prefix_economy", blank))
+
+
+def test_schema_checker_migration_bytes_by_dtype():
+    good = {"float32": {"migrations": 2, "migration_bytes": 131400},
+            "int8": {"migrations": 3, "migration_bytes": 51144}}
+    assert _run_check("check_migration_bytes_by_dtype", good) == []
+    assert _run_check("check_migration_bytes_by_dtype", {}) != []
+    bad = dict(good, int8={"migrations": 3})
+    assert any("missing key 'migration_bytes'" in e for e in
+               _run_check("check_migration_bytes_by_dtype", bad))
+    orphan = dict(good, int8={"migrations": 0,
+                              "migration_bytes": 4096})
+    assert any("zero migrations" in e for e in
+               _run_check("check_migration_bytes_by_dtype", orphan))
+    neg = dict(good, float32={"migrations": -1,
+                              "migration_bytes": 0})
+    assert any("non-negative" in e for e in
+               _run_check("check_migration_bytes_by_dtype", neg))

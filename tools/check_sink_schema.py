@@ -621,6 +621,63 @@ def check_adaptive_k(doc, schema: dict, where: str) -> None:
             "the depth controller is not clamping")
 
 
+def check_prefix_economy(doc, schema: dict, where: str) -> None:
+    """Validate a serve_bench --prefix-routing economy block (ISSUE
+    18): the mesh-wide counters must be present, non-negative ints;
+    cross-rank (remote) hit tokens can never exceed TOTAL hit tokens
+    (a remote hit IS a hit — the counters nest by construction); and
+    migration bytes without a single migration is exactly the
+    accounting bug the per-dtype byte gauges exist to catch."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["prefix_economy"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    for k in ("prefix_hit_tokens", "remote_hit_tokens", "migrations",
+              "migration_bytes_out", "stale_withdrawals"):
+        v = doc.get(k)
+        if k in doc and (not isinstance(v, int) or v < 0):
+            err(f"{where}: {k} {v!r} not a non-negative int")
+    h, r = doc.get("prefix_hit_tokens"), doc.get("remote_hit_tokens")
+    if isinstance(h, int) and isinstance(r, int) and r > h:
+        err(f"{where}: remote_hit_tokens={r} > prefix_hit_tokens={h} "
+            "— a cross-rank hit is a hit; the counters must nest")
+    m, b = doc.get("migrations"), doc.get("migration_bytes_out")
+    if isinstance(m, int) and isinstance(b, int) and b > 0 and m == 0:
+        err(f"{where}: migration_bytes_out={b} with zero migrations "
+            "— bytes moved that no migration accounts for")
+    kd = doc.get("kv_dtype")
+    if "kv_dtype" in doc and (not isinstance(kd, str) or not kd):
+        err(f"{where}: kv_dtype {kd!r} not a non-empty string")
+
+
+def check_migration_bytes_by_dtype(doc, schema: dict,
+                                   where: str) -> None:
+    """Validate a --prefix-routing migration-bytes-by-dtype table
+    (ISSUE 18): one entry per pool dtype, each with migration count +
+    byte total, bytes only when migrations happened."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict) or not doc:
+        return err(f"{where}: not a non-empty JSON object")
+    for dtype, entry in doc.items():
+        w = f"{where}.{dtype}"
+        if not isinstance(entry, dict):
+            err(f"{w}: not a JSON object")
+            continue
+        for k in sc["migration_dtype_entry"]:
+            if k not in entry:
+                err(f"{w}: missing key {k!r}")
+        for k in sc["migration_dtype_entry"]:
+            v = entry.get(k)
+            if k in entry and (not isinstance(v, int) or v < 0):
+                err(f"{w}: {k} {v!r} not a non-negative int")
+        m, b = entry.get("migrations"), entry.get("migration_bytes")
+        if isinstance(m, int) and isinstance(b, int) and b > 0 \
+                and m == 0:
+            err(f"{w}: migration_bytes={b} with zero migrations")
+
+
 def check_aux_bench_json(path: str, schema: dict) -> None:
     """Validate a mode-specific serve_bench block (--sched-matrix /
     --adaptive-k, ISSUE 15): the v15 cells plus the registry snapshot
@@ -633,7 +690,12 @@ def check_aux_bench_json(path: str, schema: dict) -> None:
         return err(f"{path}: unreadable bench JSON ({e})")
     reg = extra.get("registry")
     if not isinstance(reg, dict):
-        err(f"{path}: extra.registry (full snapshot) missing")
+        # the ISSUE 15 single-process modes snapshot the driver's
+        # registry; the ISSUE 18 real-process mode has no driver-side
+        # registry to snapshot (each rank owns its own) — its
+        # per-rank evidence lives inside the cells
+        if "sched_cells" in extra or "mixed_accept" in extra:
+            err(f"{path}: extra.registry (full snapshot) missing")
         reg = {}
     if "sched_cells" in extra:
         check_sched_cells(extra["sched_cells"], schema,
@@ -645,9 +707,20 @@ def check_aux_bench_json(path: str, schema: dict) -> None:
     if "mixed_accept" in extra:
         check_adaptive_k(extra["mixed_accept"], schema,
                          f"{path}: extra.mixed_accept")
-    if "sched_cells" not in extra and "mixed_accept" not in extra:
-        err(f"{path}: neither sched_cells nor mixed_accept present "
-            "(--aux-bench-json is for the ISSUE 15 modes)")
+    # ISSUE 18: the --prefix-routing economy block (real-process mode
+    # — no Poisson observability contract, so it rides aux)
+    if "prefix_economy" in extra:
+        check_prefix_economy(extra["prefix_economy"], schema,
+                             f"{path}: extra.prefix_economy")
+    if "migration_bytes_by_dtype" in extra:
+        check_migration_bytes_by_dtype(
+            extra["migration_bytes_by_dtype"], schema,
+            f"{path}: extra.migration_bytes_by_dtype")
+    if not any(k in extra for k in ("sched_cells", "mixed_accept",
+                                    "prefix_economy")):
+        err(f"{path}: none of sched_cells / mixed_accept / "
+            "prefix_economy present (--aux-bench-json is for the "
+            "ISSUE 15/18 modes)")
 
 
 def check_sketch(doc, schema: dict, where: str) -> None:
@@ -1010,6 +1083,14 @@ def check_bench_json(path: str, schema: dict,
     if "mixed_accept" in extra:
         check_adaptive_k(extra["mixed_accept"], schema,
                          f"{path}: extra.mixed_accept")
+    # ISSUE 18 blocks, validated whenever present
+    if "prefix_economy" in extra:
+        check_prefix_economy(extra["prefix_economy"], schema,
+                             f"{path}: extra.prefix_economy")
+    if "migration_bytes_by_dtype" in extra:
+        check_migration_bytes_by_dtype(
+            extra["migration_bytes_by_dtype"], schema,
+            f"{path}: extra.migration_bytes_by_dtype")
 
 
 def main() -> int:
