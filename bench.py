@@ -451,6 +451,123 @@ def bench_qcomm(paddle, steps=4):
     return out
 
 
+def bench_zero(paddle, steps=4, quantized=False):
+    """ZeRO-sharded weight update (ISSUE 19): the SAME tiny-GPT
+    pure-DP step compiled as a replicated-update baseline vs the
+    manual sharded update (reduce-scatter grads -> shard-local AdamW
+    on the dp-sharded flat slab -> all-gather params), each arm
+    emitting the memory ledger (``mem/{param,grad,opt_state}_bytes``
+    from actual shardings — the sharded arm's opt-state must land at
+    ~1/dp), the per-kind collective byte gauges (reduce-scatter vs
+    all-gather halves split out), ``phase/comm_traced_ms``
+    before/after, and the loss trajectories as the in-bench parity
+    check. ``quantized=False`` runs the f32 ring (losses bitwise vs
+    GSPMD — same reduce arithmetic, only reduction ORDER differs and
+    the loss is computed pre-update); ``quantized=True`` runs
+    stage-2 int8 grads + int8 param gather vs the PR 12 fused int8
+    AllReduce baseline — the sharded arm's total collective bytes
+    must not exceed the fused ring's (RS half + int8 gather ==
+    the same ring traffic)."""
+    import jax
+
+    import paddle_tpu.profiler as profiler
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.strategy_compiler import (
+        build_mesh_from_strategy, compile_train_step)
+    from paddle_tpu.models import GPT, GPTConfig
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"skipped": f"needs a multi-device dp mesh (have {ndev})"}
+
+    def make(zero, dpc, ppc=None):
+        paddle.seed(3)
+        net = GPT(GPTConfig(vocab_size=128, hidden_size=64,
+                            num_layers=2, num_heads=4, max_seq_len=64))
+        opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
+        s = DistributedStrategy()
+        kw = {}
+        if zero:
+            s.sharding = True
+            s.sharding_configs = {"sharding_stage": zero}
+            kw["dp_param_comm"] = ppc
+        if dpc != "f32" or zero:
+            # tiny model: the default 2048 block over-pads the per-rank
+            # chunk (blurring the 1/dp opt-state claim) and, on the
+            # quantized baseline, would compare different per-block
+            # scale overheads — both arms ride the SAME block size
+            kw["dp_grad_block"] = 512
+        return compile_train_step(net, opt, s,
+                                  build_mesh_from_strategy(s),
+                                  dp_grad_comm=dpc, **kw)
+
+    if quantized:
+        arms = {"fused_int8": lambda: make(0, "int8"),
+                "zero_int8": lambda: make(2, "int8", ppc="int8")}
+    else:
+        arms = {"replicated": lambda: make(0, "f32"),
+                "zero_f32": lambda: make(1, "f32")}
+
+    toks = np.random.RandomState(0).randint(
+        0, 128, (max(ndev * 2, 8), 32)).astype(np.int32)
+    out = {"dp": ndev, "model": "gpt h64 L2 v128"}
+    losses = {}
+    for name, mk in arms.items():
+        tr = mk()
+        profiler.enable()
+        try:
+            ph = tr.profile_step_phases(toks, trace_window=2)
+            losses[name] = [float(tr.step(toks)) for _ in range(steps)]
+            led = tr.memory_ledger()
+            s = profiler.summary()
+
+            def gauge(n):
+                return (s["metrics"].get(n) or {}).get("value")
+
+            def kind_bytes(kind):
+                return sum(int(gauge(
+                    f"comm/collective_bytes_{kind}_{sfx}") or 0)
+                    for sfx in ("int8", "bf16", "f32"))
+
+            cell = {
+                "phases_ms": {k: v for k, v in ph.items()
+                              if k != "trace"},
+                "mem_param_bytes": led["param"],
+                "mem_grad_bytes": led["grad"],
+                "mem_opt_state_bytes": led["opt_state"],
+                "collective_bytes_per_step":
+                    gauge("comm/collective_bytes_per_step"),
+                "collective_bytes_reduce_scatter":
+                    kind_bytes("reduce_scatter"),
+                "collective_bytes_all_gather":
+                    kind_bytes("all_gather"),
+                "comm_traced_ms": gauge("phase/comm_traced_ms"),
+                "losses": [round(l, 6) for l in losses[name]],
+            }
+            if "master" in led:
+                cell["mem_master_bytes"] = led["master"]
+            out[name] = cell
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+        finally:
+            profiler.disable()
+            profiler.reset()
+    base, shard = list(arms)
+    if "error" not in out[base] and "error" not in out[shard]:
+        out["opt_state_ratio"] = round(
+            out[shard]["mem_opt_state_bytes"]
+            / max(1, out[base]["mem_opt_state_bytes"]), 4)
+        out["loss_abs_delta_step1"] = round(
+            abs(losses[base][0] - losses[shard][0]), 6)
+        out["loss_abs_delta_final"] = round(
+            abs(losses[base][-1] - losses[shard][-1]), 6)
+        if quantized:
+            out["collective_bytes_ratio_vs_fused"] = round(
+                (out[shard]["collective_bytes_per_step"] or 0)
+                / max(1, out[base]["collective_bytes_per_step"] or 1), 4)
+    return out
+
+
 def bench_moe(paddle, steps, peak):
     """MoE-GPT (distributed/moe.py): tokens/sec + dense-equivalent MFU
     (active params only — top-1 routing activates 1/E of expert FLOPs;
@@ -780,6 +897,13 @@ def main():
     # quantized DP-grad AllReduce before/after (ISSUE 12) — cheap (two
     # tiny-GPT compiles); self-skips on single-device boxes
     extra("gpt_dp_qcomm_int8", lambda: bench_qcomm(paddle))
+
+    # ZeRO-sharded weight update (ISSUE 19): replicated vs sharded
+    # memory ledger + per-kind collective bytes; f32 parity arm and the
+    # stage-2 int8 arm vs the fused int8 ring. Self-skips like qcomm.
+    extra("gpt_dp_zero", lambda: bench_zero(paddle))
+    extra("gpt_dp_zero_qcomm", lambda: bench_zero(paddle,
+                                                  quantized=True))
 
     if on_tpu:
         from paddle_tpu.models import (BertForPretraining,

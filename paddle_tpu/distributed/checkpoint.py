@@ -595,12 +595,19 @@ def restore(directory: str, template, step: Optional[int] = None,
 
 
 def restore_degraded(directory: str, template, verify: bool = True,
-                     on_fallback=None):
+                     on_fallback=None, max_step: Optional[int] = None):
     """Degraded-mode restore: newest committed step first, walking back
     to older committed steps when a step turns out unreadable (CRC
     mismatch, truncated or missing shard, lost manifest, mangled JSON)
     instead of raising — a fleet restore must prefer losing a few steps
     of progress over losing the job.
+
+    ``max_step`` caps the walk-back's STARTING point: only committed
+    steps ``<= max_step`` are considered. A mesh-agreed rollback uses
+    it to pin every rank to the same restore target — the newest commit
+    no rank's bad streak had started before — so ranks that committed
+    ahead of the streak do not resume from a younger state than the
+    proposer (state-lockstep; resilience/runner.py).
 
     Every skipped step bumps the ``resilience/restore_fallbacks``
     profiler counter and emits a warning; ``on_fallback(step, exc)``
@@ -612,8 +619,12 @@ def restore_degraded(directory: str, template, verify: bool = True,
     from ..profiler.metrics import registry as _registry
 
     steps = all_steps(directory)
+    if max_step is not None:
+        steps = [s for s in steps if s <= max_step]
     if not steps:
-        raise FileNotFoundError(f"no committed checkpoint in {directory}")
+        raise FileNotFoundError(
+            f"no committed checkpoint in {directory}"
+            + (f" at step <= {max_step}" if max_step is not None else ""))
     errors = []
     for step in reversed(steps):
         try:
@@ -701,14 +712,18 @@ class CheckpointManager:
         return state, load_meta(self.directory, step)
 
     def restore_degraded(self, template, verify: bool = True,
-                         on_fallback=None):
-        """Newest READABLE committed step (walk-back on corruption);
+                         on_fallback=None,
+                         max_step: Optional[int] = None):
+        """Newest READABLE committed step (walk-back on corruption),
+        optionally capped at ``max_step`` (mesh-agreed rollback target);
         returns ``(state, meta, step)`` or ``(None, None, None)`` when
-        the directory holds no committed step at all."""
+        the directory holds no committed step at all (or none under the
+        cap)."""
         try:
             return restore_degraded(self.directory, template,
                                     verify=verify,
-                                    on_fallback=on_fallback)
+                                    on_fallback=on_fallback,
+                                    max_step=max_step)
         except FileNotFoundError:
             return None, None, None
 

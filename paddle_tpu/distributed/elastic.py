@@ -60,7 +60,7 @@ import numpy as np
 from ..core import rng as rng_mod
 from ..profiler import trace as _ptrace
 from ..profiler.metrics import registry as _registry
-from .checkpoint import CheckpointManager, load_meta
+from .checkpoint import CheckpointManager, all_steps, load_meta
 
 __all__ = ["ElasticTrainer"]
 
@@ -122,18 +122,26 @@ class ElasticTrainer:
         rng_mod.set_rng_state(key)
 
     # -- resume ------------------------------------------------------------
-    def resume(self) -> int:
+    def resume(self, max_step: Optional[int] = None) -> int:
         """Restore the newest readable committed checkpoint; returns the
         step to continue FROM (0 if none). Restores the trainer state,
-        the RNG stream, and the data cursor."""
+        the RNG stream, and the data cursor. ``max_step`` caps the
+        restore target (newest committed step ``<= max_step``) — the
+        mesh-agreed rollback passes the consensus target here so every
+        rank lands on the SAME step even when some committed ahead of
+        the bad streak (resilience/runner.py state-lockstep)."""
         template = self.trainer.device_state()
         if self.degraded_restore:
             state, meta, step = self.manager.restore_degraded(
-                template, verify=self.verify_restore)
+                template, verify=self.verify_restore, max_step=max_step)
             if step is None:
                 return 0
         else:
             step = self.manager.latest_step()
+            if max_step is not None:
+                eligible = [s for s in all_steps(self.manager.directory)
+                            if s <= max_step]
+                step = eligible[-1] if eligible else None
             if step is None:
                 return 0
             state = self.manager.restore(template, step=step,

@@ -46,12 +46,25 @@ all-manual ``shard_map`` over the mesh, compute per-shard local
 gradients, and reduce them through ``quantized_all_reduce_tree``
 (one fused ring over the concatenated gradient buffer, the EQuARX
 fused-buffer layout). Supported for pure data parallelism
-(every non-dp mesh axis must be size 1, no ZeRO) — composing with
-tp/pp/sharded optimizer state is ROADMAP residue.
+(every non-dp mesh axis must be size 1) — composing with tp/pp is
+ROADMAP residue.
 
 All ops are plain jax collectives (``ppermute`` / ``all_gather``), so
 the XLA graph is what runs on TPU — no host round-trip, and the
 profiler's HLO byte accounting sees the real int8 payloads.
+
+ZeRO composition (ISSUE 19; Xu et al., "Automatic Cross-Replica
+Sharding of Weight Update in Data-Parallel Training", 2004.13336):
+the ring's reduce-scatter half IS ZeRO's gradient sharding, so the
+AllReduce is split into standalone :func:`quantized_reduce_scatter`
+(shard r ends owning the fully-reduced flat chunk r) +
+:func:`quantized_all_gather`, each with an f32 spelling
+(``reduce_scatter`` / ``all_gather_cast``). :func:`dp_zero_step` is
+the ONE shard_map wrap both trainers use for the sharded weight
+update: reduce-scatter grads → clip/guard on the REDUCED shard →
+shard-local elementwise optimizer update (state at chunk shape — the
+memory win) → all-gather the updated params (``dp_param_comm`` picks
+the f32/bf16/int8 return payload).
 """
 from __future__ import annotations
 
@@ -63,7 +76,10 @@ import jax.numpy as jnp
 
 __all__ = ["quantize_blockwise", "dequantize_blockwise",
            "quantized_all_reduce", "quantized_all_reduce_tree",
-           "validate_dp_grad_comm", "dp_batch_specs"]
+           "quantized_reduce_scatter", "quantized_all_gather",
+           "reduce_scatter", "all_gather_cast", "zero_chunk_len",
+           "dp_zero_step", "validate_dp_grad_comm",
+           "validate_dp_param_comm", "dp_batch_specs"]
 
 
 def validate_dp_grad_comm(dp_grad_comm: str, mesh, *, zero_stage: int = 0,
@@ -73,8 +89,9 @@ def validate_dp_grad_comm(dp_grad_comm: str, mesh, *, zero_stage: int = 0,
     hybrid.HybridPipelineTrainer share it so the constraints cannot
     drift): value in {'f32', 'int8'}; 'int8' additionally requires a
     positive block size, a pure-DP mesh (every non-dp axis size 1),
-    no ZeRO, and none of the caller's ``unsupported`` (name, flag)
-    feature pairs."""
+    ZeRO stage <= 2 (stages 1-2 ride the ring's reduce-scatter half;
+    stage 3 is residue), and none of the caller's ``unsupported``
+    (name, flag) feature pairs."""
     if dp_grad_comm not in ("f32", "int8"):
         raise ValueError(
             f"unknown dp_grad_comm {dp_grad_comm!r}; expected "
@@ -90,15 +107,31 @@ def validate_dp_grad_comm(dp_grad_comm: str, mesh, *, zero_stage: int = 0,
             f"dp_grad_comm='int8' supports pure data parallelism; "
             f"mesh has non-dp axes {other} (quantized collectives "
             "under tp/pp/sp are ROADMAP residue)")
-    if zero_stage:
+    if zero_stage >= 3:
         raise NotImplementedError(
-            "dp_grad_comm='int8' with ZeRO sharding is ROADMAP "
-            "residue (the quantized reduce-scatter half maps onto "
-            "ZeRO's grad sharding but is not wired)")
+            "dp_grad_comm='int8' with ZeRO stage 3 (parameter "
+            "sharding) is ROADMAP residue; stages 1-2 run the "
+            "sharded weight update on the quantized ring")
     for name, flag in unsupported:
         if flag:
             raise NotImplementedError(
                 f"dp_grad_comm='int8' does not compose with {name}")
+
+
+def validate_dp_param_comm(dp_param_comm: str, zero_manual: bool) -> None:
+    """Validation of the trainers' ``dp_param_comm`` knob (the
+    all-gather payload of the ZeRO return half): value in
+    {'f32', 'bf16', 'int8'}; the compressed spellings only mean
+    anything on the manual sharded-update path."""
+    if dp_param_comm not in ("f32", "bf16", "int8"):
+        raise ValueError(
+            f"unknown dp_param_comm {dp_param_comm!r}; expected "
+            "'f32', 'bf16' or 'int8'")
+    if dp_param_comm != "f32" and not zero_manual:
+        raise ValueError(
+            f"dp_param_comm={dp_param_comm!r} requires the manual "
+            "ZeRO sharded update (zero_stage 1/2 on a pure-DP mesh "
+            "with dp > 1); without it params never ride a collective")
 
 
 def dp_quantized_value_and_grads(mesh, axis_size: int, block: int,
@@ -189,6 +222,107 @@ def _chunk(chunks: jax.Array, idx) -> jax.Array:
                                         keepdims=False)
 
 
+def zero_chunk_len(total: int, axis_size: int, block: int) -> int:
+    """Per-shard flat chunk length of the ZeRO/ring layout: ``total``
+    elements split into one chunk per shard, each a whole number of
+    quantization blocks. Callers pad their flat buffer to
+    ``axis_size * zero_chunk_len(...)``."""
+    return block * max(1, math.ceil(total / (axis_size * block)))
+
+
+def quantized_reduce_scatter(x: jax.Array, axis_name: str,
+                             axis_size: int, *, block: int = 2048,
+                             mean: bool = False) -> jax.Array:
+    """The quantized ring's reduce-scatter half, standalone (ZeRO's
+    gradient sharding). ``x`` is the per-shard flat f32 buffer, padded
+    to ``axis_size * chunk`` with ``chunk`` a multiple of ``block``
+    (:func:`zero_chunk_len`); the return is the fully-reduced f32
+    chunk THIS shard owns — shard ``r`` owns ``x[r*chunk:(r+1)*chunk]``
+    — after ``axis_size - 1`` int8 ``ppermute`` hops with f32
+    accumulation. Must run inside a shard_map manual over
+    ``axis_name``."""
+    n = int(axis_size)
+    if n < 1:
+        raise ValueError(f"axis_size must be >= 1, got {n}")
+    if block < 1:
+        raise ValueError(f"block must be >= 1, got {block}")
+    flat = x.astype(jnp.float32).reshape(-1)
+    if n == 1:
+        return flat / n if mean else flat
+    if flat.shape[0] % (n * block):
+        raise ValueError(
+            f"reduce-scatter input size {flat.shape[0]} must be a "
+            f"multiple of axis_size*block = {n * block}; pad to "
+            "zero_chunk_len first")
+    chunks = flat.reshape(n, -1)
+    r = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Ring reduce-scatter, int8 hops / f32 accumulation. Start one
+    # chunk BEHIND the owned index so that after n-1 forward hops the
+    # partial lands home: device r seeds chunk r-1, and at hop s adds
+    # its own contribution to the incoming partial of chunk r-2-s;
+    # after the last hop (s = n-2) it holds the full sum of chunk r.
+    acc = _chunk(chunks, jnp.mod(r - 1, n))
+    for s in range(n - 1):
+        q, sc = quantize_blockwise(acc, block)
+        q = jax.lax.ppermute(q, axis_name, perm)
+        sc = jax.lax.ppermute(sc, axis_name, perm)
+        acc = dequantize_blockwise(q, sc, block) \
+            + _chunk(chunks, jnp.mod(r - 2 - s, n))
+    return acc / n if mean else acc
+
+
+def reduce_scatter(x: jax.Array, axis_name: str, axis_size: int, *,
+                   mean: bool = False) -> jax.Array:
+    """f32 spelling of :func:`quantized_reduce_scatter`: the same ring
+    (same ownership — shard r gets chunk r — and the same pairwise f32
+    accumulation order) with uncompressed hops. Input must be padded
+    to a multiple of ``axis_size``."""
+    n = int(axis_size)
+    if n < 1:
+        raise ValueError(f"axis_size must be >= 1, got {n}")
+    flat = x.astype(jnp.float32).reshape(-1)
+    if n == 1:
+        return flat / n if mean else flat
+    if flat.shape[0] % n:
+        raise ValueError(
+            f"reduce-scatter input size {flat.shape[0]} must be a "
+            f"multiple of axis_size {n}")
+    chunks = flat.reshape(n, -1)
+    r = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = _chunk(chunks, jnp.mod(r - 1, n))
+    for s in range(n - 1):
+        acc = jax.lax.ppermute(acc, axis_name, perm) \
+            + _chunk(chunks, jnp.mod(r - 2 - s, n))
+    return acc / n if mean else acc
+
+
+def quantized_all_gather(chunk: jax.Array, axis_name: str, *,
+                         block: int = 2048) -> jax.Array:
+    """The quantized ring's all-gather half, standalone (ZeRO's param
+    return): each shard quantizes its owned chunk once, all-gathers
+    int8 + scales, and dequantizes locally. Because shard r owns chunk
+    r, gathered row order IS chunk order — the flat f32 concatenation
+    comes back directly."""
+    q, sc = quantize_blockwise(chunk.astype(jnp.float32), block)
+    qg = jax.lax.all_gather(q, axis_name, axis=0)
+    sg = jax.lax.all_gather(sc, axis_name, axis=0)
+    return (qg.reshape(qg.shape[0], -1, block).astype(jnp.float32)
+            * sg[:, :, None]).reshape(-1)
+
+
+def all_gather_cast(chunk: jax.Array, axis_name: str,
+                    dtype=jnp.float32) -> jax.Array:
+    """Uncompressed spelling of :func:`quantized_all_gather`: gather
+    the owned chunk cast to ``dtype`` for transport (``bf16`` halves
+    the payload at ~3 significand decimal digits; ``f32`` is exact)
+    and return the flat f32 concatenation."""
+    g = jax.lax.all_gather(chunk.astype(dtype), axis_name, axis=0)
+    return g.astype(jnp.float32).reshape(-1)
+
+
 def quantized_all_reduce(x: jax.Array, axis_name: str, axis_size: int,
                          *, block: int = 2048,
                          mean: bool = False) -> jax.Array:
@@ -200,6 +334,11 @@ def quantized_all_reduce(x: jax.Array, axis_name: str, axis_size: int,
     with f32 block scales; accumulation is f32; the result is
     replicated across the axis. ``mean=True`` divides by the axis size
     (the DP-gradient convention). Output keeps ``x``'s shape/dtype.
+
+    Spelled as the composition of the standalone ring halves:
+    :func:`quantized_reduce_scatter` then :func:`quantized_all_gather`
+    (the ZeRO split of ISSUE 19 — an AllReduce is exactly the two
+    halves back to back with no compute between).
     """
     n = int(axis_size)
     if n < 1:
@@ -212,33 +351,11 @@ def quantized_all_reduce(x: jax.Array, axis_name: str, axis_size: int,
         return (flat / n if mean else flat).reshape(orig_shape) \
             .astype(orig_dtype)
     size = flat.shape[0]
-    # one chunk per device, each a whole number of blocks
-    chunk = block * max(1, math.ceil(size / (n * block)))
+    chunk = zero_chunk_len(size, n, block)
     flat = jnp.pad(flat, (0, chunk * n - size))
-    chunks = flat.reshape(n, chunk)
-    r = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
-    # ring reduce-scatter, int8 hops / f32 accumulation: after n-1
-    # hops device r holds the full sum of chunk (r + 1) % n
-    acc = _chunk(chunks, r)
-    for s in range(n - 1):
-        q, sc = quantize_blockwise(acc, block)
-        q = jax.lax.ppermute(q, axis_name, perm)
-        sc = jax.lax.ppermute(sc, axis_name, perm)
-        acc = dequantize_blockwise(q, sc, block) \
-            + _chunk(chunks, jnp.mod(r - 1 - s, n))
-
-    # quantized all-gather of the reduced shards; gathered row d is
-    # chunk (d + 1) % n, so roll by one to restore chunk order
-    q, sc = quantize_blockwise(acc, block)
-    qg = jax.lax.all_gather(q, axis_name, axis=0)
-    sg = jax.lax.all_gather(sc, axis_name, axis=0)
-    full = (qg.reshape(n, -1, block).astype(jnp.float32)
-            * sg[:, :, None]).reshape(n, chunk)
-    full = jnp.roll(full, 1, axis=0).reshape(-1)[:size]
-    if mean:
-        full = full / n
+    acc = quantized_reduce_scatter(flat, axis_name, n, block=block,
+                                   mean=mean)
+    full = quantized_all_gather(acc, axis_name, block=block)[:size]
     return full.reshape(orig_shape).astype(orig_dtype)
 
 
@@ -263,3 +380,147 @@ def quantized_all_reduce_tree(tree, axis_name: str, axis_size: int,
                    .astype(jnp.asarray(l).dtype))
         off += sz
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def dp_zero_step(mesh, axis_size: int, block: int, grad_comm: str,
+                 param_comm: str, fn, update_fn, rep_args, params,
+                 flat_state, batch, batch_specs, key, lr, step_no,
+                 plr, wd, *, clip_norm=None, guard: bool = False):
+    """THE ZeRO sharded-weight-update shard_map wrap, shared by both
+    trainers (like ``dp_quantized_value_and_grads``, so the semantics
+    cannot drift). One manual region over ``dp`` does the whole step:
+
+    1. ``fn(rep_args, params, key, batch) -> (loss, aux, grads)`` runs
+       per shard on its batch slice (key folded with the shard index);
+       loss and floating ``aux`` leaves are pmean'd.
+    2. Gradients are flattened into ONE fused f32 buffer (EQuARX
+       layout), padded to ``axis_size * chunk``
+       (:func:`zero_chunk_len`), and reduce-scattered (mean) to their
+       owner shard — the quantized ring for ``grad_comm='int8'``, the
+       f32 ring otherwise. Per-replica transient grad memory after
+       this point is ``chunk``, not ``total``.
+    3. Global-norm clipping (when ``clip_norm`` is set) via a psum of
+       per-shard squared chunk sums — mathematically the full-tensor
+       norm, computed without regathering.
+    4. ``guard=True`` computes the bad-step verdict HERE, on the
+       reduced shard grads + pmean'd loss, and pmin-agrees it across
+       the mesh so every shard takes the identical keep/skip branch.
+    5. ``update_fn(p_chunk, g_chunk, moments, lr, step_no, plr, wd)
+       -> (new_p_chunk, new_moments)`` runs shard-locally on the owned
+       flat slice. The parameter chunk comes from ``flat_state
+       ['master']`` when present (the f32 master copy required for
+       compressed ``param_comm`` — bf16 round-trip rounding would
+       swallow small updates), else it is sliced out of the replicated
+       params. Optimizer state lives at chunk shape: the memory win.
+    6. A guarded-bad step deselects the NEW state bitwise (moments and
+       master keep their previous values).
+    7. The updated chunk all-gathers back — f32 exact, bf16 cast, or
+       the quantized gather per ``param_comm`` — and leaves are
+       restored to their shapes/dtypes; on a guarded-bad step every
+       leaf reverts bitwise to its input value (the deselect happens
+       AFTER the gather, so compressed-payload garbage from a NaN step
+       is discarded, never applied).
+
+    ``plr`` / ``wd`` are per-parameter learning-rate multipliers /
+    weight-decay factors: scalars when uniform, else flat
+    ``axis_size * chunk`` f32 vectors laid out exactly like the fused
+    param buffer (they enter the shard_map with spec ``P('dp')`` and
+    arrive pre-sliced to the owned chunk).
+
+    Returns ``(loss, aux, new_params, new_flat_state)`` plus the
+    mesh-agreed ``ok`` bool when ``guard``. ``new_flat_state`` keeps
+    the dp-sharded layout (out_spec ``P('dp')``); everything else is
+    replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from ._compat import shard_map
+
+    n = int(axis_size)
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    sizes = [int(jnp.size(l)) for l in leaves]
+    total = sum(sizes)
+    chunk = zero_chunk_len(total, n, block)
+    pad = chunk * n - total
+
+    def _knob_spec(v):
+        return P("dp") if getattr(v, "ndim", 0) >= 1 else P()
+
+    def body(rep, params_, state, key_, lr_, step_no_, plr_, wd_,
+             *batch_):
+        key_ = jax.random.fold_in(key_, jax.lax.axis_index("dp"))
+        loss, aux, grads = fn(rep, params_, key_, batch_)
+        loss = jax.lax.pmean(loss, "dp")
+        aux = jax.tree_util.tree_map(
+            lambda a: jax.lax.pmean(a, "dp")
+            if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+            else a, aux)
+
+        gleaves = jax.tree_util.tree_leaves(grads)
+        flat_g = jnp.concatenate(
+            [g.astype(jnp.float32).reshape(-1) for g in gleaves])
+        flat_g = jnp.pad(flat_g, (0, pad))
+        if grad_comm == "int8":
+            g_c = quantized_reduce_scatter(flat_g, "dp", n, block=block,
+                                           mean=True)
+        else:
+            g_c = reduce_scatter(flat_g, "dp", n, mean=True)
+
+        if clip_norm is not None:
+            gsq = jax.lax.psum(jnp.sum(jnp.square(g_c)), "dp")
+            gn = jnp.sqrt(gsq)
+            g_c = g_c * jnp.where(gn > clip_norm, clip_norm / gn, 1.0)
+
+        ok = None
+        if guard:
+            ok_local = jnp.logical_and(
+                jnp.isfinite(loss), jnp.all(jnp.isfinite(g_c)))
+            ok = jax.lax.pmin(ok_local.astype(jnp.int32), "dp") \
+                .astype(jnp.bool_)
+
+        pleaves = jax.tree_util.tree_leaves(params_)
+        if "master" in state:
+            p_c = state["master"]
+        else:
+            flat_p = jnp.concatenate(
+                [p0.astype(jnp.float32).reshape(-1) for p0 in pleaves])
+            flat_p = jnp.pad(flat_p, (0, pad))
+            r = jax.lax.axis_index("dp")
+            p_c = jax.lax.dynamic_slice(flat_p, (r * chunk,), (chunk,))
+        moments = {k: v for k, v in state.items() if k != "master"}
+        new_p_c, new_moments = update_fn(p_c, g_c, moments, lr_,
+                                         step_no_, plr_, wd_)
+        new_state = dict(new_moments)
+        if "master" in state:
+            new_state["master"] = new_p_c
+        if ok is not None:
+            new_state = {k: jnp.where(ok, v, state[k])
+                         for k, v in new_state.items()}
+
+        if param_comm == "int8":
+            full = quantized_all_gather(new_p_c, "dp", block=block)
+        elif param_comm == "bf16":
+            full = all_gather_cast(new_p_c, "dp", jnp.bfloat16)
+        else:
+            full = all_gather_cast(new_p_c, "dp", jnp.float32)
+        out_leaves, off = [], 0
+        for p0, sz in zip(pleaves, sizes):
+            nl = full[off:off + sz].reshape(p0.shape).astype(p0.dtype)
+            if ok is not None:
+                nl = jnp.where(ok, nl, p0)
+            out_leaves.append(nl)
+            off += sz
+        new_params = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        if guard:
+            return loss, aux, new_params, new_state, ok
+        return loss, aux, new_params, new_state
+
+    in_specs = (P(), P(), P("dp"), P(), P(), P(),
+                _knob_spec(plr), _knob_spec(wd)) + tuple(batch_specs)
+    out_specs = (P(), P(), P(), P("dp"))
+    if guard:
+        out_specs = out_specs + (P(),)
+    return shard_map(body, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)(
+        rep_args, params, flat_state, key, lr, step_no, plr, wd,
+        *batch)

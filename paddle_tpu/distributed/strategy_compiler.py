@@ -183,6 +183,50 @@ def make_param_update(opt):
     return upd
 
 
+def make_flat_update(opt):
+    """ZeRO flat-chunk spelling of :func:`make_param_update`: the same
+    decay rule + opt rule applied to the fused flat parameter slice a
+    dp shard owns (qcomm.dp_zero_step). Exact by construction — every
+    optimizer ``_update`` is elementwise, so updating a slice of the
+    concatenation bitwise-equals slicing the per-param updates; ``plr``
+    / ``wd`` arrive as scalars or per-element vectors laid out like the
+    flat buffer and broadcast elementwise either way."""
+    decay_mode = opt._decay_mode
+    l2 = opt._weight_decay
+
+    def upd(p, g, s, lr, step_no, plr, wd):
+        g = g.astype(jnp.float32)
+        if decay_mode == "l2" and l2:
+            g = g + l2 * p
+        return opt._update(p, g, s, lr * plr, step_no, wd=wd)
+
+    return upd
+
+
+class _FlatShim:
+    """Stand-in 'parameter' handed to ``optimizer._init_state`` to
+    allocate state at the ZeRO flat-slab shape (state init only reads
+    ``._value``)."""
+
+    def __init__(self, value):
+        self._value = value
+
+
+def _flat_knob(vals, sizes, pad_to):
+    """Per-parameter scalars -> the dp_zero_step knob spelling: one
+    scalar when uniform, else a flat f32 vector laid out exactly like
+    the fused param buffer (zero-padded tail; pad elements get knob 0,
+    which is inert — their grads are padding zeros too)."""
+    vals = [float(v) for v in vals]
+    if len(set(vals)) <= 1:
+        return jnp.float32(vals[0] if vals else 0.0)
+    vec = np.concatenate([np.full(s, v, np.float32)
+                          for v, s in zip(vals, sizes)]) \
+        if sizes else np.zeros(0, np.float32)
+    vec = np.pad(vec, (0, pad_to - vec.size))
+    return jnp.asarray(vec)
+
+
 class HybridParallelTrainer:
     """Compiled SPMD training loop over (model, optimizer, strategy).
 
@@ -195,7 +239,8 @@ class HybridParallelTrainer:
             DistributedStrategy] = None, mesh: Optional[Mesh] = None,
             loss_fn=None, data_spec: Optional[Tuple] = None,
             donate: bool = True, accumulate_steps: int = 1,
-            dp_grad_comm: str = "f32", dp_grad_block: int = 2048):
+            dp_grad_comm: str = "f32", dp_grad_block: int = 2048,
+            dp_param_comm: Optional[str] = None):
         self.layer = layer
         self.optimizer = optimizer
         # gradient merge (reference: fleet gradient_merge meta-optimizer /
@@ -220,35 +265,87 @@ class HybridParallelTrainer:
         # shard_map and reduces them through the EQuARX-style compressed
         # ring (blockwise int8 transport, f32 accumulation) instead of
         # GSPMD's implicit f32 AllReduce. Pure-DP only: every non-dp
-        # mesh axis must be 1 and ZeRO off (the quantized
-        # reduce-scatter would compose with ZeRO's grad sharding, but
-        # that wiring is ROADMAP residue).
-        from .qcomm import validate_dp_grad_comm
+        # mesh axis must be 1.
+        from . import qcomm as _qcomm
 
-        validate_dp_grad_comm(dp_grad_comm, self.mesh, zero_stage=zero,
-                              block=int(dp_grad_block))
+        _qcomm.validate_dp_grad_comm(dp_grad_comm, self.mesh,
+                                     zero_stage=zero,
+                                     block=int(dp_grad_block))
         self.dp_grad_comm = dp_grad_comm
         self.dp_grad_block = int(dp_grad_block)
+
+        # ZeRO-1/2 manual weight-update sharding (ISSUE 19; Xu et al.
+        # 2004.13336): on a pure-DP mesh, stages 1-2 run the whole
+        # update inside the ONE dp shard_map — reduce-scatter grads to
+        # their owner shard (quantized or f32 ring per dp_grad_comm),
+        # optimizer update on only the owned flat slice (state lives
+        # at shard shape: the memory win), all-gather updated params
+        # back (payload per dp_param_comm). Non-pure-DP meshes keep
+        # the GSPMD _add_axis spelling below; stage 3 (param sharding)
+        # is GSPMD-only.
+        dp = self.mesh.shape.get("dp", 1)
+        pure_dp = all(s == 1 for a, s in self.mesh.shape.items()
+                      if a != "dp")
+        self.zero_manual = bool(zero in (1, 2) and dp > 1 and pure_dp)
+        if dp_param_comm is None:
+            dp_param_comm = "bf16" if (self.zero_manual
+                                       and dp_grad_comm == "int8") \
+                else "f32"
+        _qcomm.validate_dp_param_comm(dp_param_comm, self.zero_manual)
+        self.dp_param_comm = dp_param_comm
+        if self.zero_manual:
+            clip = optimizer._grad_clip
+            if clip is not None and not isinstance(clip,
+                                                   ClipGradByGlobalNorm):
+                raise NotImplementedError(
+                    "ZeRO sharded update supports grad clipping only "
+                    "by global norm (per-leaf clips need the full "
+                    f"gradient on every shard); got {type(clip).__name__}")
 
         pn, pt, bn, bt = state_tensors(layer)
         self.param_names, self._param_tensors = pn, pt
         self.buffer_names, self._buffer_tensors = bn, bt
         self.param_specs = resolve_param_specs(layer, self.mesh, zero)
 
-        # optimizer state: init + specs (ZeRO>=1 shards moments over dp)
-        self.opt_states = []
-        self.opt_specs = []
-        dp = self.mesh.shape.get("dp", 1)
-        for name, p in zip(pn, pt):
-            s = optimizer._init_state(p)
-            self.opt_states.append(s)
-            pspec = self.param_specs[name]
-            if zero >= 1:
-                shape = _local_check_shape(p._value.shape, pspec, self.mesh)
-                sspec = _add_axis(pspec, p._value.ndim, shape, "dp", dp)
-            else:
-                sspec = pspec
-            self.opt_specs.append({k: sspec for k in s})
+        if self.zero_manual:
+            # fused flat optimizer state, dp-sharded: ONE [dp*chunk]
+            # slab per state key (+ the f32 master param copy when the
+            # param all-gather is compressed — bf16 round-trip rounding
+            # would swallow small updates without it)
+            sizes = [int(np.prod(p._value.shape)) for p in pt]
+            self._zero_sizes = sizes
+            self._zero_chunk = _qcomm.zero_chunk_len(
+                sum(sizes), dp, self.dp_grad_block)
+            slab = dp * self._zero_chunk
+            st = optimizer._init_state(
+                _FlatShim(jnp.zeros((slab,), jnp.float32)))
+            if self.dp_param_comm != "f32":
+                flat = np.concatenate(
+                    [np.asarray(p._value, np.float32).reshape(-1)
+                     for p in pt]) if pt else np.zeros(0, np.float32)
+                st["master"] = jnp.asarray(
+                    np.pad(flat, (0, slab - flat.size)))
+            dp_sh = NamedSharding(self.mesh, P("dp"))
+            self.opt_states = {k: jax.device_put(v, dp_sh)
+                               for k, v in st.items()}
+            self.opt_specs = {k: P("dp") for k in st}
+        else:
+            # optimizer state: init + specs (GSPMD ZeRO>=1 shards
+            # moments over dp via _add_axis)
+            self.opt_states = []
+            self.opt_specs = []
+            for name, p in zip(pn, pt):
+                s = optimizer._init_state(p)
+                self.opt_states.append(s)
+                pspec = self.param_specs[name]
+                if zero >= 1:
+                    shape = _local_check_shape(p._value.shape, pspec,
+                                               self.mesh)
+                    sspec = _add_axis(pspec, p._value.ndim, shape, "dp",
+                                      dp)
+                else:
+                    sspec = pspec
+                self.opt_specs.append({k: sspec for k in s})
 
         # place state onto the mesh
         self.params = [
@@ -258,10 +355,11 @@ class HybridParallelTrainer:
         self.buffers = [jax.device_put(b._value,
                                        NamedSharding(self.mesh, P()))
                         for b in bt]
-        self.opt_states = jax.device_put(
-            self.opt_states,
-            [{k: NamedSharding(self.mesh, spec[k]) for k in spec}
-             for spec in self.opt_specs])
+        if not self.zero_manual:
+            self.opt_states = jax.device_put(
+                self.opt_states,
+                [{k: NamedSharding(self.mesh, spec[k]) for k in spec}
+                 for spec in self.opt_specs])
 
         self.data_spec = data_spec
         self._step = 0
@@ -376,11 +474,45 @@ class HybridParallelTrainer:
         qcomm_dp = self.mesh.shape.get("dp", 1) \
             if self.dp_grad_comm == "int8" else 1
         qcomm_block = self.dp_grad_block
+        zero_manual = self.zero_manual
+        zdp = self.mesh.shape.get("dp", 1)
+        if zero_manual:
+            from . import qcomm as _zq
+
+            flat_upd = make_flat_update(opt)
+            clip_norm = float(clip.clip_norm) if clip is not None \
+                else None
+            slab = zdp * self._zero_chunk
+            plr_knob = _flat_knob(lrs, self._zero_sizes, slab)
+            wd_knob = _flat_knob(wds, self._zero_sizes, slab)
 
         def step_fn(params, opt_states, buffers, batch, lr, step_no, key):
             # trace-time side effect: reports every (re)trace of this
             # program with the triggering batch shapes (profiler.recompile)
             _precomp.mark_trace(self._prof_site, batch)
+            if zero_manual:
+                # ZeRO-1/2 sharded update: the ONE shared shard_map
+                # wrap (qcomm.dp_zero_step) does per-shard local
+                # grads, fused reduce-scatter (quantized or f32 ring
+                # per dp_grad_comm), global-norm clip on the reduced
+                # chunks, the shard-local flat optimizer update, and
+                # the param all-gather (dp_param_comm payload). Grad
+                # accumulation (local_loss_grads' scan) and AMP
+                # compose unchanged — they live inside `local`.
+                def local(rep, params_, key_, batch_):
+                    (buffers_,) = rep
+                    return local_loss_grads(params_, buffers_, batch_,
+                                            key_)
+
+                bspecs = tuple(self.data_spec) \
+                    if self.data_spec is not None \
+                    else _zq.dp_batch_specs(batch, zdp)
+                loss, new_buf, new_params, new_states = _zq.dp_zero_step(
+                    mesh, zdp, self.dp_grad_block, self.dp_grad_comm,
+                    self.dp_param_comm, local, flat_upd, (buffers,),
+                    params, opt_states, batch, bspecs, key, lr,
+                    step_no, plr_knob, wd_knob, clip_norm=clip_norm)
+                return loss, new_params, new_states, new_buf
             if qcomm_dp > 1:
                 # quantized DP-grad sync: per-shard local grads inside
                 # the ONE shared all-manual shard_map wrap (qcomm.py),
@@ -424,8 +556,12 @@ class HybridParallelTrainer:
 
         param_sh = [NamedSharding(mesh, self.param_specs[n])
                     for n in self.param_names]
-        state_sh = [{k: NamedSharding(mesh, spec[k]) for k in spec}
-                    for spec in self.opt_specs]
+        if zero_manual:
+            state_sh = {k: NamedSharding(mesh, P("dp"))
+                        for k in self.opt_specs}
+        else:
+            state_sh = [{k: NamedSharding(mesh, spec[k]) for k in spec}
+                        for spec in self.opt_specs]
         buf_sh = [NamedSharding(mesh, P()) for _ in self.buffers]
         repl = NamedSharding(mesh, P())
 
@@ -538,6 +674,46 @@ class HybridParallelTrainer:
             out["trace"] = cap.summary
         return out
 
+    def memory_ledger(self) -> dict:
+        """Per-rank resident bytes by state category, from ACTUAL array
+        shardings (profiler.record_memory_ledger — gauges
+        ``mem/{param,grad,opt_state,master}_bytes``). On the manual
+        ZeRO path opt state (and master) are [dp*chunk] slabs sharded
+        P('dp'), so their per-rank count is 1/dp of the replicated
+        baseline; ``grad`` is the transient fused buffer — full-size
+        pre-reduce-scatter on every path, counted at the per-rank peak
+        (the full flat buffer; after the scatter only the owned chunk
+        stays live)."""
+        cats = {"param": self.params,
+                "grad": 4 * sum(int(np.prod(np.shape(p)))
+                                for p in self.params)}
+        if self.zero_manual:
+            cats["opt_state"] = {k: v for k, v in self.opt_states.items()
+                                 if k != "master"}
+            if "master" in self.opt_states:
+                cats["master"] = self.opt_states["master"]
+        else:
+            cats["opt_state"] = self.opt_states
+        return _pinstr.record_memory_ledger(cats)
+
+    def device_state(self) -> dict:
+        """Device-resident training state as a pytree for
+        distributed/checkpoint.py (the HybridPipelineTrainer contract):
+        arrays keep their shardings, so a dp-sharded ZeRO slab saves
+        per-shard and restores back to P('dp') placement."""
+        return {"params": list(self.params),
+                "buffers": list(self.buffers),
+                "opt": self.opt_states}
+
+    def load_device_state(self, st: dict, step: Optional[int] = None):
+        """Inverse of :meth:`device_state` (restore path)."""
+        self.params = list(st["params"])
+        self.buffers = list(st["buffers"])
+        self.opt_states = st["opt"]
+        if step is not None:
+            self._step = int(step)
+            self.optimizer._global_step = int(step)
+
     def sync_to_layer(self):
         """Write device state back into the eager Layer (for save/eval)."""
         for t, v in zip(self._param_tensors, self.params):
@@ -545,8 +721,21 @@ class HybridParallelTrainer:
         for t, v in zip(self._buffer_tensors, self.buffers):
             t._value = v
         # hand optimizer its state back (for state_dict)
-        for p, s in zip(self._param_tensors, self.opt_states):
-            self.optimizer._accumulators[id(p)] = s
+        if self.zero_manual:
+            # regather the flat dp-sharded slabs and slice them back
+            # into per-param state (host-side; save/eval path only)
+            flat = {k: np.asarray(v) for k, v in self.opt_states.items()
+                    if k != "master"}
+            off = 0
+            for p, sz in zip(self._param_tensors, self._zero_sizes):
+                shape = p._value.shape
+                self.optimizer._accumulators[id(p)] = {
+                    k: jnp.asarray(v[off:off + sz].reshape(shape))
+                    for k, v in flat.items()}
+                off += sz
+        else:
+            for p, s in zip(self._param_tensors, self.opt_states):
+                self.optimizer._accumulators[id(p)] = s
         return self.layer
 
 

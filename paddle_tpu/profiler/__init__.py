@@ -102,7 +102,8 @@ from .events import log as event_log  # noqa: F401
 from .instrument import (collective_stats, device_memory_stats,  # noqa: F401
                          estimate_comm_ms, record_collective_stats,
                          record_collectives_from, record_memory_high_water,
-                         record_phases, tokens_in_batch)
+                         record_memory_ledger, record_phases,
+                         tokens_in_batch)
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       registry)
 from .recompile import (mark_trace, retraces, suppressed,  # noqa: F401
@@ -125,7 +126,7 @@ __all__ = [
     "collective_stats", "record_collective_stats",
     "record_collectives_from", "estimate_comm_ms",
     "record_phases", "device_memory_stats", "record_memory_high_water",
-    "tokens_in_batch",
+    "record_memory_ledger", "tokens_in_batch",
     "summary",
     # per-request event timelines + flight recorder (events.py)
     "emit", "event_log", "EventLog", "latency_breakdown", "latency_table",

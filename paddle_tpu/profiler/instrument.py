@@ -80,20 +80,26 @@ def collective_stats(lowered_text: str) -> dict:
     XLA's SPMD partitioner runs, so its StableHLO reports 0 — pass the
     COMPILED text to count those. Returns
     {"ops": {op_name: count}, "bytes": {op_name: bytes},
-    "bytes_by_dtype": {canonical_dtype: bytes}, "total_bytes"} — the
-    per-dtype split is what makes a quantized-collective experiment
-    (distributed/qcomm.py) readable straight off the gauges instead of
-    derived from op-level deltas.
+    "bytes_by_dtype": {canonical_dtype: bytes},
+    "bytes_by_kind_dtype": {op_name: {canonical_dtype: bytes}},
+    "total_bytes"} — the per-dtype split is what makes a
+    quantized-collective experiment (distributed/qcomm.py) readable
+    straight off the gauges instead of derived from op-level deltas,
+    and the per-kind×per-dtype split is what separates the ring's two
+    halves (reduce-scatter vs all-gather) for the ZeRO ledger.
     """
     ops: dict = {}
     byts: dict = {}
     by_dtype: dict = {}
+    by_kind_dtype: dict = {}
 
     def _acc(op: str, dims: str, dtype: str) -> None:
         b = _tensor_bytes(dims, dtype)
         byts[op] = byts.get(op, 0) + b
         canon = _DTYPE_CANON.get(dtype, dtype)
         by_dtype[canon] = by_dtype.get(canon, 0) + b
+        kd = by_kind_dtype.setdefault(op, {})
+        kd[canon] = kd.get(canon, 0) + b
 
     lines = lowered_text.splitlines()
     i = 0
@@ -141,7 +147,24 @@ def collective_stats(lowered_text: str) -> dict:
                 _acc(op, dims, dt)
         i += 1
     return {"ops": ops, "bytes": byts, "bytes_by_dtype": by_dtype,
+            "bytes_by_kind_dtype": by_kind_dtype,
             "total_bytes": sum(byts.values())}
+
+
+#: The ring's two halves, as gauge buckets over lowered op kinds. The
+#: manual ring's reduce-scatter half lowers to ``collective_permute``
+#: hops (ppermute) while GSPMD's spelling is a real ``reduce_scatter``
+#: op — both are grad-sharding traffic, so they share the bucket.
+#: ``all_reduce`` is deliberately in NEITHER: it is the fused
+#: both-halves op, so a replicated AllReduce program reads 0 on both
+#: half-gauges and the split stays strictly "ring halves".
+_KIND_BUCKETS = {
+    "reduce_scatter": ("reduce_scatter", "collective_permute"),
+    "all_gather": ("all_gather",),
+}
+#: gauge-suffix -> canonical parsed dtypes folded into it
+_DTYPE_BUCKETS = {"int8": ("i8", "ui8"), "bf16": ("bf16",),
+                  "f32": ("f32",)}
 
 
 def record_collective_stats(lowered_text: str, prefix: str = "comm") -> dict:
@@ -152,7 +175,12 @@ def record_collective_stats(lowered_text: str, prefix: str = "comm") -> dict:
     bytes halved" claim of a quantized-AllReduce config (qcomm.py)
     readable straight off the gauge: int8 counts the i8/ui8 payloads,
     f32 the f32 ones (block scales included — they ARE f32 wire
-    bytes)."""
+    bytes). The per-kind×per-dtype gauges
+    ``{prefix}/collective_bytes_{reduce_scatter,all_gather}_{int8,
+    bf16,f32}`` additionally split the ring's two halves (ZeRO's grad
+    sharding vs param return, ISSUE 19) so "the sharded arm moved its
+    gradient bytes over reduce-scatter" is a registry read, not an HLO
+    diff."""
     st = collective_stats(lowered_text)
     reg = registry()
     reg.gauge(f"{prefix}/collective_bytes_per_step").set(st["total_bytes"])
@@ -162,6 +190,13 @@ def record_collective_stats(lowered_text: str, prefix: str = "comm") -> dict:
     reg.gauge(f"{prefix}/collective_bytes_int8").set(
         bd.get("i8", 0) + bd.get("ui8", 0))
     reg.gauge(f"{prefix}/collective_bytes_f32").set(bd.get("f32", 0))
+    bkd = st["bytes_by_kind_dtype"]
+    for kind, opnames in _KIND_BUCKETS.items():
+        for sfx, canons in _DTYPE_BUCKETS.items():
+            total = sum(bkd.get(op, {}).get(c, 0)
+                        for op in opnames for c in canons)
+            reg.gauge(
+                f"{prefix}/collective_bytes_{kind}_{sfx}").set(total)
     return st
 
 
@@ -209,6 +244,53 @@ def record_memory_high_water(prefix: str = "memory") -> Optional[int]:
     if "bytes_in_use" in st:
         reg.gauge(f"{prefix}/bytes_in_use").set(int(st["bytes_in_use"]))
     return int(peak)
+
+
+def _per_rank_bytes(v) -> int:
+    """Per-rank resident bytes of one ledger entry: a pytree of arrays
+    (each counted at its PER-DEVICE shard shape via
+    ``sharding.shard_shape`` — a dp-sharded ZeRO slab counts 1/dp of
+    its global size, a replicated param counts in full) or a plain int
+    (pre-computed bytes, e.g. a transient gradient buffer that never
+    materializes as a persistent array)."""
+    if isinstance(v, (int, float)) and not hasattr(v, "shape"):
+        return int(v)
+    total = 0
+    for a in jax.tree_util.tree_leaves(v):
+        shape = getattr(a, "shape", ())
+        sharding = getattr(a, "sharding", None)
+        if sharding is not None:
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:
+                pass
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * int(getattr(getattr(a, "dtype", None), "itemsize",
+                                 None) or np.dtype(
+                                     getattr(a, "dtype", "float32")
+                                 ).itemsize)
+    return total
+
+
+def record_memory_ledger(categories: dict, prefix: str = "mem") -> dict:
+    """The ZeRO memory ledger (ISSUE 19): per-rank resident bytes per
+    state category, computed from ACTUAL array shardings — not a
+    model. ``categories`` maps a name (``param`` / ``grad`` /
+    ``opt_state`` / ``master``...) to a pytree of arrays or a raw byte
+    count; each is folded into the ``{prefix}/{name}_bytes`` gauge
+    (and thus ``profiler.summary()``, the Prometheus sink, and bench
+    blocks). Returns ``{name: bytes}``. This is the gauge pair that
+    states the ZeRO claim: sharded ``opt_state_bytes`` ≈ 1/dp of the
+    replicated baseline's."""
+    reg = registry()
+    out = {}
+    for name, v in categories.items():
+        b = _per_rank_bytes(v)
+        out[name] = b
+        reg.gauge(f"{prefix}/{name}_bytes").set(b)
+    return out
 
 
 # Nominal interconnect bandwidth (bytes/s, per direction) used by the

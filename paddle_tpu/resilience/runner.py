@@ -50,8 +50,15 @@ loader giving up) therefore takes the whole mesh back to the same
 committed step — chaos-tested on the real process mesh
 (tests/multihost/test_resilience_mesh.py). Leases ride a heartbeat
 thread for the duration of ``run`` (compile stalls must not mark the
-rank dead); checkpoints land at the same steps on every rank by the
-shared schedule, so the restored step agrees without being voted on.
+rank dead). The restored step is PART of the agreement: each vote
+carries the rank's newest commit at or before its own bad-streak
+start, the reducer publishes the min, and every rank resumes capped at
+that target (``ElasticTrainer.resume(max_step=...)``) — a rank that
+committed a checkpoint after the proposer's streak began therefore
+walks back WITH the mesh instead of silently resuming younger state
+(state-lockstep; previously the shared save schedule was assumed to
+make the newest commit agree, which drifts exactly when ranks detect
+the streak at different steps).
 """
 from __future__ import annotations
 
@@ -71,15 +78,25 @@ __all__ = ["ResilienceConfig", "ResilientRunner", "RunResult"]
 
 def _resilience_reducer(votes):
     """The ``resil`` vote's deterministic reduce: the mesh verdict is
-    the most severe any rank reported (abort > rollback > healthy) and
-    the poisoned-cursor set is the union — every rank must blocklist
-    every rank's bad batches or the data timelines diverge."""
+    the most severe any rank reported (abort > rollback > healthy), the
+    poisoned-cursor set is the union — every rank must blocklist every
+    rank's bad batches or the data timelines diverge — and the restore
+    ``target`` is the MIN over the ranks' ``restorable`` steps (each
+    rank's newest commit at or before its own bad-streak start; -1 when
+    it has none). The min is the newest step safe for EVERY rank:
+    without it, a rank that committed a checkpoint after the proposer's
+    streak began would resume from younger state than the proposer and
+    the mesh would leave state-lockstep. ``v.get`` keeps rounds with
+    older peers (votes without the field) decidable."""
     verdicts = [v["verdict"] for v in votes.values()]
     verdict = "abort" if "abort" in verdicts else (
         "rollback" if "rollback" in verdicts else "healthy")
     cursors = sorted({int(c) for v in votes.values()
                       for c in v["bad_cursors"]})
-    return {"verdict": verdict, "bad_cursors": cursors}
+    rest = [int(v.get("restorable", -1)) for v in votes.values()]
+    rest = [r for r in rest if r >= 0]
+    return {"verdict": verdict, "bad_cursors": cursors,
+            "target": min(rest) if rest else -1}
 
 
 class ResilienceConfig:
@@ -261,17 +278,33 @@ class ResilientRunner:
                      seed=cursor,          # deterministic per batch
                      on_retry=_note)
 
-    def _mesh_agree(self, verdict: str, cursors) -> dict:
+    def _restorable(self, streak_start: int) -> int:
+        """Newest committed step at or before this rank's bad-streak
+        start (-1 when none): the restore point this rank can take
+        without resuming state younger than the streak's first poisoned
+        batch. Cast into the ``resil`` vote; the reducer mins it across
+        ranks so every rank restores the SAME step (state-lockstep)."""
+        mgr = self.elastic.manager
+        mgr.wait()            # an in-flight save must count or not, not race
+        from ..distributed.checkpoint import all_steps
+        steps = [s for s in all_steps(mgr.directory)
+                 if s <= streak_start]
+        return steps[-1] if steps else -1
+
+    def _mesh_agree(self, verdict: str, cursors,
+                    restorable: int = -1) -> dict:
         """One ``resil`` agreement round (module docstring): cast this
-        rank's verdict + poisoned cursors, adopt the published
-        decision. Raises on an agreed abort — EVERY rank raises, which
-        is the point (no survivor trains into a dead mesh)."""
+        rank's verdict + poisoned cursors + newest safely-restorable
+        step, adopt the published decision. Raises on an agreed abort —
+        EVERY rank raises, which is the point (no survivor trains into
+        a dead mesh)."""
         cons = self.config.consensus
         reg = _registry()
         dec = cons.decide(
             "resil",
             {"verdict": verdict,
-             "bad_cursors": sorted(int(c) for c in cursors)},
+             "bad_cursors": sorted(int(c) for c in cursors),
+             "restorable": int(restorable)},
             reducer=_resilience_reducer)
         reg.counter("resilience/mesh_agreements").add(1)
         if dec.value["verdict"] == "abort":
@@ -293,13 +326,17 @@ class ResilientRunner:
             reg.counter("resilience/mesh_rollbacks").add(1)
         return dec.value
 
-    def _rollback(self, bad_cursors, guarded: bool) -> int:
+    def _rollback(self, bad_cursors, guarded: bool,
+                  target: Optional[int] = None) -> int:
         """K consecutive bad steps: restore the newest readable
-        committed checkpoint and blocklist the poisoned cursors.
-        Returns the step to continue from. With no committed checkpoint
-        yet, a GUARDED trainer just continues past the bad batches (the
-        compiled guard kept the weights clean; the cursors stay
-        blocklisted for any future replay) — an UNGUARDED one has
+        committed checkpoint — capped at the mesh-agreed ``target``
+        step when a consensus round produced one (>= 0), so every rank
+        lands on the SAME restore point regardless of how far past the
+        streak start it had committed — and blocklist the poisoned
+        cursors. Returns the step to continue from. With no committed
+        checkpoint yet, a GUARDED trainer just continues past the bad
+        batches (the compiled guard kept the weights clean; the cursors
+        stay blocklisted for any future replay) — an UNGUARDED one has
         already taken the poisoned updates with nothing to restore, so
         the only honest move is to fail loudly.
 
@@ -318,7 +355,15 @@ class ResilientRunner:
         _psink.flush_active("rollback")
         self._skips.update(bad_cursors)
         el.manager.wait()              # never restore under an async save
-        if el.manager.latest_step() is None:
+        cap = int(target) if target is not None and int(target) >= 0 \
+            else None
+        newest = el.manager.latest_step()
+        if cap is not None and newest is not None:
+            from ..distributed.checkpoint import all_steps
+            elig = [s for s in all_steps(el.manager.directory)
+                    if s <= cap]
+            newest = elig[-1] if elig else None
+        if newest is None:
             if not guarded:
                 raise RuntimeError(
                     f"{len(bad_cursors)} consecutive non-finite steps "
@@ -328,7 +373,7 @@ class ResilientRunner:
                     "guard_bad_steps or checkpoint before the first "
                     "fault window.")
             return -1                  # continue in place
-        step = el.resume()
+        step = el.resume(max_step=cap)
         self._merge_resumed_skips()
         return step
 
@@ -446,18 +491,28 @@ class ResilientRunner:
                                 wd.pet(s,
                                        grace_s=cfg.watchdog_first_grace_s)
                             roll_cursors = bad_cursors
+                            roll_target = None
                             if cons is not None:
                                 # THIS rank's verdict becomes the
                                 # mesh's: propose, wait for the ranks
                                 # that saw nothing wrong, adopt the
-                                # union cursor set (or the abort)
+                                # union cursor set + min restore
+                                # target (or the abort). The streak
+                                # covers steps
+                                # [s - consecutive_bad + 1, s]; the
+                                # vote's restorable is the newest
+                                # commit not younger than its start.
                                 verdict = "abort" if (
                                     el.manager.latest_step() is None
                                     and not guarded) else "rollback"
-                                dec = self._mesh_agree(verdict,
-                                                       bad_cursors)
+                                dec = self._mesh_agree(
+                                    verdict, bad_cursors,
+                                    restorable=self._restorable(
+                                        s - consecutive_bad + 1))
                                 roll_cursors = dec["bad_cursors"]
-                            back = self._rollback(roll_cursors, guarded)
+                                roll_target = dec.get("target")
+                            back = self._rollback(roll_cursors, guarded,
+                                                  target=roll_target)
                             rollbacks += 1
                             consecutive_bad = 0
                             bad_cursors = []
@@ -518,12 +573,18 @@ class ResilientRunner:
                     bad_cursors
                 if not drain(0):
                     return False
-                dec = self._mesh_agree("healthy", bad_cursors)
+                # this rank's own partial streak (may be empty) covers
+                # steps [step - consecutive_bad, step - 1]; its vote
+                # offers the newest commit at or before that start
+                dec = self._mesh_agree(
+                    "healthy", bad_cursors,
+                    restorable=self._restorable(step - consecutive_bad))
                 if dec["verdict"] != "rollback":
                     return True
                 if wd is not None:
                     wd.pet(step, grace_s=cfg.watchdog_first_grace_s)
-                back = self._rollback(dec["bad_cursors"], guarded)
+                back = self._rollback(dec["bad_cursors"], guarded,
+                                      target=dec.get("target"))
                 rollbacks += 1
                 consecutive_bad = 0
                 bad_cursors = []

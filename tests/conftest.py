@@ -50,6 +50,7 @@ _COMPILE_HEAVY_FILES = frozenset({
     "test_spec_decode.py",       # spec engines: draft tick + verify tick
     "test_kv_quant.py",          # int8-KV engines: quantized tick pairs
     "test_qcomm.py",             # quantized-DP trainers: 2 step compiles
+    "test_zero_shard.py",        # ZeRO sharded-update trainer pairs
     "test_disagg.py",            # disagg serving: prefill+decode engines
 })
 

@@ -20,8 +20,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 WORKER = os.path.join(HERE, "worker_resilience.py")
 
 
-def test_one_rank_nan_triggers_agreed_mesh_rollback(tmp_path):
-    res = mp_mesh.launch(2, WORKER, [str(tmp_path)],
+def _run_and_check(tmp_path, mode):
+    res = mp_mesh.launch(2, WORKER, [str(tmp_path), mode],
                          log_dir=str(tmp_path / "logs"), timeout=600,
                          host_devices=2)     # dp=2 trainer per rank
     assert res.ok, res.tail()
@@ -43,3 +43,18 @@ def test_one_rank_nan_triggers_agreed_mesh_rollback(tmp_path):
     assert len(l0) == len(l1) > 0
     assert np.isfinite(l0).all() and np.isfinite(l1).all()
     np.testing.assert_array_equal(l0, l1)
+
+
+def test_one_rank_nan_triggers_agreed_mesh_rollback(tmp_path):
+    _run_and_check(tmp_path, "plain")
+
+
+def test_lockstep_resume_on_zero_sharded_path(tmp_path):
+    """ISSUE 19 state-lockstep satellite: the same one-rank-NaN chaos,
+    but the trainers run the ZeRO-1 sharded weight update (dp-sharded
+    flat opt slab, reduce-scatter/all-gather params). The mesh-agreed
+    rollback target must land both ranks on the SAME committed step of
+    the SHARDED state and the resumed loss curves must stay bitwise —
+    the vote's ``restorable``/reducer ``target`` path is what pins the
+    restore step when ranks detect the streak at different points."""
+    _run_and_check(tmp_path, "zero")

@@ -6,7 +6,12 @@ finish with bitwise-identical loss curves (the trainers are replicated
 — same seed, same data; pacing stands in for the per-step DP allreduce
 barrier this jax cannot run across CPU processes).
 
-argv: out_dir
+argv: out_dir [mode]
+
+mode "zero" (default "plain") runs the ZeRO-1 sharded weight update
+(sharding_stage 1 on the per-process dp=2 mesh): the mesh-agreed
+rollback target must take BOTH ranks back to the same committed step
+on the dp-SHARDED state path too (ISSUE 19 state-lockstep satellite).
 """
 import json
 import os
@@ -23,6 +28,7 @@ NAN_CURSORS = {3, 4}
 
 def main():
     out_dir = sys.argv[1]
+    mode = sys.argv[2] if len(sys.argv) > 2 else "plain"
     # env-only ranks: this worker's device compute is rank-LOCAL
     # (replicated trainers) and 0.4.37's distributed runtime would
     # route even local sharded device_put / checkpoint barriers into
@@ -45,8 +51,14 @@ def main():
                         num_heads=2, max_seq_len=16))
     opt = paddle.optimizer.AdamW(2e-3, parameters=net.parameters())
     mesh = create_mesh({"dp": 2}, jax.devices()[:2])
-    tr = HybridPipelineTrainer(net, opt, DistributedStrategy(), mesh,
+    strat = DistributedStrategy()
+    if mode == "zero":
+        strat.sharding = True
+        strat.sharding_configs = {"sharding_stage": 1}
+    tr = HybridPipelineTrainer(net, opt, strat, mesh,
                                n_micro=1, guard_bad_steps=True)
+    if mode == "zero":
+        assert tr.zero_manual, "zero mode did not engage the sharded update"
     cons = Consensus(os.path.join(out_dir, "board"), rank, world,
                      lease_s=3.0, timeout_s=240.0)
 

@@ -796,6 +796,49 @@ def test_schema_checker_qcomm_config():
                for e in _run_check("check_qcomm_config", missing))
 
 
+def _zero_cell(opt_bytes, rs=0, ag=0):
+    return {"mem_param_bytes": 1000, "mem_grad_bytes": 1000,
+            "mem_opt_state_bytes": opt_bytes,
+            "collective_bytes_per_step": 500,
+            "collective_bytes_reduce_scatter": rs,
+            "collective_bytes_all_gather": ag, "losses": [1.0]}
+
+
+def test_schema_checker_zero_config():
+    """ISSUE 19: the zero_cell validator pins the two bench claims —
+    sharded opt-state <= 1/dp + 5% of replicated, and the sharded arm
+    actually moving reduce-scatter bytes."""
+    good = {"dp": 8, "replicated": _zero_cell(2000),
+            "zero_f32": _zero_cell(260, rs=400, ag=450)}
+    assert _run_check("check_zero_config", good) == []
+    # the qcomm arm naming validates too
+    goodq = {"dp": 8, "fused_int8": _zero_cell(2000, rs=100, ag=110),
+             "zero_int8": _zero_cell(260, rs=100, ag=110)}
+    assert _run_check("check_zero_config", goodq) == []
+    # skipped (single-device box) is not a violation
+    assert _run_check("check_zero_config", {"skipped": "1 device"}) == []
+    # THE ZeRO claim: a sharded arm whose opt-state re-replicated
+    # (ratio > 1/dp + 5%) must fail the leg
+    fat = dict(good, zero_f32=_zero_cell(1900, rs=400, ag=450))
+    assert any("did not shard" in e
+               for e in _run_check("check_zero_config", fat))
+    # a "sharded" arm that moved no reduce-scatter bytes never
+    # sharded the gradient reduction
+    no_rs = dict(good, zero_f32=_zero_cell(260, rs=0, ag=450))
+    assert any("no reduce-scatter bytes" in e
+               for e in _run_check("check_zero_config", no_rs))
+    # missing ledger key
+    broke = dict(good)
+    broke["zero_f32"] = {k: v for k, v in good["zero_f32"].items()
+                         if k != "mem_opt_state_bytes"}
+    assert any("missing key 'mem_opt_state_bytes'" in e
+               for e in _run_check("check_zero_config", broke))
+    # an arm set with no zero_* arm is a writer bug, not a pass
+    assert any("zero_* arm" in e for e in _run_check(
+        "check_zero_config",
+        {"dp": 8, "replicated": _zero_cell(2000)}))
+
+
 # ---------------------------------------------------------------------------
 # sink-schema checker: ISSUE 15 blocks (scheduler-policy cells /
 # adaptive spec-k arms) — negative-tested so the v15 CI rules are

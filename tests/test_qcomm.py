@@ -221,9 +221,11 @@ class TestQuantizedDPTraining:
             compile_train_step(net, opt, s,
                                build_mesh_from_strategy(s),
                                dp_grad_comm="int8")
+        # stages 1-2 now RUN the sharded update on the quantized ring
+        # (test_zero_shard.py); stage 3 parameter sharding stays banned
         s2 = DistributedStrategy()
         s2.sharding = True
-        s2.sharding_configs = {"sharding_stage": 1}
+        s2.sharding_configs = {"sharding_stage": 3}
         with pytest.raises(NotImplementedError, match="ZeRO"):
             compile_train_step(net, opt, s2,
                                build_mesh_from_strategy(s2),

@@ -560,6 +560,59 @@ def check_qcomm_config(doc, schema: dict, where: str) -> None:
             "baseline")
 
 
+def check_zero_config(doc, schema: dict, where: str) -> None:
+    """Validate a bench.py gpt_dp_zero{,_qcomm} config block (ISSUE
+    19): both arms carry the memory-ledger + per-kind collective-byte
+    keys, the sharded arm's opt-state lands at <= 1/dp + 5% of the
+    replicated baseline's (the ZeRO claim — a sharded arm whose
+    opt-state silently re-replicates is exactly what this pins), and
+    the sharded arm actually moved reduce-scatter bytes (a 'sharded'
+    update whose grads still ride a plain AllReduce never sharded
+    anything)."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    if "skipped" in doc or "error" in doc:
+        return
+    # arm naming: the baseline arm first, the sharded arm second
+    arms = [a for a in ("replicated", "fused_int8", "zero_f32",
+                        "zero_int8") if a in doc]
+    sharded = [a for a in arms if a.startswith("zero_")]
+    if len(arms) < 2 or not sharded:
+        return err(f"{where}: needs a baseline arm and a zero_* arm "
+                   f"(have {arms!r})")
+    for arm in arms:
+        cell = doc[arm]
+        if not isinstance(cell, dict):
+            err(f"{where}.{arm}: not a JSON object")
+            continue
+        if "error" in cell:
+            continue
+        for k in sc["zero_cell"]:
+            if k not in cell:
+                err(f"{where}.{arm}: missing key {k!r}")
+    base = next((a for a in arms if not a.startswith("zero_")), None)
+    bc = doc.get(base) or {}
+    zc = doc.get(sharded[0]) or {}
+    if "error" in bc or "error" in zc:
+        return
+    dp = doc.get("dp")
+    bo, zo = bc.get("mem_opt_state_bytes"), zc.get("mem_opt_state_bytes")
+    if isinstance(dp, int) and dp > 1 \
+            and isinstance(bo, (int, float)) and bo > 0 \
+            and isinstance(zo, (int, float)):
+        bound = 1.0 / dp + 0.05
+        if zo / bo > bound:
+            err(f"{where}: sharded opt_state ratio {zo / bo:.4f} "
+                f"exceeds 1/dp + 5% ({bound:.4f}) — the opt state "
+                "did not shard")
+    rs = zc.get("collective_bytes_reduce_scatter")
+    if isinstance(rs, (int, float)) and rs <= 0:
+        err(f"{where}.{sharded[0]}: collective_bytes_reduce_scatter "
+            f"{rs!r} not positive (the sharded update moved no "
+            "reduce-scatter bytes)")
+
+
 def check_sched_cells(doc, schema: dict, where: str) -> None:
     """Validate a serve_bench --sched-matrix block (ISSUE 15): one
     cell per policy with the v15 keys, non-negative latencies, and the
@@ -1076,6 +1129,12 @@ def check_bench_json(path: str, schema: dict,
     if qc is not None:
         check_qcomm_config(qc, schema,
                            f"{path}: extra.configs.gpt_dp_qcomm_int8")
+    # ISSUE 19 blocks: the ZeRO-sharded memory-ledger configs
+    for zname in ("gpt_dp_zero", "gpt_dp_zero_qcomm"):
+        zc = (extra.get("configs") or {}).get(zname)
+        if zc is not None:
+            check_zero_config(zc, schema,
+                              f"{path}: extra.configs.{zname}")
     # ISSUE 15 blocks, validated whenever present
     if "sched_cells" in extra:
         check_sched_cells(extra["sched_cells"], schema,
