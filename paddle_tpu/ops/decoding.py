@@ -27,9 +27,16 @@ import jax.numpy as jnp
 
 __all__ = ["greedy_decode", "sampling_decode", "beam_search_decode",
            "apply_top_k_top_p", "apply_top_k_top_p_per_row",
-           "spec_accept_length"]
+           "spec_accept_length", "spec_rejection_sample"]
 
 NEG_INF = -1e9
+
+#: fold_in salt separating the acceptance-uniform stream from the
+#: token-draw stream at the same position: the draw for position ``p``
+#: consumes ``fold_in(key, p)`` and the accept test consumes
+#: ``fold_in(fold_in(key, p), SALT)`` — two independent streams off one
+#: per-request key, both scheduling-independent by construction.
+SPEC_ACCEPT_SALT = 0x5BD1E995
 
 
 def _force_eos(logprobs, finished, eos_token_id):
@@ -159,6 +166,105 @@ def spec_accept_length(draft_toks, target_toks, n_draft):
     # cumprod turns the first mismatch into a permanent 0: the sum is
     # the longest all-accepted prefix, not the total match count
     return jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+
+
+def spec_rejection_sample(target_logits, draft_probs, draft_toks, n_draft,
+                          keys, positions, temps, top_ks, top_ps):
+    """Sampled speculative acceptance (Leviathan/Chen rejection rule):
+    accept draft token t with probability ``min(1, p_tgt(t)/p_drf(t))``;
+    on the first rejection resample the correction from the normalized
+    residual ``max(0, p_tgt - p_drf)``. Both distributions must be
+    filtered by the SAME per-row temperature/top-k/top-p before the
+    ratio — the target side is filtered HERE, the draft side arrives
+    pre-filtered from the draft tick — which is what makes the marginal
+    law at every position exactly the non-speculative sampling law.
+
+    target_logits [N, 1+k, V]  raw target logits; column j scores the
+                               position ``positions + j``
+    draft_probs   [N, k, V] f32  FILTERED draft distributions (same
+                               per-row params applied at draft time)
+    draft_toks    [N, k] int32 draft candidates; column j proposes the
+                               token at position ``positions + j``
+    n_draft       [N] int32    drafts offered per row (0 = plain row)
+    keys          [N, 2] uint32  per-request raw PRNG keys
+    positions     [N] int32    absolute position of column 0's emission
+                               (the engine's ``sample_pos``)
+    temps/top_ks/top_ps [N]    per-request sampling params
+
+    Returns ``(tokens [N, 1+k] int32, accepted [N] int32)``:
+    ``tokens[:, :accepted]`` are the accepted draft tokens,
+    ``tokens[:, accepted]`` is the correction (residual draw) or, when
+    all offered drafts were accepted, the bonus token drawn from the
+    target's own column — so rows always emit ``accepted + 1`` tokens,
+    and a row with ``n_draft == 0`` emits exactly the plain-tick draw.
+
+    Exactness at the extremes (the pinned tests):
+      * twin draft (p_drf == p_tgt): ratio 1 -> always accept, and the
+        accepted token came from ``categorical(fold_in(key, pos), lp)``
+        over the identically-filtered law — the non-spec draw bitwise.
+      * disjoint support (p_drf(t)=0 on the target's support, top_k=1):
+        ``p_tgt(t)=0`` at any draft token -> always reject; the residual
+        equals p_tgt ELEMENTWISE (max(0, p-0) = p bitwise), so the
+        correction logits equal the plain logprobs bitwise and the
+        residual draw == the plain draw at that position.
+    """
+    n, kp1, v = target_logits.shape
+    k = kp1 - 1
+    n_draft = jnp.asarray(n_draft, jnp.int32)
+    positions = jnp.asarray(positions, jnp.int32)
+
+    # target law, filtered per row by the SAME params as the draft side
+    lg = target_logits.astype(jnp.float32) / \
+        jnp.maximum(jnp.asarray(temps, jnp.float32), 1e-6)[:, None, None]
+    lg = apply_top_k_top_p_per_row(
+        lg.reshape(n * kp1, v),
+        jnp.repeat(jnp.asarray(top_ks, jnp.int32), kp1),
+        jnp.repeat(jnp.asarray(top_ps, jnp.float32), kp1))
+    lp = jax.nn.log_softmax(lg, axis=-1).reshape(n, kp1, v)  # [N,1+k,V]
+    pt = jnp.exp(lp)                                         # [N,1+k,V]
+
+    # per-column keys: the draw at absolute position p folds p into the
+    # request key — identical to the plain tick's law, so column 0 of a
+    # plain row reproduces the non-spec draw bitwise
+    pos = positions[:, None] + jnp.arange(kp1, dtype=jnp.int32)[None, :]
+    ckeys = jax.vmap(jax.vmap(jax.random.fold_in, (None, 0)))(
+        keys, pos)                                           # [N,1+k,2]
+    direct = jax.vmap(jax.vmap(jax.random.categorical))(
+        ckeys, lp).astype(jnp.int32)                         # [N,1+k]
+
+    # acceptance test per draft column, on a SALTED uniform stream so
+    # the token-draw stream at the same position is left untouched
+    pt_d = jnp.take_along_axis(pt[:, :k], draft_toks[..., None],
+                               axis=-1)[..., 0]              # [N,k]
+    pd_d = jnp.take_along_axis(draft_probs, draft_toks[..., None],
+                               axis=-1)[..., 0]              # [N,k]
+    akeys = jax.vmap(jax.vmap(jax.random.fold_in, (0, None)), (0, None))(
+        ckeys[:, :k], jnp.uint32(SPEC_ACCEPT_SALT))          # [N,k,2]
+    u = jax.vmap(jax.vmap(lambda kk: jax.random.uniform(kk, ())))(
+        akeys)                                               # [N,k]
+    offered = jnp.arange(k, dtype=jnp.int32)[None, :] < n_draft[:, None]
+    accept = offered & (u < pt_d / jnp.maximum(pd_d, 1e-30))
+    acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+
+    # residual correction: log p_tgt + log(resid/p_tgt) keeps dead
+    # entries at NEG_INF and — when resid == p_tgt elementwise (the
+    # all-reject extreme) — reduces to log p_tgt + log(1.0) bitwise
+    resid = jnp.maximum(pt[:, :k] - draft_probs, 0.0)
+    rl = jnp.where(resid > 0.0,
+                   lp[:, :k] + jnp.log(resid /
+                                       jnp.maximum(pt[:, :k], 1e-38)),
+                   NEG_INF)
+    res_tok = jax.vmap(jax.vmap(jax.random.categorical))(
+        ckeys[:, :k], rl).astype(jnp.int32)                  # [N,k]
+
+    # column j emits: accepted draft (j < acc), residual correction at
+    # the first rejected offered column, or the direct draw (bonus
+    # column k, and every column of a plain n_draft==0 row)
+    corr = jnp.where(offered, res_tok, direct[:, :k])
+    out = jnp.where(jnp.arange(k, dtype=jnp.int32)[None, :] < acc[:, None],
+                    draft_toks, corr)
+    tokens = jnp.concatenate([out, direct[:, k:]], axis=1)
+    return tokens.astype(jnp.int32), acc.astype(jnp.int32)
 
 
 def sampling_decode(step_fn: Callable, cache: Any, first_logits, start_pos,

@@ -357,11 +357,12 @@ class ServingEngine:
                     "speculative decoding needs the unified mixed-row "
                     "tick; attention_kernel='legacy' has no verify row "
                     "path")
-            if cfg.decode != "greedy":
-                raise NotImplementedError(
-                    "speculative decoding is greedy-only: sampling "
-                    "needs the rejection-sampling acceptance rule "
-                    "(ROADMAP residue)")
+            if getattr(self._spec, "overlap", False) and \
+                    cfg.decode != "sampling":
+                raise ValueError(
+                    "spec.overlap chains the next draft tick on the "
+                    "sampled verify tick's device outputs; greedy spec "
+                    "has no chained draft build — use decode='sampling'")
             if self._spec.k < 1:
                 raise ValueError("spec.k must be >= 1")
         self._legacy = kernel == "legacy"
@@ -493,20 +494,42 @@ class ServingEngine:
             #: though depth is consulted at both the draft-feed and
             #: the ks-clamp points
             self._spec_tick_depth: Dict[int, int] = {}
+            #: sampled spec decoding (ISSUE 20): the verify tick runs
+            #: the rejection-sampling acceptance kernel instead of the
+            #: greedy longest-argmax-prefix rule
+            self._spec_sampling = cfg.decode == "sampling"
+            #: hide the host-side accept/absorb sync under the NEXT
+            #: draft tick: dispatch the chained draft build against the
+            #: verify tick's still-on-device outputs before
+            #: materializing them (sampling only)
+            self._spec_overlap = bool(getattr(self._spec, "overlap",
+                                              False))
+            #: pending chained draft state: dict with device drafts /
+            #: probs plus host validity mask, or None when no chained
+            #: tick is in flight
+            self._spec_pend: Optional[dict] = None
             self._draft = DraftRunner(
                 self._spec.draft_model, b_slots,
                 self.pool.slot_capacity, self._spec_k,
-                self.prefill_chunk)
+                self.prefill_chunk, self.pool,
+                sampling=self._spec_sampling)
             #: per-admission-cycle lifecycle-event latches
             self._spec_started = [False] * b_slots
             self._spec_verifying = [False] * b_slots
             self._zero_drafts = np.zeros(b_slots * self._spec_k,
                                          np.int32)
+            if self._spec_sampling:
+                # draft-probs placeholder for ticks where no slot was
+                # offered drafts (n_draft == 0 everywhere => unread)
+                self._zero_probs = np.zeros(
+                    (b_slots, self._spec_k, mcfg.vocab_size),
+                    np.float32)
             self._tick = jax.jit(
                 make_spec_tick(mcfg, b_slots, self._spec_k,
                                self.prefill_chunk, self._impl,
                                self._tick_site,
-                               quantized=self._quantized),
+                               quantized=self._quantized,
+                               sampling=self._spec_sampling),
                 donate_argnums=(2, 3, 4, 5) if self._quantized
                 else (2, 3))
         else:
@@ -522,7 +545,9 @@ class ServingEngine:
             # per slot), speculation growth, and the selected chunks'
             # pages — so the eager-reset overflow path never triggers
             # in normal operation (it stays correct if it does).
-            spec_extra = (self._spec_k // ps + 2) \
+            # +1 covers draft-page rewind churn: freed draft pages
+            # re-enter the fresh list via the allocator's on_zero hook
+            spec_extra = (self._spec_k // ps + 3) \
                 if self._spec is not None else 0
             self._fresh_cap = (
                 b_slots * (1 + spec_extra)
@@ -1218,6 +1243,10 @@ class ServingEngine:
         if self._spec is None:
             return
         self._draft.reset_slot(slot)
+        if self._spec_pend is not None:
+            # a chained draft tick built on this tenant's frontier is
+            # meaningless for the next one
+            self._spec_pend["valid"][slot] = False
         if self._spec_ctl is not None:
             self._spec_ctl.reset(slot)
         self._spec_started[slot] = False
@@ -1477,10 +1506,19 @@ class ServingEngine:
         cover a request ``submit()`` already validated against it."""
         if need <= 0 or self.pool.grow_slot(s, need):
             return True
+        # draft pages are strictly lower-value than target pages:
+        # reclaim them (decayed slots first, then everyone) before
+        # draining finishes or preempting a tenant (ISSUE 20)
+        if self._reclaim_draft(all_slots=False) and \
+                self.pool.grow_slot(s, need):
+            return True
         self._drain(0)
         if self._slot_rid[s] is None:
             return False
         if self.pool.grow_slot(s, need):
+            return True
+        if self._reclaim_draft(all_slots=True) and \
+                self.pool.grow_slot(s, need):
             return True
         if not any(x != s and self._slot_rid[x] is not None
                    for x in range(self.config.num_slots)):
@@ -1490,6 +1528,31 @@ class ServingEngine:
                 "co-resident to preempt")
         self._preempt_for(s, need)
         return self._slot_rid[s] is not None
+
+    def _reclaim_draft(self, all_slots: bool) -> int:
+        """Return draft-KV pages to the pool under target-page
+        pressure. ``all_slots=False`` releases only slots whose
+        adaptive depth has decayed to 0 (they are not speculating
+        anyway — this is the 'adaptive-k decay returns draft pages'
+        arm); ``all_slots=True`` releases every draft cache (the slots
+        fall back to plain decode and re-feed if pressure eases).
+        Never touches target pages. Returns pages freed."""
+        if self._spec is None:
+            return 0
+        freed = 0
+        for s in range(self.config.num_slots):
+            if self._draft.aux.slot_pages(s) == 0:
+                continue
+            decayed = (self._spec_ctl is not None
+                       and self._spec_ctl.depth(s) == 0)
+            if all_slots or decayed:
+                freed += self._draft.release_pages(s)
+                if self._spec_pend is not None:
+                    self._spec_pend["valid"][s] = False
+        if freed:
+            _registry().counter(
+                "serving/spec_draft_pages_reclaimed").add(freed)
+        return freed
 
     # ------------------------------------------------------------------
     # decode scheduling
@@ -1778,14 +1841,22 @@ class ServingEngine:
     # ------------------------------------------------------------------
     def _dispatch_spec(self, chunks: List[_Chunk]) -> bool:
         """One spec scheduler step: (1) draft tick — parallel
-        catch-up feed for behind slots + k greedy draft steps for
-        caught-up decoding slots; (2) per-slot speculation depth
-        ``k_s`` (clamped by remaining budget and page headroom —
-        best-effort growth only, never preempting a co-resident to
-        speculate deeper); (3) the verify/mixed tick; (4) synchronous
-        absorb — append the accepted prefix + correction token, rewind
-        the frontier past the rejected tail and return its pages
-        (``PagePool.shrink_slot``)."""
+        catch-up feed for behind slots + k draft steps for caught-up
+        decoding slots (greedy argmax, or the slot's own sampling law
+        under ``decode='sampling'``); slots with a valid CHAINED draft
+        (overlap mode) skip this tick — their drafts were built by the
+        previous step's chained dispatch; (2) per-slot speculation
+        depth ``k_s`` (clamped by remaining budget, target page
+        headroom AND draft page headroom — best-effort growth only,
+        never preempting a co-resident to speculate deeper); (3) the
+        verify/mixed tick (greedy longest-argmax-prefix acceptance, or
+        the rejection-sampling kernel); (4) in overlap mode, dispatch
+        the NEXT draft tick chained on the verify tick's still-on-
+        device outputs — the host sync below then hides under its
+        execution; (5) absorb — append the accepted prefix +
+        correction token, rewind both frontiers past the rejected tail
+        and return their pages, reconcile the chained tick's validity
+        against what actually absorbed."""
         chunks = [c for c in chunks if self._slot_rid[c[0]] == c[1]]
         ticking = self._ticking_slots()
         if not ticking and not chunks:
@@ -1800,15 +1871,18 @@ class ServingEngine:
         reg = _registry()
         ticking_set = set(ticking)
         self._spec_tick_depth.clear()   # fresh probe decisions per tick
+        sampling = self._spec_sampling
+        pend = self._spec_pend
 
-        # ---- draft tick: feed + generate ----
+        # ---- draft tick: feed + generate (catch-up dispatch) ----
         feed_toks = np.zeros((ns, w), np.int32)
         feed_pos0 = np.zeros(ns, np.int32)
         feed_len = np.zeros(ns, np.int32)
         gen_tok = np.zeros(ns, np.int32)
-        gen_pos = np.full(ns, cap, np.int32)   # cap = the trash column
+        gen_pos = np.full(ns, cap, np.int32)   # cap = null-routed
         last_tok = np.zeros(ns, np.int32)
         gen_slots: List[int] = []
+        chained: List[int] = []   # slots riding the pending chained tick
         any_feed = False
         for s, rid in enumerate(self._slot_rid):
             if rid is None:
@@ -1816,6 +1890,7 @@ class ServingEngine:
             req = self._requests[rid]
             if s in ticking_set:
                 last_tok[s] = req.out[-1]
+            pend_ok = pend is not None and bool(pend["valid"][s])
             if self._spec_ctl is not None:
                 # one probe-state advance per slot per tick (ISSUE 16
                 # re-probe); the ks clamp below reuses the cached value
@@ -1832,7 +1907,18 @@ class ServingEngine:
                     # structure). Reset on the next admission cycle —
                     # or a scheduled re-probe (SpecConfig.
                     # reprobe_every) — re-enables it.
+                    if pend_ok:
+                        pend["valid"][s] = False
                     continue
+            if pend_ok:
+                if s in ticking_set and \
+                        req.max_new - len(req.out) >= 2:
+                    # the chained draft tick already seeded past this
+                    # frontier and drafted k tokens — no feed, no
+                    # re-generate (the overlap payoff)
+                    chained.append(s)
+                    continue
+                pend["valid"][s] = False
             behind = int(self._slot_len[s]) - int(dr.len[s])
             fed = 0
             if behind > 0:
@@ -1841,39 +1927,83 @@ class ServingEngine:
                 # draft never saw) and emitted tokens ride the same
                 # chunk-shaped feed
                 fed = min(behind, w)
-                seq = np.concatenate(
-                    [req.prompt, np.asarray(req.out, np.int32)])
                 lo = int(dr.len[s])
-                feed_toks[s, :fed] = seq[lo:lo + fed]
-                feed_pos0[s] = lo
-                feed_len[s] = fed
-                any_feed = True
-                if not self._spec_started[s]:
-                    self._spec_started[s] = True
-                    self._emit("draft", rid, slot=s, pos=lo)
+                if not dr.grow_for(s, lo + fed):
+                    # draft pages are best-effort: feed only as far as
+                    # the pages already held reach
+                    fed = max(0, min(fed, dr.held_tokens(s) - lo))
+                if fed:
+                    seq = np.concatenate(
+                        [req.prompt, np.asarray(req.out, np.int32)])
+                    feed_toks[s, :fed] = seq[lo:lo + fed]
+                    feed_pos0[s] = lo
+                    feed_len[s] = fed
+                    any_feed = True
+                    if not self._spec_started[s]:
+                        self._spec_started[s] = True
+                        self._emit("draft", rid, slot=s, pos=lo)
             if s in ticking_set and behind - fed == 0 and \
-                    req.max_new - len(req.out) >= 2:
+                    req.max_new - len(req.out) >= 2 and \
+                    dr.grow_for(s, min(int(self._slot_len[s]) + k,
+                                       cap)):
                 gen_tok[s] = req.out[-1]
                 gen_pos[s] = int(self._slot_len[s])
                 gen_slots.append(s)
         draft_flat = self._zero_drafts
+        dprobs_m = self._zero_probs if sampling else None
+        drafts = dprobs = None
         if any_feed or gen_slots:
-            dargs = (dr.stacked, dr.other, dr.kc, dr.vc, feed_toks,
-                     feed_pos0, feed_len, gen_tok, gen_pos,
-                     np.bool_(any_feed), np.bool_(len(gen_slots) > 0))
-            self._note_avals(dr.site, dr.tick, dargs)
-            with _quiet_donation():
-                dr.kc, dr.vc, drafts = dr.tick(*dargs)
+            dtab = np.ascontiguousarray(dr.aux.tables)
+            if sampling:
+                zc = np.zeros((ns, 1 + k), np.int32)
+                zi = np.zeros(ns, np.int32)
+                dargs = (dr.stacked, dr.other, dr.kc, dr.vc, dtab,
+                         feed_toks, feed_pos0, feed_len, gen_tok,
+                         gen_pos,
+                         np.ascontiguousarray(self._keys),
+                         np.ascontiguousarray(self._temps),
+                         np.ascontiguousarray(self._topks),
+                         np.ascontiguousarray(self._topps),
+                         zc, zi, zi, np.zeros(ns, bool),
+                         np.bool_(any_feed),
+                         np.bool_(len(gen_slots) > 0))
+                self._note_avals(dr.site, dr.tick, dargs)
+                with _quiet_donation():
+                    dr.kc, dr.vc, drafts, dprobs = dr.tick(*dargs)
+                dprobs_m = dprobs
+            else:
+                dargs = (dr.stacked, dr.other, dr.kc, dr.vc, dtab,
+                         feed_toks, feed_pos0, feed_len, gen_tok,
+                         gen_pos, np.bool_(any_feed),
+                         np.bool_(len(gen_slots) > 0))
+                self._note_avals(dr.site, dr.tick, dargs)
+                with _quiet_donation():
+                    dr.kc, dr.vc, drafts = dr.tick(*dargs)
             draft_flat = drafts.reshape(-1)
             dr.len += feed_len
             reg.counter("serving/spec_draft_ticks").add(1)
             if any_feed:
                 reg.counter("serving/spec_feed_tokens").add(
                     int(feed_len.sum()))
+        if chained:
+            # splice the pending chained drafts (device arrays from the
+            # previous step's overlapped dispatch) over this tick's
+            cm = np.zeros(ns, bool)
+            cm[chained] = True
+            cmj = jnp.asarray(cm)
+            base_d = drafts if drafts is not None \
+                else jnp.zeros((ns, k), jnp.int32)
+            base_p = dprobs if dprobs is not None else self._zero_probs
+            drafts = jnp.where(cmj[:, None], pend["drafts"], base_d)
+            dprobs_m = jnp.where(cmj[:, None, None], pend["probs"],
+                                 base_p)
+            draft_flat = drafts.reshape(-1)
+            reg.counter("serving/spec_chained_consumed").add(
+                len(chained))
 
         # ---- per-slot speculation depth (host-deterministic) ----
         k_arr = np.zeros(ns, np.int32)
-        for s in gen_slots:
+        for s in gen_slots + chained:
             rid = self._slot_rid[s]
             req = self._requests[rid]
             pos0 = int(self._slot_len[s])
@@ -1925,6 +2055,10 @@ class ServingEngine:
         sample[:, 0] = np.arange(ns)
         sample[:, 1:] = ns + np.arange(ns)[:, None] * k \
             + np.arange(k)[None, :]
+        # per-row emission positions for the sampling law: a ticking
+        # slot's primary token folds at slot_len + 1 (same as the
+        # unified tick); a prefill finisher's at t0 (set below)
+        sample_pos = (self._slot_len + 1).astype(np.int32)
         finishers = []
         for c, (s, rid, start, end, t0) in enumerate(chunks):
             coff = base + c * w
@@ -1940,13 +2074,72 @@ class ServingEngine:
             if end >= t0:
                 finishers.append((s, rid))
                 sample[s, 0] = coff + (t0 - 1 - start)
-        tail = (last_tok, draft_flat, pf_toks, tok_pos, tok_limit,
-                row_tab, row_pos0, row_len, sample.reshape(-1), k_arr,
-                np.bool_(len(chunks) > 0), np.bool_(has_drafts))
+                sample_pos[s] = t0
+        if sampling:
+            tail = (last_tok, draft_flat, pf_toks, tok_pos, tok_limit,
+                    row_tab, row_pos0, row_len, sample.reshape(-1),
+                    k_arr,
+                    np.ascontiguousarray(self._keys), sample_pos,
+                    np.ascontiguousarray(self._temps),
+                    np.ascontiguousarray(self._topks),
+                    np.ascontiguousarray(self._topps), dprobs_m,
+                    np.bool_(len(chunks) > 0), np.bool_(has_drafts))
+        else:
+            tail = (last_tok, draft_flat, pf_toks, tok_pos, tok_limit,
+                    row_tab, row_pos0, row_len, sample.reshape(-1),
+                    k_arr,
+                    np.bool_(len(chunks) > 0), np.bool_(has_drafts))
         args = (self._stacked, self._other) + self._pool_args() + tail
         self._note_avals(self._tick_site, self._tick, args)
         with _quiet_donation():
             tok_m, acc = self._store_pools(self._tick(*args))
+
+        # ---- overlap: chain draft tick N+1 on the un-materialized
+        # verify outputs, BEFORE the host sync below — the sync then
+        # hides under this dispatch's execution (ISSUE 20 tentpole) ----
+        pend_new = None
+        if sampling and self._spec_overlap and has_drafts:
+            cm2 = np.zeros(ns, bool)
+            ch_pos0 = np.zeros(ns, np.int32)
+            for s in np.nonzero(k_arr)[0]:
+                s = int(s)
+                req = self._requests[self._slot_rid[s]]
+                pos0 = int(self._slot_len[s])
+                ks = int(k_arr[s])
+                # the chained scan writes draft positions up to
+                # pos0 + acc + k <= pos0 + ks + k; chain only when the
+                # draft pages cover the worst case (best-effort — a
+                # refusal just means a catch-up tick next step)
+                if req.max_new - len(req.out) < 2 or \
+                        not dr.grow_for(s, min(pos0 + ks + k + 1,
+                                               cap)):
+                    continue
+                cm2[s] = True
+                ch_pos0[s] = pos0
+            if cm2.any():
+                dtab2 = np.ascontiguousarray(dr.aux.tables)
+                zi2 = np.zeros(ns, np.int32)
+                dargs2 = (dr.stacked, dr.other, dr.kc, dr.vc, dtab2,
+                          np.zeros((ns, w), np.int32), zi2, zi2, zi2,
+                          np.full(ns, cap, np.int32),
+                          np.ascontiguousarray(self._keys),
+                          np.ascontiguousarray(self._temps),
+                          np.ascontiguousarray(self._topks),
+                          np.ascontiguousarray(self._topps),
+                          tok_m, acc, ch_pos0, cm2,
+                          np.bool_(False), np.bool_(True))
+                self._note_avals(dr.site, dr.tick, dargs2)
+                with _quiet_donation():
+                    dr.kc, dr.vc, ch_drafts, ch_probs = \
+                        dr.tick(*dargs2)
+                pend_new = {"drafts": ch_drafts, "probs": ch_probs,
+                            "valid": cm2, "pos0": ch_pos0}
+                reg.counter("serving/spec_draft_ticks").add(1)
+                reg.counter("serving/spec_chained_ticks").add(1)
+        # the previous pend was consumed (or invalidated) above; the
+        # new one must be installed before the absorb loop so _finish/
+        # _spec_reset/_reclaim_draft invalidate the RIGHT entries
+        self._spec_pend = pend_new
 
         # ---- chunk bookkeeping (same as the unified tick) ----
         for s, rid, start, end, t0 in chunks:
@@ -2002,10 +2195,31 @@ class ServingEngine:
                         self._spec_ctl.observe(s, gained, ks)
                     self._emit("accept", rid, slot=s, accepted=gained,
                                drafted=ks)
-                if s in gen_slots:
-                    # the draft's own speculation wrote the accepted
-                    # tokens' KV — its frontier follows without repair
-                    dr.len[s] = pos0 + min(emitted, k)
+                if s in gen_slots or s in chained:
+                    # reconcile the chained tick against what actually
+                    # absorbed: the chain's on-device seed assumed the
+                    # full accepted prefix + correction was emitted and
+                    # the slot kept ticking — anything else (EOS inside
+                    # the window, max_new stop) invalidates it and the
+                    # slot falls back to a catch-up tick
+                    chain_ok = (pend_new is not None
+                                and bool(pend_new["valid"][s])
+                                and finished is None
+                                and emitted == a + 1
+                                and len(req.out) < req.max_new)
+                    if chain_ok:
+                        # the chained tick wrote the seed at the new
+                        # frontier (and healed the full-accept hole):
+                        # the draft cache is already caught up
+                        dr.len[s] = pos0 + emitted
+                    else:
+                        if pend_new is not None:
+                            pend_new["valid"][s] = False
+                        # the draft's own speculation wrote the
+                        # accepted tokens' KV — its frontier follows
+                        # without repair; pages past it go back to the
+                        # pool (the draft-side rewind)
+                        dr.rewind(s, pos0 + min(emitted, k))
                 if finished is None and ks:
                     # rewind: return pages past the new frontier (+1
                     # page headroom for the next tick's write) — the
@@ -2037,6 +2251,17 @@ class ServingEngine:
             reg.gauge("serving/spec_accept_rate").set(
                 reg.counter("serving/spec_accepted_tokens").value
                 / drafted)
+        # the draft cache's footprint in the SHARED pool (ISSUE 20):
+        # pages held by draft tables / pages allocated overall — the
+        # residency ledger prices draft and target bytes together
+        dp = dr.aux.total_pages()
+        reg.gauge("serving/draft_pool_pages").set(float(dp))
+        share = dp / max(self.pool.allocator.num_allocated, 1)
+        reg.gauge("serving/draft_pool_share").set(share)
+        # peak survives the end-of-run release (slots return their
+        # draft pages on finish, so the plain gauge reads 0 by the
+        # time a bench harness snapshots the registry)
+        reg.gauge("serving/draft_pool_share_peak").set_max(share)
         return True
 
     # ------------------------------------------------------------------

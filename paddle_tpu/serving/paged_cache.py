@@ -489,6 +489,15 @@ class PagePool:
         self.tables = np.zeros((num_slots, pages_per_slot), np.int32)
         # pages held per slot, in position order (prefix of the table row)
         self._held: List[List[int]] = [[] for _ in range(num_slots)]
+        # auxiliary page tables (the spec-decode draft KV) drawing from
+        # the SAME allocator: registered so check_consistency can
+        # account for their holds (ISSUE 20)
+        self._aux: List["AuxPageTable"] = []
+
+    def register_aux(self, aux: "AuxPageTable") -> None:
+        """Register an auxiliary table whose pages come from this
+        pool's allocator — its holds join the consistency audit."""
+        self._aux.append(aux)
 
     @property
     def slot_capacity(self) -> int:
@@ -663,6 +672,20 @@ class PagePool:
         if self.prefix is not None:
             for pg in self.prefix.pages():
                 holds[pg] = holds.get(pg, 0) + 1
+        for ax, aux in enumerate(self._aux):
+            for slot, held in enumerate(aux._held):
+                row = aux.tables[slot]
+                for i, pg in enumerate(held):
+                    holds[pg] = holds.get(pg, 0) + 1
+                    if int(row[i]) != pg:
+                        out.append(f"aux {ax} slot {slot} table[{i}]="
+                                   f"{int(row[i])} != held page {pg}")
+                for i in range(len(held), aux.pages_per_slot):
+                    if int(row[i]) != NULL_PAGE:
+                        out.append(f"aux {ax} slot {slot} table[{i}]="
+                                   f"{int(row[i])} past the held prefix")
+                if NULL_PAGE in held:
+                    out.append(f"aux {ax} slot {slot} holds the null page")
         alloc = self.allocator
         for pg, want in holds.items():
             have = alloc.refcount(pg)
@@ -684,3 +707,99 @@ class PagePool:
         if set(alloc._free) != alloc._free_set:
             out.append("free list and free set disagree")
         return out
+
+
+class AuxPageTable:
+    """Per-slot page tables for an auxiliary KV cache (the spec-decode
+    DRAFT model, ISSUE 20) drawing pages from the SAME allocator as the
+    target pool — one id space, one refcount economy, one residency
+    ledger, so draft and target bytes genuinely compete and the
+    engine's page-pressure ladder can reclaim draft pages before
+    resorting to preemption.
+
+    Differences from the primary tables:
+      * allocations are NOT fresh-listed — the draft cache is a
+        separate f32 device array indexed by these tables, so the
+        target pool's int8 scale rows for a draft-held page are never
+        read; the allocator's ``on_zero`` hook still fresh-lists the
+        page when its last reference drops, which is exactly when the
+        TARGET pool could next gather it.
+      * no sharing/COW/prefix legs: draft pages are private to their
+        slot (refcount stays 1), and the rewind path is plain
+        ``shrink_slot``.
+    """
+
+    def __init__(self, pool: PagePool, num_slots: int,
+                 pages_per_slot: Optional[int] = None):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.num_slots = int(num_slots)
+        self.pages_per_slot = int(pages_per_slot
+                                  if pages_per_slot is not None
+                                  else pool.pages_per_slot)
+        self.tables = np.zeros((num_slots, self.pages_per_slot), np.int32)
+        self._held: List[List[int]] = [[] for _ in range(num_slots)]
+        pool.register_aux(self)
+
+    def slot_pages(self, slot: int) -> int:
+        return len(self._held[slot])
+
+    def total_pages(self) -> int:
+        """Pages currently held across all slots — the draft-pool-share
+        numerator in the serving gauges and bench cells."""
+        return sum(len(h) for h in self._held)
+
+    def grow_slot(self, slot: int, n_pages: int) -> bool:
+        """Extend ``slot`` by ``n_pages`` pages from the shared
+        allocator (evicting unreferenced prefix-cache pages if that is
+        what it takes — same economy as the primary tables). False and
+        untouched when the pool can't cover it: draft growth is
+        BEST-EFFORT by design; the engine skips speculation rather
+        than escalate for draft bytes."""
+        if n_pages <= 0:
+            return True
+        held = self._held[slot]
+        if len(held) + n_pages > self.pages_per_slot:
+            raise ValueError(
+                f"aux slot {slot} would exceed pages_per_slot="
+                f"{self.pages_per_slot}")
+        alloc = self.pool.allocator
+        got = alloc.alloc(n_pages)
+        if got is None and self.pool.prefix is not None:
+            self.pool.prefix.evict_for(n_pages - alloc.num_free)
+            got = alloc.alloc(n_pages)
+        if got is None:
+            return False
+        self.tables[slot, len(held):len(held) + n_pages] = got
+        held.extend(got)
+        return True
+
+    def grow_to(self, slot: int, n_tokens: int) -> bool:
+        """Ensure ``slot`` holds enough pages for ``n_tokens`` draft
+        positions (no-op when it already does)."""
+        return self.grow_slot(
+            slot, self.pool.pages_for(n_tokens) - len(self._held[slot]))
+
+    def shrink_slot(self, slot: int, keep_pages: int) -> int:
+        """Release pages beyond the first ``keep_pages`` (the
+        rejection-rewind / pressure-decay path). Returns pages freed."""
+        if keep_pages < 0:
+            raise ValueError("keep_pages must be >= 0")
+        held = self._held[slot]
+        drop = held[keep_pages:]
+        if not drop:
+            return 0
+        self.pool.allocator.free(drop)
+        del held[keep_pages:]
+        self.tables[slot, keep_pages:] = NULL_PAGE
+        return len(drop)
+
+    def release_slot(self, slot: int) -> int:
+        """Return all of ``slot``'s draft pages to the pool; idempotent."""
+        held = self._held[slot]
+        n = len(held)
+        if n:
+            self.pool.allocator.free(held)
+        self._held[slot] = []
+        self.tables[slot, :] = NULL_PAGE
+        return n

@@ -342,6 +342,17 @@ class SpecKController:
     probe state, so the engine calls it exactly once per slot per
     tick. Admission/preemption/finish still :meth:`reset` the slot.
 
+    **Backoff** (ISSUE 20 satellite, closing the "probe period is
+    static, not learned" residue): each consecutive REJECTED probe
+    doubles the slot's re-probe period, capped at ``8 *
+    reprobe_every`` — a slot that keeps confirming its demotion gets
+    probed geometrically less often, so the steady-state probe tax on
+    a genuinely unpredictable request decays toward one drafted token
+    per ``8 * reprobe_every`` ticks instead of staying flat. An
+    ACCEPTED probe (or any observation with ``accepted > 0``) resets
+    the period to the base — recovery is detected at full cadence
+    again. :meth:`probe_period` exposes the current per-slot period.
+
     Depth changes never touch the compiled verify tick: ``k_s`` rides
     the existing per-slot ``row_len``/``tok_limit`` metadata, exactly
     like the budget/headroom clamps the engine already applies."""
@@ -358,11 +369,14 @@ class SpecKController:
         self._ewma = np.ones(int(num_slots), np.float64)
         self._zero_ticks = np.zeros(int(num_slots), np.int64)
         self._probing = np.zeros(int(num_slots), bool)
+        self._period = np.full(int(num_slots), int(reprobe_every),
+                               np.int64)
 
     def reset(self, slot: int) -> None:
         self._ewma[slot] = 1.0
         self._zero_ticks[slot] = 0
         self._probing[slot] = False
+        self._period[slot] = self.reprobe_every
 
     def depth(self, slot: int) -> int:
         """Pure depth read (no probe side effects) — callers inside a
@@ -382,7 +396,7 @@ class SpecKController:
         if self._probing[slot]:
             return 1                # probe still awaiting evidence
         self._zero_ticks[slot] += 1
-        if self._zero_ticks[slot] >= self.reprobe_every:
+        if self._zero_ticks[slot] >= self._period[slot]:
             self._zero_ticks[slot] = 0
             self._probing[slot] = True
             return 1
@@ -391,9 +405,25 @@ class SpecKController:
     def observe(self, slot: int, accepted: int, drafted: int) -> None:
         if drafted <= 0:
             return
+        if self._probing[slot]:
+            # multiplicative backoff on a rejected probe; base cadence
+            # restored the moment any draft token lands
+            if accepted > 0:
+                self._period[slot] = self.reprobe_every
+            else:
+                self._period[slot] = min(self._period[slot] * 2,
+                                         self.reprobe_every * 8)
+        elif accepted > 0:
+            self._period[slot] = self.reprobe_every
         self._probing[slot] = False     # the probe's evidence landed
         rate = min(max(accepted / drafted, 0.0), 1.0)
         self._ewma[slot] += self.alpha * (rate - self._ewma[slot])
+
+    def probe_period(self, slot: int) -> int:
+        """Current re-probe period for ``slot`` (base
+        ``reprobe_every``, doubled per consecutive rejected probe,
+        capped at 8x)."""
+        return int(self._period[slot])
 
     def ewma(self, slot: int) -> float:
         return float(self._ewma[slot])

@@ -7,70 +7,80 @@ slot, then ONE target **verify tick** scores every slot's
 ``k + 1``-token row through the existing mixed-row ragged program
 (``models/gpt.py::gpt_ragged_apply`` with ``spec_k`` — a verify row is
 exactly a prefill-chunk-shaped row whose logits are kept at every
-position, not just the last). Greedy acceptance takes the longest
-prefix where draft == target argmax, plus one correction token; the
-emitted stream is therefore always the TARGET's own argmax stream, so
-greedy speculative output is **bitwise identical** to non-speculative
-greedy paged decode (which is itself bitwise vs dense ``generate()``)
-— the classic invariant, and this engine's signature parity-contract
-style (tests/test_spec_decode.py pins it across admission orders,
-prefix-cache hits, COW divergence, preemption/requeue mid-speculation,
-and exact-capacity finishes).
+position, not just the last).
+
+**Greedy acceptance** takes the longest prefix where draft == target
+argmax, plus one correction token; the emitted stream is therefore
+always the TARGET's own argmax stream, so greedy speculative output is
+**bitwise identical** to non-speculative greedy paged decode (which is
+itself bitwise vs dense ``generate()``) — the classic invariant, and
+this engine's signature parity-contract style (tests/test_spec_decode.py
+pins it across admission orders, prefix-cache hits, COW divergence,
+preemption/requeue mid-speculation, and exact-capacity finishes).
+
+**Sampled acceptance** (ISSUE 20) is the rejection rule: accept draft
+token ``t`` with probability ``min(1, p_tgt(t)/p_drf(t))``; on the
+first rejection resample from the normalized residual
+``max(0, p_tgt - p_drf)`` (``ops/decoding.spec_rejection_sample``).
+Both distributions are filtered by the SAME per-request
+temperature/top-k/top-p before the ratio — the draft tick filters its
+own logits per row, the kernel filters the target's — so the marginal
+law at every position is EXACTLY the non-speculative sampling law
+(``engine._sample_tok``): ``categorical(fold_in(key, pos), lp)``. The
+sampled analogue of greedy's bitwise pin is fixed-key stream equality
+at both accept-rate extremes (twin draft → always accept → the
+accepted token IS the non-spec draw; disjoint-support draft → always
+reject → the residual equals ``p_tgt`` elementwise and the correction
+IS the non-spec draw).
 
 Two compiled dispatch sites, each tracing exactly once
 (``ServingEngine.compiled_sites`` == {draft tick, verify/mixed tick}):
 
-- **Draft tick** (``make_draft_tick``): the draft model keeps a DENSE
-  per-slot KV cache ``[L_d, num_slots, capacity + 1, NH_d, D_d]``
-  (builder's call per the issue — dense is the simple footprint;
-  position ``capacity`` is the trash column, the dense analogue of the
-  page pool's null page: pad/overflow writes land there, never in live
-  state). One fixed-shape program does BOTH draft duties per scheduler
-  step: a ``feed`` stage catches slots' draft caches up to the
-  target's accepted frontier (prompt tokens after admission or a
-  prefix-cache hit — the draft has no prefix cache — and the one
-  token the draft never saw after a full-acceptance round), then a
-  ``generate`` stage scans ``k`` greedy draft steps. Each stage sits
-  behind its own ``lax.cond`` — steady-state ticks (nothing to feed)
-  pay only the k-step scan, and feed-only ticks (chunked prefill in
-  flight) skip the generate scan — the engine's decode-only
-  fast-path idiom on both axes.
+- **Draft tick** (``make_draft_tick``): the draft KV lives on the SAME
+  ``PagePool`` allocator as the target (ISSUE 20 — the dense
+  ``[L_d, ns, cap+1]`` buffer is gone): per-slot draft page tables
+  (``paged_cache.AuxPageTable``) index draft-dtype pools
+  ``[L_d, num_pages, page_size, NH_d, D_d]``, so draft and target
+  bytes compete in one refcounted economy and the engine's pressure
+  ladder can reclaim draft pages before preempting anyone. Pad and
+  overflow writes route to page 0 (the null page — the paged analogue
+  of the old dense trash column). One fixed-shape program does BOTH
+  draft duties per scheduler step: a ``feed`` stage catches slots up
+  to the target's accepted frontier, then a ``generate`` stage scans
+  the draft steps; each stage sits behind its own ``lax.cond``.
+  The sampling build additionally samples each draft token under the
+  slot's own params/key (returning the filtered draft distributions
+  the rejection kernel needs) and accepts a **chained frontier**: the
+  previous verify tick's raw device outputs (``tok_m``, ``acc``) plus
+  ``chain_mask``, from which it computes the post-absorb seed
+  ``tok_m[s, acc]`` at position ``pos0 + acc + 1`` ON DEVICE — the
+  engine dispatches this chained tick BEFORE materializing the verify
+  result, hiding the per-tick host sync under the next draft tick's
+  execution (the deferred-sync window spec mode used to forfeit). Its
+  generate scan runs ``k + 1`` steps: step 0 re-writes the token at
+  ``seed_pos - 1`` (heals the full-acceptance case, where draft ``k``
+  was emitted but never written; for every other case it is an
+  identical rewrite of an already-valid position, routed to the null
+  page when not chained).
 - **Verify tick** (``make_spec_tick``): the unified mixed-row tick
-  widened with a draft-token section. Flat token layout
+  widened with a draft section. Flat token layout
   ``[ns last_tok | ns*k drafts | chunks]``; slot rows group as
   ``[ns, 1+k]`` ragged rows (a non-speculating slot rides with
-  ``row_len == 1`` — its draft positions are pad queries whose writes
-  route to the null page). Four ``lax.cond`` branches in ONE
-  executable extend the decode-only fast path: with speculation idle
-  (no drafts) and/or no chunks aboard, the tick pays exactly the
-  non-speculative program's capacity — verify rows cost nothing while
-  nobody speculates. Greedy argmax and acceptance
-  (``ops/decoding.spec_accept_length``) run on device; the host
-  materializes ``(tokens [ns, 1+k], accepted [ns])`` once per tick.
+  ``row_len == 1``). Four ``lax.cond`` branches in ONE executable
+  extend the decode-only fast path. The greedy build is unchanged;
+  the sampling build threads per-request keys/params and the draft
+  distributions, runs the rejection kernel in the spec branches and
+  the plain per-row sampling law in the no-draft branches.
 
 **Rewind** is what the PR-5 refcount/COW machinery makes safe: the
-rejected tail's KV writes land in pages only this slot holds (prefix
-pages are published strictly BEHIND the accepted frontier), so the
-engine just truncates ``pos`` and returns pages past the new length
-(``PagePool.shrink_slot``); the draft cache needs no repair either —
-its own speculation wrote the accepted tokens' KV, and the correction
-token arrives as the next round's ``gen_tok``. Preemption resets the
-slot's draft frontier to 0; the requeued prompt (with generated
-prefix) re-feeds chunk-by-chunk, so the draft state survives
-preemption/requeue by reconstruction, not by copy.
-
-**Why host sync per verify tick**: acceptance decides the next tick's
-positions and page growth, which are host scheduling state — the
-deferred-sync window of the plain engine cannot stay open across an
-acceptance decision. Spec mode trades the PR-3 overlap for a k-token
-amortization per dispatch; ``serving/spec_accept_rate`` and
-``serve_bench --spec-decode`` measure whether the trade pays.
-
-Residue (ROADMAP): greedy only — sampling needs the rejection-sampling
-acceptance rule; the draft cache is dense, not paged. (The "k is
-static per engine" line is retired: ``SpecConfig.adaptive`` drives a
-per-slot depth from an accept-rate EWMA — ISSUE 15,
-serving/sched.py::SpecKController.)
+rejected tail's KV writes land in pages only this slot holds, so the
+engine truncates ``pos`` and returns pages past the new length
+(``shrink_slot`` on both the target tables and the draft's
+``AuxPageTable``); the draft cache needs no repair — its own
+speculation wrote the accepted tokens' KV, and the correction token
+arrives as the next round's ``gen_tok`` (or the chained seed).
+Preemption resets the slot's draft frontier to 0 and returns its draft
+pages; the requeued prompt re-feeds chunk-by-chunk.
 """
 from __future__ import annotations
 
@@ -83,6 +93,7 @@ import jax
 import jax.numpy as jnp
 
 from ..profiler import recompile as _recompile
+from .paged_cache import AuxPageTable
 
 __all__ = ["SpecConfig", "DraftRunner", "make_draft_tick",
            "make_spec_tick"]
@@ -101,56 +112,91 @@ class SpecConfig:
     headroom (down to 0 = a plain decode row).
     ``adaptive`` (ISSUE 15; serving/sched.py::SpecKController): drive
     each slot's depth from an accept-rate EWMA (alpha ``ewma_alpha``)
-    instead of always offering the full ``k`` — high-accept slots run
-    full depth, low-accept slots decay toward 0 (a plain decode row),
-    all inside the compiled ``[0, k]`` range the verify tick already
-    supports via ``row_len``, so neither compiled site changes.
-    ``reprobe_every`` (ISSUE 16 satellite): a slot stuck at depth 0
-    re-probes at depth 1 every this-many draft ticks, so a recovered
-    accept rate regains speculation (0 disables — the PR 15 sticky
-    behavior)."""
+    instead of always offering the full ``k``.
+    ``reprobe_every`` (ISSUE 16 satellite; ISSUE 20 makes it the BASE
+    period): a slot stuck at depth 0 re-probes at depth 1, starting
+    every this-many draft ticks and backing off multiplicatively on
+    consecutive rejected probes (reset on an accepted one). 0 disables.
+    ``overlap`` (ISSUE 20, sampling only): dispatch draft tick N+1
+    against the pre-absorb frontier (chained on the verify tick's
+    device outputs) BEFORE the host materializes the verify result —
+    the per-tick sync hides under the next draft tick. Host-side
+    reconcile falls back to a re-generate only when the slot's absorb
+    diverged from the chain (EOS/finish/preemption)."""
 
     draft_model: object
     k: int = 4
     adaptive: bool = False
     ewma_alpha: float = 0.5
     reprobe_every: int = 64
+    overlap: bool = False
 
 
 class DraftRunner:
     """Draft-model state + the ONE jitted draft tick.
 
-    Host side: ``len[s]`` is the slot's draft frontier (dense-cache
-    positions ``0..len[s]-1`` hold the accepted sequence's KV). Device
-    side: the dense caches, donated per dispatch. The engine owns
+    Host side: ``len[s]`` is the slot's draft frontier (paged positions
+    ``0..len[s]-1`` hold the accepted sequence's KV) and ``aux`` is the
+    slot's draft page table on the SHARED pool allocator. Device side:
+    the paged draft pools, donated per dispatch. The engine owns
     scheduling (what to feed, who generates) and frontier bookkeeping;
     this class owns the state and the compiled program."""
 
     def __init__(self, draft_model, num_slots: int, capacity: int,
-                 k: int, feed_width: int):
+                 k: int, feed_width: int, pool, sampling: bool = False):
         cfg = draft_model.config
         self.config = cfg
         self.k = int(k)
         self.capacity = int(capacity)
         self.feed_width = int(feed_width)
+        self.sampling = bool(sampling)
+        self.pool = pool
+        self.aux = AuxPageTable(pool, num_slots)
         self.stacked, self.other = draft_model._decode_state()
         dt = self.other["embeddings.wte.weight"].dtype
         nh = cfg.num_heads
         hd = cfg.hidden_size // nh
-        shape = (cfg.num_layers, num_slots, capacity + 1, nh, hd)
+        ps = pool.page_size
+        shape = (cfg.num_layers, pool.num_pages, ps, nh, hd)
         self.kc = jnp.zeros(shape, dt)
         self.vc = jnp.zeros(shape, dt)
         self.len = np.zeros(num_slots, np.int64)
         self.site = _recompile.unique_site("serving.draft")
         self.tick = jax.jit(
             make_draft_tick(cfg, num_slots, capacity, k, feed_width,
-                            self.site),
+                            self.site, ps, sampling=sampling),
             donate_argnums=(2, 3))
+
+    def held_tokens(self, slot: int) -> int:
+        """Draft positions covered by the slot's held pages."""
+        return self.aux.slot_pages(slot) * self.pool.page_size
+
+    def grow_for(self, slot: int, n_tokens: int) -> bool:
+        """Best-effort: hold enough draft pages for ``n_tokens``
+        positions. False = pool couldn't cover it (the engine then
+        clamps or skips speculation — draft growth never escalates)."""
+        return self.aux.grow_to(slot, min(int(n_tokens), self.capacity))
+
+    def rewind(self, slot: int, n_tokens: int) -> int:
+        """Truncate the draft frontier to ``n_tokens`` and return pages
+        past it to the pool (the rejection-rewind path). Returns pages
+        freed."""
+        self.len[slot] = int(n_tokens)
+        return self.aux.shrink_slot(slot,
+                                    self.pool.pages_for(int(n_tokens)))
+
+    def release_pages(self, slot: int) -> int:
+        """Pressure decay: return ALL of the slot's draft pages. The
+        content is gone, so the frontier resets to 0 — a slot whose
+        depth recovers re-feeds from scratch. Returns pages freed."""
+        self.len[slot] = 0
+        return self.aux.release_slot(slot)
 
     def reset_slot(self, slot: int) -> None:
         """Invalidate the slot's draft cache (admission / finish /
-        preemption): the next tenant re-feeds from position 0."""
+        preemption): frontier to 0, pages back to the pool."""
         self.len[slot] = 0
+        self.aux.release_slot(slot)
 
 
 def _head(x_last, other, wte):
@@ -166,73 +212,119 @@ def _greedy(logits):
     return jnp.argmax(lp, axis=-1).astype(jnp.int32)
 
 
-def make_draft_tick(cfg, num_slots: int, capacity: int, k: int,
-                    feed_width: int, site: str):
-    """Build the draft tick body (jitted by DraftRunner; caches
-    donated).
+def _sample_rows(logits, keys, pos, temps, top_ks, top_ps):
+    """The engine's per-row sampling law (``engine._sample_tok``), on
+    device: temperature → per-row top-k/top-p → log_softmax →
+    ``categorical(fold_in(key, pos))``. Shared by the draft generate
+    scan, the verify tick's plain branches, and (via the same ops) the
+    rejection kernel — ONE spelling is what makes spec == non-spec."""
+    from ..ops.decoding import apply_top_k_top_p_per_row
 
-    Args (all fixed-shape; one trace covers every scheduler state):
+    lg = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    lg = apply_top_k_top_p_per_row(lg, top_ks, top_ps)
+    lp = jax.nn.log_softmax(lg, axis=-1)
+    def one(key, p, row):
+        return jax.random.categorical(jax.random.fold_in(key, p), row)
+
+    tok = jax.vmap(one)(keys, pos, lp).astype(jnp.int32)
+    return tok, lp
+
+
+def make_draft_tick(cfg, num_slots: int, capacity: int, k: int,
+                    feed_width: int, site: str, page_size: int,
+                    sampling: bool = False):
+    """Build the draft tick body (jitted by DraftRunner; pools
+    donated). The draft KV is PAGED (ISSUE 20): per-layer pools
+    ``[num_pages, page_size, NH, D]`` indexed through the slot's draft
+    page table ``dtab`` — position ``p`` of slot ``s`` lives at
+    ``(dtab[s, p // ps], p % ps)``; pad/overflow writes route to the
+    null page 0, and attention gathers the table view
+    ``pool[dtab].reshape(ns, -1, NH, D)`` under the causal mask (null
+    entries past the frontier are masked, contributing exactly 0).
+
+    Greedy args (fixed-shape; one trace covers every scheduler state):
       stacked/other   draft decode params
-      kc/vc           [L, ns, cap+1, NH, D] dense caches (pos ``cap``
-                      is the trash column)
+      kc/vc           [L, num_pages, ps, NH, D] paged pools
+      dtab            [ns, pages_per_slot] int32 draft page tables
       feed_toks       [ns, F] catch-up tokens per slot
       feed_pos0       [ns]    first feed position per slot
       feed_len        [ns]    real feed tokens (0 = nothing to feed)
       gen_tok         [ns]    generation seed token (the slot's last
                               emitted/accepted token)
-      gen_pos         [ns]    its position — ``cap`` for slots not
-                              generating (their scan writes go to the
-                              trash column and their drafts are
+      gen_pos         [ns]    its position — ``capacity`` for slots
+                              not generating (their writes route to
+                              the null page and their drafts are
                               garbage the engine never offers)
       has_feed        bool    lax.cond fast path: steady-state ticks
                               skip the feed stage's compute entirely
       has_gen         bool    the symmetric fast path: feed-only ticks
-                              (every chunked-prefill step) skip the
-                              k-step generate scan — nobody would read
-                              those drafts
+                              skip the generate scan
 
-    Returns (kc, vc, drafts [ns, k] — zeros when ``has_gen`` is off).
+    Greedy returns (kc, vc, drafts [ns, k]).
+
+    The sampling build inserts per-request ``keys [ns, 2] uint32``,
+    ``temps``/``top_ks``/``top_ps`` [ns] and the chain args
+    ``chain_tok_m [ns, 1+k]``, ``chain_acc [ns]``, ``chain_pos0 [ns]``,
+    ``chain_mask [ns] bool`` after ``gen_pos``; chained rows override
+    the seed with ``tok_m[s, acc]`` at ``pos0 + acc + 1`` on device
+    (the overlap arm feeds the verify tick's un-materialized outputs
+    straight in). Its generate scan runs ``k + 1`` steps — step 0
+    re-writes position ``seed_pos - 1`` (the full-acceptance heal; an
+    identical rewrite otherwise, null-routed when not chained) — and
+    it returns (kc, vc, drafts [ns, k], dprobs [ns, k, V]) where
+    ``dprobs`` are the FILTERED draft distributions the rejection
+    kernel divides by.
     """
     nh = cfg.num_heads
     hd = cfg.hidden_size // nh
     eps = cfg.layer_norm_eps
     msl = cfg.max_seq_len
+    vs = cfg.vocab_size
     ns = num_slots
     cap = capacity
+    ps = page_size
     f = feed_width
 
     from ..models.gpt import _ln, gpt_block_body
 
-    def tick(stacked, other, kc, vc, feed_toks, feed_pos0, feed_len,
-             gen_tok, gen_pos, has_feed, has_gen):
+    def body(stacked, other, kc, vc, dtab, feed_toks, feed_pos0,
+             feed_len, gen_tok, gen_pos, has_feed, has_gen,
+             sample_args):
         _recompile.mark_trace(site, kc, feed_toks, gen_tok)
         wte = other["embeddings.wte.weight"]
         wpe = other["embeddings.wpe.weight"]
         rows = jnp.arange(ns)
-        key_pos = jnp.arange(cap + 1)
+        slen = dtab.shape[1] * ps
+        key_pos = jnp.arange(slen)
 
         def feed(kc, vc):
             # chunk-style parallel catch-up: F tokens per slot in one
-            # forward; pad positions (i >= feed_len) write to trash
+            # forward; pad positions (i >= feed_len) write to the null
+            # page
             pos = feed_pos0[:, None] + jnp.arange(f)[None, :]  # [ns, F]
             real = jnp.arange(f)[None, :] < feed_len[:, None]
-            wr = jnp.where(real, jnp.minimum(pos, cap), cap)
+            live = real & (pos >= 0) & (pos < cap)
+            pc = jnp.clip(pos, 0, cap - 1)
+            pg = jnp.where(live, dtab[rows[:, None], pc // ps], 0)
+            off = pc % ps
             x = wte[feed_toks] + wpe[jnp.clip(pos, 0, msl - 1)]
 
             def block(xc, inp):
                 p, kc0, vc0 = inp
 
                 def attend(q, kk, vv):
-                    kcl = kc0.at[rows[:, None], wr].set(kk)
-                    vcl = vc0.at[rows[:, None], wr].set(vv)
-                    att = jnp.einsum("btnd,bsnd->bnts", q, kcl) / \
+                    kcl = kc0.at[pg, off].set(kk)
+                    vcl = vc0.at[pg, off].set(vv)
+                    kv = kcl[dtab].reshape(ns, slen, nh, hd)
+                    vw = vcl[dtab].reshape(ns, slen, nh, hd)
+                    att = jnp.einsum("btnd,bsnd->bnts", q, kv) / \
                         math.sqrt(hd)
                     mask = key_pos[None, None, None, :] <= \
                         pos[:, None, :, None]
                     att = jnp.where(mask, att, -1e9)
                     w = jax.nn.softmax(att.astype(jnp.float32),
                                        axis=-1).astype(xc.dtype)
-                    return jnp.einsum("bnts,bsnd->btnd", w, vcl), \
+                    return jnp.einsum("bnts,bsnd->btnd", w, vw), \
                         (kcl, vcl)
 
                 return gpt_block_body(xc, p, eps, nh, hd, attend)
@@ -243,55 +335,123 @@ def make_draft_tick(cfg, num_slots: int, capacity: int, k: int,
         kc, vc = jax.lax.cond(has_feed, feed, lambda a, b: (a, b),
                               kc, vc)
 
-        def gstep(carry, _):
+        if sampling:
+            keys, temps, top_ks, top_ps, ch_tok_m, ch_acc, ch_pos0, \
+                ch_mask = sample_args
+            acc_c = jnp.clip(ch_acc, 0, k)
+            g_tok = jnp.where(ch_mask, ch_tok_m[rows, acc_c], gen_tok)
+            g_pos = jnp.where(ch_mask, ch_pos0 + acc_c + 1, gen_pos)
+            # full-acceptance heal (step 0 of the scan): the token at
+            # seed_pos - 1 — tok_m[acc - 1] for a chained row with
+            # acc >= 1; rows with acc == 0 (and non-chained rows) have
+            # that position valid already, so their step-0 write is
+            # null-routed
+            pre_mask = ch_mask & (ch_acc > 0)
+            pre_tok = ch_tok_m[rows, jnp.clip(acc_c - 1, 0, k)]
+        else:
+            g_tok, g_pos = gen_tok, gen_pos
+            pre_mask = jnp.zeros((ns,), bool)
+            pre_tok = gen_tok
+        scan_len = k + 1 if sampling else k
+
+        def gstep(carry, i):
             tok, kc, vc, p = carry
-            wr = jnp.minimum(p, cap)
+            if sampling:
+                # step 0 writes the heal token, step 1 is FORCED to the
+                # seed (step 0's sampled output is not the true token
+                # at the seed position), later steps chain as usual
+                tok = jnp.where(i == 0, pre_tok,
+                                jnp.where(i == 1, g_tok, tok))
+                live = (p >= 0) & (p < cap) & \
+                    jnp.where(i == 0, pre_mask, True)
+            else:
+                live = (p >= 0) & (p < cap)
+            pc = jnp.clip(p, 0, cap - 1)
+            pg = jnp.where(live, dtab[rows, pc // ps], 0)
+            off = pc % ps
             x = wte[tok[:, None]] + wpe[jnp.clip(p, 0, msl - 1)][:, None]
 
             def block(xc, inp):
                 pp, kc0, vc0 = inp
 
                 def attend(q, kk, vv):
-                    kcl = kc0.at[rows, wr].set(kk[:, 0])
-                    vcl = vc0.at[rows, wr].set(vv[:, 0])
-                    att = jnp.einsum("btnd,bsnd->bnts", q, kcl) / \
+                    kcl = kc0.at[pg, off].set(kk[:, 0])
+                    vcl = vc0.at[pg, off].set(vv[:, 0])
+                    kv = kcl[dtab].reshape(ns, slen, nh, hd)
+                    vw = vcl[dtab].reshape(ns, slen, nh, hd)
+                    att = jnp.einsum("btnd,bsnd->bnts", q, kv) / \
                         math.sqrt(hd)
                     mask = key_pos[None, None, None, :] <= \
                         p[:, None, None, None]
                     att = jnp.where(mask, att, -1e9)
                     w = jax.nn.softmax(att.astype(jnp.float32),
                                        axis=-1).astype(xc.dtype)
-                    return jnp.einsum("bnts,bsnd->btnd", w, vcl), \
+                    return jnp.einsum("bnts,bsnd->btnd", w, vw), \
                         (kcl, vcl)
 
                 return gpt_block_body(xc, pp, eps, nh, hd, attend)
 
             x, (kc, vc) = jax.lax.scan(block, x, (stacked, kc, vc))
             x = _ln(x, other["ln_f.weight"], other["ln_f.bias"], eps)
-            nxt = _greedy(_head(x[:, -1], other, wte))
+            lg = _head(x[:, -1], other, wte)
+            if sampling:
+                # the token emitted after writing position p sits at
+                # p + 1 — the same fold the plain tick uses there
+                nxt, lp = _sample_rows(lg, keys, p + 1, temps,
+                                       top_ks, top_ps)
+                return (nxt, kc, vc, p + 1), (nxt, jnp.exp(lp))
+            nxt = _greedy(lg)
             return (nxt, kc, vc, p + 1), nxt
 
         def generate(kc, vc):
-            (_, kc, vc, _), drafts = jax.lax.scan(
-                gstep, (gen_tok, kc, vc, gen_pos), None, length=k)
-            return kc, vc, jnp.swapaxes(drafts, 0, 1)   # [ns, k]
+            p0 = g_pos - 1 if sampling else g_pos
+            (_, kc, vc, _), out = jax.lax.scan(
+                gstep, (g_tok, kc, vc, p0),
+                jnp.arange(scan_len), length=scan_len)
+            if sampling:
+                drafts, probs = out
+                # step 0 is the heal write; drafts come from steps 1..k
+                return (kc, vc, jnp.swapaxes(drafts[1:], 0, 1),
+                        jnp.swapaxes(probs[1:], 0, 1))
+            return kc, vc, jnp.swapaxes(out, 0, 1)   # [ns, k]
 
-        return jax.lax.cond(
-            has_gen, generate,
-            lambda kc, vc: (kc, vc, jnp.zeros((ns, k), jnp.int32)),
-            kc, vc)
+        def skip(kc, vc):
+            if sampling:
+                return (kc, vc, jnp.zeros((ns, k), jnp.int32),
+                        jnp.zeros((ns, k, vs), jnp.float32))
+            return kc, vc, jnp.zeros((ns, k), jnp.int32)
+
+        return jax.lax.cond(has_gen, generate, skip, kc, vc)
+
+    if sampling:
+        def tick(stacked, other, kc, vc, dtab, feed_toks, feed_pos0,
+                 feed_len, gen_tok, gen_pos, keys, temps, top_ks,
+                 top_ps, chain_tok_m, chain_acc, chain_pos0,
+                 chain_mask, has_feed, has_gen):
+            return body(stacked, other, kc, vc, dtab, feed_toks,
+                        feed_pos0, feed_len, gen_tok, gen_pos,
+                        has_feed, has_gen,
+                        (keys, temps, top_ks, top_ps, chain_tok_m,
+                         chain_acc, chain_pos0, chain_mask))
+    else:
+        def tick(stacked, other, kc, vc, dtab, feed_toks, feed_pos0,
+                 feed_len, gen_tok, gen_pos, has_feed, has_gen):
+            return body(stacked, other, kc, vc, dtab, feed_toks,
+                        feed_pos0, feed_len, gen_tok, gen_pos,
+                        has_feed, has_gen, None)
 
     return tick
 
 
 def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
-                   impl: str, site: str, quantized: bool = False):
+                   impl: str, site: str, quantized: bool = False,
+                   sampling: bool = False):
     """Build the spec engine's verify/mixed tick body (jitted by the
     engine; pools donated). This IS the unified mixed-row tick with a
     draft section — same site name, same single-trace contract.
     ``quantized`` (int8 KV pools, ISSUE 12) widens the signature with
     the per-page per-head scale arrays + the fresh-page reset vector,
-    exactly like the plain unified tick; the draft model's dense cache
+    exactly like the plain unified tick; the draft model's paged cache
     stays at its own model dtype either way.
 
     Flat token layout: ``[ns last_tok | ns*k drafts | npf*w chunks]``.
@@ -309,19 +469,28 @@ def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
     them into the fixed-shape output); with no chunks aboard the
     prefill capacity is skipped as before.
 
-    Returns (kpool, vpool, tokens [ns, 1+k] — the target's greedy
-    argmax at every verify position, accepted [ns]).
+    The greedy build (``sampling=False``) is unchanged from PR 9/15:
+    returns (pools..., tokens [ns, 1+k] — the target's greedy argmax
+    at every verify position, accepted [ns]). The sampling build adds
+    ``keys [ns, 2] uint32``, ``sample_pos [ns]`` (column-0 emission
+    positions), ``temps``/``top_ks``/``top_ps`` [ns] and
+    ``draft_probs [ns, k, V]`` (the draft tick's filtered
+    distributions); its spec branches run
+    ``ops/decoding.spec_rejection_sample`` and its plain branches the
+    per-row sampling law — acceptance must live INSIDE the branches
+    there because it consumes the uniform draws.
     """
     ns = num_slots
     w = chunk_width
     base = ns * (1 + k)
 
     from ..models.gpt import gpt_ragged_apply
-    from ..ops.decoding import spec_accept_length
+    from ..ops.decoding import spec_accept_length, spec_rejection_sample
 
     def core(stacked, other, pools, last_tok, draft_toks,
              pf_toks, tok_pos, tok_limit, row_tab, row_pos0, row_len,
-             sample_ix, n_draft, has_chunks, has_drafts):
+             sample_ix, n_draft, has_chunks, has_drafts,
+             sample_args=None):
         tokens = jnp.concatenate([last_tok, draft_toks, pf_toks])
         # the no-draft branches run the exact non-speculative layout:
         # the draft section sliced out of every metadata vector
@@ -361,27 +530,53 @@ def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
                 chunk_width=w, impl=impl, spec_k=sk)
             return lg, (kp, vp)
 
+        if sampling:
+            keys, sample_pos, temps, top_ks, top_ps, draft_probs = \
+                sample_args
+
+            def accept(lg):
+                tk, acc = spec_rejection_sample(
+                    lg.reshape(ns, 1 + k, -1), draft_probs,
+                    draft_toks.reshape(ns, k), n_draft, keys,
+                    sample_pos, temps, top_ks, top_ps)
+                return tk.reshape(base), acc
+
+            def plain(lg):
+                tok, _ = _sample_rows(lg, keys, sample_pos, temps,
+                                      top_ks, top_ps)
+                return scatter_primary(tok), jnp.zeros((ns,), jnp.int32)
+        else:
+            def accept(lg):
+                # acceptance runs OUTSIDE the branches in greedy mode
+                # (spec_accept_length is a pure token compare); keep
+                # the branch contract uniform anyway
+                return _greedy(lg), jnp.zeros((ns,), jnp.int32)
+
+            def plain(lg):
+                return scatter_primary(_greedy(lg)), \
+                    jnp.zeros((ns,), jnp.int32)
+
         def spec_mixed(pl_):
             lg, pl_ = run(pl_, tokens, tok_pos, tok_limit, row_tab,
                           row_pos0, row_len, sample_ix, k)
-            return (_greedy(lg),) + pl_
+            return accept(lg) + pl_
 
         def spec_only(pl_):
             lg, pl_ = run(pl_, tokens[:base], tok_pos[:base],
                           tok_limit[:base], row_tab[:ns], row_pos0[:ns],
                           row_len[:ns], sample_ix, k)
-            return (_greedy(lg),) + pl_
+            return accept(lg) + pl_
 
         def plain_mixed(pl_):
             lg, pl_ = run(pl_, tokens_plain, pos_plain, lim_plain,
                           row_tab, row_pos0, row_len, primary_ix, 0)
-            return (scatter_primary(_greedy(lg)),) + pl_
+            return plain(lg) + pl_
 
         def plain_only(pl_):
             lg, pl_ = run(pl_, tokens_plain[:ns], pos_plain[:ns],
                           lim_plain[:ns], row_tab[:ns], row_pos0[:ns],
                           row_len[:ns], primary_ix, 0)
-            return (scatter_primary(_greedy(lg)),) + pl_
+            return plain(lg) + pl_
 
         out = jax.lax.cond(
             has_drafts,
@@ -390,13 +585,51 @@ def make_spec_tick(mcfg, num_slots: int, k: int, chunk_width: int,
             lambda pl_: jax.lax.cond(has_chunks, plain_mixed,
                                      plain_only, pl_),
             pools)
-        toks, pools = out[0], out[1:]
+        toks, acc_b, pools = out[0], out[1], out[2:]
         tok_m = toks.reshape(ns, 1 + k)
-        acc = spec_accept_length(draft_toks.reshape(ns, k),
-                                 tok_m[:, :k], n_draft)
+        if sampling:
+            acc = acc_b
+        else:
+            acc = spec_accept_length(draft_toks.reshape(ns, k),
+                                     tok_m[:, :k], n_draft)
         return pools, tok_m, acc
 
-    if quantized:
+    if sampling:
+        if quantized:
+            def tick(stacked, other, kpool, vpool, kscale, vscale,
+                     fresh, last_tok, draft_toks, pf_toks, tok_pos,
+                     tok_limit, row_tab, row_pos0, row_len, sample_ix,
+                     n_draft, keys, sample_pos, temps, top_ks, top_ps,
+                     draft_probs, has_chunks, has_drafts):
+                _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                      last_tok)
+                kscale = kscale.at[:, fresh].set(0.0)
+                vscale = vscale.at[:, fresh].set(0.0)
+                (kpool, vpool, kscale, vscale), tok_m, acc = core(
+                    stacked, other, (kpool, vpool, kscale, vscale),
+                    last_tok, draft_toks, pf_toks, tok_pos, tok_limit,
+                    row_tab, row_pos0, row_len, sample_ix, n_draft,
+                    has_chunks, has_drafts,
+                    (keys, sample_pos, temps, top_ks, top_ps,
+                     draft_probs))
+                return kpool, vpool, kscale, vscale, tok_m, acc
+        else:
+            def tick(stacked, other, kpool, vpool, last_tok,
+                     draft_toks, pf_toks, tok_pos, tok_limit, row_tab,
+                     row_pos0, row_len, sample_ix, n_draft, keys,
+                     sample_pos, temps, top_ks, top_ps, draft_probs,
+                     has_chunks, has_drafts):
+                _recompile.mark_trace(site, kpool, row_tab, tok_pos,
+                                      last_tok)
+                (kpool, vpool), tok_m, acc = core(
+                    stacked, other, (kpool, vpool), last_tok,
+                    draft_toks, pf_toks, tok_pos, tok_limit, row_tab,
+                    row_pos0, row_len, sample_ix, n_draft, has_chunks,
+                    has_drafts,
+                    (keys, sample_pos, temps, top_ks, top_ps,
+                     draft_probs))
+                return kpool, vpool, tok_m, acc
+    elif quantized:
         def tick(stacked, other, kpool, vpool, kscale, vscale, fresh,
                  last_tok, draft_toks, pf_toks, tok_pos, tok_limit,
                  row_tab, row_pos0, row_len, sample_ix, n_draft,

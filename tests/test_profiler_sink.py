@@ -914,6 +914,52 @@ def test_schema_checker_adaptive_k():
                for e in _run_check("check_adaptive_k", missing))
 
 
+def _spec_sampling_cell(**over):
+    cell = {"sampling": {"temperature": 0.9, "top_k": 20,
+                         "top_p": 0.95},
+            "plain_tokens_per_sec": 474.1,
+            "spec_sync_tokens_per_sec": 698.4,
+            "spec_overlap_tokens_per_sec": 685.0,
+            "speedup_sync": 1.47, "speedup_overlap": 1.44,
+            "overlap_vs_sync": 0.98, "accept_rate": 0.44,
+            "drafted_tokens": 2000, "accepted_tokens": 880,
+            "tokens_per_verify_tick": 10.4,
+            "draft_pool_share_peak": 0.57}
+    cell.update(over)
+    return cell
+
+
+def test_schema_checker_spec_sampling_cell():
+    assert _run_check("check_spec_sampling_cell",
+                      _spec_sampling_cell()) == []
+    # accept rate outside [0, 1]
+    bad = _spec_sampling_cell(accept_rate=1.2)
+    assert any("[0, 1]" in e
+               for e in _run_check("check_spec_sampling_cell", bad))
+    # accepted > drafted is impossible by construction
+    impossible = _spec_sampling_cell(accepted_tokens=2001)
+    assert any("outside" in e for e in _run_check(
+        "check_spec_sampling_cell", impossible))
+    # the paged-draft residency invariant: drafted tokens had to land
+    # in pages the shared allocator's ledger saw
+    no_pages = _spec_sampling_cell(draft_pool_share_peak=0.0)
+    assert any("held no pages" in e for e in _run_check(
+        "check_spec_sampling_cell", no_pages))
+    # ...and phantom residency without a single draft is the inverse
+    phantom = _spec_sampling_cell(drafted_tokens=0, accepted_tokens=0,
+                                  accept_rate=0.0)
+    assert any("phantom" in e for e in _run_check(
+        "check_spec_sampling_cell", phantom))
+    missing = {k: v for k, v in _spec_sampling_cell().items()
+               if k != "overlap_vs_sync"}
+    assert any("missing key 'overlap_vs_sync'" in e for e in
+               _run_check("check_spec_sampling_cell", missing))
+    # a non-positive arm throughput means the arm never ran
+    dead_arm = _spec_sampling_cell(spec_overlap_tokens_per_sec=0.0)
+    assert any("positive" in e for e in _run_check(
+        "check_spec_sampling_cell", dead_arm))
+
+
 # ---------------------------------------------------------------------------
 # sink-schema checker: ISSUE 18 blocks (prefix-economy counters /
 # migration bytes by dtype) — negative-tested so the prefix-routing CI

@@ -462,9 +462,35 @@ class TestSpecKReprobe:
         c.observe(0, 0, 1)               # rejected: demotion confirmed
         assert not c.probing(0)
         assert c.depth(0) == 0
-        # the cycle restarts: cost is one drafted token per
-        # reprobe_every zero-ticks
-        assert [c.tick_depth(0) for _ in range(4)] == [0, 0, 0, 1]
+        # the cycle restarts with multiplicative backoff (ISSUE 20):
+        # a rejected probe doubles the period, so the next probe costs
+        # one drafted token per 2*reprobe_every zero-ticks
+        assert c.probe_period(0) == 8
+        assert [c.tick_depth(0) for _ in range(8)] == [0] * 7 + [1]
+
+    def test_rejected_probes_back_off_and_accept_resets(self):
+        c = self._decayed(2)
+        periods = []
+        for _ in range(6):
+            while c.tick_depth(0) == 0:
+                pass                     # advance to the next probe
+            c.observe(0, 0, 1)           # rejected again
+            periods.append(c.probe_period(0))
+        # doubles per consecutive rejection, capped at 8x the base
+        assert periods == [4, 8, 16, 16, 16, 16]
+        while c.tick_depth(0) == 0:
+            pass
+        c.observe(0, 1, 1)               # accepted: full cadence back
+        assert c.probe_period(0) == 2
+
+    def test_reset_restores_base_probe_period(self):
+        c = self._decayed(2)
+        while c.tick_depth(0) == 0:
+            pass
+        c.observe(0, 0, 1)
+        assert c.probe_period(0) == 4
+        c.reset(0)
+        assert c.probe_period(0) == 2
 
     def test_accepted_probe_reopens_the_depth(self):
         c = self._decayed(2)

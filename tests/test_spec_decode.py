@@ -311,14 +311,18 @@ class TestSpecObservability:
 
 
 class TestSpecConfigValidation:
-    def test_rejects_sampling_legacy_and_mismatches(self):
+    def test_rejects_legacy_and_mismatches(self):
         net = _net()
         twin = _net()
         base = dict(num_slots=1, page_size=8, pages_per_slot=2)
-        with pytest.raises(NotImplementedError):
+        # decode="sampling" is SUPPORTED since ISSUE 20 (rejection
+        # sampling); what still raises is overlap without sampling —
+        # greedy has no chained draft build to hide the sync under
+        with pytest.raises(ValueError):
             ServingEngine(net, ServingConfig(
-                decode="sampling",
-                spec=SpecConfig(draft_model=twin, k=2), **base))
+                decode="greedy",
+                spec=SpecConfig(draft_model=twin, k=2, overlap=True),
+                **base))
         with pytest.raises(ValueError):
             ServingEngine(net, ServingConfig(
                 attention_kernel="legacy",

@@ -1,0 +1,358 @@
+"""Sampling-grade speculative decoding (ISSUE 20).
+
+THE load-bearing contract is the sampled analogue of the greedy
+bitwise pin: with rejection-sampling acceptance (accept draft ``t``
+w.p. ``min(1, p_tgt(t)/p_drf(t))``, resample the correction from the
+normalized residual) and BOTH distributions filtered by the same
+per-request temperature/top-k/top-p, the per-position sampling law is
+EXACTLY the non-speculative law — so fixed-key token streams are
+EQUAL at both accept-rate extremes:
+
+* twin draft: every ratio is 1 -> always accept -> the accepted token
+  IS the plain categorical draw at its position;
+* independent draft under ``top_k=1``: accept only when the draft's
+  argmax equals the target's (then they agree), otherwise the residual
+  is one-hot at the target's argmax -> the correction IS the plain
+  draw. Equality holds at ANY accept rate, covering the all-rejected
+  extreme without needing a rigged draft.
+
+Both are asserted for the synchronous-absorb arm AND the overlap arm
+(``SpecConfig.overlap``: draft tick N+1 chained on the verify tick's
+un-materialized device outputs) — overlap must be a pure latency
+optimization, invisible in the stream.
+
+The draft KV lives on the shared ``PagePool`` allocator
+(``paged_cache.AuxPageTable``): lifecycle (alloc -> rewind ->
+pressure-decay -> release) is pinned here too. Engine builds are
+expensive (the tier-1 cap is saturated) — cases stay lean.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPT, GPTConfig, gpt_tiny
+from paddle_tpu.ops import decoding as D
+from paddle_tpu.serving import (PagePool, ServingConfig, ServingEngine,
+                                SpecConfig)
+from paddle_tpu.serving.paged_cache import AuxPageTable
+
+pytestmark = pytest.mark.serving
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    net = gpt_tiny(initializer_range=0.2)
+    net.eval()
+    return net
+
+
+def _ind_draft(seed=7):
+    """Independent 2-layer draft (random weights): its proposals and
+    the target's law share support but disagree often."""
+    paddle.seed(seed)
+    net = GPT(GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_seq_len=64,
+                        initializer_range=0.2))
+    net.eval()
+    return net
+
+
+def _law(logits, keys, pos, temps, top_ks, top_ps):
+    """The engine's per-row sampling law (engine._sample_tok), as the
+    test-side reference."""
+    lg = jnp.asarray(logits, jnp.float32) / \
+        jnp.maximum(jnp.asarray(temps, jnp.float32), 1e-6)[:, None]
+    lg = D.apply_top_k_top_p_per_row(lg, jnp.asarray(top_ks, jnp.int32),
+                                     jnp.asarray(top_ps, jnp.float32))
+    lp = jax.nn.log_softmax(lg, axis=-1)
+
+    def one(key, p, row):
+        return jax.random.categorical(jax.random.fold_in(key, p), row)
+
+    return np.asarray(jax.vmap(one)(
+        keys, jnp.asarray(pos, jnp.int32), lp))
+
+
+def _filtered_probs(logits, temps, top_ks, top_ps):
+    n, kp1, v = logits.shape
+    lg = jnp.asarray(logits, jnp.float32) / \
+        jnp.maximum(jnp.asarray(temps, jnp.float32), 1e-6)[:, None, None]
+    lg = D.apply_top_k_top_p_per_row(
+        lg.reshape(n * kp1, v),
+        jnp.repeat(jnp.asarray(top_ks, jnp.int32), kp1),
+        jnp.repeat(jnp.asarray(top_ps, jnp.float32), kp1))
+    return jnp.exp(jax.nn.log_softmax(lg, axis=-1)).reshape(n, kp1, v)
+
+
+class TestRejectionKernel:
+    """ops/decoding.spec_rejection_sample in isolation."""
+
+    def _inputs(self, n=4, k=2, v=16, seed=0):
+        rng = np.random.RandomState(seed)
+        logits = rng.randn(n, k + 1, v).astype(np.float32) * 2.0
+        keys = jnp.asarray(
+            np.stack([np.asarray(jax.random.PRNGKey(10 + i))
+                      for i in range(n)]))
+        pos = np.arange(n, dtype=np.int32) * 3 + 1
+        temps = np.full(n, 0.8, np.float32)
+        top_ks = np.full(n, 8, np.int32)
+        top_ps = np.full(n, 0.95, np.float32)
+        return logits, keys, pos, temps, top_ks, top_ps
+
+    def test_plain_rows_match_the_sampling_law(self):
+        """n_draft == 0 rows emit column 0 = the exact non-spec draw
+        at that position (same key fold, same filters)."""
+        lg, keys, pos, temps, tks, tps = self._inputs()
+        n, k = 4, 2
+        toks, acc = D.spec_rejection_sample(
+            jnp.asarray(lg), jnp.zeros((n, k, 16), jnp.float32),
+            jnp.zeros((n, k), jnp.int32), jnp.zeros(n, jnp.int32),
+            keys, jnp.asarray(pos), jnp.asarray(temps),
+            jnp.asarray(tks), jnp.asarray(tps))
+        np.testing.assert_array_equal(np.asarray(acc), 0)
+        want = _law(lg[:, 0], keys, pos, temps, tks, tps)
+        np.testing.assert_array_equal(np.asarray(toks)[:, 0], want)
+
+    def test_twin_draft_always_accepts_the_plain_draws(self):
+        """draft dist == filtered target dist and draft tokens == the
+        law's draws at their positions -> every ratio is 1, acc == k,
+        and the emitted row IS the plain draw sequence."""
+        lg, keys, pos, temps, tks, tps = self._inputs()
+        n, k = 4, 2
+        pt = _filtered_probs(lg, temps, tks, tps)
+        draft_toks = np.stack(
+            [_law(lg[:, j], keys, pos + j, temps, tks, tps)
+             for j in range(k)], axis=1)
+        toks, acc = D.spec_rejection_sample(
+            jnp.asarray(lg), pt[:, :k],
+            jnp.asarray(draft_toks, jnp.int32),
+            jnp.full(n, k, jnp.int32), keys, jnp.asarray(pos),
+            jnp.asarray(temps), jnp.asarray(tks), jnp.asarray(tps))
+        np.testing.assert_array_equal(np.asarray(acc), k)
+        np.testing.assert_array_equal(np.asarray(toks)[:, :k],
+                                      draft_toks)
+        bonus = _law(lg[:, k], keys, pos + k, temps, tks, tps)
+        np.testing.assert_array_equal(np.asarray(toks)[:, k], bonus)
+
+    def test_all_rejected_residual_is_the_plain_law(self):
+        """Draft mass entirely on a token the target filters to ~0 ->
+        always reject, and the residual max(0, p_tgt - p_drf)
+        renormalizes to the target law exactly — the correction equals
+        the plain draw under the same key."""
+        lg, keys, pos, temps, tks, tps = self._inputs()
+        n, k, v = 4, 2, 16
+        lg[:, :, 0] = -1e9               # target never emits token 0
+        pd = np.zeros((n, k, v), np.float32)
+        pd[:, :, 0] = 1.0                # draft always proposes it
+        toks, acc = D.spec_rejection_sample(
+            jnp.asarray(lg), jnp.asarray(pd),
+            jnp.zeros((n, k), jnp.int32), jnp.full(n, k, jnp.int32),
+            keys, jnp.asarray(pos), jnp.asarray(temps),
+            jnp.asarray(tks), jnp.asarray(tps))
+        np.testing.assert_array_equal(np.asarray(acc), 0)
+        want = _law(lg[:, 0], keys, pos, temps, tks, tps)
+        np.testing.assert_array_equal(np.asarray(toks)[:, 0], want)
+
+    def test_marginal_law_is_preserved_mid_spectrum(self):
+        """With an arbitrary overlapping draft dist the per-key stream
+        differs from the plain one, but the MARGINAL law must not:
+        empirical emission frequencies match the target distribution
+        (the rejection-sampling correctness guarantee)."""
+        n, v = 3000, 8
+        rng = np.random.RandomState(5)
+        row = rng.randn(v).astype(np.float32)
+        lg = np.broadcast_to(row, (n, 2, v)).copy()
+        pd = rng.rand(v).astype(np.float32)
+        pd /= pd.sum()
+        keys = jnp.asarray(np.stack(
+            [np.asarray(jax.random.PRNGKey(i)) for i in range(n)]))
+        temps = np.ones(n, np.float32)
+        tks = np.zeros(n, np.int32)
+        tps = np.ones(n, np.float32)
+        toks, _ = D.spec_rejection_sample(
+            jnp.asarray(lg),
+            jnp.broadcast_to(pd, (n, 1, v)).astype(jnp.float32),
+            jnp.asarray(rng.choice(v, (n, 1), p=pd), jnp.int32),
+            jnp.ones(n, jnp.int32), keys,
+            jnp.zeros(n, jnp.int32), jnp.asarray(temps),
+            jnp.asarray(tks), jnp.asarray(tps))
+        want = np.exp(row) / np.exp(row).sum()
+        got = np.bincount(np.asarray(toks)[:, 0], minlength=v) / n
+        assert 0.5 * np.abs(got - want).sum() < 0.05   # TV distance
+
+
+def _run_engine(net, prompts, keys, max_new=12, spec=None, eos=None,
+                top_k=20, **kw):
+    base = dict(num_slots=2, page_size=8, pages_per_slot=4,
+                prefill_chunk=8, decode="sampling", temperature=0.9,
+                top_k=top_k, top_p=0.95, eos_token_id=eos, spec=spec)
+    base.update(kw)
+    cfg = ServingConfig(**base)
+    eng = ServingEngine(net, cfg)
+    rids = [eng.submit(p, max_new, key=k)
+            for p, k in zip(prompts, keys)]
+    out = eng.run()
+    return [out[r].tolist() for r in rids], eng
+
+
+class TestSampledStreamEquality:
+    prompts = [np.arange(8, dtype=np.int32) % 128,
+               (np.arange(11, dtype=np.int32) * 3) % 128]
+    keys = [np.asarray(jax.random.PRNGKey(100 + i)) for i in range(2)]
+
+    def test_twin_draft_accept_extreme_both_arms(self):
+        """Twin draft -> ~every draft accepted; fixed-key streams stay
+        EQUAL to the non-spec sampled engine, for the synchronous arm
+        and the overlap (chained draft tick) arm; overlap really
+        chained; multi-token verify ticks actually happened."""
+        from paddle_tpu.profiler import registry
+
+        net = _net()
+        ref, _ = _run_engine(net, self.prompts, self.keys)
+        sync, es = _run_engine(
+            net, self.prompts, self.keys,
+            spec=SpecConfig(draft_model=_net(), k=3))
+        assert sync == ref
+        acc0 = registry().counter("serving/spec_accepted_tokens").value
+        ch0 = registry().counter("serving/spec_chained_ticks").value
+        over, eo = _run_engine(
+            net, self.prompts, self.keys,
+            spec=SpecConfig(draft_model=_net(), k=3, overlap=True))
+        assert over == ref
+        assert registry().counter(
+            "serving/spec_accepted_tokens").value > acc0
+        assert registry().counter(
+            "serving/spec_chained_ticks").value > ch0
+        for eng in (es, eo):
+            assert len(eng.compiled_sites) == 2
+            eng.pool.check_consistency()
+
+    def test_independent_draft_topk1_equality_any_accept_rate(self):
+        """Under top_k=1 both filtered distributions are one-hot:
+        accept -> draft argmax == target argmax == the plain draw;
+        reject -> the residual is one-hot at the target's argmax ->
+        the correction IS the plain draw. Stream equality therefore
+        holds at ANY accept rate — this is the all-rejected-extreme
+        pin without a rigged draft."""
+        net = _net()
+        ref, _ = _run_engine(net, self.prompts, self.keys, top_k=1)
+        for overlap in (False, True):
+            got, _ = _run_engine(
+                net, self.prompts, self.keys, top_k=1,
+                spec=SpecConfig(draft_model=_ind_draft(), k=3,
+                                overlap=overlap))
+            assert got == ref, f"overlap={overlap}"
+
+    def test_eos_mid_draft_stops_exactly(self):
+        """EOS landing inside the accepted window truncates the
+        emission mid-absorb; the spec stream equals the non-spec
+        sampled stream under the same eos."""
+        net = _net()
+        probe, _ = _run_engine(net, self.prompts, self.keys)
+        eos = int(probe[0][4])
+        ref, _ = _run_engine(net, self.prompts, self.keys, eos=eos)
+        assert len(ref[0]) < 12          # eos actually fired early
+        for overlap in (False, True):
+            got, eng = _run_engine(
+                net, self.prompts, self.keys, eos=eos,
+                spec=SpecConfig(draft_model=_net(), k=3,
+                                overlap=overlap))
+            assert got == ref, f"overlap={overlap}"
+            # finished slots returned their draft pages
+            assert eng._draft.aux.total_pages() == 0
+            eng.pool.check_consistency()
+
+    def test_preempt_mid_speculation_sampling(self):
+        """Pool smaller than residency (draft pages now compete in it
+        too): preemption fires with speculation live, the victim's
+        draft cache resets, and fixed-key streams still equal the
+        ample-pool non-spec reference — absolute fold positions make
+        the sampled stream preemption-invariant."""
+        from paddle_tpu.profiler import registry
+
+        net = _net()
+        prompts = [np.arange(8, dtype=np.int32) % 128,
+                   (np.arange(8, dtype=np.int32) * 5) % 128,
+                   (np.arange(8, dtype=np.int32) * 7) % 128]
+        keys = [np.asarray(jax.random.PRNGKey(200 + i))
+                for i in range(3)]
+        ref, _ = _run_engine(net, prompts, keys, max_new=16)
+        pre0 = registry().counter("serving/preemptions").value
+        got, eng = _run_engine(
+            net, prompts, keys, max_new=16,
+            spec=SpecConfig(draft_model=_net(), k=3, overlap=True),
+            num_slots=2, pages_per_slot=3, num_pages=5)
+        assert registry().counter("serving/preemptions").value > pre0
+        assert got == ref
+        eng.pool.check_consistency()
+
+
+class TestDraftPageLifecycle:
+    def test_aux_table_alloc_rewind_release(self):
+        """AuxPageTable unit: draft pages come from the shared
+        allocator at refcount 1, rewind returns the tail, release is
+        idempotent, growth is best-effort under exhaustion, and the
+        pool's consistency audit covers aux holds."""
+        pool = PagePool(num_layers=1, num_pages=8, page_size=4,
+                        num_heads=1, head_dim=2, num_slots=2,
+                        pages_per_slot=4)
+        aux = AuxPageTable(pool, num_slots=2)
+        assert aux.grow_to(0, 9)                  # 3 pages
+        assert aux.slot_pages(0) == 3 and aux.total_pages() == 3
+        held = [int(p) for p in aux.tables[0, :3]]
+        assert all(pool.allocator.refcount(p) == 1 for p in held)
+        pool.check_consistency()
+        # rewind: keep 1 page, tail freed + table tail nulled
+        assert aux.shrink_slot(0, 1) == 2
+        assert (aux.tables[0, 1:] == 0).all()
+        assert pool.allocator.refcount(held[1]) == 0
+        pool.check_consistency()
+        # target growth competes in the same pool: exhaust it, draft
+        # growth refuses (False, untouched) instead of raising
+        assert pool.grow_slot(0, 4) and pool.grow_slot(1, 2)
+        assert not aux.grow_slot(1, 2)
+        assert aux.slot_pages(1) == 0
+        assert aux.release_slot(0) == 1
+        assert aux.release_slot(0) == 0           # idempotent
+        assert aux.total_pages() == 0
+        pool.check_consistency()
+
+    def test_adaptive_decay_returns_draft_pages_under_pressure(self):
+        """The acceptance-criteria arm: an independent draft decays
+        adaptive depth to 0; the engine's pressure ladder
+        (_reclaim_draft) then returns the decayed slots' draft pages
+        to the shared pool, and decoding continues stream-exact."""
+        net = _net()
+        prompts = [np.arange(8, dtype=np.int32) % 128]
+        keys = [np.asarray(jax.random.PRNGKey(300))]
+        ref, _ = _run_engine(net, prompts, keys, max_new=14)
+        cfg = ServingConfig(num_slots=2, page_size=8, pages_per_slot=4,
+                            prefill_chunk=8, decode="sampling",
+                            temperature=0.9, top_k=20, top_p=0.95,
+                            spec=SpecConfig(draft_model=_ind_draft(),
+                                            k=3, adaptive=True,
+                                            reprobe_every=0))
+        eng = ServingEngine(net, cfg)
+        rid = eng.submit(prompts[0], 14, key=keys[0])
+        for _ in range(40):
+            if eng.idle():
+                break
+            eng.step()
+            live = [s for s, r in enumerate(eng._slot_rid)
+                    if r is not None]
+            if live and all(eng._spec_ctl.depth(s) == 0
+                            for s in live) and \
+                    eng._draft.aux.total_pages() > 0:
+                break
+        assert eng._draft.aux.total_pages() > 0
+        before = eng.pool.allocator.num_allocated
+        freed = eng._reclaim_draft(all_slots=False)
+        assert freed > 0
+        assert eng._draft.aux.total_pages() == 0
+        assert eng.pool.allocator.num_allocated == before - freed
+        eng.pool.check_consistency()
+        out = eng.run()
+        assert out[rid].tolist() == ref[0]

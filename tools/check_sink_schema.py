@@ -40,8 +40,14 @@ dead_rank, mode one of requeue/scavenge/reprefill),
 ``mesh_status`` must carry a ``membership`` key (null = static
 world; a board-sourced block must be non-empty with ``world``
 following the agreed member count) plus per-rank alert sub-blocks
-with their own firing/value/fired_count. stdlib only (the CI image
-installs jax + numpy + pytest, nothing else).
+with their own firing/value/fired_count, and (ISSUE 20) the sampled
+speculative cell (``--spec-decode --sampling``): accept rate in
+[0, 1] backed by count evidence (accepted <= drafted), positive
+three-arm throughputs, and the paged-draft residency invariant —
+nonzero drafted tokens require a positive ``draft_pool_share_peak``
+(draft KV lives on the shared page allocator), zero drafts forbid
+one. stdlib only (the CI image installs jax + numpy + pytest,
+nothing else).
 
 Note on events.jsonl seq monotonicity: the sink's writer is
 at-least-once under I/O errors — a partially-landed segment is re-sent
@@ -674,6 +680,50 @@ def check_adaptive_k(doc, schema: dict, where: str) -> None:
             "the depth controller is not clamping")
 
 
+def check_spec_sampling_cell(doc, schema: dict, where: str) -> None:
+    """Validate a serve_bench --spec-decode --sampling cell (ISSUE
+    20): the three-arm throughput keys, an accept rate inside [0, 1]
+    backed by count evidence (accepted <= drafted, both non-negative
+    ints), and the paged-draft residency invariant — a cell that
+    drafted tokens must show a positive draft-pool high-water share
+    (draft KV lives on the shared page allocator now; zero share with
+    nonzero drafts means the ledger never saw the draft pages), while
+    a cell that never drafted must show zero."""
+    sc = schema["bench_extra"]
+    if not isinstance(doc, dict):
+        return err(f"{where}: not a JSON object")
+    for k in sc["spec_sampling_cell"]:
+        if k not in doc:
+            err(f"{where}: missing key {k!r}")
+    r = doc.get("accept_rate")
+    if not isinstance(r, (int, float)) or not 0.0 <= r <= 1.0:
+        err(f"{where}: accept_rate {r!r} not a number in [0, 1]")
+    a, d = doc.get("accepted_tokens"), doc.get("drafted_tokens")
+    if "accepted_tokens" in doc and "drafted_tokens" in doc:
+        if not isinstance(a, int) or not isinstance(d, int):
+            err(f"{where}: spec counts not ints ({a!r}, {d!r})")
+        elif not 0 <= a <= d:
+            err(f"{where}: accepted_tokens={a} outside "
+                f"[0, drafted_tokens={d}]")
+    for k in ("plain_tokens_per_sec", "spec_sync_tokens_per_sec",
+              "spec_overlap_tokens_per_sec"):
+        v = doc.get(k)
+        if k in doc and (not isinstance(v, (int, float)) or v <= 0):
+            err(f"{where}: {k} {v!r} not a positive number")
+    share = doc.get("draft_pool_share_peak")
+    if not isinstance(share, (int, float)) or not 0.0 <= share <= 1.0:
+        err(f"{where}: draft_pool_share_peak {share!r} not a number "
+            "in [0, 1]")
+    elif isinstance(d, int):
+        if d > 0 and share <= 0:
+            err(f"{where}: drafted_tokens={d} with zero "
+                "draft_pool_share_peak — the paged draft cache held "
+                "no pages the residency ledger saw")
+        if d == 0 and share > 0:
+            err(f"{where}: draft_pool_share_peak={share} with zero "
+                "drafted tokens — phantom draft-pool residency")
+
+
 def check_prefix_economy(doc, schema: dict, where: str) -> None:
     """Validate a serve_bench --prefix-routing economy block (ISSUE
     18): the mesh-wide counters must be present, non-negative ints;
@@ -769,11 +819,18 @@ def check_aux_bench_json(path: str, schema: dict) -> None:
         check_migration_bytes_by_dtype(
             extra["migration_bytes_by_dtype"], schema,
             f"{path}: extra.migration_bytes_by_dtype")
+    # ISSUE 20: the sampled speculative cell (no Poisson
+    # observability contract — no latency table / events-overhead
+    # block — so it rides aux like the v15 modes)
+    ssc = (extra.get("cells") or {}).get("spec_sampling")
+    if ssc is not None:
+        check_spec_sampling_cell(ssc, schema,
+                                 f"{path}: extra.cells.spec_sampling")
     if not any(k in extra for k in ("sched_cells", "mixed_accept",
-                                    "prefix_economy")):
+                                    "prefix_economy")) and ssc is None:
         err(f"{path}: none of sched_cells / mixed_accept / "
-            "prefix_economy present (--aux-bench-json is for the "
-            "ISSUE 15/18 modes)")
+            "prefix_economy / cells.spec_sampling present "
+            "(--aux-bench-json is for the ISSUE 15/18/20 modes)")
 
 
 def check_sketch(doc, schema: dict, where: str) -> None:
@@ -1142,6 +1199,12 @@ def check_bench_json(path: str, schema: dict,
     if "mixed_accept" in extra:
         check_adaptive_k(extra["mixed_accept"], schema,
                          f"{path}: extra.mixed_accept")
+    # ISSUE 20 block, validated whenever present: the sampled
+    # speculative cell
+    ssc = (extra.get("cells") or {}).get("spec_sampling")
+    if ssc is not None:
+        check_spec_sampling_cell(ssc, schema,
+                                 f"{path}: extra.cells.spec_sampling")
     # ISSUE 18 blocks, validated whenever present
     if "prefix_economy" in extra:
         check_prefix_economy(extra["prefix_economy"], schema,
